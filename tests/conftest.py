@@ -1,0 +1,19 @@
+"""Test environment: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+SURVEY.md section 4.4: sharding logic is tested at mesh sizes {1, 8 fake} on
+CPU; the single real TPU chip is exercised by bench.py and the driver's
+compile checks, not by the unit suite (TPU compiles are slow and the suite
+must stay fast/deterministic).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
