@@ -1,0 +1,228 @@
+"""Simulation configuration and parameter pytrees.
+
+The reference bundles experiment structure in ``SimOpts`` (SURVEY.md section 2
+item 10: sink set, broadcaster->follower edge list, other-source specs,
+horizon, factory methods per policy). The TPU rebuild splits that role in
+three, per SURVEY.md section 5 "Config/flag system":
+
+- ``SimConfig`` — frozen, hashable *static* shape/horizon info (jit-static).
+- ``SourceParams`` — a struct-of-arrays pytree of per-source policy
+  parameters (traced: sweeps over q / rates re-use one compilation).
+- adjacency ``bool[S, F]`` — the bipartite broadcaster->follower graph
+  (traced: different graphs of the same shape share a compilation).
+
+``GraphBuilder`` is the ergonomic front end playing ``SimOpts``'s role; its
+``update()`` mirrors the reference's sweep idiom.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .models.base import (
+    KIND_HAWKES,
+    KIND_OPT,
+    KIND_PIECEWISE,
+    KIND_POISSON,
+    KIND_REALDATA,
+    KIND_RMTPP,
+)
+
+__all__ = ["SimConfig", "SourceParams", "GraphBuilder", "stack_components"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static simulation shape: hashable, safe to close over under jit."""
+
+    n_sources: int
+    n_sinks: int
+    end_time: float
+    start_time: float = 0.0
+    capacity: int = 4096  # scan steps (= max events) per chunk
+    rmtpp_hidden: int = 1  # H of the neural-policy recurrent state
+
+
+class SourceParams(struct.PyTreeNode):
+    """Per-source policy parameters, struct-of-arrays over sources [S].
+
+    Union layout: each policy reads only its own fields; rows belonging to
+    other policies hold benign defaults (rate 1, zero excitation, empty
+    replay) so that unselected ``lax.switch`` branches executed under vmap
+    masking can never divide by zero or spin.
+    """
+
+    kind: jnp.ndarray      # i32[S] policy code (models.base.KIND_*)
+    rate: jnp.ndarray      # f[S]   Poisson rate
+    l0: jnp.ndarray        # f[S]   Hawkes base rate
+    alpha: jnp.ndarray     # f[S]   Hawkes jump size
+    beta: jnp.ndarray      # f[S]   Hawkes decay
+    pw_times: jnp.ndarray  # f[S,Kp] piecewise segment starts (padded, see ops.sampling)
+    pw_rates: jnp.ndarray  # f[S,Kp] piecewise rates
+    rd_times: jnp.ndarray  # f[S,Kr] replay timestamps (padded with +inf)
+    q: jnp.ndarray         # f[S]   Opt posting cost
+    s_sink: jnp.ndarray    # f[F]   follower significance (shared per component)
+    rmtpp: Optional[dict] = None  # neural-policy weights pytree (None until used)
+
+
+class SimState(struct.PyTreeNode):
+    """Complete simulation carry: everything the event scan needs between
+    steps, and everything ``run_chunk`` needs to resume (chunked long-horizon
+    execution per SURVEY.md section 5 "Long-context").
+
+    The reference spreads this across mutable ``Broadcaster`` objects and
+    ``State`` (SURVEY.md section 3.1); here it is one immutable pytree.
+    """
+
+    t: jnp.ndarray        # f[]    current simulation time
+    t_next: jnp.ndarray   # f[S]   scheduled next event per source (+inf = never)
+    exc: jnp.ndarray      # f[S]   Hawkes excitation at exc_t
+    exc_t: jnp.ndarray    # f[S]   excitation fold time
+    rd_ptr: jnp.ndarray   # i32[S] RealData replay cursor
+    h: jnp.ndarray        # f[S,H] RMTPP recurrent state
+    keys: jnp.ndarray     # u32[S,2] per-source PRNG base keys
+    ctr: jnp.ndarray      # u32[S] per-source draw counters (fold_in stream)
+    n_events: jnp.ndarray  # i32[] events emitted so far (all chunks)
+
+    # Note: per-(source, sink) feed ranks are deliberately NOT carried. The
+    # Opt policy samples via superposition clocks (models/opt.py) and the
+    # metric layer reconstructs ranks from the event log post-hoc, so an
+    # [S, F] rank matrix in the hot carry would be pure HBM traffic.
+
+
+_BENIGN = dict(rate=1.0, l0=1.0, alpha=0.0, beta=1.0, q=1.0)
+
+
+class GraphBuilder:
+    """Assemble one simulation component (sources + sinks + edges) the way the
+    reference's ``SimOpts`` does, producing device-ready pytrees.
+
+    ``sinks=None`` connects a source to every sink (the controlled
+    broadcaster's default in the reference)."""
+
+    def __init__(self, n_sinks: int, end_time: float, start_time: float = 0.0,
+                 s_sink: Optional[Sequence[float]] = None):
+        self.n_sinks = int(n_sinks)
+        self.end_time = float(end_time)
+        self.start_time = float(start_time)
+        self.s_sink = (
+            np.ones(n_sinks) if s_sink is None else np.asarray(s_sink, np.float64)
+        )
+        assert self.s_sink.shape == (self.n_sinks,)
+        self._rows: List[dict] = []
+
+    # ---- source constructors (reference: SimOpts other_sources specs) ----
+
+    def _add(self, kind: int, sinks, **fields) -> int:
+        idx = len(self._rows)
+        sinks = range(self.n_sinks) if sinks is None else sinks
+        row = dict(_BENIGN)
+        row.update(kind=kind, sinks=list(sinks), pw=None, rd=None)
+        row.update(fields)
+        self._rows.append(row)
+        return idx
+
+    def add_poisson(self, rate: float, sinks=None) -> int:
+        return self._add(KIND_POISSON, sinks, rate=float(rate))
+
+    def add_hawkes(self, l0: float, alpha: float, beta: float, sinks=None) -> int:
+        return self._add(KIND_HAWKES, sinks, l0=float(l0), alpha=float(alpha),
+                         beta=float(beta))
+
+    def add_piecewise(self, change_times: Sequence[float],
+                      rates: Sequence[float], sinks=None) -> int:
+        ct = np.asarray(change_times, np.float64)
+        r = np.asarray(rates, np.float64)
+        assert ct.shape == r.shape and np.all(np.diff(ct) > 0)
+        return self._add(KIND_PIECEWISE, sinks, pw=(ct, r))
+
+    def add_realdata(self, times: Sequence[float], sinks=None) -> int:
+        return self._add(KIND_REALDATA, sinks, rd=np.sort(np.asarray(times, np.float64)))
+
+    def add_opt(self, q: float = 1.0, sinks=None) -> int:
+        if not q > 0:
+            raise ValueError(f"Opt requires q > 0, got q={q}")
+        return self._add(KIND_OPT, sinks, q=float(q))
+
+    def add_rmtpp(self, sinks=None) -> int:
+        """Neural-intensity broadcaster; weights are attached afterwards via
+        ``params.replace(rmtpp=...)`` (see redqueen_tpu.models.rmtpp)."""
+        return self._add(KIND_RMTPP, sinks)
+
+    # ---- assembly ----
+
+    def build(self, capacity: int = 4096, dtype=jnp.float32):
+        """Returns (SimConfig, SourceParams, adjacency bool[S, F])."""
+        S, F = len(self._rows), self.n_sinks
+        if S == 0:
+            raise ValueError("no sources added")
+        Kp = max([len(r["pw"][0]) for r in self._rows if r["pw"] is not None],
+                 default=1)
+        Kr = max([len(r["rd"]) for r in self._rows if r["rd"] is not None],
+                 default=1)
+        kind = np.zeros(S, np.int32)
+        rate = np.empty(S); l0 = np.empty(S); alpha = np.empty(S)
+        beta = np.empty(S); q = np.empty(S)
+        pw_t = np.zeros((S, Kp)); pw_r = np.zeros((S, Kp))
+        rd_t = np.full((S, Kr), np.inf)
+        adj = np.zeros((S, F), bool)
+        for s, row in enumerate(self._rows):
+            kind[s] = row["kind"]
+            rate[s], l0[s], alpha[s], beta[s], q[s] = (
+                row["rate"], row["l0"], row["alpha"], row["beta"], row["q"]
+            )
+            adj[s, row["sinks"]] = True
+            if row["pw"] is not None:
+                ct, r = row["pw"]
+                # Pad with +inf knots at rate 0: the last REAL segment's end
+                # stays +inf (matching the oracle's open final segment) and
+                # the inf-length pad segments contribute zero hazard
+                # (ops.sampling handles the inf-inf span).
+                pw_t[s] = np.inf
+                pw_t[s, : len(ct)] = ct
+                pw_r[s, : len(r)] = r
+            else:
+                pw_t[s] = np.inf
+                pw_t[s, 0] = 0.0  # dummy row: one segment, rate 0
+            if row["rd"] is not None:
+                rd_t[s, : len(row["rd"])] = row["rd"]
+        # Validate kinds against the live policy registry (importing the
+        # models package registers the built-ins; a kind with no registered
+        # branch would otherwise be silently clamped by lax.switch).
+        from . import models as _models  # noqa: F401
+        from .models.base import n_kinds
+
+        if int(kind.max()) >= n_kinds():
+            raise ValueError(
+                f"source kind {int(kind.max())} has no registered policy "
+                f"(registry has {n_kinds()} kinds) — import/register the "
+                f"policy module first (e.g. redqueen_tpu.models.rmtpp)"
+            )
+        cfg = SimConfig(
+            n_sources=S, n_sinks=F, end_time=self.end_time,
+            start_time=self.start_time, capacity=int(capacity),
+        )
+        params = SourceParams(
+            kind=jnp.asarray(kind),
+            rate=jnp.asarray(rate, dtype), l0=jnp.asarray(l0, dtype),
+            alpha=jnp.asarray(alpha, dtype), beta=jnp.asarray(beta, dtype),
+            pw_times=jnp.asarray(pw_t, dtype), pw_rates=jnp.asarray(pw_r, dtype),
+            rd_times=jnp.asarray(rd_t, dtype), q=jnp.asarray(q, dtype),
+            s_sink=jnp.asarray(self.s_sink, dtype),
+        )
+        return cfg, params, jnp.asarray(adj)
+
+
+def stack_components(params_list: Sequence[SourceParams],
+                     adj_list: Sequence[jnp.ndarray]):
+    """Stack same-shape components along a leading batch axis for
+    vmap/shard_map (SURVEY.md section 3.5: the sweep axis)."""
+    params = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+    adj = jnp.stack(list(adj_list))
+    return params, adj

@@ -1,0 +1,10 @@
+"""Broadcasting policies. Importing this package registers the built-in
+policy kinds with the dispatch registry (models.base) — the rebuild's
+equivalent of the reference's Broadcaster subclass table."""
+
+from . import base  # noqa: F401
+from . import poisson  # noqa: F401
+from . import hawkes  # noqa: F401
+from . import piecewise  # noqa: F401
+from . import realdata  # noqa: F401
+from . import opt  # noqa: F401

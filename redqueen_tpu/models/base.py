@@ -1,0 +1,102 @@
+"""Policy registry: the rebuild's equivalent of the reference's
+``Broadcaster`` subclass seam (SURVEY.md section 1 key layering fact; the
+BASELINE north star's "registers as an Opt subclass alongside the existing
+Poisson/Hawkes/RealData broadcasters").
+
+A policy is a *kind code* plus three pure functions over per-source state.
+The simulation kernel (``redqueen_tpu.ops.scan_core``) dispatches the fired
+source's resample through ``lax.switch`` over the registered ``on_fire``
+branches, and applies every registered vectorized ``on_react`` hook to the
+non-fired sources — so adding a policy (e.g. the RMTPP neural intensity) means
+registering one ``PolicyDef``, with no edits to the driver, exactly like
+subclassing ``Broadcaster`` in the reference.
+
+All hooks must be jit/vmap-safe (traced once, no Python control flow on
+traced values):
+
+- ``on_init(params, extra, s, t0, key) -> SourceUpdate``
+    first draw for source ``s`` at simulation start.
+- ``on_fire(params, state, s, t, key) -> SourceUpdate``
+    source ``s`` just posted at time ``t``; return its refreshed per-source
+    state (scalars; scattered back at index ``s`` by the kernel).
+- ``on_react(params, state, feeds_hit, s_star, t, keys, ctr_bump) ->
+    (t_next[S], opt_cand[S, F] or None)`` — optional, vectorized over ALL
+    sources at once; adjust next-event times in response to someone else's
+    post (the RedQueen superposition trick lives here).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Optional
+
+import jax.numpy as jnp
+
+__all__ = [
+    "KIND_POISSON",
+    "KIND_HAWKES",
+    "KIND_PIECEWISE",
+    "KIND_REALDATA",
+    "KIND_OPT",
+    "KIND_RMTPP",
+    "SourceUpdate",
+    "PolicyDef",
+    "register_policy",
+    "get_registry",
+    "n_kinds",
+]
+
+# Dense kind codes: lax.switch branch index == kind.
+KIND_POISSON = 0
+KIND_HAWKES = 1
+KIND_PIECEWISE = 2
+KIND_REALDATA = 3
+KIND_OPT = 4
+KIND_RMTPP = 5
+
+
+class SourceUpdate(NamedTuple):
+    """Per-source state slice written back after on_init/on_fire.
+
+    Every branch of the ``lax.switch`` must return the same pytree structure,
+    so this carries the union of all built-in policies' per-source state;
+    policies echo back fields they don't own.
+    """
+
+    t_next: jnp.ndarray  # next scheduled event time (absolute; +inf = never)
+    exc: jnp.ndarray     # Hawkes excitation at exc_t
+    exc_t: jnp.ndarray   # time the excitation was last folded to
+    rd_ptr: jnp.ndarray  # RealData replay cursor
+    h: jnp.ndarray       # RMTPP recurrent state slice ([H]; zeros elsewhere)
+
+
+class PolicyDef(NamedTuple):
+    kind: int
+    name: str
+    on_init: Callable
+    on_fire: Callable
+    on_react: Optional[Callable] = None
+
+
+_REGISTRY: Dict[int, PolicyDef] = {}
+
+
+def register_policy(pdef: PolicyDef) -> PolicyDef:
+    if pdef.kind in _REGISTRY and _REGISTRY[pdef.kind].name != pdef.name:
+        raise ValueError(
+            f"kind {pdef.kind} already registered as "
+            f"{_REGISTRY[pdef.kind].name!r}, refusing {pdef.name!r}"
+        )
+    _REGISTRY[pdef.kind] = pdef
+    return pdef
+
+
+def get_registry() -> Dict[int, PolicyDef]:
+    """Kind -> PolicyDef. The kernel requires codes to be dense from 0."""
+    kinds = sorted(_REGISTRY)
+    if kinds != list(range(len(kinds))):
+        raise RuntimeError(f"policy kind codes must be dense from 0, got {kinds}")
+    return dict(_REGISTRY)
+
+
+def n_kinds() -> int:
+    return len(_REGISTRY)

@@ -1,0 +1,35 @@
+"""Piecewise-constant-rate broadcaster (reference: ``PiecewiseConst`` in
+redqueen/opt_model.py, SURVEY.md section 2 item 6 — diurnal follower activity
+and the shape of the Karimi et al. offline baseline). Sampling is exact
+cumulative-hazard inversion (``ops.sampling.piecewise_next_time``) — fully
+branch-free, so it pays no thinning-loop cost on TPU.
+"""
+
+from __future__ import annotations
+
+from ..ops.sampling import piecewise_next_time
+from .base import KIND_PIECEWISE, PolicyDef, SourceUpdate, register_policy
+
+
+def _update(state, s, t_next):
+    return SourceUpdate(
+        t_next=t_next, exc=state.exc[s], exc_t=state.exc_t[s],
+        rd_ptr=state.rd_ptr[s], h=state.h[s],
+    )
+
+
+def on_init(params, state, s, t0, key):
+    return _update(
+        state, s, piecewise_next_time(key, t0, params.pw_times[s], params.pw_rates[s])
+    )
+
+
+def on_fire(params, state, s, t, key):
+    return _update(
+        state, s, piecewise_next_time(key, t, params.pw_times[s], params.pw_rates[s])
+    )
+
+
+PIECEWISE = register_policy(
+    PolicyDef(kind=KIND_PIECEWISE, name="piecewise", on_init=on_init, on_fire=on_fire)
+)

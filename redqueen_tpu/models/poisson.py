@@ -1,0 +1,40 @@
+"""Constant-rate Poisson broadcaster (reference: ``Poisson``/``Poisson2`` in
+redqueen/opt_model.py, SURVEY.md section 2 item 4).
+
+The reference's two variants differ only in when exponentials are drawn
+(precomputed block vs per-event); under JAX's counter-based PRNG the
+distinction is moot — one Exp(rate) per own event, drawn at fire time — so a
+single policy covers both. Next-event caching matches the reference: other
+sources' posts never change a Poisson broadcaster's schedule.
+"""
+
+from __future__ import annotations
+
+from jax import random as jr
+
+from ..ops.sampling import exponential_delta
+from .base import KIND_POISSON, PolicyDef, SourceUpdate, register_policy
+
+
+def _update(state, s, t_next):
+    """Echo the untouched per-source state slices back through the switch."""
+    return SourceUpdate(
+        t_next=t_next,
+        exc=state.exc[s],
+        exc_t=state.exc_t[s],
+        rd_ptr=state.rd_ptr[s],
+        h=state.h[s],
+    )
+
+
+def on_init(params, state, s, t0, key):
+    return _update(state, s, t0 + exponential_delta(key, params.rate[s]))
+
+
+def on_fire(params, state, s, t, key):
+    return _update(state, s, t + exponential_delta(key, params.rate[s]))
+
+
+POISSON = register_policy(
+    PolicyDef(kind=KIND_POISSON, name="poisson", on_init=on_init, on_fire=on_fire)
+)

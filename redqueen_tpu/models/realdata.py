@@ -1,0 +1,44 @@
+"""Trace-replay broadcaster (reference: ``RealData`` in redqueen/opt_model.py,
+SURVEY.md section 2 item 7 — Twitter trace replay). Timestamps live in a
+padded [S, Kr] tensor (+inf padding); the per-source cursor advances on own
+events only. At 100k-follower scale the padding/bucketing caveat of SURVEY.md
+section 7 "hard parts" applies: group sources by similar trace length before
+building components.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import KIND_REALDATA, PolicyDef, SourceUpdate, register_policy
+
+
+def _peek(params, ptr, s):
+    kr = params.rd_times.shape[1]
+    in_range = ptr < kr
+    t = params.rd_times[s, jnp.minimum(ptr, kr - 1)]
+    return jnp.where(in_range, t, jnp.inf)
+
+
+def on_init(params, state, s, t0, key):
+    # First replay timestamp at or after the simulation start.
+    ptr = jnp.searchsorted(params.rd_times[s], t0, side="left").astype(
+        state.rd_ptr.dtype
+    )
+    return SourceUpdate(
+        t_next=_peek(params, ptr, s), exc=state.exc[s], exc_t=state.exc_t[s],
+        rd_ptr=ptr, h=state.h[s],
+    )
+
+
+def on_fire(params, state, s, t, key):
+    ptr = state.rd_ptr[s] + 1
+    return SourceUpdate(
+        t_next=_peek(params, ptr, s), exc=state.exc[s], exc_t=state.exc_t[s],
+        rd_ptr=ptr, h=state.h[s],
+    )
+
+
+REALDATA = register_policy(
+    PolicyDef(kind=KIND_REALDATA, name="realdata", on_init=on_init, on_fire=on_fire)
+)

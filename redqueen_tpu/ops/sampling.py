@@ -1,0 +1,109 @@
+"""Point-process sampling primitives as pure, jit/vmap-safe JAX functions.
+
+These are the TPU-native equivalents of the inline samplers in the reference's
+``redqueen/opt_model.py`` broadcasters (SURVEY.md sections 2–3; mount empty at
+build time, see SURVEY.md section 0): exponential inter-arrival draws
+(Poisson), Ogata thinning for exponential-kernel Hawkes intensities rewritten
+as a ``lax.while_loop`` (SURVEY.md section 3.3), and exact inverse-CDF
+sampling for piecewise-constant rates. All take explicit PRNG keys and
+dtype-follow their float inputs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import random as jr
+
+__all__ = [
+    "exponential_delta",
+    "hawkes_intensity",
+    "hawkes_next_time",
+    "piecewise_next_time",
+]
+
+
+def exponential_delta(key, rate, dtype=None):
+    """One Exp(rate) inter-arrival time; inf when rate <= 0 (a zero-rate
+    process never fires — used for masked/padded sources)."""
+    if dtype is None:
+        dtype = jnp.result_type(rate, jnp.float32)
+    e = jr.exponential(key, dtype=dtype)
+    return jnp.where(rate > 0, e / jnp.asarray(rate, dtype), jnp.inf)
+
+
+def hawkes_intensity(t, l0, exc, exc_t, beta):
+    """lambda(t) = l0 + exc * exp(-beta (t - exc_t)) for t >= exc_t, where
+    ``exc`` is the excitation sum alpha * sum_j exp(-beta (exc_t - t_j))
+    tracked incrementally at time ``exc_t``."""
+    return l0 + exc * jnp.exp(-beta * (t - exc_t))
+
+
+def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max):
+    """Next event time of an exponential-kernel Hawkes process after
+    ``t_from``, via Ogata thinning (reference: ``Hawkes.get_next_event_time``;
+    SURVEY.md section 3.3).
+
+    Because the exponential-kernel intensity strictly decreases between
+    events, the intensity at the current proposal time is a valid upper bound
+    for all later times — each rejection therefore *tightens* the bound, and
+    the acceptance probability is bounded below by l0/lambda_bar, so the loop
+    terminates almost surely. ``t_max`` caps the search (proposals beyond it
+    exit the loop and return +inf) so all-masked vmap lanes cannot spin.
+
+    Returns the accepted absolute time, or +inf if none before ``t_max``.
+    """
+    dtype = jnp.result_type(t_from, l0, jnp.float32)
+    t_from = jnp.asarray(t_from, dtype)
+    lbd0 = hawkes_intensity(t_from, l0, exc, exc_t, beta)
+
+    def cond(c):
+        _, t, accepted, lbd_bar = c
+        return (~accepted) & (t <= t_max) & (lbd_bar > 0)
+
+    def body(c):
+        key, t, _, lbd_bar = c
+        key, k_w, k_u = jr.split(key, 3)
+        t_new = t + jr.exponential(k_w, dtype=dtype) / lbd_bar
+        lbd_new = hawkes_intensity(t_new, l0, exc, exc_t, beta)
+        accept = jr.uniform(k_u, dtype=dtype) * lbd_bar <= lbd_new
+        return (key, t_new, accept, lbd_new)
+
+    _, t_out, accepted, _ = lax.while_loop(
+        cond, body, (key, t_from, jnp.asarray(False), lbd0)
+    )
+    return jnp.where(accepted & (t_out <= t_max), t_out, jnp.inf)
+
+
+def piecewise_next_time(key, t_from, change_times, rates):
+    """Next event of an inhomogeneous Poisson process with piecewise-constant
+    rate, by exact inversion of the cumulative hazard (reference:
+    ``PiecewiseConst``); branch-free, so it vectorizes cleanly.
+
+    ``change_times`` [K] ascending segment starts; ``rates`` [K];
+    ``rates[k]`` applies on [change_times[k], change_times[k+1]), the last
+    segment extending to +inf. The rate before ``change_times[0]`` is 0.
+    Padding convention: repeat the last knot with rate 0.
+
+    Draws E ~ Exp(1) and returns the time where the hazard accumulated from
+    ``t_from`` reaches E, or +inf if total remaining hazard < E.
+    """
+    dtype = jnp.result_type(t_from, change_times, jnp.float32)
+    target = jr.exponential(key, dtype=dtype)
+    seg_end = jnp.concatenate(
+        [change_times[1:], jnp.array([jnp.inf], dtype=change_times.dtype)]
+    )
+    lo = jnp.maximum(change_times, t_from)  # effective start of each segment
+    span = jnp.maximum(seg_end - lo, 0.0)
+    # rate * span with 0 * inf := 0 (zero-rate final/padding segments).
+    hz = jnp.where(rates > 0, rates * jnp.minimum(span, jnp.inf), 0.0)
+    hz = jnp.where(span > 0, hz, 0.0)
+    cum = jnp.cumsum(hz)
+    k = jnp.searchsorted(cum, target, side="left")  # first segment reaching E
+    k_safe = jnp.minimum(k, rates.shape[0] - 1)
+    prev = jnp.where(k_safe > 0, cum[jnp.maximum(k_safe - 1, 0)], 0.0)
+    remaining = target - prev
+    rate_k = rates[k_safe]
+    t_hit = lo[k_safe] + jnp.where(rate_k > 0, remaining / rate_k, jnp.inf)
+    return jnp.where(k < rates.shape[0], t_hit, jnp.inf).astype(dtype)

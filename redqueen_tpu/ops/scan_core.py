@@ -1,0 +1,140 @@
+"""The event-scan kernel: the reference's ``Manager.run_till`` hot loop
+(SURVEY.md section 3.1) re-designed as a fixed-capacity ``lax.scan``.
+
+One scan step == one global event: argmin over the per-source next-event
+times picks the fired source (ties -> lowest index, matching the NumPy
+oracle's ``np.argmin``), the fired source's resample dispatches through
+``lax.switch`` over the registered policy branches, and every registered
+react hook (RedQueen's superposition trick) adjusts the remaining sources.
+Feed ranks are never materialized in the carry — the superposition clocks
+encode them implicitly and the metric layer reconstructs them from the log. Steps after the horizon
+are absorbing no-ops, so a chunk is always a statically-shaped computation:
+XLA traces it once and the TPU replays it for every chunk of every
+simulation of the sweep.
+
+The per-event Python-object churn this deletes is the O(events x sources)
+cost called out in SURVEY.md section 3.1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import random as jr
+
+from ..config import SimConfig, SimState, SourceParams
+from ..models.base import get_registry
+
+__all__ = ["init_state", "make_run_chunk"]
+
+
+def _fire_branches():
+    reg = get_registry()
+    return [reg[k].on_fire for k in sorted(reg)]
+
+
+def _init_branches():
+    reg = get_registry()
+    return [reg[k].on_init for k in sorted(reg)]
+
+
+def _react_hooks():
+    reg = get_registry()
+    return [reg[k].on_react for k in sorted(reg) if reg[k].on_react is not None]
+
+
+def init_state(cfg: SimConfig, params: SourceParams, adj, key,
+               dtype=jnp.float32) -> SimState:
+    """Build the initial carry: per-source PRNG streams and first draws.
+
+    Per-source keys are ``fold_in(component_key, source_index)`` and every
+    subsequent draw is ``fold_in(key_s, counter_s)`` — SURVEY.md section 7
+    "PRNG discipline": streams depend only on (component key, source index,
+    draw count), never on vmap/mesh layout.
+    """
+    S = cfg.n_sources
+    H = cfg.rmtpp_hidden
+    keys = jax.vmap(lambda i: jr.fold_in(key, i))(jnp.arange(S))
+    t0 = jnp.asarray(cfg.start_time, dtype)
+    state0 = SimState(
+        t=t0,
+        t_next=jnp.full((S,), jnp.inf, dtype),
+        exc=jnp.zeros((S,), dtype),
+        exc_t=jnp.full((S,), t0, dtype),
+        rd_ptr=jnp.zeros((S,), jnp.int32),
+        h=jnp.zeros((S, H), dtype),
+        keys=keys,
+        ctr=jnp.zeros((S,), jnp.uint32),
+        n_events=jnp.zeros((), jnp.int32),
+    )
+    branches = _init_branches()
+    init_keys = jax.vmap(jr.fold_in)(keys, jnp.zeros((S,), jnp.uint32))
+
+    def one(s, k):
+        return lax.switch(params.kind[s], branches, params, state0, s, t0, k)
+
+    upd = jax.vmap(one, in_axes=(0, 0))(jnp.arange(S), init_keys)
+    return state0.replace(
+        t_next=upd.t_next, exc=upd.exc, exc_t=upd.exc_t, rd_ptr=upd.rd_ptr,
+        h=upd.h, ctr=jnp.ones((S,), jnp.uint32),
+    )
+
+
+def make_run_chunk(cfg: SimConfig):
+    """Returns ``run_chunk(params, adj, state) -> (state, (times, srcs))``,
+    advancing the simulation by up to ``cfg.capacity`` events. Pure and
+    jit/vmap-safe; the driver (redqueen_tpu.sim) jits/vmaps/shards it."""
+    fire_branches = _fire_branches()
+    react_hooks = _react_hooks()
+    end_time = cfg.end_time
+
+    def run_chunk(params: SourceParams, adj, state: SimState):
+        def step(state: SimState, _):
+            S = state.t_next.shape[0]
+            s_star = jnp.argmin(state.t_next)
+            t_ev = state.t_next[s_star]
+            valid = t_ev <= end_time
+            feeds = adj[s_star]                       # [F] feeds hit
+
+            # -- fired source resamples (policy dispatch, SURVEY.md 3.1) --
+            key_fire = jr.fold_in(state.keys[s_star], state.ctr[s_star])
+            upd = lax.switch(
+                params.kind[s_star], fire_branches,
+                params, state, s_star, t_ev, key_fire,
+            )
+
+            new = state.replace(
+                t=t_ev,
+                t_next=state.t_next.at[s_star].set(upd.t_next),
+                exc=state.exc.at[s_star].set(upd.exc),
+                exc_t=state.exc_t.at[s_star].set(upd.exc_t),
+                rd_ptr=state.rd_ptr.at[s_star].set(upd.rd_ptr),
+                h=state.h.at[s_star].set(upd.h),
+                ctr=state.ctr.at[s_star].add(1),
+                n_events=state.n_events + 1,
+            )
+
+            # -- react hooks: non-fired sources re-decide (RedQueen trick) --
+            for hook in react_hooks:
+                t_next, bumped = hook(params, new, adj, feeds, s_star, t_ev, valid)
+                new = new.replace(
+                    t_next=t_next, ctr=new.ctr + bumped.astype(new.ctr.dtype)
+                )
+
+            # Past-horizon steps absorb: emit a sentinel, keep state frozen.
+            state = jax.tree.map(
+                lambda a, b: jnp.where(valid, a, b), new, state
+            )
+            ev = (
+                jnp.where(valid, t_ev, jnp.inf),
+                jnp.where(valid, s_star, -1).astype(jnp.int32),
+            )
+            return state, ev
+
+        state, (times, srcs) = lax.scan(
+            step, state, None, length=cfg.capacity
+        )
+        return state, (times, srcs)
+
+    return run_chunk

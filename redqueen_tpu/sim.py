@@ -1,0 +1,172 @@
+"""Simulation driver: chunked jitted execution of the event-scan kernel.
+
+Reference counterpart: ``Manager.run_till`` / ``run_dynamic`` plus the
+seed/q sweep loops of SURVEY.md section 3.5. The TPU shape of it:
+
+- ``simulate``   — one component, jitted chunked scan to the horizon.
+- ``simulate_batch`` — a batch of same-shape components, ``vmap`` over the
+  leading axis (the sweep axis: seeds, q values, broadcasters of the
+  bipartite graph). ``redqueen_tpu.parallel`` shards this axis over a mesh.
+
+Long horizons run as repeated fixed-capacity chunks with the full carry
+(SURVEY.md section 5 "long-context" analogue); the driver loops on the host
+at *chunk* granularity only, and overflow is detected, never silent: if
+``max_chunks`` elapse with active sources, a RuntimeError reports progress.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import random as jr
+
+from .config import SimConfig, SimState, SourceParams
+from .ops.scan_core import init_state, make_run_chunk
+
+# Importing the models package registers the built-in policies (the
+# reference's Broadcaster subclasses; see models/base.py).
+from . import models as _models  # noqa: F401
+from .models import base
+
+__all__ = ["EventLog", "simulate", "simulate_batch", "resume"]
+
+
+class EventLog:
+    """Host-side event log: the rebuild's counterpart of the reference's
+    ``State.get_dataframe()`` artifact (SURVEY.md section 5 "observability").
+
+    ``times``/``srcs`` are [E] (single component) or [B, E] (batch); invalid
+    tail entries hold (+inf, -1). ``n_events`` is the valid-event count
+    (scalar or [B]). Use ``redqueen_tpu.utils.dataframe`` to export the
+    reference-schema DataFrame, or ``redqueen_tpu.utils.metrics`` to compute
+    feed metrics on device without leaving HBM.
+    """
+
+    def __init__(self, times, srcs, n_events, cfg: SimConfig):
+        self.times = times
+        self.srcs = srcs
+        self.n_events = n_events
+        self.cfg = cfg
+
+    @property
+    def batched(self) -> bool:
+        return self.times.ndim == 2
+
+    def __repr__(self):
+        return (
+            f"EventLog(batched={self.batched}, n_events={self.n_events!r}, "
+            f"buffer={tuple(self.times.shape)})"
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_fn_cached(cfg: SimConfig, batched: bool, n_kinds: int):
+    # n_kinds keys the cache to the policy registry: registering a new
+    # policy after a simulate() with the same SimConfig must re-trace, or
+    # lax.switch would silently clamp the new kind onto a stale branch list.
+    fn = make_run_chunk(cfg)
+    if batched:
+        fn = jax.vmap(fn)
+    return jax.jit(fn)
+
+
+def _chunk_fn(cfg: SimConfig, batched: bool):
+    return _chunk_fn_cached(cfg, batched, base.n_kinds())
+
+
+@functools.lru_cache(maxsize=None)
+def _init_fn_cached(cfg: SimConfig, batched: bool, n_kinds: int):
+    def init(params, adj, key):
+        return init_state(cfg, params, adj, key)
+
+    if batched:
+        init = jax.vmap(init)
+    return jax.jit(init)
+
+
+def _init_fn(cfg: SimConfig, batched: bool):
+    return _init_fn_cached(cfg, batched, base.n_kinds())
+
+
+def _as_key(seed: Union[int, jnp.ndarray]):
+    if isinstance(seed, (int, np.integer)):
+        return jr.PRNGKey(seed)
+    return seed
+
+
+def _drive(cfg, params, adj, state, chunk, max_chunks, batched):
+    times_chunks, srcs_chunks = [], []
+    n_chunks = 0
+    while True:
+        state, (t_c, s_c) = chunk(params, adj, state)
+        times_chunks.append(t_c)
+        srcs_chunks.append(s_c)
+        n_chunks += 1
+        # Host sync at chunk granularity only (SURVEY.md section 7 design).
+        active = bool(jnp.any(state.t_next.min(axis=-1) <= cfg.end_time))
+        if not active:
+            break
+        if n_chunks >= max_chunks:
+            done = np.asarray(state.n_events)
+            raise RuntimeError(
+                f"simulation still active after {n_chunks} chunks of "
+                f"{cfg.capacity} events (events so far: {done}); raise "
+                f"capacity or max_chunks — refusing to truncate silently"
+            )
+    axis = 1 if batched else 0
+    times = jnp.concatenate(times_chunks, axis=axis)
+    srcs = jnp.concatenate(srcs_chunks, axis=axis)
+    return EventLog(times, srcs, state.n_events, cfg), state
+
+
+def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
+             max_chunks: int = 100, return_state: bool = False):
+    """Run one component to its horizon. ``seed`` is an int or a PRNG key.
+
+    Returns an ``EventLog`` (and the final ``SimState`` if
+    ``return_state=True`` — the carry is resumable: pass it to
+    :func:`resume` with a longer-horizon ``SimConfig`` to continue)."""
+    key = _as_key(seed)
+    state = _init_fn(cfg, False)(params, adj, key)
+    log, state = _drive(
+        cfg, params, adj, state, _chunk_fn(cfg, False), max_chunks, False
+    )
+    return (log, state) if return_state else log
+
+
+def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
+                   max_chunks: int = 100, return_state: bool = False):
+    """Run B same-shape components in lockstep (params/adj have a leading
+    batch axis; ``seeds`` is an int array [B] or a key array [B, 2]).
+
+    This is the reference's embarrassingly-parallel sweep loop (SURVEY.md
+    section 3.5) turned into a vmap axis: components finish at different
+    event counts and simply absorb until the slowest one is done."""
+    seeds = jnp.asarray(seeds)
+    keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
+    state = _init_fn(cfg, True)(params, adj, keys)
+    log, state = _drive(
+        cfg, params, adj, state, _chunk_fn(cfg, True), max_chunks, True
+    )
+    return (log, state) if return_state else log
+
+
+def resume(cfg: SimConfig, params: SourceParams, adj, state: SimState,
+           max_chunks: int = 100):
+    """Continue a simulation from a carried ``SimState`` (obtained via
+    ``return_state=True``), e.g. after extending the horizon with a new
+    ``SimConfig``. Valid because every policy schedules its TRUE next event
+    time (never truncated at the old horizon), so an absorbed state wakes up
+    under a later ``end_time`` with the correct distribution — the oracle's
+    re-entrant ``Manager.run_till`` contract (SURVEY.md section 3.1).
+
+    Returns (EventLog-of-the-extension, final state). Batched states resume
+    batched."""
+    batched = state.t_next.ndim == 2
+    return _drive(
+        cfg, params, adj, state, _chunk_fn(cfg, batched), max_chunks, batched
+    )

@@ -1,0 +1,61 @@
+"""Event-log -> pandas export: the backend-agnostic observability contract.
+
+The reference's single observable artifact is the (event, sink) DataFrame
+from ``State.get_dataframe()`` (SURVEY.md section 5 "observability"); the
+BASELINE north star requires the TPU backend to feed the *unchanged* pandas
+evaluation layer. This module turns the device event buffer (times, srcs)
+plus the adjacency into exactly that schema:
+``event_id, t, time_delta, src_id, sink_id`` — one row per (event, sink).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+__all__ = ["events_to_dataframe"]
+
+
+def events_to_dataframe(times, srcs, adj, src_ids=None,
+                        sink_ids=None, start_time: float = 0.0) -> pd.DataFrame:
+    """Expand one component's event log to the reference DataFrame schema.
+
+    ``times`` [E] / ``srcs`` [E] (invalid tail: src == -1), ``adj`` [S, F].
+    ``src_ids``/``sink_ids`` optionally relabel rows/columns to external ids
+    (the oracle's arbitrary hashable ids); defaults are positional indices.
+    ``time_delta`` is the gap since the same source's previous post, measured
+    from ``start_time`` (the simulation start) for a source's first post
+    (reference Event semantics, SURVEY.md section 2 item 1).
+    """
+    times = np.asarray(times, np.float64)
+    srcs = np.asarray(srcs, np.int64)
+    adj = np.asarray(adj, bool)
+    valid = srcs >= 0
+    times, srcs = times[valid], srcs[valid]
+    S = adj.shape[0]
+    src_ids = np.arange(S) if src_ids is None else np.asarray(src_ids)
+    sink_ids = (
+        np.arange(adj.shape[1]) if sink_ids is None else np.asarray(sink_ids)
+    )
+
+    # time_delta: per-source consecutive gaps (first post from start_time).
+    last = np.full(S, float(start_time))
+    deltas = np.empty(len(times))
+    for j, (t, s) in enumerate(zip(times, srcs)):
+        deltas[j] = t - last[s]
+        last[s] = t
+
+    counts = adj[srcs].sum(axis=1)  # sinks per event
+    rows = np.repeat(np.arange(len(times)), counts)
+    sink_idx = np.concatenate(
+        [np.flatnonzero(adj[s]) for s in srcs]
+    ) if len(srcs) else np.empty(0, np.int64)
+    return pd.DataFrame(
+        {
+            "event_id": rows,
+            "t": times[rows],
+            "time_delta": deltas[rows],
+            "src_id": src_ids[srcs[rows]],
+            "sink_id": sink_ids[sink_idx],
+        }
+    )

@@ -1,0 +1,106 @@
+"""On-device feed-rank metrics over the event log.
+
+JAX re-implementation of the reference's ``redqueen/utils.py`` evaluation
+layer (SURVEY.md section 2 items 11–14: rank time-series, ``time_in_top_k``,
+``average_rank``, rank integrals) so that sweeps at scale never leave HBM.
+The pandas twin (``redqueen_tpu.utils.metrics_pandas``) consumes the exported
+DataFrame with identical conventions; ``tests/test_metrics.py`` pins the two
+layers to each other.
+
+One ``lax.scan`` over the event log reconstructs the tracked source's rank
+step function per follower and accumulates every integral in a single pass;
+``vmap`` handles batched logs. Invalid tail entries (src == -1) are no-ops.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["FeedMetrics", "feed_metrics", "feed_metrics_batch", "num_posts"]
+
+
+class FeedMetrics(NamedTuple):
+    """Per-sink integrals over [start_time, end_time] for the tracked source;
+    sinks the tracked source does not post to hold 0 and are excluded from
+    the means. All arrays [F] (or [B, F] for batched logs)."""
+
+    time_in_top_k: jnp.ndarray  # int 1[r_i(t) < K] dt
+    int_rank: jnp.ndarray       # int r_i(t) dt
+    int_rank2: jnp.ndarray      # int r_i(t)^2 dt
+    follows: jnp.ndarray        # bool: tracked source posts into this feed
+
+    def mean_time_in_top_k(self):
+        n = jnp.maximum(self.follows.sum(-1), 1)
+        return (self.time_in_top_k * self.follows).sum(-1) / n
+
+    def mean_average_rank(self, end_time, start_time=0.0):
+        n = jnp.maximum(self.follows.sum(-1), 1)
+        return (self.int_rank * self.follows).sum(-1) / n / (end_time - start_time)
+
+
+def feed_metrics(times, srcs, adj, src_index, end_time, K: int = 1,
+                 start_time: float = 0.0) -> FeedMetrics:
+    """Single pass over one event log [E] (reference: ``rank_of_src_in_df`` +
+    the integral metrics, SURVEY.md section 3.4).
+
+    ``times``/``srcs`` may contain (+inf, -1) tail entries; ``adj`` is the
+    component's [S, F] adjacency; ``src_index`` is the tracked source's row.
+    Events before ``start_time`` still build rank history (the carried-rank
+    convention shared with the pandas layer)."""
+    F = adj.shape[1]
+    dtype = times.dtype
+    follows = adj[src_index]
+    end = jnp.asarray(end_time, dtype)
+    start = jnp.asarray(start_time, dtype)
+
+    def step(carry, ev):
+        r, t_prev, top, ir, ir2 = carry
+        t, s = ev
+        valid = s >= 0
+        # Integrate the held rank over the in-window part of [t_prev, t).
+        t_clip = jnp.clip(jnp.where(valid, t, t_prev), start, end)
+        dt = jnp.maximum(t_clip - t_prev, 0)
+        rf = r.astype(dtype)
+        top = top + dt * (r < K)
+        ir = ir + dt * rf
+        ir2 = ir2 + dt * rf * rf
+        # Then apply the event to the rank vector.
+        hit = adj[jnp.maximum(s, 0)] & follows
+        own = s == src_index
+        r_new = jnp.where(hit, jnp.where(own, 0, r + 1), r)
+        r = jnp.where(valid, r_new, r)
+        t_prev = jnp.maximum(t_prev, t_clip)
+        return (r, t_prev, top, ir, ir2), None
+
+    zeros = jnp.zeros((F,), dtype)
+    init = (jnp.zeros((F,), jnp.int32), start, zeros, zeros, zeros)
+    (r, t_prev, top, ir, ir2), _ = lax.scan(step, init, (times, srcs))
+    # Flush the final segment to the horizon.
+    dt = jnp.maximum(end - t_prev, 0)
+    rf = r.astype(dtype)
+    top = top + dt * (r < K)
+    ir = ir + dt * rf
+    ir2 = ir2 + dt * rf * rf
+    return FeedMetrics(
+        time_in_top_k=top * follows, int_rank=ir * follows,
+        int_rank2=ir2 * follows, follows=follows,
+    )
+
+
+def feed_metrics_batch(times, srcs, adj, src_index, end_time, K: int = 1,
+                       start_time: float = 0.0) -> FeedMetrics:
+    """vmap of ``feed_metrics`` over a batched log [B, E] / adjacency
+    [B, S, F]; ``src_index`` may be scalar (same row per component)."""
+    fn = lambda t, s, a: feed_metrics(t, s, a, src_index, end_time, K, start_time)
+    return jax.vmap(fn)(times, srcs, adj)
+
+
+def num_posts(srcs, src_index):
+    """Posting budget actually spent: #events by the tracked source
+    (reference: the int u dt helper — for a counting path the integral is the
+    post count). Works on [E] or [B, E]."""
+    return (srcs == src_index).sum(axis=-1)

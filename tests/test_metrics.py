@@ -1,0 +1,77 @@
+"""Pin the two evaluation layers to each other: on-device JAX metrics vs the
+pandas layer consuming the exported DataFrame (the backend-agnostic contract
+of SURVEY.md sections 3.4 / 5)."""
+
+import numpy as np
+
+from redqueen_tpu.config import GraphBuilder
+from redqueen_tpu.sim import simulate
+from redqueen_tpu.utils import metrics_pandas as mp
+from redqueen_tpu.utils.dataframe import events_to_dataframe
+from redqueen_tpu.utils.metrics import feed_metrics, num_posts
+
+
+def _run(q=1.0, T=100.0, n=6, seed=0):
+    gb = GraphBuilder(n_sinks=n, end_time=T)
+    opt = gb.add_opt(q=q)
+    for i in range(n):
+        gb.add_poisson(rate=1.0, sinks=[i])
+    cfg, params, adj = gb.build(capacity=1024)
+    log = simulate(cfg, params, adj, seed=seed)
+    return log, adj, opt, T, n
+
+
+class TestMetricParity:
+    def test_jax_matches_pandas_layer(self):
+        log, adj, opt, T, n = _run()
+        m = feed_metrics(log.times, log.srcs, adj, opt, T)
+        df = events_to_dataframe(log.times, log.srcs, adj)
+        sinks = list(range(n))
+        assert abs(
+            float(m.mean_time_in_top_k())
+            - mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=sinks)
+        ) < 1e-3
+        assert abs(
+            float(m.mean_average_rank(T))
+            - mp.average_rank(df, T, src_id=0, sink_ids=sinks)
+        ) < 1e-4
+        per_top = mp.time_in_top_k(df, 1, T, src_id=0, per_sink=True,
+                                   sink_ids=sinks)
+        np.testing.assert_allclose(
+            np.asarray(m.time_in_top_k), [per_top[i] for i in sinks], atol=1e-3
+        )
+        assert int(num_posts(log.srcs, opt)) == mp.num_posts_of_src(df, 0)
+
+    def test_windowed_metrics_match(self):
+        log, adj, opt, T, n = _run(seed=3)
+        m = feed_metrics(log.times, log.srcs, adj, opt, T, K=2,
+                         start_time=30.0)
+        df = events_to_dataframe(log.times, log.srcs, adj)
+        sinks = list(range(n))
+        pd_top = mp.time_in_top_k(df, 2, T, src_id=0, start_time=30.0,
+                                  sink_ids=sinks)
+        assert abs(float(m.mean_time_in_top_k()) - pd_top) < 1e-3
+        pd_r2 = mp.int_rank2_dt(df, T, src_id=0, start_time=30.0,
+                                sink_ids=sinks)
+        jax_r2 = float(
+            (m.int_rank2 * m.follows).sum() / max(int(m.follows.sum()), 1)
+        )
+        assert abs(jax_r2 - pd_r2) / max(pd_r2, 1.0) < 1e-3
+
+    def test_dataframe_schema_and_deltas(self):
+        log, adj, opt, T, n = _run(seed=1)
+        df = events_to_dataframe(log.times, log.srcs, adj)
+        assert list(df.columns) == ["event_id", "t", "time_delta", "src_id",
+                                    "sink_id"]
+        # per-source deltas telescope back to the event times
+        for src in df["src_id"].unique():
+            g = df[df["src_id"] == src].drop_duplicates("event_id")
+            np.testing.assert_allclose(
+                g["time_delta"].to_numpy().cumsum(), g["t"].to_numpy(),
+                rtol=1e-5,
+            )
+        # opt posts hit all feeds, walls hit exactly one
+        counts = df.groupby("event_id")["sink_id"].count()
+        srcs = df.drop_duplicates("event_id").set_index("event_id")["src_id"]
+        assert (counts[srcs == 0] == n).all()
+        assert (counts[srcs != 0] == 1).all()

@@ -1,0 +1,269 @@
+"""JAX kernel tests: closed forms, determinism, chunking invariance, and the
+BASELINE quality gate — statistical parity with the NumPy oracle on config 1
+(SURVEY.md section 4 items 1–3, 5)."""
+
+import numpy as np
+import pytest
+
+from redqueen_tpu.config import GraphBuilder, stack_components
+from redqueen_tpu.sim import simulate, simulate_batch
+from redqueen_tpu.utils.metrics import feed_metrics, feed_metrics_batch, num_posts
+from redqueen_tpu.oracle.numpy_ref import SimOpts
+from redqueen_tpu.utils import metrics_pandas as mp
+
+
+def config1(n_followers=10, rate=1.0, end_time=100.0, q=1.0, capacity=1024):
+    """BASELINE config 1: 1 Opt broadcaster, n Poisson-feed followers."""
+    gb = GraphBuilder(n_sinks=n_followers, end_time=end_time)
+    opt = gb.add_opt(q=q)
+    for i in range(n_followers):
+        gb.add_poisson(rate=rate, sinks=[i])
+    cfg, params, adj = gb.build(capacity=capacity)
+    return cfg, params, adj, opt
+
+
+def oracle_config1(n_followers=10, rate=1.0, end_time=100.0, q=1.0, seed0=1000):
+    sink_ids = list(range(n_followers))
+    others = [
+        ("poisson", dict(src_id=100 + i, seed=seed0 + i, rate=rate, sink_ids=[i]))
+        for i in range(n_followers)
+    ]
+    return SimOpts(src_id=0, sink_ids=sink_ids, other_sources=others,
+                   end_time=end_time, q=q)
+
+
+class TestDeterminism:
+    def test_same_seed_same_log(self):
+        cfg, params, adj, opt = config1()
+        a = simulate(cfg, params, adj, seed=3)
+        b = simulate(cfg, params, adj, seed=3)
+        np.testing.assert_array_equal(np.asarray(a.times), np.asarray(b.times))
+        np.testing.assert_array_equal(np.asarray(a.srcs), np.asarray(b.srcs))
+
+    def test_different_seed_differs(self):
+        cfg, params, adj, opt = config1()
+        a = simulate(cfg, params, adj, seed=3)
+        b = simulate(cfg, params, adj, seed=4)
+        assert not np.array_equal(np.asarray(a.times), np.asarray(b.times))
+
+    def test_chunk_boundary_invariance(self):
+        """Chunked execution must reproduce the single-chunk run exactly:
+        the carry is the complete state (SURVEY.md section 5 long-context)."""
+        big_cfg, params, adj, opt = config1(capacity=2048)
+        small_cfg = type(big_cfg)(**{**big_cfg.__dict__, "capacity": 128})
+        a = simulate(big_cfg, params, adj, seed=9)
+        b = simulate(small_cfg, params, adj, seed=9)
+        na, nb = int(a.n_events), int(b.n_events)
+        assert na == nb
+        np.testing.assert_array_equal(
+            np.asarray(a.times)[:na], np.asarray(b.times)[:nb]
+        )
+
+    def test_batch_lane_matches_single(self):
+        """A component inside a batch must produce the same log as alone:
+        PRNG streams are layout-independent (SURVEY.md section 7 PRNG
+        discipline)."""
+        cfg, p0, a0, opt = config1(n_followers=4)
+        single = simulate(cfg, p0, a0, seed=5)
+        params, adj = stack_components([p0] * 3, [a0] * 3)
+        batch = simulate_batch(cfg, params, adj, np.array([4, 5, 6]))
+        n = int(single.n_events)
+        np.testing.assert_array_equal(
+            np.asarray(single.times)[:n], np.asarray(batch.times)[1, :n]
+        )
+
+    def test_overflow_raises_not_truncates(self):
+        cfg, params, adj, opt = config1(capacity=16)
+        with pytest.raises(RuntimeError, match="refusing to truncate"):
+            simulate(cfg, params, adj, seed=0, max_chunks=2)
+
+
+class TestClosedForm:
+    def test_poisson_count(self):
+        T, rate, B = 200.0, 1.1, 64
+        gb = GraphBuilder(n_sinks=1, end_time=T)
+        gb.add_poisson(rate=rate)
+        cfg, p0, a0 = gb.build(capacity=512)
+        params, adj = stack_components([p0] * B, [a0] * B)
+        log = simulate_batch(cfg, params, adj, np.arange(B))
+        mean = np.mean(np.asarray(log.n_events))
+        assert abs(mean - rate * T) < 4 * np.sqrt(rate * T / B)
+
+    def test_hawkes_stationary_count(self):
+        T, l0, alpha, beta, B = 300.0, 0.5, 0.5, 1.5, 64
+        expected = l0 * T / (1 - alpha / beta)
+        gb = GraphBuilder(n_sinks=1, end_time=T)
+        gb.add_hawkes(l0=l0, alpha=alpha, beta=beta)
+        cfg, p0, a0 = gb.build(capacity=2048)
+        params, adj = stack_components([p0] * B, [a0] * B)
+        log = simulate_batch(cfg, params, adj, np.arange(B))
+        mean = np.mean(np.asarray(log.n_events))
+        assert abs(mean - expected) < 0.12 * expected
+
+    def test_piecewise_segments(self):
+        T, B = 100.0, 32
+        gb = GraphBuilder(n_sinks=1, end_time=T)
+        gb.add_piecewise(change_times=[0.0, 40.0, 60.0], rates=[0.0, 3.0, 0.0])
+        cfg, p0, a0 = gb.build(capacity=256)
+        params, adj = stack_components([p0] * B, [a0] * B)
+        log = simulate_batch(cfg, params, adj, np.arange(B))
+        times = np.asarray(log.times)
+        srcs = np.asarray(log.srcs)
+        ts = times[srcs >= 0]
+        assert len(ts) > 0
+        assert np.all((ts >= 40.0) & (ts <= 60.0))
+        mean = np.mean(np.asarray(log.n_events))
+        assert abs(mean - 60.0) < 4 * np.sqrt(60.0 / B)
+
+    def test_realdata_exact_replay(self):
+        trace = [3.0, 7.5, 11.0, 42.0, 77.7]
+        gb = GraphBuilder(n_sinks=1, end_time=50.0)
+        gb.add_realdata(times=trace)
+        cfg, params, adj = gb.build(capacity=64)
+        log = simulate(cfg, params, adj, seed=0)
+        n = int(log.n_events)
+        got = np.asarray(log.times)[:n]
+        np.testing.assert_allclose(got, [3.0, 7.5, 11.0, 42.0], rtol=1e-6)
+
+    def test_opt_never_posts_alone(self):
+        gb = GraphBuilder(n_sinks=2, end_time=50.0)
+        gb.add_opt(q=0.01)
+        cfg, params, adj = gb.build(capacity=64)
+        log = simulate(cfg, params, adj, seed=1)
+        assert int(log.n_events) == 0
+
+    def test_event_times_sorted(self):
+        cfg, params, adj, opt = config1()
+        log = simulate(cfg, params, adj, seed=2)
+        n = int(log.n_events)
+        ts = np.asarray(log.times)[:n]
+        assert np.all(np.diff(ts) >= 0)
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 kernel code-review findings."""
+
+    def test_piecewise_final_segment_extends_to_inf(self):
+        """Padding must not kill the last real segment: a single-knot source
+        padded alongside a 3-knot source keeps its rate forever."""
+        T, B = 100.0, 32
+        gb = GraphBuilder(n_sinks=2, end_time=T)
+        gb.add_piecewise(change_times=[0.0], rates=[2.0], sinks=[0])
+        gb.add_piecewise(change_times=[0.0, 10.0, 20.0], rates=[1.0, 0.0, 1.0],
+                         sinks=[1])
+        cfg, p0, a0 = gb.build(capacity=1024)
+        params, adj = stack_components([p0] * B, [a0] * B)
+        log = simulate_batch(cfg, params, adj, np.arange(B))
+        srcs = np.asarray(log.srcs)
+        times = np.asarray(log.times)
+        n0 = (srcs == 0).sum(axis=1).mean()
+        late0 = times[(srcs == 0) & (times > 50.0)]
+        assert len(late0) > 0, "rate-2 source died after its only knot"
+        assert abs(n0 - 2.0 * T) < 4 * np.sqrt(2.0 * T / B)
+        # source 1: rate 1 on [0,10) and [20,inf) -> ~90 events, none in [10,20)
+        mid1 = times[(srcs == 1) & (times > 10.0) & (times < 20.0)]
+        assert len(mid1) == 0
+
+    def test_unregistered_kind_rejected_at_build(self):
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb.add_rmtpp()
+        with pytest.raises(ValueError, match="no registered policy"):
+            gb.build()
+
+    def test_dataframe_time_delta_respects_start_time(self):
+        from redqueen_tpu.utils.dataframe import events_to_dataframe
+        times = np.array([12.0, 15.0, np.inf])
+        srcs = np.array([0, 0, -1], np.int32)
+        adj = np.ones((1, 1), bool)
+        df = events_to_dataframe(times, srcs, adj, start_time=10.0)
+        np.testing.assert_allclose(df["time_delta"].to_numpy(), [2.0, 3.0])
+
+    def test_resume_extends_horizon(self):
+        from redqueen_tpu.sim import resume
+        cfg, params, adj, opt = config1(end_time=50.0, capacity=512)
+        log1, state = simulate(cfg, params, adj, seed=11, return_state=True)
+        cfg2 = type(cfg)(**{**cfg.__dict__, "end_time": 100.0})
+        log2, state2 = resume(cfg2, params, adj, state)
+        n1, n2 = int(log1.n_events), int(state2.n_events)
+        assert n2 > n1
+        t2 = np.asarray(log2.times)
+        s2 = np.asarray(log2.srcs)
+        new_ts = t2[s2 >= 0]
+        assert np.all(new_ts > 50.0) and np.all(new_ts <= 100.0)
+        # full pass over both segments has sorted times
+        t1 = np.asarray(log1.times)[np.asarray(log1.srcs) >= 0]
+        allts = np.concatenate([t1, new_ts])
+        assert np.all(np.diff(allts) >= 0)
+
+
+class TestOracleParity:
+    """The BASELINE quality gate: JAX time-in-top-1 statistically matches the
+    NumPy reference at matched configs (SURVEY.md section 4 item 1)."""
+
+    N_SEEDS = 12
+
+    def _jax_stats(self, q, T=100.0, n=10):
+        cfg, params, adj, opt = config1(n_followers=n, end_time=T, q=q)
+        p, a = stack_components([params] * self.N_SEEDS, [adj] * self.N_SEEDS)
+        log = simulate_batch(cfg, p, a, np.arange(self.N_SEEDS))
+        m = feed_metrics_batch(log.times, log.srcs, a, opt, T)
+        return (
+            np.asarray(m.mean_time_in_top_k()),
+            np.asarray(num_posts(log.srcs, opt)),
+        )
+
+    def _oracle_stats(self, q, T=100.0, n=10):
+        tops, posts = [], []
+        for seed in range(self.N_SEEDS):
+            so = oracle_config1(n_followers=n, end_time=T, q=q,
+                                seed0=5000 + 100 * seed)
+            m = so.create_manager_with_opt(seed=seed)
+            m.run_till()
+            df = m.state.get_dataframe()
+            tops.append(
+                mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=so.sink_ids)
+            )
+            posts.append(mp.num_posts_of_src(df, 0))
+        return np.array(tops), np.array(posts)
+
+    @pytest.mark.parametrize("q", [1.0, 0.1])
+    def test_time_in_top1_and_budget_match(self, q):
+        jt, jp = self._jax_stats(q)
+        ot, op = self._oracle_stats(q)
+        for jx, orc in ((jt, ot), (jp, op)):
+            se = np.sqrt(jx.var() / len(jx) + orc.var() / len(orc))
+            assert abs(jx.mean() - orc.mean()) < 4 * max(se, 1e-9), (
+                f"jax {jx.mean():.3f} vs oracle {orc.mean():.3f} (se {se:.3f})"
+            )
+
+    def test_hawkes_wall_parity(self):
+        """Config-2 shape: Opt vs Hawkes feeds, JAX vs oracle."""
+        T, n = 80.0, 4
+        gb = GraphBuilder(n_sinks=n, end_time=T)
+        opt = gb.add_opt(q=0.5)
+        for i in range(n):
+            gb.add_hawkes(l0=0.5, alpha=0.4, beta=1.2, sinks=[i])
+        cfg, p0, a0 = gb.build(capacity=2048)
+        p, a = stack_components([p0] * self.N_SEEDS, [a0] * self.N_SEEDS)
+        log = simulate_batch(cfg, p, a, np.arange(self.N_SEEDS))
+        m = feed_metrics_batch(log.times, log.srcs, a, opt, T)
+        jt = np.asarray(m.mean_time_in_top_k())
+
+        ot = []
+        for seed in range(self.N_SEEDS):
+            others = [
+                ("hawkes", dict(src_id=100 + i, seed=7000 + 100 * seed + i,
+                                l_0=0.5, alpha=0.4, beta=1.2, sink_ids=[i]))
+                for i in range(n)
+            ]
+            so = SimOpts(src_id=0, sink_ids=list(range(n)),
+                         other_sources=others, end_time=T, q=0.5)
+            mgr = so.create_manager_with_opt(seed=seed)
+            mgr.run_till()
+            df = mgr.state.get_dataframe()
+            ot.append(mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=so.sink_ids))
+        ot = np.array(ot)
+        se = np.sqrt(jt.var() / len(jt) + ot.var() / len(ot))
+        assert abs(jt.mean() - ot.mean()) < 4 * max(se, 1e-9), (
+            f"jax {jt.mean():.3f} vs oracle {ot.mean():.3f} (se {se:.3f})"
+        )
