@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Headline benchmark: the BASELINE.json metric — simulated events/sec on the
+10k-broadcaster x 100k-follower bipartite graph, with time-in-top-1 matched
+against the NumPy reference path (quality gate) and ``vs_baseline`` the
+wall-clock speedup over that NumPy path on identical work.
+
+The 10k x 100k graph decomposes into 10k independent per-broadcaster
+components of 10 followers each (RedQueen broadcasters do not couple), run as
+one vmapped batch on the device — SURVEY.md section 6 / section 7.
+
+Prints EXACTLY ONE JSON line on stdout:
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+Diagnostics (quality gate, sizes, timings) go to stderr.
+
+Usage: python bench.py [--quick] [--broadcasters N] [--horizon T]
+  --quick: small shapes for CPU smoke verification (seconds, not minutes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_component(n_followers: int, T: float, q: float, wall_rate: float,
+                    capacity: int):
+    from redqueen_tpu.config import GraphBuilder
+
+    gb = GraphBuilder(n_sinks=n_followers, end_time=T)
+    opt = gb.add_opt(q=q)
+    for i in range(n_followers):
+        gb.add_poisson(rate=wall_rate, sinks=[i])
+    cfg, params, adj = gb.build(capacity=capacity)
+    return cfg, params, adj, opt
+
+
+def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
+            capacity: int):
+    import jax
+    from redqueen_tpu.config import stack_components
+    from redqueen_tpu.sim import simulate_batch
+    from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
+
+    cfg, p0, a0, opt = build_component(n_followers, T, q, wall_rate, capacity)
+    params, adj = stack_components([p0] * B, [a0] * B)
+    adj_b = jax.numpy.broadcast_to(a0, (B,) + a0.shape)
+
+    # Warm-up: compiles the chunk kernel (cached for the timed run).
+    warm = simulate_batch(cfg, params, adj, np.arange(B), max_chunks=64)
+    jax.block_until_ready(warm.times)
+
+    t0 = time.perf_counter()
+    logb = simulate_batch(cfg, params, adj, np.arange(B) + 10_000, max_chunks=64)
+    jax.block_until_ready(logb.times)
+    secs = time.perf_counter() - t0
+
+    events = int(np.asarray(logb.n_events).sum())
+    m = feed_metrics_batch(logb.times, logb.srcs, adj_b, opt, T)
+    top1 = float(np.asarray(m.mean_time_in_top_k()).mean())
+    posts = float(np.asarray(num_posts(logb.srcs, opt)).mean())
+    return events, secs, top1, posts
+
+
+def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
+               wall_rate: float):
+    from redqueen_tpu.oracle.numpy_ref import SimOpts
+    from redqueen_tpu.utils import metrics_pandas as mp
+
+    events = 0
+    tops = []
+    t0 = time.perf_counter()
+    for c in range(n_comps):
+        others = [
+            ("poisson", dict(src_id=100 + i, seed=40_000 + 1000 * c + i,
+                             rate=wall_rate, sink_ids=[i]))
+            for i in range(n_followers)
+        ]
+        so = SimOpts(src_id=0, sink_ids=list(range(n_followers)),
+                     other_sources=others, end_time=T, q=q)
+        mgr = so.create_manager_with_opt(seed=c)
+        mgr.run_till()
+        df = mgr.state.get_dataframe()
+        events += df["event_id"].nunique()
+        tops.append(mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=so.sink_ids))
+    secs = time.perf_counter() - t0
+    return events, secs, float(np.mean(tops))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes for CPU smoke verification")
+    ap.add_argument("--broadcasters", type=int, default=None)
+    ap.add_argument("--followers", type=int, default=10)
+    ap.add_argument("--horizon", type=float, default=None)
+    ap.add_argument("--q", type=float, default=1.0)
+    ap.add_argument("--wall-rate", type=float, default=1.0)
+    args = ap.parse_args()
+
+    if args.quick:
+        B = args.broadcasters or 64
+        T = args.horizon or 20.0
+        capacity = 512
+        oracle_comps = 2
+    else:
+        B = args.broadcasters or 10_000
+        T = args.horizon or 100.0
+        capacity = 2048
+        oracle_comps = 4
+
+    import jax
+
+    log(f"devices: {jax.devices()}")
+    log(f"graph: {B} broadcasters x {args.followers} followers "
+        f"(= {B * args.followers} feed edges), horizon T={T}")
+
+    events, secs, top1, posts = run_jax(
+        B, args.followers, T, args.q, args.wall_rate, capacity
+    )
+    eps = events / secs
+    log(f"jax: {events} events in {secs:.3f}s -> {eps:,.0f} events/s; "
+        f"time-in-top-1 {top1:.2f}/{T}, posts/broadcaster {posts:.1f}")
+
+    o_events, o_secs, o_top1 = run_oracle(
+        oracle_comps, args.followers, T, args.q, args.wall_rate
+    )
+    o_eps = o_events / o_secs
+    speedup = eps / o_eps
+    log(f"numpy ref: {o_events} events in {o_secs:.3f}s -> {o_eps:,.0f} "
+        f"events/s (on {oracle_comps} components); time-in-top-1 {o_top1:.2f}")
+    log(f"quality gate: |jax - numpy| = {abs(top1 - o_top1):.2f} "
+        f"(MC tolerance; see tests/test_sim_jax.py for the 4-sigma gate)")
+    log(f"speedup vs NumPy path: {speedup:,.1f}x (north-star target: >=100x)")
+
+    print(json.dumps({
+        "metric": f"simulated events/sec ({B}x{B * args.followers} graph)",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

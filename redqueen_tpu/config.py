@@ -47,6 +47,12 @@ class SimConfig:
     start_time: float = 0.0
     capacity: int = 4096  # scan steps (= max events) per chunk
     rmtpp_hidden: int = 1  # H of the neural-policy recurrent state
+    # Static specialization (filled by GraphBuilder.build): the kernel
+    # compiles lax.switch branches ONLY for kinds that exist in the
+    # component, and unrolls the react hook over the known Opt rows — a
+    # Poisson+Opt config never pays for the Hawkes thinning loop.
+    present_kinds: tuple = ()
+    opt_rows: tuple = ()
 
 
 class SourceParams(struct.PyTreeNode):
@@ -207,6 +213,10 @@ class GraphBuilder:
         cfg = SimConfig(
             n_sources=S, n_sinks=F, end_time=self.end_time,
             start_time=self.start_time, capacity=int(capacity),
+            present_kinds=tuple(sorted(set(int(k) for k in kind))),
+            opt_rows=tuple(
+                s for s in range(S) if kind[s] == KIND_OPT
+            ),
         )
         params = SourceParams(
             kind=jnp.asarray(kind),
@@ -222,7 +232,21 @@ class GraphBuilder:
 def stack_components(params_list: Sequence[SourceParams],
                      adj_list: Sequence[jnp.ndarray]):
     """Stack same-shape components along a leading batch axis for
-    vmap/shard_map (SURVEY.md section 3.5: the sweep axis)."""
+    vmap/shard_map (SURVEY.md section 3.5: the sweep axis).
+
+    Components must share the same source-kind LAYOUT (which row is which
+    policy): the kernel specializes statically on the SimConfig's
+    present_kinds/opt_rows, so a batch mixing layouts would dispatch
+    incorrectly. Parameters (rates, q, ...) may differ freely — that is the
+    sweep axis."""
+    k0 = np.asarray(params_list[0].kind)
+    for p in params_list[1:]:
+        if not np.array_equal(np.asarray(p.kind), k0):
+            raise ValueError(
+                "stack_components: all components must share the same "
+                "source-kind layout (got differing params.kind rows); build "
+                "them from the same GraphBuilder structure"
+            )
     params = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
     adj = jnp.stack(list(adj_list))
     return params, adj

@@ -19,10 +19,11 @@ traced values):
 - ``on_fire(params, state, s, t, key) -> SourceUpdate``
     source ``s`` just posted at time ``t``; return its refreshed per-source
     state (scalars; scattered back at index ``s`` by the kernel).
-- ``on_react(params, state, feeds_hit, s_star, t, keys, ctr_bump) ->
-    (t_next[S], opt_cand[S, F] or None)`` — optional, vectorized over ALL
-    sources at once; adjust next-event times in response to someone else's
-    post (the RedQueen superposition trick lives here).
+- ``on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid) ->
+    (t_next[S], ctr_bump bool[S])`` — optional; adjust next-event times of
+    non-fired sources in response to the fired source's post (the RedQueen
+    superposition trick lives here). ``cfg`` carries static specialization
+    info (e.g. ``cfg.opt_rows``) so hooks can unroll over known rows.
 """
 
 from __future__ import annotations

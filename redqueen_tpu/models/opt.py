@@ -39,8 +39,8 @@ def on_fire(params, state, s, t, key):
     )
 
 
-def on_react(params, state, adj, feeds_hit, s_star, t, valid):
-    """Vectorized superposition update for all non-fired Opt sources.
+def on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid):
+    """Superposition update for all non-fired Opt sources.
 
     Returns (t_next[S], ctr_bump bool[S]). ``feeds_hit`` [F] marks the feeds
     the fired source posted into; an Opt source s reacts on its followed
@@ -48,10 +48,34 @@ def on_react(params, state, adj, feeds_hit, s_star, t, valid):
     spawns an Exp(sqrt(s_i/q)) clock and the earliest wins — and the minimum
     of independent exponentials is Exp(sum of rates), so ONE draw per source
     against the summed affected rate is distributionally identical to the
-    reference's per-follower draws while doing O(S) instead of O(S*F) RNG
+    reference's per-follower draws while doing O(1) instead of O(S*F) RNG
     work per event.
+
+    When the config carries static ``opt_rows`` (GraphBuilder output) the
+    update unrolls over those rows — typically ONE controlled broadcaster —
+    instead of masking all S sources; hand-built configs fall back to the
+    vectorized form.
     """
     S, F = adj.shape
+    dtype = state.t_next.dtype
+
+    if cfg is not None and cfg.present_kinds:  # static specialization
+        t_next, bump = state.t_next, jnp.zeros((S,), bool)
+        for row in cfg.opt_rows:
+            affected = adj[row] & feeds_hit                  # [F]
+            react = (row != s_star) & affected.any() & valid
+            rate_sum = jnp.where(
+                affected, jnp.sqrt(params.s_sink / params.q[row]), 0.0
+            ).sum()
+            key = jr.fold_in(state.keys[row], state.ctr[row])
+            draw = jr.exponential(key, (), dtype)
+            cand = t + jnp.where(rate_sum > 0, draw / rate_sum, jnp.inf)
+            t_next = t_next.at[row].set(
+                jnp.where(react, jnp.minimum(t_next[row], cand), t_next[row])
+            )
+            bump = bump.at[row].set(react)
+        return t_next, bump
+
     affected = adj & feeds_hit[None, :]                      # [S, F]
     react = (
         (params.kind == KIND_OPT)

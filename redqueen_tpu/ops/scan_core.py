@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax import random as jr
 
@@ -29,19 +30,41 @@ from ..models.base import get_registry
 __all__ = ["init_state", "make_run_chunk"]
 
 
-def _fire_branches():
+def _kinds_for(cfg: SimConfig):
+    """Static branch set: only the policy kinds present in the component
+    (cfg.present_kinds, filled by GraphBuilder) — a Poisson+Opt component
+    never compiles the Hawkes thinning loop. Empty tuple (hand-built
+    configs) falls back to every registered kind."""
     reg = get_registry()
-    return [reg[k].on_fire for k in sorted(reg)]
+    return list(cfg.present_kinds) if cfg.present_kinds else sorted(reg)
 
 
-def _init_branches():
+def _fire_branches(cfg):
     reg = get_registry()
-    return [reg[k].on_init for k in sorted(reg)]
+    return [reg[k].on_fire for k in _kinds_for(cfg)]
 
 
-def _react_hooks():
+def _init_branches(cfg):
     reg = get_registry()
-    return [reg[k].on_react for k in sorted(reg) if reg[k].on_react is not None]
+    return [reg[k].on_init for k in _kinds_for(cfg)]
+
+
+def _react_hooks(cfg):
+    reg = get_registry()
+    return [
+        reg[k].on_react for k in _kinds_for(cfg) if reg[k].on_react is not None
+    ]
+
+
+def _local_kind(cfg, kind):
+    """Map global kind codes to indices into the compiled branch list."""
+    kinds = _kinds_for(cfg)
+    if kinds == list(range(len(kinds))):
+        return kind  # identity mapping, skip the gather
+    lookup = np.zeros(max(kinds) + 1, np.int32)
+    for i, k in enumerate(kinds):
+        lookup[k] = i
+    return jnp.asarray(lookup)[kind]
 
 
 def init_state(cfg: SimConfig, params: SourceParams, adj, key,
@@ -68,13 +91,14 @@ def init_state(cfg: SimConfig, params: SourceParams, adj, key,
         ctr=jnp.zeros((S,), jnp.uint32),
         n_events=jnp.zeros((), jnp.int32),
     )
-    branches = _init_branches()
+    branches = _init_branches(cfg)
+    kind_local = _local_kind(cfg, params.kind)
     init_keys = jax.vmap(jr.fold_in)(keys, jnp.zeros((S,), jnp.uint32))
 
-    def one(s, k):
-        return lax.switch(params.kind[s], branches, params, state0, s, t0, k)
+    def one(s, kl, k):
+        return lax.switch(kl, branches, params, state0, s, t0, k)
 
-    upd = jax.vmap(one, in_axes=(0, 0))(jnp.arange(S), init_keys)
+    upd = jax.vmap(one, in_axes=(0, 0, 0))(jnp.arange(S), kind_local, init_keys)
     return state0.replace(
         t_next=upd.t_next, exc=upd.exc, exc_t=upd.exc_t, rd_ptr=upd.rd_ptr,
         h=upd.h, ctr=jnp.ones((S,), jnp.uint32),
@@ -85,13 +109,14 @@ def make_run_chunk(cfg: SimConfig):
     """Returns ``run_chunk(params, adj, state) -> (state, (times, srcs))``,
     advancing the simulation by up to ``cfg.capacity`` events. Pure and
     jit/vmap-safe; the driver (redqueen_tpu.sim) jits/vmaps/shards it."""
-    fire_branches = _fire_branches()
-    react_hooks = _react_hooks()
+    fire_branches = _fire_branches(cfg)
+    react_hooks = _react_hooks(cfg)
     end_time = cfg.end_time
 
     def run_chunk(params: SourceParams, adj, state: SimState):
+        kind_local = _local_kind(cfg, params.kind)
+
         def step(state: SimState, _):
-            S = state.t_next.shape[0]
             s_star = jnp.argmin(state.t_next)
             t_ev = state.t_next[s_star]
             valid = t_ev <= end_time
@@ -100,7 +125,7 @@ def make_run_chunk(cfg: SimConfig):
             # -- fired source resamples (policy dispatch, SURVEY.md 3.1) --
             key_fire = jr.fold_in(state.keys[s_star], state.ctr[s_star])
             upd = lax.switch(
-                params.kind[s_star], fire_branches,
+                kind_local[s_star], fire_branches,
                 params, state, s_star, t_ev, key_fire,
             )
 
@@ -117,7 +142,9 @@ def make_run_chunk(cfg: SimConfig):
 
             # -- react hooks: non-fired sources re-decide (RedQueen trick) --
             for hook in react_hooks:
-                t_next, bumped = hook(params, new, adj, feeds, s_star, t_ev, valid)
+                t_next, bumped = hook(
+                    cfg, params, new, adj, feeds, s_star, t_ev, valid
+                )
                 new = new.replace(
                     t_next=t_next, ctr=new.ctr + bumped.astype(new.ctr.dtype)
                 )

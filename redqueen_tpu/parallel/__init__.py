@@ -1,0 +1,6 @@
+"""Distributed execution over jax.sharding meshes (SURVEY.md section 5):
+collective wrappers that no-op at mesh size 1 (comm), component-batch SPMD
+sharding (shard), and the follower-sharded big-F kernel (bigf)."""
+
+from .comm import make_mesh, psum, pmin, pmax, pany, shard_leading, replicate  # noqa: F401
+from .shard import simulate_sharded  # noqa: F401

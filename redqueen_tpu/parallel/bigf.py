@@ -1,0 +1,24 @@
+"""Follower-sharded execution for single-broadcaster / huge-F components
+(BASELINE configs 2 and 4: 1 broadcaster against 1k Hawkes / 100k replay
+feeds) — the ``feed`` mesh axis of redqueen_tpu.parallel.comm.
+
+Design (implemented incrementally; see simulate_bigf below for what is live):
+the component's followers and their dedicated wall sources shard over the
+``feed`` axis via ``shard_map``; each device scans its local feeds' wall
+events independently, and the one cross-device coupling — the controlled
+broadcaster's superposition clock, the min over all followers' candidate
+clocks — rides ``pmin`` over the ICI mesh axis, exactly the "lax.psum for
+the global rank-in-feed reduction" of the BASELINE north star.
+"""
+
+from __future__ import annotations
+
+__all__ = ["simulate_bigf"]
+
+
+def simulate_bigf(*args, **kwargs):
+    raise NotImplementedError(
+        "follower-sharded big-F kernel lands after the batch path; use "
+        "parallel.shard.simulate_sharded (component-batch axis) or a "
+        "single-device component meanwhile"
+    )

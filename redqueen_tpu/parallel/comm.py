@@ -1,0 +1,112 @@
+"""Collective/communication layer: the TPU-native equivalent of a
+distributed backend (SURVEY.md section 5 "Distributed communication
+backend"). The reference is single-process; here all cross-device talk goes
+through this one module so kernel code stays mesh-shape-agnostic: on a
+1-device mesh (or when the named axis is absent) every collective degrades
+to a no-op, and the same code scales to an ICI mesh axis (devices in one
+slice) with a DCN axis reserved for multi-slice scale-out.
+
+Axis conventions:
+- ``data``  — independent simulation components (broadcasters of the
+  bipartite graph, sweep seeds/q points). Pure SPMD, no communication in
+  the hot loop; metrics aggregate with ``psum``.
+- ``feed``  — followers of ONE component (the 100k-follower configs). The
+  RedQueen candidate-clock reduction rides ``pmin``/``psum`` over this axis
+  (see redqueen_tpu.parallel.bigf).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "axis_present",
+    "axis_size_or_1",
+    "psum",
+    "pmin",
+    "pmax",
+    "pany",
+    "shard_leading",
+    "replicate",
+]
+
+
+def make_mesh(axes: dict, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: size}; sizes must multiply to the device
+    count (use -1 once for 'all remaining'). ``make_mesh({'data': 8})``."""
+    devices = jax.devices() if devices is None else list(devices)
+    names = tuple(axes)
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {len(devices)} devices")
+    mesh_devices = mesh_utils.create_device_mesh(sizes, devices=devices)
+    return Mesh(mesh_devices, names)
+
+
+def _in_collective(axis_name: str) -> bool:
+    """True iff ``axis_name`` is a bound collective axis here (inside
+    shard_map/vmap with that axis); collectives outside are no-ops."""
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def axis_present(axis_name: str) -> bool:
+    return _in_collective(axis_name)
+
+
+def axis_size_or_1(axis_name: str) -> int:
+    return lax.axis_size(axis_name) if _in_collective(axis_name) else 1
+
+
+def psum(x, axis_name: str = "data"):
+    """Sum over the named mesh axis; identity when the axis is unbound or
+    size 1 — kernel code never branches on mesh shape."""
+    return lax.psum(x, axis_name) if _in_collective(axis_name) else x
+
+
+def pmin(x, axis_name: str = "data"):
+    return lax.pmin(x, axis_name) if _in_collective(axis_name) else x
+
+
+def pmax(x, axis_name: str = "data"):
+    return lax.pmax(x, axis_name) if _in_collective(axis_name) else x
+
+
+def pany(x, axis_name: str = "data"):
+    """Logical-or reduction across the axis (failure/overflow detection)."""
+    if not _in_collective(axis_name):
+        return x
+    return lax.pmax(x.astype(jnp.int32), axis_name) > 0
+
+
+def shard_leading(tree, mesh: Mesh, axis: str = "data"):
+    """Place every array in ``tree`` with its LEADING dim sharded over
+    ``axis`` (rest replicated) — the component-batch layout. Leading dims
+    must divide the axis size evenly."""
+    def put(x):
+        x = jnp.asarray(x)
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, tree)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate ``tree`` over the mesh."""
+    return jax.tree.map(
+        lambda x: jax.device_put(jnp.asarray(x), NamedSharding(mesh, P())), tree
+    )

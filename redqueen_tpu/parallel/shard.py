@@ -1,0 +1,61 @@
+"""Sharded sweep execution: the BASELINE's "broadcaster x follower graphs
+shard over a TPU slice" path (north star; SURVEY.md section 2 parallelism
+table). A 10k-broadcaster / 100k-follower bipartite graph decomposes into
+independent per-broadcaster components (RedQueen broadcasters do not couple:
+each one's u*(t) reads only its own followers' ranks), so the scale-out is
+SPMD over the component batch: inputs land sharded over the ``data`` mesh
+axis, the vmapped event-scan kernel runs with zero hot-loop communication,
+and only metric aggregation reduces across devices.
+
+This file deliberately contains no kernel logic: it places data
+(``comm.shard_leading``) and reuses the exact ``sim`` driver, so sharded and
+unsharded paths cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import random as jr
+from jax.sharding import Mesh
+
+from ..config import SimConfig, SourceParams
+from ..sim import EventLog, simulate_batch
+from . import comm
+
+__all__ = ["simulate_sharded"]
+
+
+def simulate_sharded(cfg: SimConfig, params: SourceParams, adj, seeds,
+                     mesh: Mesh, axis: str = "data",
+                     max_chunks: int = 100, return_state: bool = False):
+    """Run a component batch sharded over ``mesh`` axis ``axis``.
+
+    ``params``/``adj``/``seeds`` carry a leading batch dim divisible by the
+    axis size. Results are identical (bit-for-bit at matched seeds) to
+    ``simulate_batch`` on one device: sharding only changes placement, and
+    the per-source PRNG streams are layout-independent by construction
+    (SURVEY.md section 7 PRNG discipline; pinned by
+    tests/test_sharding.py)."""
+    B = jnp.asarray(seeds).shape[0]
+    B_params = params.kind.shape[0]
+    B_adj = adj.shape[0]
+    if not (B == B_params == B_adj):
+        raise ValueError(
+            f"batch dims disagree: seeds={B}, params={B_params}, adj={B_adj}"
+        )
+    ax_size = mesh.shape[axis]
+    if B % ax_size != 0:
+        raise ValueError(f"batch {B} not divisible by mesh axis {axis}={ax_size}")
+    seeds = jnp.asarray(seeds)
+    keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
+    with mesh:
+        params_s = comm.shard_leading(params, mesh, axis)
+        adj_s = comm.shard_leading(adj, mesh, axis)
+        keys_s = comm.shard_leading(keys, mesh, axis)
+        return simulate_batch(
+            cfg, params_s, adj_s, keys_s,
+            max_chunks=max_chunks, return_state=return_state,
+        )

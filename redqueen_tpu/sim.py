@@ -101,6 +101,7 @@ def _as_key(seed: Union[int, jnp.ndarray]):
 def _drive(cfg, params, adj, state, chunk, max_chunks, batched):
     times_chunks, srcs_chunks = [], []
     n_chunks = 0
+    n_before = state.n_events  # resume(): count only this drive's events
     while True:
         state, (t_c, s_c) = chunk(params, adj, state)
         times_chunks.append(t_c)
@@ -120,7 +121,7 @@ def _drive(cfg, params, adj, state, chunk, max_chunks, batched):
     axis = 1 if batched else 0
     times = jnp.concatenate(times_chunks, axis=axis)
     srcs = jnp.concatenate(srcs_chunks, axis=axis)
-    return EventLog(times, srcs, state.n_events, cfg), state
+    return EventLog(times, srcs, state.n_events - n_before, cfg), state
 
 
 def simulate(cfg: SimConfig, params: SourceParams, adj, seed,

@@ -26,20 +26,26 @@ __all__ = ["FeedMetrics", "feed_metrics", "feed_metrics_batch", "num_posts"]
 class FeedMetrics(NamedTuple):
     """Per-sink integrals over [start_time, end_time] for the tracked source;
     sinks the tracked source does not post to hold 0 and are excluded from
-    the means. All arrays [F] (or [B, F] for batched logs)."""
+    the means. Arrays [F] (or [B, F] for batched logs); the integration
+    window is carried along so derived quantities cannot silently use a
+    different window than the integrals."""
 
     time_in_top_k: jnp.ndarray  # int 1[r_i(t) < K] dt
     int_rank: jnp.ndarray       # int r_i(t) dt
     int_rank2: jnp.ndarray      # int r_i(t)^2 dt
     follows: jnp.ndarray        # bool: tracked source posts into this feed
+    start_time: jnp.ndarray     # window start the integrals used
+    end_time: jnp.ndarray       # window end the integrals used
 
     def mean_time_in_top_k(self):
         n = jnp.maximum(self.follows.sum(-1), 1)
         return (self.time_in_top_k * self.follows).sum(-1) / n
 
-    def mean_average_rank(self, end_time, start_time=0.0):
+    def mean_average_rank(self):
         n = jnp.maximum(self.follows.sum(-1), 1)
-        return (self.int_rank * self.follows).sum(-1) / n / (end_time - start_time)
+        return (self.int_rank * self.follows).sum(-1) / n / (
+            self.end_time - self.start_time
+        )
 
 
 def feed_metrics(times, srcs, adj, src_index, end_time, K: int = 1,
@@ -88,6 +94,7 @@ def feed_metrics(times, srcs, adj, src_index, end_time, K: int = 1,
     return FeedMetrics(
         time_in_top_k=top * follows, int_rank=ir * follows,
         int_rank2=ir2 * follows, follows=follows,
+        start_time=start, end_time=end,
     )
 
 

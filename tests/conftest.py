@@ -9,7 +9,6 @@ must stay fast/deterministic).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +16,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's "axon" TPU-tunnel plugin force-registers itself as the
+# default platform and ignores the JAX_PLATFORMS env var, so select the CPU
+# backend through the config API instead (before any computation runs).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -32,7 +32,7 @@ class TestMetricParity:
             - mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=sinks)
         ) < 1e-3
         assert abs(
-            float(m.mean_average_rank(T))
+            float(m.mean_average_rank())
             - mp.average_rank(df, T, src_id=0, sink_ids=sinks)
         ) < 1e-4
         per_top = mp.time_in_top_k(df, 1, T, src_id=0, per_sink=True,

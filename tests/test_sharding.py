@@ -1,0 +1,89 @@
+"""Mesh-sharded execution tests on the virtual 8-device CPU mesh
+(SURVEY.md section 4 item 4: mesh sizes {1, 8} without a cluster)."""
+
+import jax
+import numpy as np
+import pytest
+
+from redqueen_tpu.config import GraphBuilder, stack_components
+from redqueen_tpu.parallel import comm
+from redqueen_tpu.parallel.shard import simulate_sharded
+from redqueen_tpu.sim import simulate_batch
+from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
+
+
+def _component(n=4, T=60.0, q=1.0):
+    gb = GraphBuilder(n_sinks=n, end_time=T)
+    opt = gb.add_opt(q=q)
+    for i in range(n):
+        gb.add_poisson(rate=1.0, sinks=[i])
+    cfg, params, adj = gb.build(capacity=1024)
+    return cfg, params, adj, opt, T
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+
+
+@pytest.mark.parametrize("mesh_axes", [{"data": 1}, {"data": 8}])
+def test_sharded_matches_unsharded_bitwise(mesh_axes):
+    cfg, p0, a0, opt, T = _component()
+    B = 16
+    params, adj = stack_components([p0] * B, [a0] * B)
+    seeds = np.arange(B)
+    ref = simulate_batch(cfg, params, adj, seeds)
+    devs = jax.devices()[: int(np.prod(list(mesh_axes.values())))]
+    mesh = comm.make_mesh(mesh_axes, devices=devs)
+    log = simulate_sharded(cfg, params, adj, seeds, mesh)
+    np.testing.assert_array_equal(np.asarray(ref.times), np.asarray(log.times))
+    np.testing.assert_array_equal(np.asarray(ref.srcs), np.asarray(log.srcs))
+
+
+def test_sharded_metrics_aggregate(teardown=None):
+    cfg, p0, a0, opt, T = _component()
+    B = 8
+    params, adj = stack_components([p0] * B, [a0] * B)
+    seeds = np.arange(B)
+    mesh = comm.make_mesh({"data": 8})
+    log = simulate_sharded(cfg, params, adj, seeds, mesh)
+    adj_b = np.broadcast_to(np.asarray(a0), (B,) + np.asarray(a0).shape)
+    m = feed_metrics_batch(log.times, log.srcs, adj_b, opt, T)
+    ref = simulate_batch(cfg, params, adj, seeds)
+    mr = feed_metrics_batch(ref.times, ref.srcs, adj_b, opt, T)
+    np.testing.assert_allclose(
+        np.asarray(m.mean_time_in_top_k()),
+        np.asarray(mr.mean_time_in_top_k()), rtol=1e-6,
+    )
+    # global scalar aggregate on the sharded array (XLA inserts collectives)
+    assert np.isfinite(float(np.asarray(m.mean_time_in_top_k()).mean()))
+
+
+def test_indivisible_batch_rejected():
+    cfg, p0, a0, opt, T = _component()
+    params, adj = stack_components([p0] * 6, [a0] * 6)
+    mesh = comm.make_mesh({"data": 8})
+    with pytest.raises(ValueError, match="not divisible"):
+        simulate_sharded(cfg, params, adj, np.arange(6), mesh)
+
+
+def test_collectives_noop_outside_mesh():
+    x = np.array([1.0, 2.0])
+    np.testing.assert_array_equal(np.asarray(comm.psum(x)), x)
+    np.testing.assert_array_equal(np.asarray(comm.pmin(x)), x)
+    assert bool(np.all(np.asarray(comm.pany(np.array(True)))))
+
+
+def test_collectives_inside_shard_map():
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = comm.make_mesh({"data": 8})
+    x = np.arange(8.0)
+
+    def f(xs):
+        return comm.psum(xs.sum(), "data") * jnp.ones_like(xs)
+
+    with mesh:
+        out = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))

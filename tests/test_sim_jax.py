@@ -189,6 +189,8 @@ class TestReviewRegressions:
         t2 = np.asarray(log2.times)
         s2 = np.asarray(log2.srcs)
         new_ts = t2[s2 >= 0]
+        # extension log counts ONLY its own events (times[:n] idiom safe)
+        assert int(log2.n_events) == len(new_ts) == n2 - n1
         assert np.all(new_ts > 50.0) and np.all(new_ts <= 100.0)
         # full pass over both segments has sorted times
         t1 = np.asarray(log1.times)[np.asarray(log1.srcs) >= 0]
