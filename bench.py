@@ -97,7 +97,13 @@ def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
-                    help="small shapes for CPU smoke verification")
+                    help="small shapes for CPU smoke verification (forces "
+                         "the CPU backend; see --tpu to override)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (JAX_PLATFORMS is ignored "
+                         "by the axon plugin; this uses the config API)")
+    ap.add_argument("--tpu", action="store_true",
+                    help="keep the default (TPU) backend even with --quick")
     ap.add_argument("--broadcasters", type=int, default=None)
     ap.add_argument("--followers", type=int, default=10)
     ap.add_argument("--horizon", type=float, default=None)
@@ -117,6 +123,12 @@ def main():
         oracle_comps = 4
 
     import jax
+
+    if (args.cpu or args.quick) and not args.tpu:
+        # The axon TPU-tunnel plugin ignores JAX_PLATFORMS; the config API is
+        # the reliable switch. A killed TPU run can wedge the tunnel, so the
+        # smoke path must never touch it.
+        jax.config.update("jax_platforms", "cpu")
 
     log(f"devices: {jax.devices()}")
     log(f"graph: {B} broadcasters x {args.followers} followers "

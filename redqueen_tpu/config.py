@@ -163,8 +163,15 @@ class GraphBuilder:
 
     # ---- assembly ----
 
-    def build(self, capacity: int = 4096, dtype=jnp.float32):
-        """Returns (SimConfig, SourceParams, adjacency bool[S, F])."""
+    def build(self, capacity: int = 4096, dtype=jnp.float32,
+              rmtpp_hidden: Optional[int] = None):
+        """Returns (SimConfig, SourceParams, adjacency bool[S, F]).
+
+        ``rmtpp_hidden`` sizes the recurrent-state slot and must match the
+        hidden size of any weights later attached via models.rmtpp.attach
+        (the sim driver validates this). Default: 16 when the component has
+        an RMTPP source, else 1 — components without a neural policy must
+        not ship a dead [S, 16] slot through the hot scan carry."""
         S, F = len(self._rows), self.n_sinks
         if S == 0:
             raise ValueError("no sources added")
@@ -210,9 +217,12 @@ class GraphBuilder:
                 f"(registry has {n_kinds()} kinds) — import/register the "
                 f"policy module first (e.g. redqueen_tpu.models.rmtpp)"
             )
+        if rmtpp_hidden is None:
+            rmtpp_hidden = 16 if KIND_RMTPP in set(int(k) for k in kind) else 1
         cfg = SimConfig(
             n_sources=S, n_sinks=F, end_time=self.end_time,
             start_time=self.start_time, capacity=int(capacity),
+            rmtpp_hidden=int(rmtpp_hidden),
             present_kinds=tuple(sorted(set(int(k) for k in kind))),
             opt_rows=tuple(
                 s for s in range(S) if kind[s] == KIND_OPT

@@ -59,7 +59,10 @@ def on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid):
     S, F = adj.shape
     dtype = state.t_next.dtype
 
-    if cfg is not None and cfg.present_kinds:  # static specialization
+    # Unrolling wins for the typical one-controlled-broadcaster component;
+    # past a handful of Opt rows the serial draw/scatter chain and compile
+    # time lose to one vectorized masked reduction.
+    if cfg is not None and cfg.present_kinds and len(cfg.opt_rows) <= 4:
         t_next, bump = state.t_next, jnp.zeros((S,), bool)
         for row in cfg.opt_rows:
             affected = adj[row] & feeds_hit                  # [F]

@@ -1,0 +1,201 @@
+"""RMTPP neural-intensity broadcaster — BASELINE config 5: "Neural intensity
+lambda_theta (RMTPP) as Opt subclass — learned broadcasting policy".
+
+Model (Du et al., KDD 2016, adapted to the broadcaster seam): a GRU consumes
+the source's own inter-event times; the conditional intensity until the next
+own event is lambda(tau) = exp(v.h + b + w tau). Sampling needs NO thinning:
+the exponential-in-tau form inverts in closed form
+(ops.sampling.rmtpp_next_delta), so the policy is a cheap branch in the event
+scan. The policy registers as one more ``PolicyDef`` — the reference's
+"register an Opt subclass" extension point (SURVEY.md section 1) — with its
+recurrent state living in the ``h`` slot of the per-source state union and
+its last-own-event time reusing the ``exc_t`` slot (kinds are exclusive per
+source, so the Hawkes fields are free).
+
+Training (``nll_loss``/``fit``) maximizes sequence likelihood on observed
+posting traces (e.g. the RealData Twitter replays), with the standard
+closed-form compensator term; ``utils.checkpoint`` persists weights.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from jax import lax
+from jax import random as jr
+
+from ..ops.sampling import rmtpp_cum_hazard, rmtpp_log_intensity, rmtpp_next_delta
+from .base import KIND_RMTPP, PolicyDef, SourceUpdate, register_policy
+
+__all__ = [
+    "RMTPPCell",
+    "init_weights",
+    "attach",
+    "nll_loss",
+    "fit",
+    "sequence_nll",
+]
+
+
+def _features(tau):
+    """Inter-event-time features fed to the GRU: raw and log-compressed."""
+    return jnp.stack([tau, jnp.log1p(tau)], axis=-1)
+
+
+class RMTPPCell(nn.Module):
+    """GRU over own-event gaps + affine head (v, b, w) for the intensity."""
+
+    hidden: int
+
+    def setup(self):
+        self.gru = nn.GRUCell(features=self.hidden)
+        self.v = nn.Dense(1)
+        self.w = self.param("w", nn.initializers.constant(-0.1), ())
+
+    def __call__(self, h, tau):
+        h, _ = self.gru(h, _features(tau))
+        return h
+
+    def head(self, h):
+        """(a, w) of log lambda(tau) = a + w tau; a = v.h + b."""
+        return self.v(h)[..., 0], self.w
+
+    def step_and_head(self, h, tau):
+        """Touches every parameter — used for init."""
+        h = self(h, tau)
+        return h, self.head(h)
+
+
+def _cell(h_dim: int) -> RMTPPCell:
+    return RMTPPCell(hidden=h_dim)
+
+
+def _step_h(weights, h, tau):
+    return _cell(h.shape[-1]).apply({"params": weights}, h, tau)
+
+
+def _head(weights, h):
+    return _cell(h.shape[-1]).apply({"params": weights}, h, method=RMTPPCell.head)
+
+
+def init_weights(key, hidden: int = 16):
+    """Initialize RMTPP weights for ``SourceParams.rmtpp``."""
+    cell = _cell(hidden)
+    h0 = jnp.zeros((hidden,))
+    return cell.init(
+        key, h0, jnp.asarray(0.5), method=RMTPPCell.step_and_head
+    )["params"]
+
+
+def attach(params, weights):
+    """Attach trained weights to a built component's SourceParams (the
+    builder cannot know them: ``gb.add_rmtpp(); ...; attach(params, w)``)."""
+    return params.replace(rmtpp=weights)
+
+
+# ---- policy hooks (scan-kernel side) ----
+
+
+def _sample(weights, h, t, key, dtype):
+    a, w = _head(weights, h)
+    tau = rmtpp_next_delta(key, a, w, dtype=dtype)
+    return t + tau
+
+
+def on_init(params, state, s, t0, key):
+    if params.rmtpp is None:
+        # Traced without weights: lax.switch traces every branch, so a
+        # weightless component that merely COMPILES alongside RMTPP becomes
+        # a never-firing source here; actual RMTPP rows without weights are
+        # rejected host-side by the sim driver.
+        return SourceUpdate(
+            t_next=jnp.asarray(jnp.inf, state.t_next.dtype), exc=state.exc[s],
+            exc_t=t0, rd_ptr=state.rd_ptr[s], h=state.h[s],
+        )
+    h = state.h[s]  # zeros at init
+    return SourceUpdate(
+        t_next=_sample(params.rmtpp, h, t0, key, state.t_next.dtype),
+        exc=state.exc[s], exc_t=t0, rd_ptr=state.rd_ptr[s], h=h,
+    )
+
+
+def on_fire(params, state, s, t, key):
+    if params.rmtpp is None:
+        return SourceUpdate(
+            t_next=jnp.asarray(jnp.inf, state.t_next.dtype), exc=state.exc[s],
+            exc_t=t, rd_ptr=state.rd_ptr[s], h=state.h[s],
+        )
+    tau = t - state.exc_t[s]  # exc_t slot = last own event time for RMTPP
+    h = _step_h(params.rmtpp, state.h[s], tau)
+    return SourceUpdate(
+        t_next=_sample(params.rmtpp, h, t, key, state.t_next.dtype),
+        exc=state.exc[s], exc_t=t, rd_ptr=state.rd_ptr[s], h=h,
+    )
+
+
+RMTPP = register_policy(
+    PolicyDef(kind=KIND_RMTPP, name="rmtpp", on_init=on_init, on_fire=on_fire)
+)
+
+
+# ---- training (sequence likelihood on observed posting traces) ----
+
+
+def sequence_nll(weights, taus, mask, hidden: int):
+    """NLL of one padded gap sequence ``taus`` [L] with validity ``mask``.
+
+    Event k contributes -log lambda(tau_k | h_{k-1}) + Lambda(tau_k | h_{k-1});
+    the GRU then absorbs tau_k. Padding contributes exactly 0.
+    """
+    h0 = jnp.zeros((hidden,), taus.dtype)
+
+    def step(h, inp):
+        tau, m = inp
+        a, w = _head(weights, h)
+        ll = rmtpp_log_intensity(a, w, tau) - rmtpp_cum_hazard(a, w, tau)
+        h_new = _step_h(weights, h, tau)
+        h = jnp.where(m, h_new, h)
+        return h, jnp.where(m, ll, 0.0)
+
+    _, lls = lax.scan(step, h0, (taus, mask))
+    return -lls.sum()
+
+
+def nll_loss(weights, taus, mask, hidden: int):
+    """Mean NLL over a batch of padded sequences [B, L]."""
+    per = jax.vmap(lambda t, m: sequence_nll(weights, t, m, hidden))(taus, mask)
+    return per.mean()
+
+
+def fit(key, taus, mask, hidden: int = 16, steps: int = 300,
+        lr: float = 1e-2, weights=None, opt_state=None,
+        optimizer: Optional[optax.GradientTransformation] = None):
+    """Fit RMTPP weights to observed gap sequences (full-batch Adam).
+
+    Returns (weights, opt_state, losses). Pass ``weights``/``opt_state`` to
+    continue training (checkpoint/resume via utils.checkpoint).
+    """
+    taus = jnp.asarray(taus)
+    mask = jnp.asarray(mask, bool)
+    optimizer = optax.adam(lr) if optimizer is None else optimizer
+    if weights is None:
+        weights = init_weights(key, hidden)
+    if opt_state is None:
+        opt_state = optimizer.init(weights)
+
+    @jax.jit
+    def train_step(weights, opt_state):
+        loss, grads = jax.value_and_grad(nll_loss)(weights, taus, mask, hidden)
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return optax.apply_updates(weights, updates), opt_state, loss
+
+    losses = []
+    for _ in range(steps):
+        weights, opt_state, loss = train_step(weights, opt_state)
+        losses.append(float(loss))
+    return weights, opt_state, np.asarray(losses)

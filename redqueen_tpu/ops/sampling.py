@@ -21,6 +21,9 @@ __all__ = [
     "hawkes_intensity",
     "hawkes_next_time",
     "piecewise_next_time",
+    "rmtpp_next_delta",
+    "rmtpp_log_intensity",
+    "rmtpp_cum_hazard",
 ]
 
 
@@ -107,3 +110,40 @@ def piecewise_next_time(key, t_from, change_times, rates):
     rate_k = rates[k_safe]
     t_hit = lo[k_safe] + jnp.where(rate_k > 0, remaining / rate_k, jnp.inf)
     return jnp.where(k < rates.shape[0], t_hit, jnp.inf).astype(dtype)
+
+
+def rmtpp_log_intensity(a, w, tau):
+    """RMTPP conditional intensity (Du et al. 2016, the neural policy of
+    BASELINE config 5): log lambda(tau) = a + w * tau, with a = v.h + b the
+    history embedding and tau the time since the source's last own event."""
+    return a + w * tau
+
+
+def rmtpp_cum_hazard(a, w, tau):
+    """Integral of exp(a + w u) du over [0, tau]: exp(a) * expm1(w tau) / w,
+    with the w -> 0 limit exp(a) * tau handled stably."""
+    small = jnp.abs(w) < 1e-6
+    w_safe = jnp.where(small, 1.0, w)
+    return jnp.exp(a) * jnp.where(
+        small, tau, jnp.expm1(w * tau) / w_safe
+    )
+
+
+def rmtpp_next_delta(key, a, w, dtype=None):
+    """Exact inverse-CDF sample of the next inter-event time for the RMTPP
+    intensity exp(a + w tau). No thinning loop: Lambda(tau) = E with
+    E ~ Exp(1) inverts in closed form, tau = log1p(w E exp(-a)) / w. When
+    w < 0 the total hazard is finite (exp(a)/(-w)); draws beyond it mean the
+    process never fires again (+inf)."""
+    if dtype is None:
+        dtype = jnp.result_type(a, jnp.float32)
+    e = jr.exponential(key, dtype=dtype)
+    small = jnp.abs(w) < 1e-6
+    w_safe = jnp.where(small, 1.0, w)
+    z = w * e * jnp.exp(-a)
+    tau = jnp.where(
+        small,
+        e * jnp.exp(-a),               # w ~ 0: constant intensity exp(a)
+        jnp.where(z > -1.0, jnp.log1p(z) / w_safe, jnp.inf),
+    )
+    return tau.astype(dtype)

@@ -98,6 +98,47 @@ def _as_key(seed: Union[int, jnp.ndarray]):
     return seed
 
 
+def _check_kinds(cfg: SimConfig, params: SourceParams):
+    """A specialized config compiles switch branches only for
+    cfg.present_kinds; a params row of any other kind would be silently
+    clamped onto branch 0 by the local-code gather. Reject host-side."""
+    if not cfg.present_kinds:
+        return
+    present = set(cfg.present_kinds)
+    got = set(int(k) for k in np.unique(np.asarray(params.kind)))
+    if not got.issubset(present):
+        raise ValueError(
+            f"params contain source kinds {sorted(got - present)} not in the "
+            f"config's present_kinds {sorted(present)} — build params and "
+            f"config from the same GraphBuilder structure"
+        )
+
+
+def _check_weights(cfg: SimConfig, params: SourceParams):
+    """RMTPP rows need attached weights (models.rmtpp.attach) whose hidden
+    size matches the config's recurrent-state slot; catch both misuses
+    host-side with clear messages instead of a never-firing source or a
+    flax shape error deep in the scan."""
+    if not np.any(np.asarray(params.kind) == base.KIND_RMTPP):
+        return
+    if params.rmtpp is None:
+        raise ValueError(
+            "component has RMTPP sources but params.rmtpp is None — attach "
+            "trained weights via redqueen_tpu.models.rmtpp.attach(params, w)"
+        )
+    w = params.rmtpp
+    try:
+        hidden = int(np.asarray(w["v"]["kernel"]).shape[-2])
+    except (KeyError, TypeError, IndexError):
+        return  # unexpected weight layout; let tracing report it
+    if hidden != cfg.rmtpp_hidden:
+        raise ValueError(
+            f"RMTPP weights have hidden={hidden} but the config was built "
+            f"with rmtpp_hidden={cfg.rmtpp_hidden}; pass "
+            f"GraphBuilder.build(rmtpp_hidden={hidden})"
+        )
+
+
 def _drive(cfg, params, adj, state, chunk, max_chunks, batched):
     times_chunks, srcs_chunks = [], []
     n_chunks = 0
@@ -131,6 +172,8 @@ def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
     Returns an ``EventLog`` (and the final ``SimState`` if
     ``return_state=True`` — the carry is resumable: pass it to
     :func:`resume` with a longer-horizon ``SimConfig`` to continue)."""
+    _check_kinds(cfg, params)
+    _check_weights(cfg, params)
     key = _as_key(seed)
     state = _init_fn(cfg, False)(params, adj, key)
     log, state = _drive(
@@ -147,6 +190,8 @@ def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
     This is the reference's embarrassingly-parallel sweep loop (SURVEY.md
     section 3.5) turned into a vmap axis: components finish at different
     event counts and simply absorb until the slowest one is done."""
+    _check_kinds(cfg, params)
+    _check_weights(cfg, params)
     seeds = jnp.asarray(seeds)
     keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
     state = _init_fn(cfg, True)(params, adj, keys)

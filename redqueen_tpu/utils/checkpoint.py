@@ -1,0 +1,64 @@
+"""Checkpoint/resume for long sweeps and learned policies (SURVEY.md
+section 5: the reference has none — runs are minutes-long and seeded — but
+the rebuild's long sweeps and RMTPP training are restartable via
+orbax-checkpoint).
+
+Three checkpointable artifacts, all plain pytrees:
+- RMTPP weights (+ optax state) from ``models.rmtpp.fit``;
+- a ``SimState`` carry (resume a long-horizon simulation with ``sim.resume``);
+- sweep results (metric pytrees accumulated across seed/q grids).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+__all__ = ["save", "restore", "latest_step"]
+
+
+def _manager(path: str) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(path),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+    )
+
+
+def save(path: str, step: int, tree: Any) -> None:
+    """Save a pytree (weights/opt state/SimState/metrics) under ``path`` at
+    ``step``. Keeps the last 3 steps."""
+    mgr = _manager(path)
+    mgr.save(step, args=ocp.args.StandardSave(tree))
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(path: str) -> Optional[int]:
+    if not os.path.isdir(path):
+        return None
+    mgr = _manager(path)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore(path: str, step: Optional[int] = None, like: Any = None):
+    """Restore the pytree saved at ``step`` (default: latest). ``like``
+    optionally provides the target structure/dtypes (required to restore
+    custom pytree nodes such as SimState)."""
+    mgr = _manager(path)
+    step = mgr.latest_step() if step is None else step
+    if step is None:
+        mgr.close()
+        raise FileNotFoundError(f"no checkpoint under {path}")
+    if like is None:
+        out = mgr.restore(step)
+    else:
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+        out = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    mgr.close()
+    return out

@@ -1,0 +1,98 @@
+"""RMTPP neural-intensity policy tests (BASELINE config 5): sampler closed
+forms, likelihood training, and integration behind the policy-dispatch seam."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import random as jr
+
+from redqueen_tpu.config import GraphBuilder, stack_components
+from redqueen_tpu.models import rmtpp
+from redqueen_tpu.ops.sampling import rmtpp_cum_hazard, rmtpp_next_delta
+from redqueen_tpu.sim import simulate, simulate_batch
+from redqueen_tpu.utils.metrics import num_posts
+
+
+class TestSampler:
+    def test_constant_intensity_limit(self):
+        # w=0: lambda = exp(a); mean gap must be exp(-a).
+        key = jr.PRNGKey(0)
+        a = jnp.log(2.0)
+        taus = jax.vmap(
+            lambda k: rmtpp_next_delta(k, a, jnp.asarray(0.0))
+        )(jr.split(key, 4000))
+        assert abs(float(taus.mean()) - 0.5) < 0.05
+
+    def test_negative_w_can_never_fire(self):
+        # Total hazard exp(a)/(-w) = 0.1: ~90% of draws exceed it -> inf.
+        key = jr.PRNGKey(1)
+        a, w = jnp.log(0.1), jnp.asarray(-1.0)
+        taus = jax.vmap(lambda k: rmtpp_next_delta(k, a, w))(jr.split(key, 2000))
+        frac_inf = float(jnp.isinf(taus).mean())
+        assert abs(frac_inf - np.exp(-0.1)) < 0.05
+
+    def test_inverse_matches_hazard(self):
+        # Lambda(tau_sampled) must be Exp(1)-distributed (mean 1).
+        key = jr.PRNGKey(2)
+        a, w = jnp.asarray(0.3), jnp.asarray(0.7)
+        taus = jax.vmap(lambda k: rmtpp_next_delta(k, a, w))(jr.split(key, 4000))
+        haz = rmtpp_cum_hazard(a, w, taus)
+        assert abs(float(haz.mean()) - 1.0) < 0.06
+
+
+class TestTraining:
+    def test_fit_learns_poisson_rate(self):
+        """Gaps from a rate-2 Poisson process: the learned model's simulated
+        event count should approach 2*T."""
+        rng = np.random.RandomState(0)
+        B, L, rate, T = 64, 64, 2.0, 30.0
+        taus = rng.exponential(1.0 / rate, (B, L))
+        mask = np.ones((B, L), bool)
+        w, _, losses = rmtpp.fit(jr.PRNGKey(3), taus, mask, hidden=8,
+                                 steps=200, lr=2e-2)
+        assert losses[-1] < losses[0]  # NLL decreased
+
+        gb = GraphBuilder(n_sinks=1, end_time=T)
+        gb.add_rmtpp()
+        cfg, params, adj = gb.build(capacity=512, rmtpp_hidden=8)
+        params = rmtpp.attach(params, w)
+        p, a = stack_components([params] * 16, [adj] * 16)
+        log = simulate_batch(cfg, p, a, np.arange(16))
+        mean_events = float(np.asarray(log.n_events).mean())
+        assert abs(mean_events - rate * T) < 0.25 * rate * T, mean_events
+
+    def test_fit_resumes_from_checkpointed_state(self):
+        rng = np.random.RandomState(1)
+        taus = rng.exponential(0.5, (16, 32))
+        mask = np.ones((16, 32), bool)
+        w1, opt1, l1 = rmtpp.fit(jr.PRNGKey(4), taus, mask, hidden=8, steps=50)
+        w2, _, l2 = rmtpp.fit(jr.PRNGKey(4), taus, mask, hidden=8, steps=50,
+                              weights=w1, opt_state=opt1)
+        assert l2[-1] <= l1[0]
+
+
+class TestSeamIntegration:
+    def test_rmtpp_as_broadcaster_among_walls(self):
+        """The learned policy drops into the same component structure as any
+        Broadcaster subclass (the north-star seam)."""
+        w = rmtpp.init_weights(jr.PRNGKey(5), hidden=8)
+        gb = GraphBuilder(n_sinks=3, end_time=20.0)
+        src = gb.add_rmtpp()
+        for i in range(3):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        cfg, params, adj = gb.build(capacity=512, rmtpp_hidden=8)
+        params = rmtpp.attach(params, w)
+        log = simulate(cfg, params, adj, seed=0)
+        assert int(log.n_events) > 0
+        # both the neural broadcaster and the walls fired
+        srcs = np.asarray(log.srcs)
+        assert int(num_posts(log.srcs, src)) > 0
+        assert (srcs > 0).sum() > 0
+
+    def test_missing_weights_clear_error(self):
+        gb = GraphBuilder(n_sinks=1, end_time=5.0)
+        gb.add_rmtpp()
+        cfg, params, adj = gb.build(capacity=32)
+        with pytest.raises(ValueError, match="rmtpp"):
+            simulate(cfg, params, adj, seed=0)

@@ -39,7 +39,7 @@ def test_sharded_matches_unsharded_bitwise(mesh_axes):
     np.testing.assert_array_equal(np.asarray(ref.srcs), np.asarray(log.srcs))
 
 
-def test_sharded_metrics_aggregate(teardown=None):
+def test_sharded_metrics_aggregate():
     cfg, p0, a0, opt, T = _component()
     B = 8
     params, adj = stack_components([p0] * B, [a0] * B)
@@ -76,8 +76,6 @@ def test_collectives_noop_outside_mesh():
 def test_collectives_inside_shard_map():
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
-
     mesh = comm.make_mesh({"data": 8})
     x = np.arange(8.0)
 
@@ -85,5 +83,7 @@ def test_collectives_inside_shard_map():
         return comm.psum(xs.sum(), "data") * jnp.ones_like(xs)
 
     with mesh:
-        out = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"))(x)
+        out = jax.shard_map(
+            f, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+        )(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))

@@ -166,7 +166,8 @@ class TestReviewRegressions:
 
     def test_unregistered_kind_rejected_at_build(self):
         gb = GraphBuilder(n_sinks=1, end_time=10.0)
-        gb.add_rmtpp()
+        gb.add_poisson(rate=1.0)
+        gb._rows[0]["kind"] = 59  # a kind no policy module registers
         with pytest.raises(ValueError, match="no registered policy"):
             gb.build()
 
@@ -269,3 +270,33 @@ class TestOracleParity:
         assert abs(jt.mean() - ot.mean()) < 4 * max(se, 1e-9), (
             f"jax {jt.mean():.3f} vs oracle {ot.mean():.3f} (se {se:.3f})"
         )
+
+
+class TestKindGuards:
+    def test_kind_outside_present_kinds_rejected(self):
+        """A specialized config must reject params rows of foreign kinds
+        instead of silently clamping them onto branch 0."""
+        gb1 = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb1.add_poisson(rate=1.0)
+        cfg1, p1, a1 = gb1.build(capacity=32)
+        gb2 = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb2.add_hawkes(l0=1.0, alpha=0.2, beta=1.0)
+        cfg2, p2, a2 = gb2.build(capacity=32)
+        with pytest.raises(ValueError, match="present_kinds"):
+            simulate(cfg1, p2, a2, seed=0)
+
+    def test_many_opt_rows_use_vectorized_react(self):
+        """>4 competing Opt broadcasters share feeds: the vectorized react
+        fallback must still produce a working simulation."""
+        n_opt, F, T = 6, 3, 30.0
+        gb = GraphBuilder(n_sinks=F, end_time=T)
+        for _ in range(n_opt):
+            gb.add_opt(q=0.5)
+        for i in range(F):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        cfg, params, adj = gb.build(capacity=2048)
+        assert len(cfg.opt_rows) == n_opt
+        log = simulate(cfg, params, adj, seed=0)
+        srcs = np.asarray(log.srcs)
+        fired_opts = {int(s) for s in srcs[srcs >= 0] if s < n_opt}
+        assert len(fired_opts) == n_opt  # every competing broadcaster posted
