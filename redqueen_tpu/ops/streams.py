@@ -1,0 +1,155 @@
+"""Whole-stream point-process samplers: all events of one source to a horizon.
+
+The batch kernel (ops.scan_core) interleaves sources event-by-event because
+policies there may react to each other. The big-F path
+(redqueen_tpu.parallel.bigf) exploits the converse fact: the reference's wall
+broadcasters — Poisson, Hawkes, PiecewiseConst, RealData (SURVEY.md section 2
+items 4–7, reference redqueen/opt_model.py) — never react to other sources,
+so each source's FULL event stream over [t0, T] can be sampled independently
+and in parallel. These samplers return a fixed-capacity, +inf-padded times
+vector plus the valid count; they are pure, jit/vmap-safe, and reuse the
+per-draw primitives in ops.sampling so the two kernels cannot drift apart
+distributionally.
+
+Overflow is detected, never silent (SURVEY.md section 7 hard parts): each
+sampler also returns ``truncated`` — True iff the buffer filled while events
+before the horizon remained.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+from jax import random as jr
+
+from .sampling import hawkes_next_time, piecewise_next_time, rmtpp_next_delta
+
+__all__ = [
+    "Stream",
+    "poisson_stream",
+    "hawkes_stream",
+    "piecewise_stream",
+    "realdata_stream",
+    "rmtpp_stream",
+]
+
+
+class Stream(NamedTuple):
+    """One source's events on [t0, T]: ``times`` [cap] ascending, +inf-padded;
+    ``n`` valid events; ``truncated`` True iff capacity cut the stream."""
+
+    times: jnp.ndarray
+    n: jnp.ndarray
+    truncated: jnp.ndarray
+
+
+def _finish(times, t0, T, dtype):
+    times = jnp.asarray(times, dtype)
+    valid = (times > t0) & (times <= T)
+    times = jnp.where(valid, times, jnp.inf)
+    times = jnp.sort(times)
+    n = valid.sum()
+    return times, n
+
+
+def poisson_stream(key, rate, t0, T, cap: int) -> Stream:
+    """Constant-rate Poisson events on (t0, T] (reference: ``Poisson`` /
+    ``Poisson2`` — the precomputed-block and incremental variants are
+    distributionally identical, SURVEY.md section 2 item 4): cumulative sum
+    of exponential gaps, one batched draw. A probe draw beyond the buffer
+    makes the truncation flag exact: True iff event cap+1 lands in-window."""
+    dtype = jnp.result_type(rate, jnp.float32)
+    gaps = jr.exponential(key, (cap + 1,), dtype)
+    rate = jnp.asarray(rate, dtype)
+    safe = jnp.where(rate > 0, rate, 1.0)
+    times_all = t0 + jnp.where(rate > 0, jnp.cumsum(gaps) / safe, jnp.inf)
+    times, n = _finish(times_all[:cap], t0, T, dtype)
+    truncated = (rate > 0) & (times_all[cap] <= T)
+    return Stream(times, n, truncated)
+
+
+def hawkes_stream(key, l0, alpha, beta, t0, T, cap: int) -> Stream:
+    """Exponential-kernel Hawkes events on (t0, T] (reference: ``Hawkes``,
+    Ogata thinning per event — SURVEY.md section 3.3), as a scan over cap
+    slots carrying (t, excitation)."""
+    dtype = jnp.result_type(l0, jnp.float32)
+
+    def step(carry, i):
+        t, exc, exc_t = carry
+        k = jr.fold_in(key, i)
+        t_new = hawkes_next_time(k, t, l0, alpha, beta, exc, exc_t, T)
+        fired = jnp.isfinite(t_new)
+        exc = jnp.where(
+            fired, exc * jnp.exp(-beta * (jnp.where(fired, t_new, t) - exc_t))
+            + alpha, exc
+        )
+        exc_t = jnp.where(fired, t_new, exc_t)
+        t = jnp.where(fired, t_new, jnp.inf)
+        return (t, exc, exc_t), t_new
+
+    init = (jnp.asarray(t0, dtype), jnp.asarray(0.0, dtype),
+            jnp.asarray(t0, dtype))
+    # One probe slot past the buffer makes truncation exact: the stream was
+    # cut iff an in-horizon event cap+1 exists.
+    _, times_all = lax.scan(step, init, jnp.arange(cap + 1))
+    times, n = _finish(times_all[:cap], t0, T, dtype)
+    truncated = jnp.isfinite(times_all[cap])
+    return Stream(times, n, truncated)
+
+
+def piecewise_stream(key, change_times, rates, t0, T, cap: int) -> Stream:
+    """Inhomogeneous-Poisson events on (t0, T] for a piecewise-constant rate
+    (reference: ``PiecewiseConst``), one exact-inversion draw per slot."""
+    dtype = jnp.result_type(change_times, jnp.float32)
+
+    def step(t, i):
+        k = jr.fold_in(key, i)
+        t_new = jnp.where(
+            jnp.isfinite(t),
+            piecewise_next_time(k, t, change_times, rates), jnp.inf,
+        )
+        # Absorb once past the horizon — later events can't matter.
+        return jnp.where(t_new > T, jnp.inf, t_new), t_new
+
+    _, times_all = lax.scan(
+        step, jnp.asarray(t0, dtype), jnp.arange(cap + 1)
+    )
+    times, n = _finish(times_all[:cap], t0, T, dtype)
+    truncated = times_all[cap] <= T
+    return Stream(times, n, truncated)
+
+
+def realdata_stream(times, t0, T) -> Stream:
+    """Replay of recorded timestamps clipped to (t0, T] (reference:
+    ``RealData``; ``times`` is the +inf-padded [cap] replay row)."""
+    dtype = jnp.result_type(times, jnp.float32)
+    times, n = _finish(times, t0, T, dtype)
+    return Stream(times, n, jnp.asarray(False))
+
+
+def rmtpp_stream(weights, key, t0, T, cap: int, hidden: int) -> Stream:
+    """Self-history-only RMTPP events on (t0, T] (BASELINE config 5 policy):
+    the learned intensity depends only on the source's own past, so the whole
+    stream samples independently — scan carrying (t, h)."""
+    from ..models.rmtpp import _head, _step_h  # local import: avoids cycle
+
+    dtype = jnp.float32
+
+    def step(carry, i):
+        t, h = carry
+        k = jr.fold_in(key, i)
+        a, w = _head(weights, h)
+        tau = rmtpp_next_delta(k, a, w, dtype=dtype)
+        t_new = t + tau
+        fired = jnp.isfinite(t_new) & (t_new <= T)
+        h = jnp.where(fired, _step_h(weights, h, tau), h)
+        t = jnp.where(fired, t_new, jnp.inf)
+        return (t, h), t_new
+
+    init = (jnp.asarray(t0, dtype), jnp.zeros((hidden,), dtype))
+    _, times_all = lax.scan(step, init, jnp.arange(cap + 1))
+    times, n = _finish(times_all[:cap], t0, T, dtype)
+    truncated = times_all[cap] <= T
+    return Stream(times, n, truncated)

@@ -4,3 +4,10 @@ sharding (shard), and the follower-sharded big-F kernel (bigf)."""
 
 from .comm import make_mesh, psum, pmin, pmax, pany, shard_leading, replicate  # noqa: F401
 from .shard import simulate_sharded  # noqa: F401
+from .bigf import (  # noqa: F401
+    StarBuilder,
+    StarConfig,
+    StarResult,
+    simulate_star,
+    star_to_dataframe,
+)

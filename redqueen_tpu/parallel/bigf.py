@@ -1,24 +1,653 @@
-"""Follower-sharded execution for single-broadcaster / huge-F components
-(BASELINE configs 2 and 4: 1 broadcaster against 1k Hawkes / 100k replay
-feeds) — the ``feed`` mesh axis of redqueen_tpu.parallel.comm.
+"""Follower-sharded simulation of star components: ONE controlled broadcaster
+against a huge follower set (BASELINE configs 2 and 4: 1 broadcaster vs 1k
+Hawkes feeds / 100k replay feeds) — the ``feed`` mesh axis of
+redqueen_tpu.parallel.comm.
 
-Design (implemented incrementally; see simulate_bigf below for what is live):
-the component's followers and their dedicated wall sources shard over the
-``feed`` axis via ``shard_map``; each device scans its local feeds' wall
-events independently, and the one cross-device coupling — the controlled
-broadcaster's superposition clock, the min over all followers' candidate
-clocks — rides ``pmin`` over the ICI mesh axis, exactly the "lax.psum for
-the global rank-in-feed reduction" of the BASELINE north star.
+The batch kernel (ops.scan_core) replays the reference's global event loop
+(reference ``Manager.run_till``, SURVEY.md section 3.1) one event per scan
+step; at F = 100k followers that loop is hopeless (~F * rate * T sequential
+steps). This module uses a TPU-first reformulation that deletes the loop
+entirely, exact by construction:
+
+1. Wall sources never react to anything (SURVEY.md section 2 items 4-7), so
+   every feed's wall stream samples INDEPENDENTLY — ``vmap`` over feeds,
+   sharded over the ``feed`` mesh axis (ops.streams).
+2. The RedQueen policy's superposition clocks (reference ``Opt``, paper
+   Algorithm 1): each wall event m at time t_m in feed f spawns one clock
+   c_m = t_m + Exp(sqrt(s_f / q)), alive until the broadcaster's next post.
+   Because every clock satisfies c_m > t_m, the k-th own post is simply
+
+       fire_{k+1} = min{ c_m : t_m > fire_k },
+
+   a suffix-minimum query over candidates ordered by wall time. So: draw ONE
+   exponential per wall event (exactly the reference's draw count), sort
+   locally by t_m, take a reverse running min, and the whole posting
+   trajectory is a tiny ``lax.scan`` of searchsorted lookups whose only
+   cross-device traffic is a scalar ``pmin`` over the ICI mesh axis per own
+   post — the BASELINE north star's "global rank-in-feed reduction".
+3. Feed-rank metrics (reference ``utils.py``) come from a per-feed
+   merge-scan of (wall events, own posts), again vmapped and sharded; means
+   reduce with ``psum``.
+
+Controlled policies other than Opt (Poisson / PiecewiseConst / RealData
+replay / RMTPP) depend only on their own history, so their posting stream
+samples directly (ops.streams) and steps 2 is skipped — this covers the
+reference's ``create_manager_with_poisson / _with_times / _with_piecewise_
+const`` factory surface at big F.
+
+Overflow (wall buffers or post buffer) is detected and raised, never silent.
 """
 
 from __future__ import annotations
 
-__all__ = ["simulate_bigf"]
+import dataclasses
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax import lax
+from jax import random as jr
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.base import (
+    KIND_HAWKES,
+    KIND_OPT,
+    KIND_PIECEWISE,
+    KIND_POISSON,
+    KIND_REALDATA,
+    KIND_RMTPP,
+)
+from ..ops import streams
+from ..utils.metrics import FeedMetrics
+from . import comm
+
+__all__ = [
+    "StarConfig",
+    "WallParams",
+    "CtrlParams",
+    "StarBuilder",
+    "StarResult",
+    "simulate_star",
+    "star_to_dataframe",
+]
+
+_EMPTY = -1  # wall-slot kind code for "no source in this slot"
 
 
-def simulate_bigf(*args, **kwargs):
-    raise NotImplementedError(
-        "follower-sharded big-F kernel lands after the batch path; use "
-        "parallel.shard.simulate_sharded (component-batch axis) or a "
-        "single-device component meanwhile"
+@dataclasses.dataclass(frozen=True)
+class StarConfig:
+    """Static shape of a star component (hashable, jit-static)."""
+
+    n_feeds: int
+    walls_per_feed: int
+    end_time: float
+    start_time: float = 0.0
+    wall_cap: int = 256    # events per wall source
+    post_cap: int = 1024   # controlled-broadcaster posts
+    ctrl_kind: int = KIND_OPT
+    rmtpp_hidden: int = 1
+    wall_kinds: tuple = ()  # kinds present in wall slots (branch pruning)
+
+
+class WallParams(struct.PyTreeNode):
+    """Wall-source parameters, [F, M] grids (feed-sharded leaves; slot kind
+    ``_EMPTY`` marks unused slots)."""
+
+    kind: jnp.ndarray       # i32[F, M]
+    rate: jnp.ndarray       # f[F, M]
+    l0: jnp.ndarray         # f[F, M]
+    alpha: jnp.ndarray      # f[F, M]
+    beta: jnp.ndarray       # f[F, M]
+    pw_times: jnp.ndarray   # f[F, M, Kp]
+    pw_rates: jnp.ndarray   # f[F, M, Kp]
+    rd_times: jnp.ndarray   # f[F, M, Kr]
+    s_sink: jnp.ndarray     # f[F] follower significance
+
+
+class CtrlParams(struct.PyTreeNode):
+    """Controlled-broadcaster parameters (replicated scalars/rows)."""
+
+    q: jnp.ndarray          # f[] Opt posting cost
+    rate: jnp.ndarray       # f[] Poisson rate
+    pw_times: jnp.ndarray   # f[Kp] piecewise knots
+    pw_rates: jnp.ndarray   # f[Kp]
+    rd_times: jnp.ndarray   # f[Kr] replay timestamps
+    rmtpp: Optional[dict] = None
+
+
+class StarResult(NamedTuple):
+    """Host-side result of one star simulation.
+
+    ``own_times`` [post_cap] ascending +inf-padded; ``wall_times`` [F, M*cap]
+    per-feed merged ascending +inf-padded; ``wall_n`` [F] valid wall events
+    per feed; ``metrics`` per-feed FeedMetrics over [start, T]."""
+
+    own_times: np.ndarray
+    n_posts: int
+    wall_times: np.ndarray
+    wall_n: np.ndarray
+    metrics: FeedMetrics
+    cfg: StarConfig
+
+
+# --------------------------------------------------------------------------
+# kernel
+# --------------------------------------------------------------------------
+
+
+def _wall_branches(cfg: StarConfig):
+    """(codes, branch fns) for the wall-slot lax.switch, pruned to the kinds
+    present (cfg.wall_kinds; empty tuple = all supported)."""
+    t0, T, cap = cfg.start_time, cfg.end_time, cfg.wall_cap
+
+    def b_empty(p, m, key):
+        return streams.Stream(
+            jnp.full((cap,), jnp.inf, jnp.float32),
+            jnp.zeros((), jnp.int32), jnp.zeros((), bool),
+        )
+
+    def b_poisson(p, m, key):
+        return streams.poisson_stream(key, p.rate[m], t0, T, cap)
+
+    def b_hawkes(p, m, key):
+        return streams.hawkes_stream(
+            key, p.l0[m], p.alpha[m], p.beta[m], t0, T, cap
+        )
+
+    def b_piecewise(p, m, key):
+        return streams.piecewise_stream(
+            key, p.pw_times[m], p.pw_rates[m], t0, T, cap
+        )
+
+    def b_realdata(p, m, key):
+        row = p.rd_times[m]
+        Kr = row.shape[0]
+        if Kr < cap:
+            row = jnp.concatenate(
+                [row, jnp.full((cap - Kr,), jnp.inf, row.dtype)]
+            )
+        s = streams.realdata_stream(row, t0, T)
+        if Kr <= cap:
+            return s
+        # replay longer than the buffer: keep the first cap in-window events,
+        # flag truncation if any were dropped.
+        n_all = s.n
+        return streams.Stream(
+            s.times[:cap], jnp.minimum(n_all, cap), n_all > cap
+        )
+
+    table = {
+        _EMPTY: b_empty,
+        KIND_POISSON: b_poisson,
+        KIND_HAWKES: b_hawkes,
+        KIND_PIECEWISE: b_piecewise,
+        KIND_REALDATA: b_realdata,
+    }
+    codes = sorted(cfg.wall_kinds) if cfg.wall_kinds else sorted(table)
+    for c in codes:
+        if c not in table:
+            raise ValueError(f"unsupported wall-source kind {c}")
+    return codes, [table[c] for c in codes]
+
+
+def _ctrl_stream(cfg: StarConfig, ctrl: CtrlParams, key):
+    """Posting stream of a non-Opt controlled broadcaster (static dispatch on
+    cfg.ctrl_kind — the reference's per-policy manager factories)."""
+    t0, T, K = cfg.start_time, cfg.end_time, cfg.post_cap
+    k = cfg.ctrl_kind
+    if k == KIND_POISSON:
+        return streams.poisson_stream(key, ctrl.rate, t0, T, K)
+    if k == KIND_PIECEWISE:
+        return streams.piecewise_stream(key, ctrl.pw_times, ctrl.pw_rates,
+                                        t0, T, K)
+    if k == KIND_REALDATA:
+        return streams.realdata_stream(ctrl.rd_times, t0, T)
+    if k == KIND_RMTPP:
+        if ctrl.rmtpp is None:
+            raise ValueError("ctrl_kind=RMTPP requires CtrlParams.rmtpp weights")
+        return streams.rmtpp_stream(ctrl.rmtpp, key, t0, T, K,
+                                    cfg.rmtpp_hidden)
+    raise ValueError(f"unsupported ctrl_kind {k}")
+
+
+def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset):
+    """RedQueen posting times via the sorted suffix-min formulation.
+
+    ``feed_times`` [F_local, E] ascending wall events per feed; ``rate_f``
+    [F_local] = sqrt(s_f / q). Returns (own_times [post_cap], truncated)."""
+    Fl, E = feed_times.shape
+    dtype = feed_times.dtype
+
+    # One Exp clock per wall event — the reference's exact draw count, keyed
+    # by GLOBAL feed index so mesh layout cannot change the streams.
+    def feed_draws(f_global):
+        return jr.exponential(jr.fold_in(key_tau, f_global), (E,), dtype)
+
+    draws = jax.vmap(feed_draws)(feed_offset + jnp.arange(Fl))
+    cand = feed_times + draws / jnp.maximum(rate_f[:, None], 1e-30)
+    cand = jnp.where(rate_f[:, None] > 0, cand, jnp.inf)
+
+    t_flat = feed_times.reshape(-1)
+    order = jnp.argsort(t_flat)
+    t_sorted = t_flat[order]
+    c_sorted = cand.reshape(-1)[order]
+    # suffix_min[i] = min candidate among wall events with index >= i.
+    suffix = jnp.flip(lax.cummin(jnp.flip(c_sorted)))
+    suffix = jnp.concatenate([suffix, jnp.full((1,), jnp.inf, dtype)])
+
+    def fire(t_last, _):
+        idx = jnp.searchsorted(t_sorted, t_last, side="right")
+        t_next = comm.pmin(suffix[idx], "feed")
+        t_next = jnp.where(t_next <= cfg.end_time, t_next, jnp.inf)
+        return t_next, t_next
+
+    t0 = jnp.asarray(cfg.start_time, dtype)
+    t_last, own = lax.scan(fire, t0, None, length=cfg.post_cap)
+    # Overflow: a further post would still fit before the horizon.
+    idx = jnp.searchsorted(t_sorted, t_last, side="right")
+    more = comm.pmin(suffix[idx], "feed") <= cfg.end_time
+    truncated = jnp.isfinite(t_last) & more
+    return own, truncated
+
+
+def _feed_metrics_star(cfg: StarConfig, feed_times, own_times, K: int):
+    """Per-feed rank integrals via a two-pointer merge-scan over (wall
+    events, own posts) — the reference's ``utils.py`` integrals (SURVEY.md
+    section 2 items 11-14) without materializing a global event log.
+
+    Tie rule: an own post at exactly a wall-event time applies FIRST (the
+    oracle's Manager pops the lowest source index — the controlled
+    broadcaster is row 0)."""
+    Fl, E = feed_times.shape
+    Kp = own_times.shape[0]
+    dtype = feed_times.dtype
+    start = jnp.asarray(cfg.start_time, dtype)
+    end = jnp.asarray(cfg.end_time, dtype)
+    own_ext = jnp.concatenate([own_times, jnp.full((1,), jnp.inf, dtype)])
+
+    def one_feed(times_row):
+        row_ext = jnp.concatenate([times_row, jnp.full((1,), jnp.inf, dtype)])
+
+        def step(carry, _):
+            i, j, r, t_prev, top, ir, ir2 = carry
+            t_w, t_o = row_ext[i], own_ext[j]
+            own_first = t_o <= t_w
+            t = jnp.minimum(t_w, t_o)
+            valid = jnp.isfinite(t)
+            t_clip = jnp.clip(jnp.where(valid, t, t_prev), start, end)
+            dt = jnp.maximum(t_clip - t_prev, 0)
+            rf = r.astype(dtype)
+            top2 = top + dt * (r < K)
+            ir_2 = ir + dt * rf
+            ir2_2 = ir2 + dt * rf * rf
+            r_new = jnp.where(own_first, 0, r + 1)
+            return (
+                jnp.where(valid & ~own_first, i + 1, i),
+                jnp.where(valid & own_first, j + 1, j),
+                jnp.where(valid, r_new, r),
+                jnp.maximum(t_prev, t_clip),
+                top2, ir_2, ir2_2,
+            ), None
+
+        zero = jnp.asarray(0.0, dtype)
+        init = (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                jnp.zeros((), jnp.int32), start, zero, zero, zero)
+        (i, j, r, t_prev, top, ir, ir2), _ = lax.scan(
+            step, init, None, length=E + Kp
+        )
+        dt = jnp.maximum(end - t_prev, 0)
+        rf = r.astype(dtype)
+        return top + dt * (r < K), ir + dt * rf, ir2 + dt * rf * rf
+
+    top, ir, ir2 = jax.vmap(one_feed)(feed_times)
+    return FeedMetrics(
+        time_in_top_k=top, int_rank=ir, int_rank2=ir2,
+        follows=jnp.ones((Fl,), bool), start_time=start, end_time=end,
+    )
+
+
+def _make_kernel(cfg: StarConfig, metric_K: int):
+    codes, branches = _wall_branches(cfg)
+    lookup = np.full(max(codes) + 2, 0, np.int32)  # +1 shift for _EMPTY
+    for i, c in enumerate(codes):
+        lookup[c + 1] = i
+    lookup = jnp.asarray(lookup)
+
+    def kernel(wall: WallParams, ctrl: CtrlParams, key):
+        Fl, M = wall.kind.shape
+        feed_offset = (
+            lax.axis_index("feed") * Fl if comm.axis_present("feed") else 0
+        )
+
+        # 1) independent wall streams, vmapped over the [F_local, M] grid.
+        key_wall = jr.fold_in(key, 101)
+        key_tau = jr.fold_in(key, 202)
+        key_own = jr.fold_in(key, 303)
+
+        def one_slot(p_row, f_global, m):
+            k = jr.fold_in(key_wall, f_global * M + m)
+            return lax.switch(
+                lookup[p_row.kind[m] + 1], branches, p_row, m, k
+            )
+
+        def one_feed(p_row, f_global):
+            return jax.vmap(one_slot, (None, None, 0))(
+                p_row, f_global, jnp.arange(M)
+            )
+
+        wall_nos = WallParams(  # drop s_sink for the per-feed rows
+            kind=wall.kind, rate=wall.rate, l0=wall.l0, alpha=wall.alpha,
+            beta=wall.beta, pw_times=wall.pw_times, pw_rates=wall.pw_rates,
+            rd_times=wall.rd_times, s_sink=jnp.zeros((Fl,)),
+        )
+        per_feed_rows = jax.tree.map(
+            lambda x: x if x.ndim > 1 else x[:, None], wall_nos
+        )
+        st = jax.vmap(one_feed)(per_feed_rows, feed_offset + jnp.arange(Fl))
+        # [F_local, M, cap] -> per-feed merged ascending [F_local, M*cap]
+        feed_times = jnp.sort(st.times.reshape(Fl, -1), axis=-1)
+        wall_n = st.n.sum(axis=-1)
+        wall_trunc = comm.pany(st.truncated.any(), "feed")
+
+        # 2) controlled broadcaster posting times.
+        if cfg.ctrl_kind == KIND_OPT:
+            rate_f = jnp.sqrt(wall.s_sink / jnp.maximum(ctrl.q, 1e-30))
+            own, post_trunc = _opt_fires(
+                cfg, feed_times, rate_f.astype(feed_times.dtype),
+                key_tau, feed_offset,
+            )
+        else:
+            s = _ctrl_stream(cfg, ctrl, key_own)
+            own, post_trunc = s.times, s.truncated
+        n_posts = jnp.isfinite(own).sum()
+
+        # 3) per-feed metrics + flags.
+        metrics = _feed_metrics_star(cfg, feed_times, own, metric_K)
+        return own, n_posts, feed_times, wall_n, metrics, wall_trunc, post_trunc
+
+    return kernel
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+
+_FN_CACHE: dict = {}
+
+
+def _get_fn(cfg: StarConfig, metric_K: int, mesh: Optional[Mesh], axis: str,
+            wall: WallParams, ctrl: CtrlParams):
+    """Jitted-kernel cache keyed on everything that forces a retrace
+    (StarConfig is hashable for exactly this — the sim.py convention)."""
+    cache_key = (cfg, metric_K, mesh, axis, jax.tree.structure((wall, ctrl)))
+    fn = _FN_CACHE.get(cache_key)
+    if fn is not None:
+        return fn
+    kernel = _make_kernel(cfg, metric_K)
+    if mesh is None:
+        fn = jax.jit(kernel)
+    else:
+        wall_spec = jax.tree.map(
+            lambda x: P(axis, *([None] * (jnp.asarray(x).ndim - 1))), wall
+        )
+        ctrl_spec = jax.tree.map(lambda x: P(), ctrl)
+        feedP = P(axis)
+        metrics_spec = FeedMetrics(
+            time_in_top_k=feedP, int_rank=feedP, int_rank2=feedP,
+            follows=feedP, start_time=P(), end_time=P(),
+        )
+        out_specs = (P(), P(), P(axis, None), feedP, metrics_spec, P(), P())
+        fn = jax.jit(jax.shard_map(
+            kernel, mesh=mesh, in_specs=(wall_spec, ctrl_spec, P()),
+            out_specs=out_specs, check_vma=False,
+        ))
+    _FN_CACHE[cache_key] = fn
+    return fn
+
+
+def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
+                  seed, mesh: Optional[Mesh] = None, axis: str = "feed",
+                  metric_K: int = 1) -> StarResult:
+    """Simulate one star component to its horizon.
+
+    With ``mesh``, the feed axis shards over ``mesh.shape[axis]`` devices
+    (F must divide evenly); results are bit-identical to the unsharded run
+    at matched seeds (PRNG streams key off GLOBAL feed indices). Raises on
+    wall-buffer or post-buffer overflow instead of truncating."""
+    key = jr.PRNGKey(seed) if isinstance(seed, (int, np.integer)) else seed
+    # A wall slot whose kind is outside the compiled branch set would be
+    # silently mis-dispatched by the lookup gather; reject host-side
+    # (wall.kind is concrete here — same guard as sim._check_kinds).
+    codes, _ = _wall_branches(cfg)
+    got = set(int(k) for k in np.unique(np.asarray(wall.kind)))
+    if not got.issubset(codes):
+        raise ValueError(
+            f"wall slots contain kinds {sorted(got - set(codes))} not in the "
+            f"config's wall_kinds {codes} — build wall params and config "
+            f"from the same StarBuilder"
+        )
+
+    if mesh is None:
+        out = _get_fn(cfg, metric_K, None, axis, wall, ctrl)(wall, ctrl, key)
+    else:
+        n_dev = mesh.shape[axis]
+        if cfg.n_feeds % n_dev != 0:
+            raise ValueError(
+                f"n_feeds={cfg.n_feeds} not divisible by mesh axis "
+                f"{axis}={n_dev}"
+            )
+        fn = _get_fn(cfg, metric_K, mesh, axis, wall, ctrl)
+        with mesh:
+            out = fn(comm.shard_leading(wall, mesh, axis),
+                     comm.replicate(ctrl, mesh), comm.replicate(key, mesh))
+
+    own, n_posts, feed_times, wall_n, metrics, wall_trunc, post_trunc = out
+    jax.block_until_ready(own)
+    if bool(wall_trunc):
+        raise RuntimeError(
+            f"wall stream overflow: some wall source hit wall_cap="
+            f"{cfg.wall_cap} before the horizon — raise StarConfig.wall_cap "
+            f"(refusing to truncate silently)"
+        )
+    if bool(post_trunc):
+        raise RuntimeError(
+            f"posting buffer overflow: controlled broadcaster hit post_cap="
+            f"{cfg.post_cap} before the horizon — raise StarConfig.post_cap "
+            f"(refusing to truncate silently)"
+        )
+    return StarResult(
+        own_times=np.asarray(own), n_posts=int(n_posts),
+        wall_times=np.asarray(feed_times), wall_n=np.asarray(wall_n),
+        metrics=metrics, cfg=cfg,
+    )
+
+
+class StarBuilder:
+    """Front end assembling a star component (the big-F counterpart of
+    config.GraphBuilder / the reference's ``SimOpts``). One wall slot list
+    per feed; exactly one controlled broadcaster."""
+
+    def __init__(self, n_feeds: int, end_time: float, start_time: float = 0.0,
+                 s_sink: Optional[Sequence[float]] = None):
+        self.n_feeds = int(n_feeds)
+        self.end_time = float(end_time)
+        self.start_time = float(start_time)
+        self.s_sink = (
+            np.ones(n_feeds) if s_sink is None
+            else np.asarray(s_sink, np.float64)
+        )
+        assert self.s_sink.shape == (self.n_feeds,)
+        self._walls = [[] for _ in range(self.n_feeds)]
+        self._ctrl = None
+
+    # ---- wall sources (one feed each) ----
+
+    def wall_poisson(self, feed: int, rate: float):
+        self._walls[feed].append(dict(kind=KIND_POISSON, rate=float(rate)))
+        return self
+
+    def wall_hawkes(self, feed: int, l0: float, alpha: float, beta: float):
+        self._walls[feed].append(
+            dict(kind=KIND_HAWKES, l0=float(l0), alpha=float(alpha),
+                 beta=float(beta))
+        )
+        return self
+
+    def wall_piecewise(self, feed: int, change_times, rates):
+        ct = np.asarray(change_times, np.float64)
+        r = np.asarray(rates, np.float64)
+        assert ct.shape == r.shape and np.all(np.diff(ct) > 0)
+        self._walls[feed].append(dict(kind=KIND_PIECEWISE, pw=(ct, r)))
+        return self
+
+    def wall_replay(self, feed: int, times):
+        t = np.sort(np.asarray(times, np.float64))
+        self._walls[feed].append(dict(kind=KIND_REALDATA, rd=t))
+        return self
+
+    # ---- controlled broadcaster (reference: the manager factories) ----
+
+    def ctrl_opt(self, q: float = 1.0):
+        if not q > 0:
+            raise ValueError(f"Opt requires q > 0, got q={q}")
+        self._ctrl = dict(kind=KIND_OPT, q=float(q))
+        return self
+
+    def ctrl_poisson(self, rate: float):
+        self._ctrl = dict(kind=KIND_POISSON, rate=float(rate))
+        return self
+
+    def ctrl_piecewise(self, change_times, rates):
+        ct = np.asarray(change_times, np.float64)
+        r = np.asarray(rates, np.float64)
+        assert ct.shape == r.shape and np.all(np.diff(ct) > 0)
+        self._ctrl = dict(kind=KIND_PIECEWISE, pw=(ct, r))
+        return self
+
+    def ctrl_replay(self, times):
+        self._ctrl = dict(
+            kind=KIND_REALDATA, rd=np.sort(np.asarray(times, np.float64))
+        )
+        return self
+
+    def ctrl_rmtpp(self, weights, hidden: int = 16):
+        self._ctrl = dict(kind=KIND_RMTPP, rmtpp=weights, hidden=int(hidden))
+        return self
+
+    # ---- assembly ----
+
+    def build(self, wall_cap: int = 256, post_cap: int = 1024,
+              dtype=jnp.float32):
+        if self._ctrl is None:
+            raise ValueError("no controlled broadcaster set (ctrl_* methods)")
+        F = self.n_feeds
+        M = max((len(w) for w in self._walls), default=0)
+        M = max(M, 1)
+        Kp = max(
+            [len(w["pw"][0]) for row in self._walls for w in row
+             if "pw" in w] + (
+                [len(self._ctrl["pw"][0])] if "pw" in self._ctrl else []
+            ),
+            default=1,
+        )
+        Kr = max(
+            [len(w["rd"]) for row in self._walls for w in row if "rd" in w],
+            default=1,
+        )
+        kind = np.full((F, M), _EMPTY, np.int32)
+        rate = np.ones((F, M)); l0 = np.ones((F, M))
+        alpha = np.zeros((F, M)); beta = np.ones((F, M))
+        pw_t = np.full((F, M, Kp), np.inf); pw_t[:, :, 0] = 0.0
+        pw_r = np.zeros((F, M, Kp))
+        rd_t = np.full((F, M, Kr), np.inf)
+        kinds_present = set()
+        for f, row in enumerate(self._walls):
+            for m, w in enumerate(row):
+                kind[f, m] = w["kind"]
+                kinds_present.add(int(w["kind"]))
+                if w["kind"] == KIND_POISSON:
+                    rate[f, m] = w["rate"]
+                elif w["kind"] == KIND_HAWKES:
+                    l0[f, m] = w["l0"]; alpha[f, m] = w["alpha"]
+                    beta[f, m] = w["beta"]
+                elif w["kind"] == KIND_PIECEWISE:
+                    ct, r = w["pw"]
+                    pw_t[f, m] = np.inf
+                    pw_t[f, m, : len(ct)] = ct
+                    pw_r[f, m, : len(r)] = r
+                elif w["kind"] == KIND_REALDATA:
+                    rd_t[f, m, : len(w["rd"])] = w["rd"]
+        kinds_present.add(_EMPTY)
+
+        c = self._ctrl
+        c_pw_t = np.full(Kp, np.inf); c_pw_t[0] = 0.0
+        c_pw_r = np.zeros(Kp)
+        if "pw" in c:
+            ct, r = c["pw"]
+            c_pw_t[:] = np.inf
+            c_pw_t[: len(ct)] = ct
+            c_pw_r[: len(r)] = r
+        c_rd = (
+            np.asarray(c["rd"], np.float64) if "rd" in c
+            else np.full(1, np.inf)
+        )
+        cfg = StarConfig(
+            n_feeds=F, walls_per_feed=M, end_time=self.end_time,
+            start_time=self.start_time, wall_cap=int(wall_cap),
+            post_cap=int(post_cap), ctrl_kind=int(c["kind"]),
+            rmtpp_hidden=int(c.get("hidden", 1)),
+            wall_kinds=tuple(sorted(kinds_present)),
+        )
+        wall = WallParams(
+            kind=jnp.asarray(kind),
+            rate=jnp.asarray(rate, dtype), l0=jnp.asarray(l0, dtype),
+            alpha=jnp.asarray(alpha, dtype), beta=jnp.asarray(beta, dtype),
+            pw_times=jnp.asarray(pw_t, dtype),
+            pw_rates=jnp.asarray(pw_r, dtype),
+            rd_times=jnp.asarray(rd_t, dtype),
+            s_sink=jnp.asarray(self.s_sink, dtype),
+        )
+        ctrl = CtrlParams(
+            q=jnp.asarray(c.get("q", 1.0), dtype),
+            rate=jnp.asarray(c.get("rate", 1.0), dtype),
+            pw_times=jnp.asarray(c_pw_t, dtype),
+            pw_rates=jnp.asarray(c_pw_r, dtype),
+            rd_times=jnp.asarray(c_rd, dtype),
+            rmtpp=c.get("rmtpp"),
+        )
+        return cfg, wall, ctrl
+
+
+def star_to_dataframe(res: StarResult, src_id=0, wall_src_offset: int = 100):
+    """Export a star run as the reference-schema event DataFrame (one row per
+    (event, sink); columns event_id/t/time_delta/src_id/sink_id) so the
+    backend-agnostic pandas metric layer applies unchanged — intended for
+    small-F validation, not 100k-feed exports.
+
+    Wall source ids are ``wall_src_offset + feed``; own posts land in every
+    feed. Tie order matches the oracle: own post first."""
+    import pandas as pd
+
+    F = res.cfg.n_feeds
+    own = res.own_times[np.isfinite(res.own_times)]
+    rows = []  # (t, order, src, sinks)
+    for t in own:
+        rows.append((float(t), 0, src_id, None))
+    for f in range(F):
+        for t in res.wall_times[f][: int(res.wall_n[f])]:
+            rows.append((float(t), 1, wall_src_offset + f, f))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    recs = []
+    last = {}
+    for eid, (t, _, src, sink) in enumerate(rows):
+        delta = t - last.get(src, res.cfg.start_time)
+        last[src] = t
+        sinks = range(F) if sink is None else [sink]
+        for sk in sinks:
+            recs.append((eid, t, delta, src, sk))
+    return pd.DataFrame(
+        recs, columns=["event_id", "t", "time_delta", "src_id", "sink_id"]
     )

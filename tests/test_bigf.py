@@ -1,0 +1,263 @@
+"""Tests for the follower-sharded big-F path (parallel.bigf + ops.streams):
+closed forms for the decoupled stream samplers, mesh-layout invariance at
+sizes {1, 8 fake} (SURVEY.md section 4.4), statistical parity with the NumPy
+oracle, and overflow detection."""
+
+import jax
+import numpy as np
+import pytest
+from jax import random as jr
+
+from redqueen_tpu.ops import streams
+from redqueen_tpu.oracle.numpy_ref import SimOpts
+from redqueen_tpu.parallel import comm
+from redqueen_tpu.parallel.bigf import (
+    StarBuilder,
+    simulate_star,
+    star_to_dataframe,
+)
+from redqueen_tpu.utils import metrics_pandas as mp
+
+
+class TestStreams:
+    def test_poisson_count_closed_form(self):
+        """E[#events] = rate * T (SURVEY.md section 4.2)."""
+        rate, T, n = 2.0, 50.0, 64
+        ns = jax.vmap(
+            lambda k: streams.poisson_stream(k, rate, 0.0, T, 512).n
+        )(jr.split(jr.PRNGKey(0), n))
+        mean = float(np.asarray(ns).mean())
+        tol = 4 * np.sqrt(rate * T / n)
+        assert abs(mean - rate * T) < tol
+
+    def test_hawkes_count_closed_form(self):
+        """Stationary Hawkes: E[#events] ~ l0*T/(1 - alpha/beta)."""
+        l0, alpha, beta, T, n = 1.0, 1.0, 2.0, 100.0, 48
+        ns = jax.vmap(
+            lambda k: streams.hawkes_stream(k, l0, alpha, beta, 0.0, T, 1024).n
+        )(jr.split(jr.PRNGKey(1), n))
+        mean = float(np.asarray(ns).mean())
+        expect = l0 * T / (1 - alpha / beta)
+        assert abs(mean - expect) < 0.15 * expect
+
+    def test_piecewise_counts_per_segment(self):
+        """Events per segment ~ rate_k * len_k; zero-rate tail -> none."""
+        ct = np.array([0.0, 10.0, 20.0])
+        rr = np.array([2.0, 0.0, 1.0])
+        T, n = 30.0, 64
+        all_times = jax.vmap(
+            lambda k: streams.piecewise_stream(
+                k, jnp_arr(ct), jnp_arr(rr), 0.0, T, 256
+            ).times
+        )(jr.split(jr.PRNGKey(2), n))
+        t = np.asarray(all_times)
+        seg1 = ((t > 0) & (t <= 10)).sum() / n
+        seg2 = ((t > 10) & (t <= 20)).sum() / n
+        seg3 = ((t > 20) & (t <= 30)).sum() / n
+        assert abs(seg1 - 20.0) < 4 * np.sqrt(20.0 / n)
+        assert seg2 == 0
+        assert abs(seg3 - 10.0) < 4 * np.sqrt(10.0 / n)
+
+    def test_realdata_clip_and_sort(self):
+        times = np.array([5.0, 1.0, 30.0, 12.0])
+        s = streams.realdata_stream(jnp_arr(times), 2.0, 20.0)
+        got = np.asarray(s.times)[: int(s.n)]
+        np.testing.assert_allclose(got, [5.0, 12.0])
+
+    def test_streams_ascending_and_in_window(self):
+        for s in [
+            streams.poisson_stream(jr.PRNGKey(3), 3.0, 1.0, 40.0, 256),
+            streams.hawkes_stream(jr.PRNGKey(4), 1.0, 0.5, 1.0, 1.0, 40.0, 256),
+        ]:
+            t = np.asarray(s.times)[: int(s.n)]
+            assert mp.is_sorted(t)
+            assert np.all((t > 1.0) & (t <= 40.0))
+
+    def test_truncation_flag(self):
+        s = streams.poisson_stream(jr.PRNGKey(5), 10.0, 0.0, 100.0, 16)
+        assert bool(s.truncated)
+
+
+def jnp_arr(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x, jnp.float32)
+
+
+def star_poisson(n_feeds=6, T=40.0, q=1.0, wall_rate=1.0, **kw):
+    sb = StarBuilder(n_feeds=n_feeds, end_time=T)
+    for f in range(n_feeds):
+        sb.wall_poisson(f, wall_rate)
+    sb.ctrl_opt(q=q)
+    return sb.build(**kw)
+
+
+class TestStarOpt:
+    def test_posts_increasing_within_horizon(self):
+        cfg, wall, ctrl = star_poisson()
+        res = simulate_star(cfg, wall, ctrl, seed=0)
+        own = res.own_times[np.isfinite(res.own_times)]
+        assert len(own) == res.n_posts > 0
+        assert mp.is_sorted(own) and np.all(np.diff(own) > 0)
+        assert np.all((own > 0) & (own <= cfg.end_time))
+
+    def test_mesh_layout_invariance(self):
+        """Sharded over 8 virtual devices == unsharded, bit for bit
+        (SURVEY.md section 7 PRNG discipline)."""
+        cfg, wall, ctrl = star_poisson(n_feeds=8)
+        a = simulate_star(cfg, wall, ctrl, seed=7)
+        mesh = comm.make_mesh({"feed": 8})
+        b = simulate_star(cfg, wall, ctrl, seed=7, mesh=mesh)
+        np.testing.assert_array_equal(a.own_times, b.own_times)
+        np.testing.assert_array_equal(a.wall_times, b.wall_times)
+        np.testing.assert_allclose(
+            np.asarray(a.metrics.time_in_top_k),
+            np.asarray(b.metrics.time_in_top_k), rtol=1e-6,
+        )
+
+    def test_metrics_match_pandas_on_exported_log(self):
+        """The on-device merge-scan metrics equal the backend-agnostic pandas
+        layer on the exported reference-schema DataFrame."""
+        cfg, wall, ctrl = star_poisson(n_feeds=5, T=25.0)
+        res = simulate_star(cfg, wall, ctrl, seed=3)
+        df = star_to_dataframe(res)
+        per = mp.time_in_top_k(
+            df, 1, cfg.end_time, src_id=0, per_sink=True,
+            sink_ids=range(cfg.n_feeds),
+        )
+        got = np.asarray(res.metrics.time_in_top_k)
+        want = np.array([per[f] for f in range(cfg.n_feeds)])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        ar = mp.average_rank(df, cfg.end_time, src_id=0,
+                             sink_ids=range(cfg.n_feeds))
+        got_ar = float(np.asarray(res.metrics.mean_average_rank()))
+        np.testing.assert_allclose(got_ar, ar, rtol=1e-4, atol=1e-4)
+
+    def test_quality_parity_with_oracle(self):
+        """Mean time-in-top-1 and posting budget match the NumPy oracle on
+        the same component within Monte-Carlo tolerance (the BASELINE quality
+        gate, applied to the big-F kernel)."""
+        F, T, q, rate, n_runs = 5, 60.0, 1.0, 1.0, 12
+        tops_j, posts_j = [], []
+        cfg, wall, ctrl = star_poisson(n_feeds=F, T=T, q=q, wall_rate=rate)
+        for seed in range(n_runs):
+            res = simulate_star(cfg, wall, ctrl, seed=seed)
+            tops_j.append(float(np.asarray(res.metrics.mean_time_in_top_k())))
+            posts_j.append(res.n_posts)
+        tops_o, posts_o = [], []
+        for seed in range(n_runs):
+            others = [
+                ("poisson", dict(src_id=100 + i, seed=5000 + 97 * seed + i,
+                                 rate=rate, sink_ids=[i]))
+                for i in range(F)
+            ]
+            so = SimOpts(src_id=0, sink_ids=list(range(F)),
+                         other_sources=others, end_time=T, q=q)
+            mgr = so.create_manager_with_opt(seed=seed)
+            mgr.run_till()
+            df = mgr.state.get_dataframe()
+            tops_o.append(mp.time_in_top_k(df, 1, T, src_id=0,
+                                           sink_ids=so.sink_ids))
+            posts_o.append(mp.num_posts_of_src(df, 0))
+        d_top = abs(np.mean(tops_j) - np.mean(tops_o))
+        se_top = np.sqrt(np.var(tops_j) / n_runs + np.var(tops_o) / n_runs)
+        assert d_top < 4 * max(se_top, 1e-9), (np.mean(tops_j), np.mean(tops_o))
+        d_post = abs(np.mean(posts_j) - np.mean(posts_o))
+        se_post = np.sqrt(np.var(posts_j) / n_runs + np.var(posts_o) / n_runs)
+        assert d_post < 4 * max(se_post, 1e-9), (np.mean(posts_j), np.mean(posts_o))
+
+    def test_significance_weights_shift_attention(self):
+        """Feeds with higher significance s_i get proportionally more of the
+        broadcaster's attention (higher time-at-top) — paper's
+        significance-weighted u*(t)."""
+        F, T = 4, 80.0
+        s = [4.0, 1.0, 1.0, 1.0]
+        sb = StarBuilder(n_feeds=F, end_time=T, s_sink=s)
+        for f in range(F):
+            sb.wall_poisson(f, 1.0)
+        sb.ctrl_opt(q=1.0)
+        cfg, wall, ctrl = sb.build()
+        tops = np.zeros(F)
+        for seed in range(8):
+            res = simulate_star(cfg, wall, ctrl, seed=seed)
+            tops += np.asarray(res.metrics.time_in_top_k)
+        assert tops[0] > tops[1:].max()
+
+    def test_overflow_wall_raises(self):
+        cfg, wall, ctrl = star_poisson(T=100.0, wall_rate=5.0, wall_cap=32)
+        with pytest.raises(RuntimeError, match="wall stream overflow"):
+            simulate_star(cfg, wall, ctrl, seed=0)
+
+    def test_overflow_posts_raises(self):
+        cfg, wall, ctrl = star_poisson(T=40.0, q=0.01, post_cap=8)
+        with pytest.raises(RuntimeError, match="posting buffer overflow"):
+            simulate_star(cfg, wall, ctrl, seed=0)
+
+
+class TestStarOtherCtrl:
+    def test_ctrl_poisson_budget(self):
+        """Poisson controlled broadcaster: E[#posts] = rate*T, feeds don't
+        influence it."""
+        F, T, rate = 4, 50.0, 0.8
+        sb = StarBuilder(n_feeds=F, end_time=T)
+        for f in range(F):
+            sb.wall_poisson(f, 1.0)
+        sb.ctrl_poisson(rate)
+        cfg, wall, ctrl = sb.build()
+        posts = [simulate_star(cfg, wall, ctrl, seed=s).n_posts
+                 for s in range(16)]
+        mean = np.mean(posts)
+        assert abs(mean - rate * T) < 4 * np.sqrt(rate * T / len(posts))
+
+    def test_ctrl_replay_deterministic_metrics(self):
+        """RealData controlled broadcaster (reference
+        create_manager_with_times): deterministic walls + deterministic posts
+        -> exact metrics, checked against the pandas layer."""
+        F, T = 3, 10.0
+        sb = StarBuilder(n_feeds=F, end_time=T)
+        for f in range(F):
+            sb.wall_replay(f, [1.0 + f, 4.0 + f, 8.0])
+        sb.ctrl_replay([2.0, 6.0])
+        cfg, wall, ctrl = sb.build()
+        res = simulate_star(cfg, wall, ctrl, seed=0)
+        assert res.n_posts == 2
+        df = star_to_dataframe(res)
+        want = mp.time_in_top_k(df, 1, T, src_id=0, per_sink=True,
+                                sink_ids=range(F))
+        got = np.asarray(res.metrics.time_in_top_k)
+        np.testing.assert_allclose(
+            got, [want[f] for f in range(F)], rtol=1e-5, atol=1e-5
+        )
+
+    def test_hawkes_walls_run(self):
+        sb = StarBuilder(n_feeds=4, end_time=30.0)
+        for f in range(4):
+            sb.wall_hawkes(f, l0=0.5, alpha=0.5, beta=1.5)
+        sb.ctrl_opt(q=1.0)
+        cfg, wall, ctrl = sb.build(wall_cap=512)
+        res = simulate_star(cfg, wall, ctrl, seed=2)
+        assert res.n_posts > 0
+        assert int(res.wall_n.sum()) > 0
+
+    def test_mixed_walls_and_multi_wall_feeds(self):
+        """Multiple wall sources on one feed + mixed kinds in one component."""
+        sb = StarBuilder(n_feeds=3, end_time=20.0)
+        sb.wall_poisson(0, 1.0)
+        sb.wall_poisson(0, 0.5)      # second wall on feed 0
+        sb.wall_hawkes(1, 0.5, 0.3, 1.0)
+        sb.wall_replay(2, [3.0, 9.0, 15.0])
+        sb.ctrl_opt(q=0.5)
+        cfg, wall, ctrl = sb.build(wall_cap=256)
+        res = simulate_star(cfg, wall, ctrl, seed=4)
+        assert res.cfg.walls_per_feed == 2
+        # feed 0 carries both walls' events
+        rate_feed0 = res.wall_n[0] / 20.0
+        assert res.wall_n[2] == 3
+        df = star_to_dataframe(res)
+        want = mp.time_in_top_k(df, 1, 20.0, src_id=0, per_sink=True,
+                                sink_ids=range(3))
+        got = np.asarray(res.metrics.time_in_top_k)
+        np.testing.assert_allclose(
+            got, [want[f] for f in range(3)], rtol=1e-4, atol=1e-4
+        )
+        assert rate_feed0 > 0
