@@ -109,6 +109,10 @@ def main():
     ap.add_argument("--horizon", type=float, default=None)
     ap.add_argument("--q", type=float, default=1.0)
     ap.add_argument("--wall-rate", type=float, default=1.0)
+    ap.add_argument("--config", type=int, default=None, choices=[1, 2, 3, 4, 5],
+                    help="benchmark one of the five BASELINE presets instead "
+                         "of the headline graph (see redqueen_tpu.presets / "
+                         "benchmarks/run.py for the full harness)")
     args = ap.parse_args()
 
     if args.quick:
@@ -131,6 +135,14 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     log(f"devices: {jax.devices()}")
+
+    if args.config is not None:
+        from benchmarks.run import bench_config
+
+        out = bench_config(args.config, quick=args.quick, log=log)
+        print(json.dumps(out))
+        return
+
     log(f"graph: {B} broadcasters x {args.followers} followers "
         f"(= {B * args.followers} feed edges), horizon T={T}")
 

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Benchmark + profiling harness over the five BASELINE presets (SURVEY.md
+section 5 "Tracing/profiling": the events/sec bench harness is a first-class
+deliverable; the reference has no perf tooling at all).
+
+Usage:
+    python benchmarks/run.py                      # all configs, full scale
+    python benchmarks/run.py --configs 1 2 --quick
+    python benchmarks/run.py --configs 3 --profile /tmp/trace
+    python benchmarks/run.py --out results.json
+
+Per config: build the preset, one warm-up run (compilation), then a timed
+run with ``jax.block_until_ready``; optional ``jax.profiler.trace`` around
+the timed region (view with TensorBoard/XProf). Writes one JSON object per
+config; ``vs_baseline`` is the events/sec speedup over the NumPy oracle on
+a scaled-down component of the same shape (the oracle's per-event cost is
+O(sources), so full-size oracle runs are infeasible by construction — that
+gap IS the point of the rebuild).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# (scale, end_time, extra kwargs, oracle feed-count sample) per config
+_FULL = {
+    1: dict(scale=1.0, end_time=100.0),
+    2: dict(scale=1.0, end_time=100.0, wall_cap=1024, post_cap=8192),
+    3: dict(scale=1.0, end_time=100.0),
+    4: dict(scale=1.0, end_time=100.0, post_cap=16384),
+    5: dict(scale=1.0, end_time=100.0),
+}
+_QUICK = {
+    1: dict(scale=1.0, end_time=30.0, capacity=512),
+    2: dict(scale=0.05, end_time=30.0, wall_cap=512, post_cap=1024),
+    3: dict(scale=0.05, end_time=30.0, capacity=512),
+    4: dict(scale=0.002, end_time=30.0, post_cap=1024),
+    5: dict(scale=1.0, end_time=30.0, train_steps=30, capacity=512),
+}
+_DESC = {
+    1: "toy: 1 Opt vs 10 Poisson feeds",
+    2: "1 Opt vs 1k Hawkes feeds (star path)",
+    3: "1k-broadcaster bipartite batch",
+    4: "replay walls, 100k feeds (star path)",
+    5: "RMTPP neural policy vs Poisson feeds",
+}
+
+
+def _time_preset(which, kw, seeds, profile_dir=None):
+    import jax
+
+    from redqueen_tpu.presets import build_preset, run_preset
+
+    bundle = build_preset(which, **kw)
+    run_preset(bundle, seeds)  # warm-up: compiles every kernel involved
+    if profile_dir:
+        ctx = jax.profiler.trace(profile_dir)
+    else:
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+    t0 = time.perf_counter()
+    with ctx:
+        out = run_preset(bundle, seeds)
+    secs = time.perf_counter() - t0
+    return bundle, out, secs
+
+
+def _oracle_events_per_sec(which, kw, n_feeds_cap=40, T_cap=20.0):
+    """NumPy-oracle events/sec on a same-shape (scaled-down) component."""
+    from redqueen_tpu.oracle.numpy_ref import SimOpts
+
+    end_time = min(float(kw.get("end_time", 100.0)), T_cap)
+    if which in (1, 3, 5):
+        F, others = 10, [
+            ("poisson", dict(src_id=100 + i, seed=50_000 + i, rate=1.0,
+                             sink_ids=[i]))
+            for i in range(10)
+        ]
+    elif which == 2:
+        F = n_feeds_cap
+        others = [
+            ("hawkes", dict(src_id=100 + i, seed=50_000 + i, l_0=0.5,
+                            alpha=0.8, beta=2.0, sink_ids=[i]))
+            for i in range(F)
+        ]
+    else:  # 4: replay walls
+        from redqueen_tpu.data import synthetic_twitter
+
+        F = n_feeds_cap
+        traces = synthetic_twitter(7, F, end_time)
+        others = [
+            ("realdata", dict(src_id=100 + i, times=traces[i], sink_ids=[i]))
+            for i in range(F)
+        ]
+    so = SimOpts(src_id=0, sink_ids=list(range(F)), other_sources=others,
+                 end_time=end_time, q=float(kw.get("q", 1.0)))
+    t0 = time.perf_counter()
+    events = 0
+    for seed in range(2):
+        mgr = so.create_manager_with_opt(seed=seed)
+        mgr.run_till()
+        events += len(mgr.state.events)
+    secs = time.perf_counter() - t0
+    return events / max(secs, 1e-9)
+
+
+def bench_config(which: int, quick: bool = False, profile_dir=None,
+                 n_seeds: int = 4, log=log):
+    kw = dict((_QUICK if quick else _FULL)[which])
+    seeds = 0 if which == 3 else np.arange(n_seeds)
+    bundle, out, secs = _time_preset(which, kw, seeds, profile_dir)
+    events = out["events"]
+    eps = events / max(secs, 1e-9)
+    o_eps = _oracle_events_per_sec(which, kw)
+    log(f"config {which} ({_DESC[which]}): {events} events in {secs:.3f}s "
+        f"-> {eps:,.0f} events/s; top-{1} {out['mean_time_in_top_k']:.2f}/"
+        f"{out['end_time']}, posts {out['mean_posts']:.1f}; "
+        f"oracle {o_eps:,.0f} ev/s (scaled sample) -> {eps / o_eps:,.1f}x")
+    return {
+        "metric": f"config{which} events/sec ({_DESC[which]})",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "vs_baseline": round(eps / o_eps, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", type=int, nargs="*", default=[1, 2, 3, 4, 5])
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--profile", type=str, default=None,
+                    help="directory for jax.profiler traces (TensorBoard)")
+    ap.add_argument("--out", type=str, default=None)
+    ap.add_argument("--seeds", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu or args.quick:
+        jax.config.update("jax_platforms", "cpu")
+    log(f"devices: {jax.devices()}")
+
+    results = []
+    for which in args.configs:
+        pdir = f"{args.profile}/config{which}" if args.profile else None
+        results.append(bench_config(which, quick=args.quick,
+                                    profile_dir=pdir, n_seeds=args.seeds))
+        print(json.dumps(results[-1]))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+        log(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

@@ -1,0 +1,15 @@
+"""Trace data layer: loading, padding/bucketing, and synthetic generation of
+real-event replay traces (reference: the Twitter dataset consumed by the
+``RealData`` broadcaster and ``SimOpts.create_manager_with_times``)."""
+
+from .traces import (  # noqa: F401
+    bucket_traces,
+    load_csv,
+    normalize_traces,
+    pad_traces,
+    save_npz,
+    load_npz,
+    replay_buckets,
+    star_from_traces,
+    synthetic_twitter,
+)

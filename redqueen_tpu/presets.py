@@ -1,0 +1,243 @@
+"""The five BASELINE benchmark configurations as named presets (SURVEY.md
+section 5 "Config/flag system": the reference's ``SimOpts.update`` sweep
+idiom becomes frozen configs + these factory presets; configs listed in
+BASELINE.md "Benchmark configs").
+
+Each preset returns a ready-to-run bundle:
+
+- batch-path presets (1, 3, 5) -> ``("batch", cfg, params, adj, opt_row)``
+  for ``sim.simulate_batch`` / ``parallel.shard.simulate_sharded``;
+- star-path presets (2, 4)     -> ``("star", cfg, wall, ctrl)`` for
+  ``parallel.bigf.simulate_star``.
+
+``run_preset`` executes either kind and reports one consistent metrics dict
+— the shared entry point for bench.py, benchmarks/, and tests. All presets
+accept a ``scale`` in (0, 1] shrinking them for CPU smoke runs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["PRESETS", "build_preset", "run_preset"]
+
+
+def _scaled(n: int, scale: float, lo: int = 1) -> int:
+    return max(int(round(n * scale)), lo)
+
+
+def config1_toy(scale: float = 1.0, end_time: float = 100.0, q: float = 1.0,
+                wall_rate: float = 1.0, n_components: int = 1,
+                capacity: int = 2048):
+    """1 Opt broadcaster vs 10 Poisson-feed followers — the paper toy and
+    the NumPy-parity anchor (BASELINE config 1)."""
+    from .config import GraphBuilder, stack_components
+
+    n_followers = _scaled(10, scale)
+    gb = GraphBuilder(n_sinks=n_followers, end_time=end_time)
+    opt = gb.add_opt(q=q)
+    for i in range(n_followers):
+        gb.add_poisson(rate=wall_rate, sinks=[i])
+    cfg, p0, a0 = gb.build(capacity=capacity)
+    if n_components > 1:
+        params, adj = stack_components([p0] * n_components, [a0] * n_components)
+        return ("batch", cfg, params, adj, opt)
+    return ("batch", cfg, p0, a0, opt)
+
+
+def config2_hawkes(scale: float = 1.0, end_time: float = 100.0,
+                   q: float = 1.0, l0: float = 0.5, alpha: float = 0.8,
+                   beta: float = 2.0, wall_cap: int = 512,
+                   post_cap: int = 4096):
+    """1 broadcaster vs 1k self-exciting Hawkes feeds — the vmapped-thinning
+    config (BASELINE config 2), on the follower-sharded star path."""
+    from .parallel.bigf import StarBuilder
+
+    n_feeds = _scaled(1000, scale)
+    sb = StarBuilder(n_feeds=n_feeds, end_time=end_time)
+    for f in range(n_feeds):
+        sb.wall_hawkes(f, l0=l0, alpha=alpha, beta=beta)
+    sb.ctrl_opt(q=q)
+    cfg, wall, ctrl = sb.build(wall_cap=wall_cap, post_cap=post_cap)
+    return ("star", cfg, wall, ctrl)
+
+
+def config3_bipartite(scale: float = 1.0, end_time: float = 100.0,
+                      q: float = 1.0, wall_rate: float = 1.0,
+                      followers_per: int = 10, capacity: int = 2048):
+    """1k broadcasters x 10k followers bipartite — shards over broadcasters
+    (BASELINE config 3). RedQueen broadcasters do not couple, so the graph
+    decomposes into independent per-broadcaster components run as one
+    batch axis (SURVEY.md section 7)."""
+    from .config import GraphBuilder, stack_components
+
+    B = _scaled(1000, scale)
+    gb = GraphBuilder(n_sinks=followers_per, end_time=end_time)
+    opt = gb.add_opt(q=q)
+    for i in range(followers_per):
+        gb.add_poisson(rate=wall_rate, sinks=[i])
+    cfg, p0, a0 = gb.build(capacity=capacity)
+    params, adj = stack_components([p0] * B, [a0] * B)
+    return ("batch", cfg, params, adj, opt)
+
+
+def config4_replay(scale: float = 1.0, end_time: float = 100.0,
+                   q: float = 1.0, seed: int = 7, mean_rate: float = 1.0,
+                   traces=None, post_cap: int = 4096,
+                   trace_max_len: Optional[int] = 256):
+    """Twitter retweet-cascade replay: RealData walls, 100k followers
+    (BASELINE config 4). Uses the synthetic heavy-tailed corpus when no real
+    trace is supplied (no network in this environment). ``trace_max_len``
+    bounds per-user trace length at generation: the Opt-controlled component
+    is one coupled system (see data.replay_buckets for why it cannot be
+    bucketed), so the replay tensor pads to the longest trace — unbounded
+    heavy tails would waste GBs on +inf padding."""
+    from .data import star_from_traces, synthetic_twitter
+
+    n_feeds = _scaled(100_000, scale)
+    if traces is None:
+        traces = synthetic_twitter(seed, n_feeds, end_time,
+                                   mean_rate=mean_rate,
+                                   max_len=trace_max_len)
+    cfg, wall, ctrl = star_from_traces(traces, end_time, ctrl="opt", q=q,
+                                       post_cap=post_cap)
+    return ("star", cfg, wall, ctrl)
+
+
+def config5_rmtpp(scale: float = 1.0, end_time: float = 100.0,
+                  wall_rate: float = 1.0, hidden: int = 8,
+                  train_steps: int = 120, seed: int = 0,
+                  capacity: int = 2048, weights=None):
+    """Neural-intensity lambda_theta (RMTPP) as the controlled broadcaster
+    (BASELINE config 5) behind the same policy seam — the north star's
+    "registers as an Opt subclass" extension point.
+
+    Trains a small model on synthetic gap sequences unless ``weights`` is
+    given (utils.checkpoint round-trips them)."""
+    import jax.numpy as jnp
+    from jax import random as jr
+
+    from .config import GraphBuilder
+    from .models import rmtpp
+
+    n_followers = _scaled(10, scale)
+    if weights is None:
+        rng = np.random.RandomState(seed)
+        taus = rng.exponential(0.7, (32, 24)).astype(np.float32)
+        mask = np.ones_like(taus, bool)
+        weights, _, _ = rmtpp.fit(jr.PRNGKey(seed), taus, mask,
+                                  hidden=hidden, steps=train_steps)
+    gb = GraphBuilder(n_sinks=n_followers, end_time=end_time)
+    row = gb.add_rmtpp()
+    for i in range(n_followers):
+        gb.add_poisson(rate=wall_rate, sinks=[i])
+    cfg, params, adj = gb.build(capacity=capacity, rmtpp_hidden=hidden)
+    params = rmtpp.attach(params, weights)
+    return ("batch", cfg, params, adj, row)
+
+
+PRESETS = {
+    1: config1_toy,
+    2: config2_hawkes,
+    3: config3_bipartite,
+    4: config4_replay,
+    5: config5_rmtpp,
+    "toy": config1_toy,
+    "hawkes": config2_hawkes,
+    "bipartite": config3_bipartite,
+    "replay": config4_replay,
+    "rmtpp": config5_rmtpp,
+}
+
+
+def build_preset(which, **kw):
+    """Build BASELINE preset ``which`` (1-5 or name). Keyword args override
+    the preset's defaults — the reference's ``SimOpts.update`` role."""
+    if which not in PRESETS:
+        raise KeyError(f"unknown preset {which!r}; have {sorted(PRESETS, key=str)}")
+    return PRESETS[which](**kw)
+
+
+def run_preset(bundle, seeds, mesh=None, max_chunks: int = 256,
+               metric_K: int = 1):
+    """Run a preset bundle over ``seeds`` and return a metrics dict:
+    events (total), mean time-in-top-K, mean posts per broadcaster, and the
+    per-seed values. Batch bundles treat an int-array ``seeds`` as the
+    component batch (must match the stacked batch dim if any); star bundles
+    loop seeds host-side (each run is one big component)."""
+    import jax
+    import jax.numpy as jnp
+
+    kind = bundle[0]
+    if kind == "batch":
+        _, cfg, params, adj, opt_row = bundle
+        from .sim import simulate_batch
+        from .utils.metrics import feed_metrics_batch, num_posts
+
+        seeds = np.asarray(seeds)
+        batched = params.kind.ndim == 2
+        if batched:
+            from .parallel.shard import simulate_sharded
+
+            B = params.kind.shape[0]
+            if seeds.ndim == 0:
+                seeds = np.arange(B) + int(seeds)  # base seed -> one per lane
+            elif len(seeds) != B:
+                raise ValueError(
+                    f"batched preset needs {B} seeds (one per component) or "
+                    f"a scalar base seed; got {len(seeds)}"
+                )
+
+            if mesh is not None:
+                log = simulate_sharded(cfg, params, adj, seeds, mesh,
+                                       max_chunks=max_chunks)
+            else:
+                log = simulate_batch(cfg, params, adj, seeds,
+                                     max_chunks=max_chunks)
+            adj_b = adj if adj.ndim == 3 else jnp.broadcast_to(
+                adj, (len(seeds),) + adj.shape
+            )
+            m = feed_metrics_batch(log.times, log.srcs, adj_b, opt_row,
+                                   cfg.end_time, K=metric_K)
+            tops = np.asarray(m.mean_time_in_top_k())
+            posts = np.asarray(num_posts(log.srcs, opt_row))
+            events = int(np.asarray(log.n_events).sum())
+        else:
+            # Seed sweep = a vmap batch axis (SURVEY.md section 3.5), not a
+            # host loop: stack the single component once per seed.
+            from .config import stack_components
+
+            seeds = np.atleast_1d(seeds)
+            n = len(seeds)
+            params_b, adj_b = stack_components([params] * n, [adj] * n)
+            log = simulate_batch(cfg, params_b, adj_b, seeds,
+                                 max_chunks=max_chunks)
+            m = feed_metrics_batch(log.times, log.srcs, adj_b, opt_row,
+                                   cfg.end_time, K=metric_K)
+            tops = np.asarray(m.mean_time_in_top_k())
+            posts = np.asarray(num_posts(log.srcs, opt_row))
+            events = int(np.asarray(log.n_events).sum())
+    elif kind == "star":
+        _, cfg, wall, ctrl = bundle
+        from .parallel.bigf import simulate_star
+
+        tops, posts, events = [], [], 0
+        for s in np.asarray(seeds).ravel():
+            res = simulate_star(cfg, wall, ctrl, seed=int(s), mesh=mesh,
+                                metric_K=metric_K)
+            tops.append(float(np.asarray(res.metrics.mean_time_in_top_k())))
+            posts.append(res.n_posts)
+            events += int(res.wall_n.sum()) + res.n_posts
+        tops, posts = np.asarray(tops), np.asarray(posts)
+    else:
+        raise ValueError(f"unknown bundle kind {kind!r}")
+    return {
+        "events": events,
+        "mean_time_in_top_k": float(tops.mean()),
+        "mean_posts": float(posts.mean()),
+        "per_seed_top_k": tops.tolist(),
+        "per_seed_posts": posts.tolist(),
+        "end_time": cfg.end_time,
+    }

@@ -1,0 +1,125 @@
+"""Tests for the trace data layer (redqueen_tpu.data) and the five BASELINE
+presets (redqueen_tpu.presets) at smoke scale."""
+
+import numpy as np
+import pytest
+
+from redqueen_tpu import data
+from redqueen_tpu.presets import PRESETS, build_preset, run_preset
+
+
+class TestTraces:
+    def test_csv_roundtrip(self, tmp_path):
+        p = tmp_path / "trace.csv"
+        p.write_text(
+            "user,time\n"
+            "alice,3.0\nbob,1.0\nalice,1.5\nbob,4.0\nalice,2.0\n"
+        )
+        tr = data.load_csv(str(p))
+        assert len(tr) == 2  # order of first appearance: alice, bob
+        np.testing.assert_allclose(tr[0], [1.5, 2.0, 3.0])
+        np.testing.assert_allclose(tr[1], [1.0, 4.0])
+
+    def test_npz_roundtrip(self, tmp_path):
+        tr = [np.array([1.0, 2.0]), np.array([0.5]), np.array([])]
+        p = tmp_path / "t.npz"
+        data.save_npz(str(p), tr)
+        back = data.load_npz(str(p))
+        assert len(back) == 3
+        for a, b in zip(tr, back):
+            np.testing.assert_allclose(a, b)
+
+    def test_normalize_maps_to_window(self):
+        tr = [np.array([1.5e9, 1.5e9 + 86400]), np.array([1.5e9 + 43200])]
+        out = data.normalize_traces(tr, end_time=100.0)
+        np.testing.assert_allclose(out[0], [0.0, 100.0])
+        np.testing.assert_allclose(out[1], [50.0])
+
+    def test_pad_refuses_silent_truncation(self):
+        with pytest.raises(ValueError, match="refusing to truncate"):
+            data.pad_traces([np.arange(5.0)], length=3)
+
+    def test_bucketing_partitions_all_users(self):
+        rng = np.random.RandomState(0)
+        tr = [np.sort(rng.uniform(0, 10, n))
+              for n in rng.randint(0, 300, size=50)]  # includes empty traces
+        buckets = data.bucket_traces(tr, edges=(16, 64, 256))
+        seen = np.concatenate([idx for idx, _, _ in buckets])
+        assert sorted(seen) == list(range(50))
+        for idx, padded, lens in buckets:
+            assert padded.shape[0] == len(idx) == len(lens)
+            # pad length is the bucket edge; no row exceeds it
+            assert (lens <= padded.shape[1]).all()
+
+    def test_replay_buckets_exact_vs_unbucketed(self):
+        """Bucketed replay-ctrl runs are EXACT (feeds decouple given the
+        fixed posting sequence): per-feed metrics must match the single
+        unbucketed component bit-for-bit after scatter-back."""
+        from redqueen_tpu.parallel.bigf import simulate_star
+
+        rng = np.random.RandomState(1)
+        T = 15.0
+        tr = [np.sort(rng.uniform(0, T, n))
+              for n in rng.randint(0, 40, size=12)]
+        ctrl_times = np.sort(rng.uniform(0, T, 5))
+        cfg, wall, ctrl = data.star_from_traces(
+            tr, T, ctrl="replay", ctrl_times=ctrl_times
+        )
+        whole = simulate_star(cfg, wall, ctrl, seed=0)
+        got = np.full(len(tr), np.nan)
+        for idx, bcfg, bwall, bctrl in data.replay_buckets(
+            tr, T, ctrl_times, edges=(8, 16)
+        ):
+            res = simulate_star(bcfg, bwall, bctrl, seed=0)
+            got[idx] = np.asarray(res.metrics.time_in_top_k)
+        np.testing.assert_allclose(
+            got, np.asarray(whole.metrics.time_in_top_k), rtol=1e-6
+        )
+
+    def test_synthetic_heavy_tail(self):
+        tr = data.synthetic_twitter(0, 200, end_time=50.0, mean_rate=1.0)
+        lens = np.array([len(t) for t in tr])
+        assert len(tr) == 200
+        assert lens.max() > 4 * max(np.median(lens), 1)  # heavy tail
+        for t in tr[:10]:
+            assert np.all(np.diff(t) >= 0)
+            assert np.all((t >= 0) & (t <= 50.0))
+
+
+class TestPresets:
+    def test_all_presets_build_and_run_smoke(self):
+        for which in (1, 2, 3, 4, 5):
+            kw = dict(scale=0.02, end_time=12.0)
+            if which == 2:
+                kw.update(wall_cap=256, post_cap=512)
+            if which == 4:
+                kw.update(scale=0.0002, post_cap=512)  # 20 feeds
+            if which == 5:
+                kw.update(train_steps=5)
+            bundle = build_preset(which, **kw)
+            # batched presets take a scalar base seed (one lane per component)
+            seeds = 0 if which == 3 else np.arange(2)
+            out = run_preset(bundle, seeds)
+            assert out["events"] > 0, which
+            assert 0.0 <= out["mean_time_in_top_k"] <= 12.0, which
+            assert out["mean_posts"] >= 0, which
+
+    def test_names_alias_numbers(self):
+        assert PRESETS["toy"] is PRESETS[1]
+        assert PRESETS["replay"] is PRESETS[4]
+
+    def test_batch_preset_runs_sharded(self):
+        from redqueen_tpu.parallel import comm
+
+        bundle = build_preset(3, scale=0.008, end_time=10.0)
+        assert bundle[1].n_sources == 11  # 1 opt + 10 walls
+        mesh = comm.make_mesh({"data": 8})
+        out = run_preset(bundle, np.arange(8), mesh=mesh)
+        out2 = run_preset(bundle, np.arange(8))
+        np.testing.assert_allclose(
+            out["per_seed_top_k"], out2["per_seed_top_k"], rtol=1e-6
+        )
+
+    def test_unknown_preset_raises(self):
+        with pytest.raises(KeyError):
+            build_preset("nope")
