@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Paper figure 2 analogue: budget vs visibility trade-off curve.
+
+Sweeps the RedQueen posting cost q over a grid — each q yields a realized
+posting budget and a time-in-top-1 — and runs budget-matched Poisson at each
+realized budget. The whole sweep is ONE vmapped batch on device: (q grid x
+seeds) components run in lockstep (SURVEY.md section 3.5: the reference's
+nested seed/q host loops become a batch axis).
+
+Usage:
+    python experiments/tradeoff.py [--qgrid 0.1 0.3 1 3] [--seeds N]
+        [--fig out.png] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(q_grid, n_seeds=8, F=10, T=100.0, wall_rate=1.0, capacity=4096):
+    import jax.numpy as jnp
+
+    from redqueen_tpu import GraphBuilder, baselines, simulate_batch, stack_components
+    from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
+
+    def components(make):
+        """One component per (q, seed) lane; returns cfg, params, adj."""
+        ps, ads = [], []
+        for q in q_grid:
+            gb = GraphBuilder(n_sinks=F, end_time=T)
+            me = make(gb, q)
+            for i in range(F):
+                gb.add_poisson(rate=wall_rate, sinks=[i])
+            cfg, p0, a0 = gb.build(capacity=capacity)
+            ps += [p0] * n_seeds
+            ads += [a0] * n_seeds
+        params, adj = stack_components(ps, ads)
+        return cfg, params, adj, me
+
+    def evaluate(cfg, params, adj, me, seed0):
+        B = len(q_grid) * n_seeds
+        seeds = np.arange(B) + seed0
+        log = simulate_batch(cfg, params, adj, seeds, max_chunks=64)
+        adj_b = adj if adj.ndim == 3 else jnp.broadcast_to(adj, (B,) + adj.shape)
+        m = feed_metrics_batch(log.times, log.srcs, adj_b, me, T)
+        top = np.asarray(m.mean_time_in_top_k()).reshape(len(q_grid), n_seeds)
+        posts = np.asarray(num_posts(log.srcs, me)).reshape(len(q_grid), n_seeds)
+        return top, posts
+
+    top_o, posts_o = evaluate(*components(lambda gb, q: gb.add_opt(q=q)), 0)
+    budgets = posts_o.mean(axis=1)
+
+    # Budget-matched Poisson per q lane (rate varies per lane: same config,
+    # params carry the rate, so one compilation covers the whole grid).
+    rates = [baselines.budget_matched_poisson_rate(b, T) for b in budgets]
+    rate_iter = iter(np.repeat(rates, 1))
+
+    def add_poisson(gb, q):
+        return gb.add_poisson(rate=float(next(rate_iter)))
+
+    top_p, posts_p = evaluate(*components(add_poisson), 10_000)
+    return budgets, top_o, top_p, posts_p
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--qgrid", type=float, nargs="*",
+                    default=[0.05, 0.1, 0.3, 1.0, 3.0, 10.0])
+    ap.add_argument("--seeds", type=int, default=8)
+    ap.add_argument("--followers", type=int, default=10)
+    ap.add_argument("--horizon", type=float, default=100.0)
+    ap.add_argument("--fig", type=str, default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    budgets, top_o, top_p, _ = run(args.qgrid, args.seeds, args.followers,
+                                   args.horizon)
+    T = args.horizon
+    print(f"{'q':>7} {'budget':>8} {'opt top-1':>10} {'poisson top-1':>14}")
+    for q, b, to, tp in zip(args.qgrid, budgets, top_o.mean(1), top_p.mean(1)):
+        print(f"{q:>7.2f} {b:>8.1f} {to / T:>10.3f} {tp / T:>14.3f}")
+
+    if args.fig:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(5, 3.5))
+        ax.plot(budgets, top_o.mean(1) / T, "o-", color="black",
+                label="RedQueen (Opt)")
+        ax.plot(budgets, top_p.mean(1) / T, "s--", color="#888",
+                label="budget-matched Poisson")
+        ax.set_xlabel("posting budget (posts per horizon)")
+        ax.set_ylabel("time-in-top-1 fraction")
+        ax.set_xscale("log")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(args.fig, dpi=150)
+        print(f"wrote {args.fig}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""redqueen-tpu: a TPU-native smart-broadcasting framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of MPI-SWS/RedQueen
+(Zarezade et al., WSDM 2017): event-driven simulation of marked temporal
+point processes over broadcaster->follower feed graphs, the RedQueen optimal
+posting policy, baselines (Poisson, Hawkes, piecewise-constant, real-trace
+replay, neural RMTPP), and feed-rank evaluation metrics — all as scan-based
+kernels that vmap over components and shard over a device mesh.
+
+Public surface (reference counterparts in parentheses; the reference mount
+was empty at build time, so parity targets are SURVEY.md sections 1-3 citing
+``redqueen/opt_model.py`` and ``redqueen/utils.py``):
+
+- ``GraphBuilder`` / ``SimConfig`` / ``SourceParams``  (``SimOpts``)
+- ``simulate`` / ``simulate_batch`` / ``resume``       (``Manager.run_till``)
+- ``EventLog`` + ``utils.dataframe.events_to_dataframe``
+  (``State.get_dataframe``)
+- ``utils.metrics`` (on-device) and ``utils.metrics_pandas``
+  (``utils.time_in_top_k`` / ``average_rank`` / rank integrals)
+- ``parallel.shard.simulate_sharded`` / ``parallel.bigf.simulate_star`` —
+  mesh-sharded execution (no reference counterpart; single-process NumPy)
+- ``baselines`` — budget-matched Poisson and the Karimi-style offline
+  piecewise-constant oracle the paper compares against
+- ``oracle.numpy_ref`` — the trusted NumPy parity oracle mirroring the
+  reference's API (``SimOpts`` / ``Manager`` / ``Broadcaster`` subclasses)
+- ``presets.build_preset`` / ``run_preset`` — the five BASELINE configs
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .config import GraphBuilder, SimConfig, SourceParams, stack_components
+from .sim import EventLog, resume, simulate, simulate_batch
+from .presets import PRESETS, build_preset, run_preset
+
+# Subpackages re-exported for discoverability. models/ops load eagerly (the
+# driver registers the built-in policies); oracle, parallel, and data stay
+# import-on-use.
+from . import utils  # noqa: F401
+
+__all__ = [
+    "__version__",
+    "GraphBuilder",
+    "SimConfig",
+    "SourceParams",
+    "stack_components",
+    "EventLog",
+    "simulate",
+    "simulate_batch",
+    "resume",
+    "PRESETS",
+    "build_preset",
+    "run_preset",
+    "utils",
+]

@@ -1,0 +1,159 @@
+"""Comparison baselines from the RedQueen paper's experiment suite.
+
+The reference evaluates RedQueen against (SURVEY.md section 2 item 15 and
+section 6): (a) budget-matched Poisson posting, (b) the *offline* optimal
+"when-to-post" method of Karimi et al. (NIPS 2016 "Smart broadcasting: do you
+want to be seen?"), whose solution is a piecewise-constant posting-rate
+schedule fitted to the followers' (piecewise-constant) activity profiles, and
+(c) the user's real posting trace. The reference carries (b) implicitly as
+the ``PiecewiseConst`` broadcaster + ``create_manager_with_piecewise_const``
+(reference ``redqueen/opt_model.py``, SURVEY.md section 2 item 6); the fitted
+schedule itself came from the paper pipeline. This module supplies that
+missing fit as a TPU-friendly convex water-filling solve, so the full paper
+comparison (RedQueen vs Poisson vs offline oracle vs replay) runs end-to-end
+inside this framework (see ``experiments/``).
+
+Model for the offline fit: in segment s (duration d_s) follower f's wall
+posts as Poisson with rate L[f, s]; if we broadcast as Poisson with rate
+mu_s, the stationary probability of holding the top slot of f's feed is
+mu_s / (mu_s + L[f, s]).  The offline problem is
+
+    maximize_{mu >= 0}  sum_s d_s * sum_f  mu_s / (mu_s + L[f, s])
+    subject to          sum_s d_s * mu_s = budget                    (E#posts)
+
+— concave with a monotone KKT system: per segment,
+g_s(mu) = sum_f L/(mu+L)^2 equals a global multiplier nu, i.e. water-filling.
+Both the inner (per-segment mu) and outer (nu) solves are monotone
+bisections, vectorized over segments — O(iters * F * S) with static shapes,
+jit-friendly by construction.  Zero-rate (f, s) entries are ignored: a feed
+receiving no competing posts is held at rank 0 by any single post, so it
+contributes no gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "offline_rates",
+    "offline_visibility",
+    "budget_matched_poisson_rate",
+    "offline_schedule",
+]
+
+_INNER_ITERS = 60
+_OUTER_ITERS = 60
+
+
+def budget_matched_poisson_rate(n_posts: float, end_time: float,
+                                start_time: float = 0.0) -> float:
+    """Constant Poisson rate spending the same expected budget as an observed
+    run — the paper's budget-matched Poisson baseline."""
+    return float(n_posts) / (float(end_time) - float(start_time))
+
+
+def _g(mu, L, active):
+    """KKT derivative sum_f L/(mu+L)^2 per segment; [S] from L [F, S]."""
+    terms = jnp.where(active, L / jnp.square(mu[None, :] + L), 0.0)
+    return terms.sum(axis=0)
+
+
+def _mu_of_nu(nu, L, active, mu_hi0):
+    """Per-segment water level mu_s(nu): solve g_s(mu) = nu, monotone in mu.
+
+    g_s(mu) <= sum_f L / mu^2, so the root lies in [0, sqrt(sum_f L / nu)];
+    fixed-iteration bisection keeps the whole solve shape-static under jit.
+    """
+    lo = jnp.zeros_like(mu_hi0)
+    hi = jnp.sqrt(jnp.where(active, L, 0.0).sum(axis=0) / nu) + 1e-12
+
+    def body(i, bounds):
+        lo, hi = bounds
+        mid = 0.5 * (lo + hi)
+        too_low = _g(mid, L, active) > nu  # g decreasing: root above mid
+        return jnp.where(too_low, mid, lo), jnp.where(too_low, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, _INNER_ITERS, body, (lo, hi))
+    mu = 0.5 * (lo + hi)
+    # Segments already saturated at mu = 0 (g_s(0) <= nu) post nothing.
+    return jnp.where(_g(jnp.zeros_like(mu), L, active) <= nu, 0.0, mu)
+
+
+def offline_rates(wall_rates, durations, budget: float):
+    """Karimi-style offline optimal posting rates.
+
+    ``wall_rates``: [F, S] (or [S] for one follower) piecewise-constant wall
+    intensity of each follower per segment; ``durations``: [S] segment
+    lengths; ``budget``: expected total number of posts over the horizon.
+    Returns mu [S] >= 0 with sum_s durations[s] * mu[s] == budget (to solver
+    tolerance). Pure jittable function.
+    """
+    L = jnp.atleast_2d(jnp.asarray(wall_rates, jnp.float64 if
+                                   jax.config.jax_enable_x64 else jnp.float32))
+    d = jnp.asarray(durations, L.dtype)
+    active = L > 0
+    mu_hi0 = jnp.zeros(L.shape[1], L.dtype)
+
+    def spent(nu):
+        return (d * _mu_of_nu(nu, L, active, mu_hi0)).sum()
+
+    # Outer bisection on nu (spent is decreasing in nu). nu_hi = max g_s(0)
+    # spends 0 < budget; nu_lo shrinks geometrically until overspending.
+    nu_hi = jnp.maximum(_g(jnp.zeros(L.shape[1], L.dtype), L, active).max(),
+                        1e-12)
+    budget = jnp.asarray(budget, L.dtype)
+
+    def grow(state):
+        nu_lo, _ = state
+        return nu_lo * 0.25, spent(nu_lo * 0.25)
+
+    def need_grow(state):
+        nu_lo, sp = state
+        return (sp < budget) & (nu_lo > 1e-30)
+
+    nu_lo, _ = jax.lax.while_loop(
+        need_grow, grow, (nu_hi, spent(nu_hi))
+    )
+
+    def body(i, bounds):
+        lo, hi = bounds
+        mid = jnp.sqrt(lo * hi)  # log-space: nu spans many decades
+        over = spent(mid) > budget  # spending too much: raise nu
+        return jnp.where(over, mid, lo), jnp.where(over, hi, mid)
+
+    nu_lo, nu_hi = jax.lax.fori_loop(0, _OUTER_ITERS, body, (nu_lo, nu_hi))
+    return _mu_of_nu(jnp.sqrt(nu_lo * nu_hi), L, active, mu_hi0)
+
+
+def offline_visibility(mu, wall_rates, durations):
+    """The objective the offline fit maximizes: expected time-at-top summed
+    over followers, sum_s d_s sum_f mu_s/(mu_s+L) (zero-rate entries count as
+    held — they cost nothing). Useful for optimality checks and experiment
+    tables."""
+    L = jnp.atleast_2d(jnp.asarray(wall_rates))
+    mu = jnp.asarray(mu)
+    d = jnp.asarray(durations)
+    frac = jnp.where(L > 0, mu[None, :] / (mu[None, :] + L), 1.0)
+    return (d[None, :] * frac).sum(axis=1).mean()
+
+
+def offline_schedule(wall_rates, change_times, end_time: float,
+                     budget: float) -> Tuple[np.ndarray, np.ndarray]:
+    """Fit the offline baseline and return ``(change_times, rates)`` ready for
+    ``GraphBuilder.add_piecewise`` / ``StarBuilder.ctrl_piecewise`` / the
+    oracle's ``create_manager_with_piecewise_const`` (the reference's offline-
+    baseline consumer surface).
+
+    ``change_times``: [S] ascending segment starts (last segment ends at
+    ``end_time``); ``wall_rates``: [F, S] or [S].
+    """
+    ct = np.asarray(change_times, np.float64)
+    assert np.all(np.diff(ct) > 0)
+    durations = np.diff(np.concatenate([ct, [float(end_time)]]))
+    assert np.all(durations > 0), "last change_time must precede end_time"
+    mu = offline_rates(wall_rates, durations, budget)
+    return ct, np.asarray(mu, np.float64)

@@ -1,0 +1,29 @@
+"""Evaluation and persistence utilities.
+
+- ``metrics`` — on-device (JAX) feed-rank metrics over event logs
+  (reference: ``redqueen/utils.py`` re-implemented as one scan pass).
+- ``metrics_pandas`` — the backend-agnostic pandas twin consuming the
+  reference-schema DataFrame (``time_in_top_k`` / ``average_rank`` / rank
+  integrals / budget helpers).
+- ``dataframe`` — event-buffer -> reference-schema DataFrame export
+  (reference: ``State.get_dataframe``).
+- ``checkpoint`` — orbax round-trip of sweep state and learned-policy
+  weights (no reference counterpart; SURVEY.md section 5).
+"""
+
+from . import dataframe, metrics, metrics_pandas  # noqa: F401
+
+__all__ = ["dataframe", "metrics", "metrics_pandas", "checkpoint"]
+
+
+def __getattr__(name):
+    # orbax import is slow; load the checkpoint module on first use only.
+    # (importlib, not `from . import`: a from-import would re-probe this
+    # __getattr__ before the submodule binds and recurse forever.)
+    if name == "checkpoint":
+        import importlib
+
+        module = importlib.import_module(".checkpoint", __name__)
+        globals()["checkpoint"] = module
+        return module
+    raise AttributeError(name)

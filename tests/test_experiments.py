@@ -1,0 +1,39 @@
+"""Experiment scripts (SURVEY.md section 2 item 15): tiny smoke runs pinning
+the paper's qualitative ordering — RedQueen >= budget-matched Poisson — and
+that every policy runs end-to-end through the comparison harness."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_compare_policies_smoke():
+    from experiments.compare_policies import run
+
+    results, budget, T = run(n_seeds=6, F=4, T=40.0, q=0.5, capacity=1024)
+    assert set(results) == {"opt", "poisson", "offline", "replay"}
+    assert budget > 0
+    for name, (top, rank, posts) in results.items():
+        assert top.shape == (6,)
+        assert np.all(top >= 0) and np.all(top <= T)
+        assert np.all(rank >= 0)
+    # The headline claim, at matched budget, mean over seeds.
+    assert results["opt"][0].mean() > results["poisson"][0].mean()
+
+
+def test_tradeoff_smoke():
+    from experiments.tradeoff import run
+
+    budgets, top_o, top_p, posts_p = run(
+        [0.2, 2.0], n_seeds=4, F=4, T=30.0, capacity=1024
+    )
+    assert budgets.shape == (2,)
+    # Lower q -> higher intensity -> more posts.
+    assert budgets[0] > budgets[1]
+    # Poisson budgets track the opt budgets they were matched to.
+    assert np.allclose(posts_p.mean(1), budgets, rtol=0.35)
+    # Opt dominates at every budget (mean over seeds).
+    assert np.all(top_o.mean(1) >= top_p.mean(1))
