@@ -30,6 +30,29 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _default_backend_alive(log, deadline_s: float = 120.0) -> bool:
+    """True iff the default JAX backend (the tunneled TPU here) initializes
+    within a deadline. Probed in a subprocess because a wedged tunnel HANGS
+    jax.devices() rather than raising."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=deadline_s, capture_output=True, text=True,
+        )
+        if r.returncode == 0 and "ok" in r.stdout:
+            return True
+        log(f"default backend probe failed (rc={r.returncode}): "
+            f"{r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ''}")
+        return False
+    except subprocess.TimeoutExpired:
+        log(f"default backend probe hung > {deadline_s}s; assuming TPU "
+            f"tunnel is down")
+        return False
+
+
 def build_component(n_followers: int, T: float, q: float, wall_rate: float,
                     capacity: int):
     from redqueen_tpu.config import GraphBuilder
@@ -133,7 +156,13 @@ def main():
         # the reliable switch. A killed TPU run can wedge the tunnel, so the
         # smoke path must never touch it.
         jax.config.update("jax_platforms", "cpu")
-
+    elif not _default_backend_alive(log):
+        # TPU tunnel down. Two observed failure modes: axon init raises
+        # UNAVAILABLE, or it hangs for minutes — so the probe runs in a
+        # SUBPROCESS with a deadline (an in-process try/except cannot catch a
+        # hang) and we fall back to CPU rather than dying without the JSON
+        # line the driver records.
+        jax.config.update("jax_platforms", "cpu")
     log(f"devices: {jax.devices()}")
 
     if args.config is not None:

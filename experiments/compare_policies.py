@@ -98,10 +98,11 @@ def run(n_seeds=16, F=10, T=96.0, q=0.4, lo=0.3, hi=2.5, capacity=4096):
     cfg, params, adj, me = build(lambda gb: gb.add_piecewise(ct_off, mu))
     results["offline"] = evaluate(cfg, params, adj, me, seeds + 2000)
 
-    # 4) "Real user" replay: busy-hours posting at the same budget.
+    # 4) "Real user" replay: busy-hours posting at the same budget (one
+    # distinct trace per seed lane, so traces vary like the other policies'
+    # randomness does).
     rng = np.random.RandomState(7)
     n_posts = max(int(round(budget)), 1)
-    cfg, params, adj, me = None, None, None, None
     gb_list = []
     for s in range(n_seeds):
         gb = GraphBuilder(n_sinks=F, end_time=T)
@@ -112,7 +113,7 @@ def run(n_seeds=16, F=10, T=96.0, q=0.4, lo=0.3, hi=2.5, capacity=4096):
     cfg = gb_list[0][0]
     params, adj = stack_components([g[1] for g in gb_list],
                                    [g[2] for g in gb_list])
-    results["replay"] = evaluate(cfg, params, adj, 0, seeds + 3000)
+    results["replay"] = evaluate(cfg, params, adj, me, seeds + 3000)
 
     return results, budget, T
 

@@ -30,11 +30,13 @@ def run(q_grid, n_seeds=8, F=10, T=100.0, wall_rate=1.0, capacity=4096):
     from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
 
     def components(make):
-        """One component per (q, seed) lane; returns cfg, params, adj."""
+        """One component per (q, seed) lane; returns cfg, params, adj.
+        ``make(gb, qi, q)`` adds the controlled broadcaster for grid slot
+        qi and returns its source row."""
         ps, ads = [], []
-        for q in q_grid:
+        for qi, q in enumerate(q_grid):
             gb = GraphBuilder(n_sinks=F, end_time=T)
-            me = make(gb, q)
+            me = make(gb, qi, q)
             for i in range(F):
                 gb.add_poisson(rate=wall_rate, sinks=[i])
             cfg, p0, a0 = gb.build(capacity=capacity)
@@ -53,16 +55,17 @@ def run(q_grid, n_seeds=8, F=10, T=100.0, wall_rate=1.0, capacity=4096):
         posts = np.asarray(num_posts(log.srcs, me)).reshape(len(q_grid), n_seeds)
         return top, posts
 
-    top_o, posts_o = evaluate(*components(lambda gb, q: gb.add_opt(q=q)), 0)
+    top_o, posts_o = evaluate(
+        *components(lambda gb, qi, q: gb.add_opt(q=q)), 0
+    )
     budgets = posts_o.mean(axis=1)
 
     # Budget-matched Poisson per q lane (rate varies per lane: same config,
     # params carry the rate, so one compilation covers the whole grid).
     rates = [baselines.budget_matched_poisson_rate(b, T) for b in budgets]
-    rate_iter = iter(np.repeat(rates, 1))
 
-    def add_poisson(gb, q):
-        return gb.add_poisson(rate=float(next(rate_iter)))
+    def add_poisson(gb, qi, q):
+        return gb.add_poisson(rate=float(rates[qi]))
 
     top_p, posts_p = evaluate(*components(add_poisson), 10_000)
     return budgets, top_o, top_p, posts_p

@@ -138,7 +138,7 @@ def offline_visibility(mu, wall_rates, durations):
     mu = jnp.asarray(mu)
     d = jnp.asarray(durations)
     frac = jnp.where(L > 0, mu[None, :] / (mu[None, :] + L), 1.0)
-    return (d[None, :] * frac).sum(axis=1).mean()
+    return (d[None, :] * frac).sum()
 
 
 def offline_schedule(wall_rates, change_times, end_time: float,

@@ -253,9 +253,85 @@ def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset):
 
 
 def _feed_metrics_star(cfg: StarConfig, feed_times, own_times, K: int):
-    """Per-feed rank integrals via a two-pointer merge-scan over (wall
-    events, own posts) — the reference's ``utils.py`` integrals (SURVEY.md
-    section 2 items 11-14) without materializing a global event log.
+    """Per-feed rank integrals in closed form — no sequential pass at all.
+
+    The merge-scan twin (``_feed_metrics_star_scan``, kept as the test
+    oracle) walks E+K events per feed; on TPU that is a length-(E+K)
+    sequential dependency vmapped over feeds. But with one broadcaster the
+    rank process decomposes per event (reference ``utils.py`` integrals,
+    SURVEY.md section 2 items 11-14):
+
+    - each wall event w raises the rank by 1 until the next own post (or the
+      horizon), so  int r dt   = sum_e  (b_e - w_e)^+  and, numbering walls
+      1..m within their inter-own-post window,
+      int r^2 dt = sum_e (2 i_e - 1)(b_e - w_e)^+   (telescoping i^2),
+      where b_e = min(first own post > w_e, T);
+    - the rank is 0 from each own post (and from the start) until the first
+      wall event >= it, clipped at the next own post and T.
+
+    Everything is searchsorted + gathers over already-sorted arrays —
+    embarrassingly parallel over events AND feeds, which is exactly what the
+    VPU wants. Generalizing to K > 1: rank < K holds from the (K-1)-th wall
+    event of each window until the K-th, giving the same gather shape.
+
+    Tie rule (matches the oracle's argmin-lowest-index pop): an own post at
+    exactly a wall-event time applies FIRST, so the wall event counts into
+    the window STARTED by that own post."""
+    Fl, E = feed_times.shape
+    dtype = feed_times.dtype
+    start = jnp.asarray(cfg.start_time, dtype)
+    end = jnp.asarray(cfg.end_time, dtype)
+    inf = jnp.asarray(jnp.inf, dtype)
+    own_ext = jnp.concatenate([own_times, inf[None]])          # [Kp+1]
+    # Two window-start arrays: integration clips at start_time, but wall
+    # COUNTING must include pre-start walls (the carried-rank convention:
+    # events before the window still build rank history), so window 0 counts
+    # from -inf, not from start_time.
+    own_lo = jnp.concatenate([start[None], own_times])         # [Kp+1]
+    own_cnt = jnp.concatenate([-inf[None], own_times])         # [Kp+1]
+    own_succ = jnp.minimum(jnp.concatenate([own_times, end[None]]), end)
+
+    def one_feed(w_row):
+        w_ext = jnp.concatenate([w_row, inf[None]])            # [E+1]
+
+        # --- wall-event side: int r dt and int r^2 dt -------------------
+        nxt_idx = jnp.searchsorted(own_times, w_row, side="right")
+        b = jnp.minimum(own_ext[nxt_idx], end)                 # window end
+        a = own_cnt[nxt_idx]                                   # window start
+        walls_before = jnp.searchsorted(w_row, a, side="left")
+        i_e = jnp.arange(E) - walls_before + 1                 # 1-based in-window
+        # Left-clipping at start_time keeps the telescoped sum exact: wall i
+        # contributes (i^2 - (i-1)^2) * (b - max(w_i, start))^+ .
+        dt = jnp.maximum(b - jnp.maximum(w_row, start), 0.0)
+        ir = dt.sum()
+        ir2 = ((2.0 * i_e.astype(dtype) - 1.0) * dt).sum()
+
+        # --- own-post side: time below rank K ---------------------------
+        # rank < K from each window start until the window's K-th wall
+        # event (first wall >= the own post: a wall AT an own post counts
+        # into that window — own applies first), clipped at the next own
+        # post and the horizon. Window 0 counts walls from -inf so a rank
+        # built before start_time carries into the integration window.
+        first_wall = jnp.searchsorted(w_row, own_cnt, side="left")
+        w_k = w_ext[jnp.minimum(first_wall + (K - 1), E)]
+        topk = jnp.maximum(
+            jnp.minimum(jnp.minimum(w_k, own_succ), end)
+            - jnp.maximum(own_lo, start),
+            0.0,
+        )
+        return topk.sum(), ir, ir2
+
+    top, ir, ir2 = jax.vmap(one_feed)(feed_times)
+    return FeedMetrics(
+        time_in_top_k=top, int_rank=ir, int_rank2=ir2,
+        follows=jnp.ones((Fl,), bool), start_time=start, end_time=end,
+    )
+
+
+def _feed_metrics_star_scan(cfg: StarConfig, feed_times, own_times, K: int):
+    """Sequential merge-scan twin of :func:`_feed_metrics_star` (the
+    reference-shaped two-pointer walk). Kept as the property-test oracle for
+    the closed form; not used in the hot path.
 
     Tie rule: an own post at exactly a wall-event time applies FIRST (the
     oracle's Manager pops the lowest source index — the controlled

@@ -261,3 +261,110 @@ class TestStarOtherCtrl:
             got, [want[f] for f in range(3)], rtol=1e-4, atol=1e-4
         )
         assert rate_feed0 > 0
+
+
+class TestClosedFormMetrics:
+    """The closed-form (searchsorted/gather) star metrics must match the
+    sequential merge-scan twin exactly — including pads, ties, horizon
+    clipping, and K > 1."""
+
+    def _random_case(self, rng, E=40, Kp=12, T=20.0, start=0.0):
+        import jax.numpy as jnp
+
+        from redqueen_tpu.parallel.bigf import StarConfig
+
+        F = 5
+        # wall times: sorted, some BEFORE start (carried-rank convention) and
+        # some beyond T, inf pads at the tail
+        n_w = rng.randint(0, E, size=F)
+        w = np.full((F, E), np.inf, np.float32)
+        for f in range(F):
+            w[f, : n_w[f]] = np.sort(
+                rng.uniform(start - 0.2 * T, T * 1.2, n_w[f])
+            )
+        # own posts: sorted within [start, T], inf pads
+        n_o = rng.randint(0, Kp)
+        own = np.full(Kp, np.inf, np.float32)
+        own[:n_o] = np.sort(rng.uniform(start, T, n_o))
+        cfg = StarConfig(n_feeds=F, walls_per_feed=1, end_time=T,
+                         start_time=start, wall_cap=E, post_cap=Kp)
+        return cfg, jnp.asarray(w), jnp.asarray(own)
+
+    def test_matches_scan_twin_random(self):
+        from redqueen_tpu.parallel.bigf import (
+            _feed_metrics_star,
+            _feed_metrics_star_scan,
+        )
+
+        rng = np.random.RandomState(0)
+        for trial in range(24):
+            cfg, w, own = self._random_case(
+                rng, start=0.0 if trial % 2 == 0 else 3.0
+            )
+            for K in (1, 2, 3):
+                a = _feed_metrics_star(cfg, w, own, K)
+                b = _feed_metrics_star_scan(cfg, w, own, K)
+                np.testing.assert_allclose(
+                    np.asarray(a.time_in_top_k),
+                    np.asarray(b.time_in_top_k), rtol=1e-5, atol=1e-4,
+                    err_msg=f"top_k trial={trial} K={K}")
+                np.testing.assert_allclose(
+                    np.asarray(a.int_rank), np.asarray(b.int_rank),
+                    rtol=1e-5, atol=1e-4, err_msg=f"ir trial={trial}")
+                np.testing.assert_allclose(
+                    np.asarray(a.int_rank2), np.asarray(b.int_rank2),
+                    rtol=1e-5, atol=1e-3, err_msg=f"ir2 trial={trial}")
+
+    def test_tie_own_post_at_wall_time(self):
+        import jax.numpy as jnp
+
+        from redqueen_tpu.parallel.bigf import (
+            StarConfig,
+            _feed_metrics_star,
+            _feed_metrics_star_scan,
+        )
+
+        T = 10.0
+        cfg = StarConfig(n_feeds=1, walls_per_feed=1, end_time=T,
+                         wall_cap=4, post_cap=2)
+        w = jnp.asarray([[2.0, 5.0, 5.0, np.inf]], jnp.float32)
+        own = jnp.asarray([5.0, np.inf], jnp.float32)  # own post AT wall time
+        a = _feed_metrics_star(cfg, w, own, 1)
+        b = _feed_metrics_star_scan(cfg, w, own, 1)
+        np.testing.assert_allclose(np.asarray(a.time_in_top_k),
+                                   np.asarray(b.time_in_top_k), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a.int_rank),
+                                   np.asarray(b.int_rank), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a.int_rank2),
+                                   np.asarray(b.int_rank2), atol=1e-4)
+        # hand check: own-first tie -> ranks: 0 on [0,2), 1 on [2,5),
+        # reset at 5 then two walls at 5 -> rank 2 on [5,10).
+        assert np.isclose(float(np.asarray(a.time_in_top_k)[0]), 2.0)
+        assert np.isclose(float(np.asarray(a.int_rank)[0]), 3.0 + 10.0)
+
+    def test_prestart_walls_reviewer_case(self):
+        # Walls before start_time must carry rank history into the window:
+        # start=2, T=10, walls=[0.5, 3], own=[5] -> rank 1 on [2,3), 2 on
+        # [3,5), reset, 0 on [5,10): top1=5, int_r=1+4+0=6... computed by the
+        # scan twin; closed form must agree exactly.
+        import jax.numpy as jnp
+
+        from redqueen_tpu.parallel.bigf import (
+            StarConfig,
+            _feed_metrics_star,
+            _feed_metrics_star_scan,
+        )
+
+        cfg = StarConfig(n_feeds=1, walls_per_feed=1, end_time=10.0,
+                         start_time=2.0, wall_cap=2, post_cap=1)
+        w = jnp.asarray([[0.5, 3.0]], jnp.float32)
+        own = jnp.asarray([5.0], jnp.float32)
+        a = _feed_metrics_star(cfg, w, own, 1)
+        b = _feed_metrics_star_scan(cfg, w, own, 1)
+        for field in ("time_in_top_k", "int_rank", "int_rank2"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+                atol=1e-5, err_msg=field)
+        assert np.isclose(float(np.asarray(a.time_in_top_k)[0]), 5.0)
+        assert np.isclose(float(np.asarray(a.int_rank)[0]), 1.0 + 4.0)
+        assert np.isclose(float(np.asarray(a.int_rank2)[0]), 1.0 + 8.0)
