@@ -65,6 +65,38 @@ def build_component(n_followers: int, T: float, q: float, wall_rate: float,
     return cfg, params, adj, opt
 
 
+def run_jax_star(B: int, n_followers: int, T: float, q: float,
+                 wall_rate: float, wall_cap: int, post_cap: int):
+    """Headline graph on the loop-free star-batch engine: each broadcaster
+    component is (1 Opt vs n_followers Poisson walls); the 10k-lane batch is
+    one vmap — streams + sort + suffix-min, no per-event loop at all."""
+    import jax
+    import numpy as np
+
+    from redqueen_tpu.parallel.bigf import (
+        StarBuilder,
+        broadcast_star,
+        simulate_star_batch,
+    )
+
+    sb = StarBuilder(n_feeds=n_followers, end_time=T)
+    for f in range(n_followers):
+        sb.wall_poisson(f, wall_rate)
+    sb.ctrl_opt(q=q)
+    cfg, wall, ctrl = sb.build(wall_cap=wall_cap, post_cap=post_cap)
+    wall_b, ctrl_b = broadcast_star(wall, ctrl, B)
+
+    warm = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B))
+    t0 = time.perf_counter()
+    res = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B) + 10_000)
+    secs = time.perf_counter() - t0  # block_until_ready inside
+
+    events = int(res.wall_n.sum()) + int(res.n_posts.sum())
+    top1 = float(np.asarray(res.metrics.mean_time_in_top_k()).mean())
+    posts = float(res.n_posts.mean())
+    return events, secs, top1, posts
+
+
 def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
             capacity: int):
     import jax
@@ -136,6 +168,14 @@ def main():
                     help="benchmark one of the five BASELINE presets instead "
                          "of the headline graph (see redqueen_tpu.presets / "
                          "benchmarks/run.py for the full harness)")
+    ap.add_argument("--engine", choices=["auto", "star", "scan"],
+                    default="auto",
+                    help="star: loop-free stream/suffix-min batch kernel; "
+                         "scan: the general event-scan kernel (arbitrary "
+                         "graphs/policy mixes); auto (default): time both "
+                         "and report the faster one — the winner differs by "
+                         "backend (scan wins on CPU, star targets the TPU's "
+                         "parallel sort/gather units)")
     args = ap.parse_args()
 
     if args.quick:
@@ -173,11 +213,37 @@ def main():
         return
 
     log(f"graph: {B} broadcasters x {args.followers} followers "
-        f"(= {B * args.followers} feed edges), horizon T={T}")
+        f"(= {B * args.followers} feed edges), horizon T={T}, "
+        f"engine={args.engine}")
 
-    events, secs, top1, posts = run_jax(
-        B, args.followers, T, args.q, args.wall_rate, capacity
-    )
+    def star():
+        # Capacity: Poisson(rate*T) wall events per feed; mean + 9 sigma
+        # headroom rounded up so 100k+ streams cannot overflow.
+        mean_w = args.wall_rate * T
+        wall_cap = int(mean_w + 9 * max(mean_w, 1.0) ** 0.5 + 16)
+        # Opt posting scales ~ sqrt(1/q)-weighted with the wall volume;
+        # 4x headroom (overflow raises loudly rather than truncating).
+        post_cap = max(int(4 * mean_w * max(1.0, args.q ** -0.5)), 64)
+        post_cap = 1 << (post_cap - 1).bit_length()  # round to pow2
+        return run_jax_star(
+            B, args.followers, T, args.q, args.wall_rate, wall_cap, post_cap
+        )
+
+    def scan():
+        return run_jax(B, args.followers, T, args.q, args.wall_rate, capacity)
+
+    if args.engine == "auto":
+        candidates = {}
+        for name, fn in (("scan", scan), ("star", star)):
+            ev, secs, top1, posts = fn()
+            candidates[name] = (ev, secs, top1, posts)
+            log(f"engine {name}: {ev} events in {secs:.3f}s "
+                f"-> {ev / secs:,.0f} events/s")
+        winner = max(candidates, key=lambda n: candidates[n][0] / candidates[n][1])
+        log(f"engine auto -> {winner}")
+        events, secs, top1, posts = candidates[winner]
+    else:
+        events, secs, top1, posts = (star if args.engine == "star" else scan)()
     eps = events / secs
     log(f"jax: {events} events in {secs:.3f}s -> {eps:,.0f} events/s; "
         f"time-in-top-1 {top1:.2f}/{T}, posts/broadcaster {posts:.1f}")

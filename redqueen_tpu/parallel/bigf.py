@@ -69,7 +69,11 @@ __all__ = [
     "CtrlParams",
     "StarBuilder",
     "StarResult",
+    "StarBatchResult",
     "simulate_star",
+    "simulate_star_batch",
+    "stack_star",
+    "broadcast_star",
     "star_to_dataframe",
 ]
 
@@ -237,14 +241,31 @@ def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset):
     suffix = jnp.flip(lax.cummin(jnp.flip(c_sorted)))
     suffix = jnp.concatenate([suffix, jnp.full((1,), jnp.inf, dtype)])
 
-    def fire(t_last, _):
+    # Adaptive fire loop: post_cap bounds the buffer, but the while_loop
+    # exits as soon as the trajectory absorbs (a vmapped while runs until
+    # every lane is done — with 4x-headroom caps that is typically a ~4x
+    # shorter loop than a fixed-length scan). Sharded lanes stay in
+    # lockstep: after the pmin the carry is identical on every shard, so
+    # the loop condition is too.
+    Kp = cfg.post_cap
+    t0 = jnp.asarray(cfg.start_time, dtype)
+    buf0 = jnp.full((Kp,), jnp.inf, dtype)
+
+    def cond(c):
+        t_last, n, _ = c
+        return jnp.isfinite(t_last) & (n < Kp)
+
+    def fire(c):
+        t_last, n, buf = c
         idx = jnp.searchsorted(t_sorted, t_last, side="right")
         t_next = comm.pmin(suffix[idx], "feed")
         t_next = jnp.where(t_next <= cfg.end_time, t_next, jnp.inf)
-        return t_next, t_next
+        buf = buf.at[n].set(t_next)  # +inf write into +inf pad: no-op
+        return t_next, n + jnp.isfinite(t_next).astype(n.dtype), buf
 
-    t0 = jnp.asarray(cfg.start_time, dtype)
-    t_last, own = lax.scan(fire, t0, None, length=cfg.post_cap)
+    t_last, _, own = lax.while_loop(
+        cond, fire, (t0, jnp.zeros((), jnp.int32), buf0)
+    )
     # Overflow: a further post would still fit before the horizon.
     idx = jnp.searchsorted(t_sorted, t_last, side="right")
     more = comm.pmin(suffix[idx], "feed") <= cfg.end_time
@@ -484,6 +505,38 @@ def _get_fn(cfg: StarConfig, metric_K: int, mesh: Optional[Mesh], axis: str,
     return fn
 
 
+def _check_wall_kinds(cfg: StarConfig, wall: WallParams):
+    """A wall slot whose kind is outside the compiled branch set would be
+    silently mis-dispatched by the lookup gather; reject host-side
+    (wall.kind is concrete here — same guard as sim._check_kinds)."""
+    codes, _ = _wall_branches(cfg)
+    got = set(int(k) for k in np.unique(np.asarray(wall.kind)))
+    if not got.issubset(codes):
+        raise ValueError(
+            f"wall slots contain kinds {sorted(got - set(codes))} not in the "
+            f"config's wall_kinds {codes} — build wall params and config "
+            f"from the same StarBuilder"
+        )
+
+
+def _check_overflow(cfg: StarConfig, wall_trunc, post_trunc):
+    """Raise (never truncate silently) when any lane's buffers filled."""
+    n_wall = int(np.asarray(wall_trunc).sum())
+    if n_wall:
+        raise RuntimeError(
+            f"wall stream overflow ({n_wall} lane(s) hit wall_cap="
+            f"{cfg.wall_cap} before the horizon) — raise StarConfig.wall_cap "
+            f"(refusing to truncate silently)"
+        )
+    n_post = int(np.asarray(post_trunc).sum())
+    if n_post:
+        raise RuntimeError(
+            f"posting buffer overflow ({n_post} lane(s) hit post_cap="
+            f"{cfg.post_cap} before the horizon) — raise StarConfig.post_cap "
+            f"(refusing to truncate silently)"
+        )
+
+
 def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
                   seed, mesh: Optional[Mesh] = None, axis: str = "feed",
                   metric_K: int = 1) -> StarResult:
@@ -494,17 +547,7 @@ def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
     at matched seeds (PRNG streams key off GLOBAL feed indices). Raises on
     wall-buffer or post-buffer overflow instead of truncating."""
     key = jr.PRNGKey(seed) if isinstance(seed, (int, np.integer)) else seed
-    # A wall slot whose kind is outside the compiled branch set would be
-    # silently mis-dispatched by the lookup gather; reject host-side
-    # (wall.kind is concrete here — same guard as sim._check_kinds).
-    codes, _ = _wall_branches(cfg)
-    got = set(int(k) for k in np.unique(np.asarray(wall.kind)))
-    if not got.issubset(codes):
-        raise ValueError(
-            f"wall slots contain kinds {sorted(got - set(codes))} not in the "
-            f"config's wall_kinds {codes} — build wall params and config "
-            f"from the same StarBuilder"
-        )
+    _check_wall_kinds(cfg, wall)
 
     if mesh is None:
         out = _get_fn(cfg, metric_K, None, axis, wall, ctrl)(wall, ctrl, key)
@@ -522,22 +565,101 @@ def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
 
     own, n_posts, feed_times, wall_n, metrics, wall_trunc, post_trunc = out
     jax.block_until_ready(own)
-    if bool(wall_trunc):
-        raise RuntimeError(
-            f"wall stream overflow: some wall source hit wall_cap="
-            f"{cfg.wall_cap} before the horizon — raise StarConfig.wall_cap "
-            f"(refusing to truncate silently)"
-        )
-    if bool(post_trunc):
-        raise RuntimeError(
-            f"posting buffer overflow: controlled broadcaster hit post_cap="
-            f"{cfg.post_cap} before the horizon — raise StarConfig.post_cap "
-            f"(refusing to truncate silently)"
-        )
+    _check_overflow(cfg, wall_trunc, post_trunc)
     return StarResult(
         own_times=np.asarray(own), n_posts=int(n_posts),
         wall_times=np.asarray(feed_times), wall_n=np.asarray(wall_n),
         metrics=metrics, cfg=cfg,
+    )
+
+
+class StarBatchResult(NamedTuple):
+    """Host-side result of a batched star run: leaves carry a leading [B]
+    axis (``metrics`` is a FeedMetrics of [B, F] arrays)."""
+
+    own_times: np.ndarray   # [B, post_cap]
+    n_posts: np.ndarray     # [B]
+    wall_n: np.ndarray      # [B, F]
+    metrics: FeedMetrics
+    cfg: StarConfig
+
+
+def stack_star(wall_list: Sequence[WallParams],
+               ctrl_list: Sequence[CtrlParams]):
+    """Stack same-shape star components along a leading batch axis (the
+    sweep/bipartite axis — one lane per broadcaster of the reference's
+    10k x 100k graph, SURVEY.md section 3.5). Parameters may differ freely
+    across lanes; shapes and the controlled-policy kind may not."""
+    wall = jax.tree.map(lambda *xs: jnp.stack(xs), *wall_list)
+    ctrl = jax.tree.map(lambda *xs: jnp.stack(xs), *ctrl_list)
+    return wall, ctrl
+
+
+def broadcast_star(wall: WallParams, ctrl: CtrlParams, B: int):
+    """Tile ONE component to a [B]-lane batch without materializing copies
+    host-side (lanes differ only by seed)."""
+    return (
+        jax.tree.map(lambda x: jnp.broadcast_to(x, (B,) + x.shape), wall),
+        jax.tree.map(
+            lambda x: jnp.broadcast_to(jnp.asarray(x), (B,) + jnp.asarray(x).shape),
+            ctrl,
+        ),
+    )
+
+
+_BATCH_FN_CACHE: dict = {}
+
+
+def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
+                        seeds, mesh: Optional[Mesh] = None,
+                        axis: str = "data",
+                        metric_K: int = 1) -> StarBatchResult:
+    """Run B star components in lockstep — the loop-free engine for the
+    bipartite sweep (BASELINE configs 1/3 and the headline 10k x 100k
+    graph): every lane is one broadcaster vs its follower feeds, the whole
+    batch is one ``vmap`` of the stream/suffix-min kernel, and with ``mesh``
+    the batch shards over the ``data`` axis by input placement (the
+    redqueen_tpu.parallel.shard convention — no kernel changes, so sharded
+    and unsharded runs are bit-identical at matched seeds).
+
+    ``wall``/``ctrl`` leaves carry a leading [B] dim (see :func:`stack_star`
+    / :func:`broadcast_star`); ``seeds`` is an int array [B] or key array
+    [B, 2]. Raises on any lane's buffer overflow, never truncates silently.
+    """
+    seeds = jnp.asarray(seeds)
+    keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
+    B = keys.shape[0]
+    if wall.kind.shape[0] != B:
+        raise ValueError(
+            f"batch dims disagree: seeds={B}, wall={wall.kind.shape[0]}"
+        )
+    _check_wall_kinds(cfg, wall)
+
+    cache_key = (cfg, metric_K, jax.tree.structure((wall, ctrl)))
+    fn = _BATCH_FN_CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(jax.vmap(_make_kernel(cfg, metric_K)))
+        _BATCH_FN_CACHE[cache_key] = fn
+
+    if mesh is not None:
+        n_dev = mesh.shape[axis]
+        if B % n_dev != 0:
+            raise ValueError(
+                f"batch {B} not divisible by mesh axis {axis}={n_dev}"
+            )
+        with mesh:
+            wall = comm.shard_leading(wall, mesh, axis)
+            ctrl = comm.shard_leading(ctrl, mesh, axis)
+            keys = comm.shard_leading(keys, mesh, axis)
+            out = fn(wall, ctrl, keys)
+    else:
+        out = fn(wall, ctrl, keys)
+    own, n_posts, _feed_times, wall_n, metrics, wall_trunc, post_trunc = out
+    jax.block_until_ready(own)
+    _check_overflow(cfg, wall_trunc, post_trunc)
+    return StarBatchResult(
+        own_times=np.asarray(own), n_posts=np.asarray(n_posts),
+        wall_n=np.asarray(wall_n), metrics=metrics, cfg=cfg,
     )
 
 

@@ -368,3 +368,123 @@ class TestClosedFormMetrics:
         assert np.isclose(float(np.asarray(a.time_in_top_k)[0]), 5.0)
         assert np.isclose(float(np.asarray(a.int_rank)[0]), 1.0 + 4.0)
         assert np.isclose(float(np.asarray(a.int_rank2)[0]), 1.0 + 8.0)
+
+
+class TestStarBatch:
+    """simulate_star_batch: the loop-free engine for the bipartite sweep."""
+
+    def test_batch_matches_per_component_runs(self):
+        # vmap over B lanes == B separate simulate_star calls at matched
+        # seeds, bit for bit.
+        from redqueen_tpu.parallel.bigf import (
+            broadcast_star,
+            simulate_star,
+            simulate_star_batch,
+        )
+
+        cfg, wall, ctrl = star_poisson(n_feeds=6, T=30.0)
+        B = 5
+        wall_b, ctrl_b = broadcast_star(wall, ctrl, B)
+        res = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B))
+        assert res.own_times.shape == (B, cfg.post_cap)
+        for lane in range(B):
+            single = simulate_star(cfg, wall, ctrl, seed=lane)
+            np.testing.assert_array_equal(res.own_times[lane],
+                                          single.own_times)
+            assert res.n_posts[lane] == single.n_posts
+            np.testing.assert_allclose(
+                np.asarray(res.metrics.time_in_top_k)[lane],
+                np.asarray(single.metrics.time_in_top_k), rtol=1e-6)
+
+    def test_sharded_over_data_axis_bit_identical(self):
+        from redqueen_tpu.parallel.bigf import (
+            broadcast_star,
+            simulate_star_batch,
+        )
+
+        cfg, wall, ctrl = star_poisson(n_feeds=4, T=25.0)
+        B = 8
+        wall_b, ctrl_b = broadcast_star(wall, ctrl, B)
+        a = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B))
+        mesh = comm.make_mesh({"data": 8})
+        b = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B), mesh=mesh)
+        np.testing.assert_array_equal(a.own_times, b.own_times)
+        np.testing.assert_allclose(np.asarray(a.metrics.time_in_top_k),
+                                   np.asarray(b.metrics.time_in_top_k),
+                                   rtol=1e-6)
+
+    def test_quality_parity_with_oracle_config1(self):
+        # The headline-bench shape: Opt vs 10 per-feed Poisson walls; batch
+        # lanes are seeds. Mean time-in-top-1 and budget within 4 sigma of
+        # the NumPy oracle.
+        from redqueen_tpu.parallel.bigf import (
+            broadcast_star,
+            simulate_star_batch,
+        )
+
+        F, T, q, rate, B = 10, 60.0, 1.0, 1.0, 16
+        cfg, wall, ctrl = star_poisson(n_feeds=F, T=T, q=q, wall_rate=rate,
+                                       wall_cap=128, post_cap=512)
+        wall_b, ctrl_b = broadcast_star(wall, ctrl, B)
+        res = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B))
+        tops_j = np.asarray(res.metrics.mean_time_in_top_k())
+        posts_j = res.n_posts
+
+        tops_o, posts_o = [], []
+        for seed in range(B):
+            others = [
+                ("poisson", dict(src_id=100 + i, seed=8000 + 131 * seed + i,
+                                 rate=rate, sink_ids=[i]))
+                for i in range(F)
+            ]
+            so = SimOpts(src_id=0, sink_ids=list(range(F)),
+                         other_sources=others, end_time=T, q=q)
+            mgr = so.create_manager_with_opt(seed=seed)
+            mgr.run_till()
+            df = mgr.state.get_dataframe()
+            tops_o.append(mp.time_in_top_k(df, 1, T, src_id=0,
+                                           sink_ids=so.sink_ids))
+            posts_o.append(mp.num_posts_of_src(df, 0))
+        d = abs(tops_j.mean() - np.mean(tops_o))
+        se = np.sqrt(tops_j.var() / B + np.var(tops_o) / B)
+        assert d < 4 * max(se, 1e-9), (tops_j.mean(), np.mean(tops_o))
+        dp = abs(posts_j.mean() - np.mean(posts_o))
+        sep = np.sqrt(posts_j.var() / B + np.var(posts_o) / B)
+        assert dp < 4 * max(sep, 1e-9), (posts_j.mean(), np.mean(posts_o))
+
+    def test_overflow_raises_with_lane_count(self):
+        from redqueen_tpu.parallel.bigf import (
+            broadcast_star,
+            simulate_star_batch,
+        )
+
+        cfg, wall, ctrl = star_poisson(n_feeds=3, T=100.0, wall_rate=5.0,
+                                       wall_cap=16)
+        wall_b, ctrl_b = broadcast_star(wall, ctrl, 4)
+        with pytest.raises(RuntimeError, match="wall stream overflow"):
+            simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(4))
+
+    def test_stack_star_heterogeneous_params(self):
+        # Lanes may differ in wall rates / q: a q sweep as one batch.
+        import jax.numpy as jnp
+
+        from redqueen_tpu.parallel.bigf import (
+            StarBuilder,
+            simulate_star_batch,
+            stack_star,
+        )
+
+        T, F = 40.0, 4
+        bundles = []
+        for q in (0.3, 3.0):
+            sb = StarBuilder(n_feeds=F, end_time=T)
+            for f in range(F):
+                sb.wall_poisson(f, 1.0)
+            sb.ctrl_opt(q=q)
+            bundles.append(sb.build(wall_cap=128, post_cap=1024))
+        cfg = bundles[0][0]
+        wall_b, ctrl_b = stack_star([b[1] for b in bundles],
+                                    [b[2] for b in bundles])
+        res = simulate_star_batch(cfg, wall_b, ctrl_b, np.array([0, 0]))
+        # smaller q -> higher posting intensity
+        assert res.n_posts[0] > res.n_posts[1]
