@@ -1,0 +1,83 @@
+"""Golden statistical tests (SURVEY.md section 4.5): fixed-seed runs produce
+exact event logs because the JAX PRNG is deterministic — cheap regression
+tests with no tolerances. A failure here means the sampled streams changed:
+either an unintended semantic drift (a bug) or a deliberate PRNG-discipline
+change, in which case regenerate these constants and say so in the commit.
+
+Values generated on the CPU backend (the test backend per conftest.py);
+float comparisons use 1e-4 — loose enough for cross-platform fastmath
+reassociation, tight enough that any stream change trips it.
+"""
+
+import numpy as np
+
+from redqueen_tpu import GraphBuilder, simulate, simulate_batch, stack_components
+from redqueen_tpu.parallel.bigf import (
+    StarBuilder,
+    broadcast_star,
+    simulate_star,
+    simulate_star_batch,
+)
+from redqueen_tpu.utils.metrics import feed_metrics
+
+T = 20.0
+
+
+def _component():
+    gb = GraphBuilder(n_sinks=4, end_time=T)
+    me = gb.add_opt(q=1.0)
+    for i in range(4):
+        gb.add_poisson(rate=1.0, sinks=[i])
+    cfg, p0, a0 = gb.build(capacity=256)
+    return cfg, p0, a0, me
+
+
+def _star():
+    sb = StarBuilder(n_feeds=4, end_time=T)
+    for f in range(4):
+        sb.wall_poisson(f, 1.0)
+    sb.ctrl_opt(q=1.0)
+    return sb.build(wall_cap=64, post_cap=128)
+
+
+def test_golden_scan_single():
+    cfg, p0, a0, me = _component()
+    log = simulate(cfg, p0, a0, seed=42)
+    assert int(log.n_events) == 105
+    np.testing.assert_allclose(
+        np.asarray(log.times)[:5],
+        [0.259291, 0.378744, 0.41326, 0.420472, 0.447331], atol=1e-4)
+    assert np.asarray(log.srcs)[:5].tolist() == [1, 2, 0, 1, 3]
+    m = feed_metrics(log.times, log.srcs, a0, me, T)
+    np.testing.assert_allclose(
+        float(m.mean_time_in_top_k()), 12.954633, atol=1e-4)
+
+
+def test_golden_scan_batch():
+    cfg, p0, a0, me = _component()
+    params, adj = stack_components([p0] * 3, [a0] * 3)
+    logb = simulate_batch(cfg, params, adj, np.array([7, 8, 9]))
+    assert np.asarray(logb.n_events).tolist() == [114, 102, 96]
+    np.testing.assert_allclose(
+        np.asarray(logb.times)[:, 0],
+        [0.228758, 0.207175, 0.07253], atol=1e-4)
+
+
+def test_golden_star_single():
+    scfg, wall, ctrl = _star()
+    res = simulate_star(scfg, wall, ctrl, seed=42)
+    assert res.n_posts == 26
+    np.testing.assert_allclose(
+        res.own_times[:3], [1.268021, 2.689512, 3.328598], atol=1e-4)
+    np.testing.assert_allclose(
+        float(np.asarray(res.metrics.mean_time_in_top_k()).mean()),
+        14.374208, atol=1e-4)
+
+
+def test_golden_star_batch():
+    scfg, wall, ctrl = _star()
+    wb, cb = broadcast_star(wall, ctrl, 3)
+    rb = simulate_star_batch(scfg, wb, cb, np.array([7, 8, 9]))
+    assert rb.n_posts.tolist() == [23, 24, 32]
+    np.testing.assert_allclose(
+        rb.own_times[:, 0], [0.726041, 0.337657, 0.670188], atol=1e-4)
