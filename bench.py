@@ -97,6 +97,35 @@ def run_jax_star(B: int, n_followers: int, T: float, q: float,
     return events, secs, top1, posts
 
 
+def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
+                   wall_rate: float, capacity: int):
+    """Headline graph on the Pallas event-scan engine: the whole chunk is one
+    fused kernel with state resident in VMEM (ops/pallas_chunk.py). TPU
+    only — interpret mode exists for tests, not timing."""
+    import jax
+    from redqueen_tpu.config import stack_components
+    from redqueen_tpu.ops.pallas_chunk import simulate_pallas
+    from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
+
+    cfg, p0, a0, opt = build_component(n_followers, T, q, wall_rate, capacity)
+    params, adj = stack_components([p0] * B, [a0] * B)
+    adj_b = jax.numpy.broadcast_to(a0, (B,) + a0.shape)
+
+    warm = simulate_pallas(cfg, params, adj, np.arange(B), max_chunks=64)
+    jax.block_until_ready(warm.times)
+    t0 = time.perf_counter()
+    log = simulate_pallas(cfg, params, adj, np.arange(B) + 10_000,
+                          max_chunks=64)
+    jax.block_until_ready(log.times)
+    secs = time.perf_counter() - t0
+
+    events = int(np.asarray(log.n_events).sum())
+    m = feed_metrics_batch(log.times, log.srcs, adj_b, opt, T)
+    top1 = float(np.asarray(m.mean_time_in_top_k()).mean())
+    posts = float(np.asarray(num_posts(log.srcs, opt)).mean())
+    return events, secs, top1, posts
+
+
 def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
             capacity: int):
     import jax
@@ -168,14 +197,14 @@ def main():
                     help="benchmark one of the five BASELINE presets instead "
                          "of the headline graph (see redqueen_tpu.presets / "
                          "benchmarks/run.py for the full harness)")
-    ap.add_argument("--engine", choices=["auto", "star", "scan"],
+    ap.add_argument("--engine", choices=["auto", "star", "scan", "pallas"],
                     default="auto",
                     help="star: loop-free stream/suffix-min batch kernel; "
                          "scan: the general event-scan kernel (arbitrary "
-                         "graphs/policy mixes); auto (default): time both "
-                         "and report the faster one — the winner differs by "
-                         "backend (scan wins on CPU, star targets the TPU's "
-                         "parallel sort/gather units)")
+                         "graphs/policy mixes); pallas: the VMEM-resident "
+                         "fused chunk kernel (TPU only); auto (default): "
+                         "time the engines available on this backend and "
+                         "report the fastest")
     args = ap.parse_args()
 
     if args.quick:
@@ -232,18 +261,36 @@ def main():
     def scan():
         return run_jax(B, args.followers, T, args.q, args.wall_rate, capacity)
 
+    def pallas():
+        return run_jax_pallas(B, args.followers, T, args.q, args.wall_rate,
+                              capacity)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
     if args.engine == "auto":
+        entries = [("scan", scan), ("star", star)]
+        if on_tpu:  # interpret mode exists for tests, not timing
+            entries.append(("pallas", pallas))
         candidates = {}
-        for name, fn in (("scan", scan), ("star", star)):
-            ev, secs, top1, posts = fn()
+        for name, fn in entries:
+            try:
+                ev, secs, top1, posts = fn()
+            except Exception as e:  # an engine failing must not kill bench
+                log(f"engine {name} FAILED: {e}")
+                continue
             candidates[name] = (ev, secs, top1, posts)
             log(f"engine {name}: {ev} events in {secs:.3f}s "
                 f"-> {ev / secs:,.0f} events/s")
+        if not candidates:
+            raise RuntimeError(
+                "all engines failed (see per-engine errors above) — no "
+                "benchmark result to report"
+            )
         winner = max(candidates, key=lambda n: candidates[n][0] / candidates[n][1])
         log(f"engine auto -> {winner}")
         events, secs, top1, posts = candidates[winner]
     else:
-        events, secs, top1, posts = (star if args.engine == "star" else scan)()
+        fn = {"star": star, "scan": scan, "pallas": pallas}[args.engine]
+        events, secs, top1, posts = fn()
     eps = events / secs
     log(f"jax: {events} events in {secs:.3f}s -> {eps:,.0f} events/s; "
         f"time-in-top-1 {top1:.2f}/{T}, posts/broadcaster {posts:.1f}")

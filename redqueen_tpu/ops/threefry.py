@@ -1,0 +1,64 @@
+"""Threefry-2x32 as pure 32-bit jnp ops — a counter-based PRNG usable INSIDE
+Pallas kernels (and in interpret mode), bit-identical to JAX's own
+``threefry_2x32`` (Salmon et al., "Parallel random numbers: as easy as
+1, 2, 3", SC'11; validated against the random123 test vectors and against
+``jax._src.prng`` in tests/test_pallas_chunk.py).
+
+Why this exists: the TPU event-scan Pallas kernel (ops/pallas_chunk.py)
+keeps all simulation state in VMEM across a whole chunk; its draws must be
+generated in-kernel. ``pltpu.prng_random_bits`` has no interpret-mode
+lowering, so the kernel instead uses this implementation — plain shifts,
+xors and adds that Mosaic and the interpreter both handle, with the same
+per-source (key, counter) stream discipline as the XLA engine.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["threefry2x32", "uniform_from_bits", "exponential_from_bits"]
+
+# Python-int constants (not jnp scalars): Pallas kernels may not capture
+# traced constant arrays, and uint32-array (op) python-int stays uint32.
+_ROTATIONS = ((13, 15, 26, 6), (17, 29, 16, 24))
+_PARITY = 0x1BD11BDA
+
+
+def _rotl(x, d):
+    return (x << d) | (x >> (32 - d))
+
+
+def threefry2x32(k0, k1, x0, x1):
+    """One threefry-2x32 block: key (k0, k1), counter (x0, x1) -> two uint32
+    words. All inputs uint32 arrays of a common shape; vectorizes
+    elementwise."""
+    k0 = jnp.asarray(k0, jnp.uint32)
+    k1 = jnp.asarray(k1, jnp.uint32)
+    x0 = jnp.asarray(x0, jnp.uint32)
+    x1 = jnp.asarray(x1, jnp.uint32)
+    ks = (k0, k1, k0 ^ k1 ^ _PARITY)
+
+    x0 = x0 + ks[0]
+    x1 = x1 + ks[1]
+    # 5 four-round groups with a key injection after each.
+    for group in range(5):
+        rots = _ROTATIONS[group % 2]
+        for r in rots:
+            x0 = x0 + x1
+            x1 = _rotl(x1, r)
+            x1 = x0 ^ x1
+        x0 = x0 + ks[(group + 1) % 3]
+        x1 = x1 + ks[(group + 2) % 3] + (group + 1)
+    return x0, x1
+
+
+def uniform_from_bits(bits):
+    """uint32 bits -> float32 uniform in [0, 1): top 24 bits scaled by 2^-24.
+    (Arithmetic rather than the bitcast mantissa trick so the same code
+    lowers in Pallas/Mosaic, interpret mode, and plain XLA.)"""
+    return (bits >> 8).astype(jnp.float32) * 2.0**-24
+
+
+def exponential_from_bits(bits):
+    """uint32 bits -> Exp(1) float32 draw: -log1p(-U), U in [0, 1)."""
+    return -jnp.log1p(-uniform_from_bits(bits))
