@@ -97,52 +97,23 @@ def run_jax_star(B: int, n_followers: int, T: float, q: float,
     return events, secs, top1, posts
 
 
-def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
-                   wall_rate: float, capacity: int):
-    """Headline graph on the Pallas event-scan engine: the whole chunk is one
-    fused kernel with state resident in VMEM (ops/pallas_chunk.py). TPU
-    only — interpret mode exists for tests, not timing."""
+def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
+                          q: float, wall_rate: float, capacity: int):
+    """Shared harness for engines with the EventLog contract: build the
+    component batch, one warm-up run (compilation), one timed run, metrics.
+    ``simulate_fn(cfg, params, adj, seeds)`` -> EventLog."""
     import jax
     from redqueen_tpu.config import stack_components
-    from redqueen_tpu.ops.pallas_chunk import simulate_pallas
     from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
 
     cfg, p0, a0, opt = build_component(n_followers, T, q, wall_rate, capacity)
     params, adj = stack_components([p0] * B, [a0] * B)
     adj_b = jax.numpy.broadcast_to(a0, (B,) + a0.shape)
 
-    warm = simulate_pallas(cfg, params, adj, np.arange(B), max_chunks=64)
+    warm = simulate_fn(cfg, params, adj, np.arange(B))
     jax.block_until_ready(warm.times)
     t0 = time.perf_counter()
-    log = simulate_pallas(cfg, params, adj, np.arange(B) + 10_000,
-                          max_chunks=64)
-    jax.block_until_ready(log.times)
-    secs = time.perf_counter() - t0
-
-    events = int(np.asarray(log.n_events).sum())
-    m = feed_metrics_batch(log.times, log.srcs, adj_b, opt, T)
-    top1 = float(np.asarray(m.mean_time_in_top_k()).mean())
-    posts = float(np.asarray(num_posts(log.srcs, opt)).mean())
-    return events, secs, top1, posts
-
-
-def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
-            capacity: int):
-    import jax
-    from redqueen_tpu.config import stack_components
-    from redqueen_tpu.sim import simulate_batch
-    from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
-
-    cfg, p0, a0, opt = build_component(n_followers, T, q, wall_rate, capacity)
-    params, adj = stack_components([p0] * B, [a0] * B)
-    adj_b = jax.numpy.broadcast_to(a0, (B,) + a0.shape)
-
-    # Warm-up: compiles the chunk kernel (cached for the timed run).
-    warm = simulate_batch(cfg, params, adj, np.arange(B), max_chunks=64)
-    jax.block_until_ready(warm.times)
-
-    t0 = time.perf_counter()
-    logb = simulate_batch(cfg, params, adj, np.arange(B) + 10_000, max_chunks=64)
+    logb = simulate_fn(cfg, params, adj, np.arange(B) + 10_000)
     jax.block_until_ready(logb.times)
     secs = time.perf_counter() - t0
 
@@ -151,6 +122,25 @@ def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
     top1 = float(np.asarray(m.mean_time_in_top_k()).mean())
     posts = float(np.asarray(num_posts(logb.srcs, opt)).mean())
     return events, secs, top1, posts
+
+
+def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
+                   wall_rate: float, capacity: int):
+    """Headline graph on the Pallas event-scan engine: the whole chunk is one
+    fused kernel with state resident in VMEM (ops/pallas_chunk.py). TPU
+    only — interpret mode exists for tests, not timing."""
+    from redqueen_tpu.ops.pallas_chunk import simulate_pallas
+
+    fn = lambda cfg, p, a, s: simulate_pallas(cfg, p, a, s, max_chunks=64)
+    return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate, capacity)
+
+
+def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
+            capacity: int):
+    from redqueen_tpu.sim import simulate_batch
+
+    fn = lambda cfg, p, a, s: simulate_batch(cfg, p, a, s, max_chunks=64)
+    return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate, capacity)
 
 
 def run_oracle(n_comps: int, n_followers: int, T: float, q: float,

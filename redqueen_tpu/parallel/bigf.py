@@ -548,6 +548,12 @@ def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
     wall-buffer or post-buffer overflow instead of truncating."""
     key = jr.PRNGKey(seed) if isinstance(seed, (int, np.integer)) else seed
     _check_wall_kinds(cfg, wall)
+    if mesh is not None and axis != "feed":
+        # The kernel's collectives (pmin/pany and the global-feed-index PRNG
+        # offset) are bound to the axis NAME "feed"; any other name would
+        # silently skip the reduction and corrupt results.
+        raise ValueError(f"the follower mesh axis must be named 'feed', got "
+                         f"{axis!r}")
 
     if mesh is None:
         out = _get_fn(cfg, metric_K, None, axis, wall, ctrl)(wall, ctrl, key)
@@ -610,9 +616,44 @@ def broadcast_star(wall: WallParams, ctrl: CtrlParams, B: int):
 _BATCH_FN_CACHE: dict = {}
 
 
+def _batch_specs(wall: WallParams, ctrl: CtrlParams, dp: str, fp):
+    """(in_specs, out_specs) for shard_map over a [B]-batched star kernel:
+    batch dim over ``dp``; the per-feed dim (axis 1 of wall leaves) over
+    ``fp`` when given."""
+    def wall_spec(x):
+        rest = [None] * (jnp.asarray(x).ndim - 2)
+        return P(dp, fp, *rest)
+
+    def lead_spec(x):
+        rest = [None] * (jnp.asarray(x).ndim - 1)
+        return P(dp, *rest)
+
+    in_specs = (
+        jax.tree.map(wall_spec, wall),
+        jax.tree.map(lead_spec, ctrl),
+        P(dp, None),                      # keys [B, 2]
+    )
+    feedP = P(dp, fp)
+    metrics_spec = FeedMetrics(
+        time_in_top_k=feedP, int_rank=feedP, int_rank2=feedP,
+        follows=feedP,
+        start_time=P(dp), end_time=P(dp),  # vmapped scalars -> [B]
+    )
+    out_specs = (
+        P(dp, None),     # own_times [B, post_cap] (replicated over feed)
+        P(dp),           # n_posts [B]
+        P(dp, fp, None),  # feed_times [B, F, E]
+        P(dp, fp),       # wall_n [B, F]
+        metrics_spec,
+        P(dp),           # wall_trunc [B] (pany over feed inside the kernel)
+        P(dp),           # post_trunc [B]
+    )
+    return in_specs, out_specs
+
+
 def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
                         seeds, mesh: Optional[Mesh] = None,
-                        axis: str = "data",
+                        axis: str = "data", feed_axis: Optional[str] = None,
                         metric_K: int = 1) -> StarBatchResult:
     """Run B star components in lockstep — the loop-free engine for the
     bipartite sweep (BASELINE configs 1/3 and the headline 10k x 100k
@@ -625,6 +666,13 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
     ``wall``/``ctrl`` leaves carry a leading [B] dim (see :func:`stack_star`
     / :func:`broadcast_star`); ``seeds`` is an int array [B] or key array
     [B, 2]. Raises on any lane's buffer overflow, never truncates silently.
+
+    With ``feed_axis`` as well, the mesh is 2-D — components over ``axis``
+    (dp) x followers-within-a-component over ``feed_axis`` (the sequence-
+    parallel analogue): the kernel runs under ``shard_map`` with the
+    RedQueen clock reduction riding ``pmin`` over the feed axis, and per-
+    source PRNG streams keyed off GLOBAL feed indices, so every mesh layout
+    (1x8, 2x4, 8x1, unsharded) is bit-identical at matched seeds.
     """
     seeds = jnp.asarray(seeds)
     keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
@@ -634,11 +682,21 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
             f"batch dims disagree: seeds={B}, wall={wall.kind.shape[0]}"
         )
     _check_wall_kinds(cfg, wall)
+    if feed_axis is not None and feed_axis != "feed":
+        raise ValueError(f"the follower mesh axis must be named 'feed', got "
+                         f"{feed_axis!r} (kernel collectives bind to the "
+                         f"name)")
 
-    cache_key = (cfg, metric_K, jax.tree.structure((wall, ctrl)))
+    cache_key = (cfg, metric_K, mesh, axis, feed_axis,
+                 jax.tree.structure((wall, ctrl)))
     fn = _BATCH_FN_CACHE.get(cache_key)
     if fn is None:
-        fn = jax.jit(jax.vmap(_make_kernel(cfg, metric_K)))
+        vk = jax.vmap(_make_kernel(cfg, metric_K))
+        if mesh is not None and feed_axis is not None:
+            in_specs, out_specs = _batch_specs(wall, ctrl, axis, feed_axis)
+            vk = jax.shard_map(vk, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+        fn = jax.jit(vk)
         _BATCH_FN_CACHE[cache_key] = fn
 
     if mesh is not None:
@@ -647,11 +705,21 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
             raise ValueError(
                 f"batch {B} not divisible by mesh axis {axis}={n_dev}"
             )
-        with mesh:
-            wall = comm.shard_leading(wall, mesh, axis)
-            ctrl = comm.shard_leading(ctrl, mesh, axis)
-            keys = comm.shard_leading(keys, mesh, axis)
-            out = fn(wall, ctrl, keys)
+        if feed_axis is not None:
+            n_feed = mesh.shape[feed_axis]
+            if cfg.n_feeds % n_feed != 0:
+                raise ValueError(
+                    f"n_feeds={cfg.n_feeds} not divisible by mesh axis "
+                    f"{feed_axis}={n_feed}"
+                )
+            with mesh:
+                out = fn(wall, ctrl, keys)
+        else:
+            with mesh:
+                wall = comm.shard_leading(wall, mesh, axis)
+                ctrl = comm.shard_leading(ctrl, mesh, axis)
+                keys = comm.shard_leading(keys, mesh, axis)
+                out = fn(wall, ctrl, keys)
     else:
         out = fn(wall, ctrl, keys)
     own, n_posts, _feed_times, wall_n, metrics, wall_trunc, post_trunc = out

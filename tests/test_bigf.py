@@ -488,3 +488,45 @@ class TestStarBatch:
         res = simulate_star_batch(cfg, wall_b, ctrl_b, np.array([0, 0]))
         # smaller q -> higher posting intensity
         assert res.n_posts[0] > res.n_posts[1]
+
+    def test_2d_mesh_layouts_bit_identical(self):
+        # dp x sp analogue: components over "data" x followers over "feed";
+        # every layout must equal the unsharded run bit for bit (PRNG keys
+        # off global indices; clock reduction rides pmin over "feed").
+        from redqueen_tpu.parallel.bigf import (
+            broadcast_star,
+            simulate_star_batch,
+        )
+
+        cfg, wall, ctrl = star_poisson(n_feeds=8, T=25.0)
+        B = 8
+        wb, cb = broadcast_star(wall, ctrl, B)
+        ref = simulate_star_batch(cfg, wb, cb, np.arange(B))
+        for shape in ({"data": 4, "feed": 2}, {"data": 2, "feed": 4},
+                      {"data": 1, "feed": 8}):
+            mesh = comm.make_mesh(shape)
+            r = simulate_star_batch(cfg, wb, cb, np.arange(B), mesh=mesh,
+                                    feed_axis="feed")
+            np.testing.assert_array_equal(ref.own_times, r.own_times,
+                                          err_msg=str(shape))
+            np.testing.assert_allclose(
+                np.asarray(ref.metrics.time_in_top_k),
+                np.asarray(r.metrics.time_in_top_k), rtol=1e-6,
+                err_msg=str(shape))
+
+    def test_feed_axis_name_is_enforced(self):
+        from redqueen_tpu.parallel.bigf import (
+            broadcast_star,
+            simulate_star,
+            simulate_star_batch,
+        )
+
+        cfg, wall, ctrl = star_poisson(n_feeds=8)
+        mesh = comm.make_mesh({"data": 4, "sp": 2})
+        wb, cb = broadcast_star(wall, ctrl, 4)
+        with pytest.raises(ValueError, match="must be named 'feed'"):
+            simulate_star_batch(cfg, wb, cb, np.arange(4), mesh=mesh,
+                                feed_axis="sp")
+        mesh1 = comm.make_mesh({"f": 8})
+        with pytest.raises(ValueError, match="must be named 'feed'"):
+            simulate_star(cfg, wall, ctrl, seed=0, mesh=mesh1, axis="f")
