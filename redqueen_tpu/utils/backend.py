@@ -1,0 +1,50 @@
+"""Default-backend liveness probing for the axon TPU tunnel.
+
+The single real TPU chip in this environment sits behind a remote tunnel; a
+killed/timeouted TPU process can wedge the tunnel so that ``jax.devices()``
+HANGS forever rather than raising (observed as the round-1 rc=124
+MULTICHIP failure and the all-session bench fallback). An in-process
+try/except cannot catch a hang, so the probe runs ``jax.devices()`` in a
+SUBPROCESS with a deadline. Both ``bench.py`` and ``__graft_entry__.py``
+share this helper so tunnel-behavior fixes land in exactly one place.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import Callable, Optional, Tuple
+
+__all__ = ["probe_default_backend"]
+
+
+def probe_default_backend(
+    deadline_s: float = 120.0, log: Optional[Callable] = None
+) -> Tuple[bool, int, str]:
+    """Probe the DEFAULT jax backend in a subprocess with a deadline.
+
+    Returns ``(alive, n_devices, platform)``; ``alive`` is True iff backend
+    init completed within the deadline. Never initializes a backend in the
+    calling process.
+    """
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print('PROBE_OK', len(d), d[0].platform)"],
+            timeout=deadline_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        if log:
+            log(f"default backend probe hung > {deadline_s}s; assuming TPU "
+                f"tunnel is down")
+        return False, 0, ""
+    # Parse defensively: jax/plugin init may print banners around our line.
+    for line in reversed(r.stdout.strip().splitlines() if r.stdout else []):
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "PROBE_OK" and r.returncode == 0:
+            return True, int(parts[1]), parts[2]
+    if log:
+        tail = r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ""
+        log(f"default backend probe failed (rc={r.returncode}): {tail}")
+    return False, 0, ""
