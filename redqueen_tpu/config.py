@@ -34,7 +34,31 @@ from .models.base import (
     KIND_RMTPP,
 )
 
-__all__ = ["SimConfig", "SourceParams", "GraphBuilder", "stack_components"]
+__all__ = [
+    "SimConfig", "SourceParams", "GraphBuilder", "stack_components",
+    "check_piecewise",
+]
+
+
+def check_piecewise(change_times, rates):
+    """Validate a piecewise-constant rate spec and return ``(ct, rates)`` as
+    float64 arrays (explicit raises, not asserts — asserts vanish under
+    ``python -O``). Shared by GraphBuilder / StarBuilder / the oracle
+    factories."""
+    ct = np.asarray(change_times, np.float64)
+    r = np.asarray(rates, np.float64)
+    if ct.shape != r.shape:
+        raise ValueError(
+            f"change_times and rates must have equal shapes, got "
+            f"{ct.shape} vs {r.shape}"
+        )
+    if ct.ndim != 1 or ct.size == 0:
+        raise ValueError(
+            f"change_times must be a non-empty 1-D array, got shape {ct.shape}"
+        )
+    if not np.all(np.diff(ct) > 0):
+        raise ValueError("change_times must be strictly increasing")
+    return ct, r
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,9 +116,15 @@ class SimState(struct.PyTreeNode):
     exc_t: jnp.ndarray    # f[S]   excitation fold time
     rd_ptr: jnp.ndarray   # i32[S] RealData replay cursor
     h: jnp.ndarray        # f[S,H] RMTPP recurrent state
+    key: jnp.ndarray      # u32[2] component key (the fused per-step panel
+    #                       draws fold this with the global event index)
     keys: jnp.ndarray     # u32[S,2] per-source PRNG base keys
     ctr: jnp.ndarray      # u32[S] per-source draw counters (fold_in stream)
     n_events: jnp.ndarray  # i32[] events emitted so far (all chunks)
+    # Absolute event-count stop (the oracle's ``run_dynamic(max_events)``,
+    # SURVEY.md section 2 item 9): the scan absorbs once n_events reaches it.
+    # None = unbounded (run to the horizon).
+    budget: Optional[jnp.ndarray] = None  # i32[]
 
     # Note: per-(source, sink) feed ranks are deliberately NOT carried. The
     # Opt policy samples via superposition clocks (models/opt.py) and the
@@ -120,7 +150,11 @@ class GraphBuilder:
         self.s_sink = (
             np.ones(n_sinks) if s_sink is None else np.asarray(s_sink, np.float64)
         )
-        assert self.s_sink.shape == (self.n_sinks,)
+        if self.s_sink.shape != (self.n_sinks,):
+            raise ValueError(
+                f"s_sink must have shape ({self.n_sinks},), got "
+                f"{self.s_sink.shape}"
+            )
         self._rows: List[dict] = []
 
     # ---- source constructors (reference: SimOpts other_sources specs) ----
@@ -143,10 +177,7 @@ class GraphBuilder:
 
     def add_piecewise(self, change_times: Sequence[float],
                       rates: Sequence[float], sinks=None) -> int:
-        ct = np.asarray(change_times, np.float64)
-        r = np.asarray(rates, np.float64)
-        assert ct.shape == r.shape and np.all(np.diff(ct) > 0)
-        return self._add(KIND_PIECEWISE, sinks, pw=(ct, r))
+        return self._add(KIND_PIECEWISE, sinks, pw=check_piecewise(change_times, rates))
 
     def add_realdata(self, times: Sequence[float], sinks=None) -> int:
         return self._add(KIND_REALDATA, sinks, rd=np.sort(np.asarray(times, np.float64)))

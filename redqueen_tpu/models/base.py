@@ -16,14 +16,20 @@ traced values):
 
 - ``on_init(params, extra, s, t0, key) -> SourceUpdate``
     first draw for source ``s`` at simulation start.
-- ``on_fire(params, state, s, t, key) -> SourceUpdate``
+- ``on_fire(params, state, s, t, key, u) -> SourceUpdate``
     source ``s`` just posted at time ``t``; return its refreshed per-source
-    state (scalars; scattered back at index ``s`` by the kernel).
-- ``on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid) ->
+    state (scalars; scattered back at index ``s`` by the kernel). ``u`` is
+    the step's pre-drawn Uniform[0,1) fire word from the fused panel —
+    policies needing exactly one draw use it (Poisson); policies with
+    open-ended randomness (Hawkes thinning, RMTPP) use ``key``, the
+    per-source (key, ctr) stream.
+- ``on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid, us) ->
     (t_next[S], ctr_bump bool[S])`` — optional; adjust next-event times of
     non-fired sources in response to the fired source's post (the RedQueen
-    superposition trick lives here). ``cfg`` carries static specialization
-    info (e.g. ``cfg.opt_rows``) so hooks can unroll over known rows.
+    superposition trick lives here). ``us`` [S] is the fused panel's react
+    words (one per source, this event). ``cfg`` carries static
+    specialization info (e.g. ``cfg.opt_rows``) so hooks can unroll over
+    known rows.
 """
 
 from __future__ import annotations
@@ -76,6 +82,10 @@ class PolicyDef(NamedTuple):
     on_init: Callable
     on_fire: Callable
     on_react: Optional[Callable] = None
+    # False when on_fire ignores ``key`` (draws only from the fused panel's
+    # ``u`` or from no randomness at all): a component whose kinds all have
+    # False compiles with NO per-source fold_in chain in the hot step.
+    fire_uses_key: bool = True
 
 
 _REGISTRY: Dict[int, PolicyDef] = {}

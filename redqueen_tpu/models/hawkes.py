@@ -27,7 +27,7 @@ def on_init(params, state, s, t0, key):
     )
 
 
-def on_fire(params, state, s, t, key):
+def on_fire(params, state, s, t, key, u):
     # Fold the decayed excitation to the fire time and add this event's jump.
     decay = jnp.exp(-params.beta[s] * (t - state.exc_t[s]))
     exc = state.exc[s] * decay + params.alpha[s]

@@ -15,11 +15,27 @@ followers are sharded across the mesh (redqueen_tpu.parallel).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
-from jax import random as jr
 
 from .base import KIND_OPT, PolicyDef, SourceUpdate, register_policy
+
+# Compile-time branch heuristic: up to this many Opt rows the react update
+# unrolls per row; beyond it the vectorized masked reduction wins. The two
+# paths consume IDENTICAL panel words (slot 1+row of the step's fused draw
+# panel) and are pinned bit-equal by tests/test_sim_jax.py, so the cutover
+# is purely a performance choice.
+UNROLL_MAX_OPT_ROWS = 4
+
+
+def unrolled_rows(cfg):
+    """The react rows whose panel words a step must provide, or None for
+    "all sources" (the vectorized fallback). Single source of truth for the
+    branch choice: ops.scan_core sizes the draw panel with it and on_react
+    below dispatches on it, so they can never disagree."""
+    if (cfg is not None and cfg.present_kinds
+            and len(cfg.opt_rows) <= UNROLL_MAX_OPT_ROWS):
+        return cfg.opt_rows
+    return None
 
 
 def on_init(params, state, s, t0, key):
@@ -30,7 +46,7 @@ def on_init(params, state, s, t0, key):
     )
 
 
-def on_fire(params, state, s, t, key):
+def on_fire(params, state, s, t, key, u):
     # Own post: every follower's rank resets, so the intensity drops to 0 and
     # all outstanding candidate clocks are cancelled until the next increment.
     return SourceUpdate(
@@ -39,7 +55,7 @@ def on_fire(params, state, s, t, key):
     )
 
 
-def on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid):
+def on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid, us):
     """Superposition update for all non-fired Opt sources.
 
     Returns (t_next[S], ctr_bump bool[S]). ``feeds_hit`` [F] marks the feeds
@@ -49,7 +65,10 @@ def on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid):
     of independent exponentials is Exp(sum of rates), so ONE draw per source
     against the summed affected rate is distributionally identical to the
     reference's per-follower draws while doing O(1) instead of O(S*F) RNG
-    work per event.
+    work per event. ``us`` [S] is the step's fused uniform panel
+    (ops.scan_core): us[s] is source s's react word this event, so the
+    unrolled and vectorized paths below consume IDENTICAL randomness and are
+    pinned bit-equal by tests.
 
     When the config carries static ``opt_rows`` (GraphBuilder output) the
     update unrolls over those rows — typically ONE controlled broadcaster —
@@ -62,16 +81,16 @@ def on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid):
     # Unrolling wins for the typical one-controlled-broadcaster component;
     # past a handful of Opt rows the serial draw/scatter chain and compile
     # time lose to one vectorized masked reduction.
-    if cfg is not None and cfg.present_kinds and len(cfg.opt_rows) <= 4:
+    rows = unrolled_rows(cfg)
+    if rows is not None:
         t_next, bump = state.t_next, jnp.zeros((S,), bool)
-        for row in cfg.opt_rows:
+        for row in rows:
             affected = adj[row] & feeds_hit                  # [F]
             react = (row != s_star) & affected.any() & valid
             rate_sum = jnp.where(
                 affected, jnp.sqrt(params.s_sink / params.q[row]), 0.0
             ).sum()
-            key = jr.fold_in(state.keys[row], state.ctr[row])
-            draw = jr.exponential(key, (), dtype)
+            draw = -jnp.log1p(-us[row]).astype(dtype)
             cand = t + jnp.where(rate_sum > 0, draw / rate_sum, jnp.inf)
             t_next = t_next.at[row].set(
                 jnp.where(react, jnp.minimum(t_next[row], cand), t_next[row])
@@ -88,8 +107,7 @@ def on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid):
     )
     rates = jnp.sqrt(params.s_sink[None, :] / params.q[:, None])  # [S, F]
     rate_sum = jnp.where(affected, rates, 0.0).sum(axis=1)        # [S]
-    keys = jax.vmap(jr.fold_in)(state.keys, state.ctr)
-    draws = jax.vmap(lambda k: jr.exponential(k, (), state.t_next.dtype))(keys)
+    draws = -jnp.log1p(-us).astype(dtype)                         # [S]
     tau = jnp.where(rate_sum > 0, draws / rate_sum, jnp.inf)
     cand = t + tau                                           # [S]
     t_next = jnp.where(react, jnp.minimum(state.t_next, cand), state.t_next)
@@ -99,6 +117,6 @@ def on_react(cfg, params, state, adj, feeds_hit, s_star, t, valid):
 OPT = register_policy(
     PolicyDef(
         kind=KIND_OPT, name="opt", on_init=on_init, on_fire=on_fire,
-        on_react=on_react,
+        on_react=on_react, fire_uses_key=False,
     )
 )

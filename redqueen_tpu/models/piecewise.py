@@ -24,7 +24,7 @@ def on_init(params, state, s, t0, key):
     )
 
 
-def on_fire(params, state, s, t, key):
+def on_fire(params, state, s, t, key, u):
     return _update(
         state, s, piecewise_next_time(key, t, params.pw_times[s], params.pw_rates[s])
     )

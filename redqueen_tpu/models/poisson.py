@@ -10,9 +10,7 @@ sources' posts never change a Poisson broadcaster's schedule.
 
 from __future__ import annotations
 
-from jax import random as jr
-
-from ..ops.sampling import exponential_delta
+from ..ops.sampling import exponential_delta, exponential_from_uniform
 from .base import KIND_POISSON, PolicyDef, SourceUpdate, register_policy
 
 
@@ -31,10 +29,14 @@ def on_init(params, state, s, t0, key):
     return _update(state, s, t0 + exponential_delta(key, params.rate[s]))
 
 
-def on_fire(params, state, s, t, key):
-    return _update(state, s, t + exponential_delta(key, params.rate[s]))
+def on_fire(params, state, s, t, key, u):
+    # One Exp(rate) per own event from the step's fused draw panel — the
+    # per-source key goes unused, so a Poisson+Opt component compiles with
+    # no per-source fold_in chain at all.
+    return _update(state, s, t + exponential_from_uniform(u, params.rate[s]))
 
 
 POISSON = register_policy(
-    PolicyDef(kind=KIND_POISSON, name="poisson", on_init=on_init, on_fire=on_fire)
+    PolicyDef(kind=KIND_POISSON, name="poisson", on_init=on_init,
+              on_fire=on_fire, fire_uses_key=False)
 )

@@ -31,7 +31,7 @@ def on_init(params, state, s, t0, key):
     )
 
 
-def on_fire(params, state, s, t, key):
+def on_fire(params, state, s, t, key, u):
     ptr = state.rd_ptr[s] + 1
     return SourceUpdate(
         t_next=_peek(params, ptr, s), exc=state.exc[s], exc_t=state.exc_t[s],
@@ -40,5 +40,6 @@ def on_fire(params, state, s, t, key):
 
 
 REALDATA = register_policy(
-    PolicyDef(kind=KIND_REALDATA, name="realdata", on_init=on_init, on_fire=on_fire)
+    PolicyDef(kind=KIND_REALDATA, name="realdata", on_init=on_init,
+              on_fire=on_fire, fire_uses_key=False)
 )

@@ -124,7 +124,7 @@ def on_init(params, state, s, t0, key):
     )
 
 
-def on_fire(params, state, s, t, key):
+def on_fire(params, state, s, t, key, u):
     if params.rmtpp is None:
         return SourceUpdate(
             t_next=jnp.asarray(jnp.inf, state.t_next.dtype), exc=state.exc[s],

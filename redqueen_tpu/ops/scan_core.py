@@ -87,6 +87,7 @@ def init_state(cfg: SimConfig, params: SourceParams, adj, key,
         exc_t=jnp.full((S,), t0, dtype),
         rd_ptr=jnp.zeros((S,), jnp.int32),
         h=jnp.zeros((S, H), dtype),
+        key=key,
         keys=keys,
         ctr=jnp.zeros((S,), jnp.uint32),
         n_events=jnp.zeros((), jnp.int32),
@@ -105,13 +106,39 @@ def init_state(cfg: SimConfig, params: SourceParams, adj, key,
     )
 
 
+def _panel_pairs(cfg: SimConfig, has_react: bool):
+    """Static threefry pair indices covering the step's draw-panel slots.
+
+    Slot layout: word 0 = the fire draw; word 1+s = source s's react draw.
+    Words come from ``threefry2x32(component_key, (event_index, pair))`` —
+    pair j yields words (2j, 2j+1) — so each slot is directly addressable
+    and an unrolled-opt config (models.opt.unrolled_rows) pays for exactly
+    the pairs its slots touch: the headline Poisson+Opt component needs ONE
+    threefry block per step (slots {0, 1+opt_row}). The vectorized fallback
+    covers all S+1 slots."""
+    from ..models.opt import unrolled_rows
+
+    S = cfg.n_sources
+    rows = unrolled_rows(cfg) if has_react else ()
+    if rows is None:
+        slots = list(range(S + 1))
+    else:
+        slots = [0] + [1 + r for r in rows]
+    return tuple(sorted({s // 2 for s in slots}))
+
+
 def make_run_chunk(cfg: SimConfig):
     """Returns ``run_chunk(params, adj, state) -> (state, (times, srcs))``,
     advancing the simulation by up to ``cfg.capacity`` events. Pure and
     jit/vmap-safe; the driver (redqueen_tpu.sim) jits/vmaps/shards it."""
+    from .threefry import threefry2x32, uniform_from_bits
+
     fire_branches = _fire_branches(cfg)
     react_hooks = _react_hooks(cfg)
     end_time = cfg.end_time
+    pairs = _panel_pairs(cfg, bool(react_hooks))
+    reg = get_registry()
+    needs_fire_key = any(reg[k].fire_uses_key for k in _kinds_for(cfg))
 
     def run_chunk(params: SourceParams, adj, state: SimState):
         kind_local = _local_kind(cfg, params.kind)
@@ -120,13 +147,55 @@ def make_run_chunk(cfg: SimConfig):
             s_star = jnp.argmin(state.t_next)
             t_ev = state.t_next[s_star]
             valid = t_ev <= end_time
+            if state.budget is not None:
+                # run_dynamic semantics: absorb once the event budget is
+                # spent (exactly the oracle's per-event stop, not chunk
+                # granularity).
+                valid &= state.n_events < state.budget
             feeds = adj[s_star]                       # [F] feeds hit
 
+            # -- the step's fused draw panel: counter-addressed threefry
+            # words keyed on (component key, global event index, slot) cover
+            # the fire draw (slot 0) and the react draws (slot 1+s) —
+            # layout-independent like the per-source streams they replace,
+            # and an unrolled-opt config computes ONLY the pairs its slots
+            # touch (one block per step for the headline shape, vs four
+            # fold_in/exponential chains before). Policies with open-ended
+            # randomness (Hawkes thinning, RMTPP) still get the per-source
+            # (key, ctr) stream below; XLA dead-code-eliminates it when no
+            # compiled branch uses it.
+            S = state.t_next.shape[0]
+            ev = state.n_events.astype(jnp.uint32)
+            # High bit of the pair counter is a domain separator: without
+            # it, event 0's panel blocks (0, pair) would collide with the
+            # per-source base keys fold_in(component_key, s) = block (0, s)
+            # from init_state.
+            pj = np.asarray(pairs, np.uint32) | np.uint32(0x8000_0000)
+            w0, w1 = threefry2x32(
+                state.key[0], state.key[1],
+                jnp.broadcast_to(ev, pj.shape), pj,
+            )
+            word_idx = np.asarray(
+                [w for j in pairs for w in (2 * j, 2 * j + 1)], np.int32
+            )
+            vals = uniform_from_bits(
+                jnp.stack([w0, w1], -1).reshape(-1)
+            ).astype(state.t_next.dtype)
+            keep = word_idx <= S  # static mask: last pair may overhang
+            us = jnp.zeros((S + 1,), state.t_next.dtype).at[
+                jnp.asarray(word_idx[keep])
+            ].set(vals[np.flatnonzero(keep)])
+
             # -- fired source resamples (policy dispatch, SURVEY.md 3.1) --
-            key_fire = jr.fold_in(state.keys[s_star], state.ctr[s_star])
+            if needs_fire_key:
+                key_fire = jr.fold_in(state.keys[s_star], state.ctr[s_star])
+            else:
+                # every compiled branch draws from the panel (or not at
+                # all); skip the per-source gather + fold_in chain entirely
+                key_fire = state.key
             upd = lax.switch(
                 kind_local[s_star], fire_branches,
-                params, state, s_star, t_ev, key_fire,
+                params, state, s_star, t_ev, key_fire, us[0],
             )
 
             new = state.replace(
@@ -143,7 +212,7 @@ def make_run_chunk(cfg: SimConfig):
             # -- react hooks: non-fired sources re-decide (RedQueen trick) --
             for hook in react_hooks:
                 t_next, bumped = hook(
-                    cfg, params, new, adj, feeds, s_star, t_ev, valid
+                    cfg, params, new, adj, feeds, s_star, t_ev, valid, us[1:]
                 )
                 new = new.replace(
                     t_next=t_next, ctr=new.ctr + bumped.astype(new.ctr.dtype)

@@ -149,8 +149,10 @@ def _drive(cfg, params, adj, state, chunk, max_chunks, batched):
         srcs_chunks.append(s_c)
         n_chunks += 1
         # Host sync at chunk granularity only (SURVEY.md section 7 design).
-        active = bool(jnp.any(state.t_next.min(axis=-1) <= cfg.end_time))
-        if not active:
+        alive = state.t_next.min(axis=-1) <= cfg.end_time
+        if state.budget is not None:
+            alive &= state.n_events < state.budget
+        if not bool(jnp.any(alive)):
             break
         if n_chunks >= max_chunks:
             done = np.asarray(state.n_events)
@@ -166,8 +168,13 @@ def _drive(cfg, params, adj, state, chunk, max_chunks, batched):
 
 
 def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
-             max_chunks: int = 100, return_state: bool = False):
+             max_chunks: int = 100, return_state: bool = False,
+             max_events: Optional[int] = None):
     """Run one component to its horizon. ``seed`` is an int or a PRNG key.
+
+    ``max_events`` stops after exactly that many events (the oracle's
+    ``Manager.run_dynamic`` semantics — SURVEY.md section 2 item 9), not at
+    chunk granularity: the scan absorbs mid-chunk once the budget is spent.
 
     Returns an ``EventLog`` (and the final ``SimState`` if
     ``return_state=True`` — the carry is resumable: pass it to
@@ -176,6 +183,8 @@ def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
     _check_weights(cfg, params)
     key = _as_key(seed)
     state = _init_fn(cfg, False)(params, adj, key)
+    if max_events is not None:
+        state = state.replace(budget=jnp.asarray(max_events, jnp.int32))
     log, state = _drive(
         cfg, params, adj, state, _chunk_fn(cfg, False), max_chunks, False
     )
@@ -183,18 +192,27 @@ def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
 
 
 def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
-                   max_chunks: int = 100, return_state: bool = False):
+                   max_chunks: int = 100, return_state: bool = False,
+                   max_events: Optional[int] = None):
     """Run B same-shape components in lockstep (params/adj have a leading
     batch axis; ``seeds`` is an int array [B] or a key array [B, 2]).
 
     This is the reference's embarrassingly-parallel sweep loop (SURVEY.md
     section 3.5) turned into a vmap axis: components finish at different
-    event counts and simply absorb until the slowest one is done."""
+    event counts and simply absorb until the slowest one is done.
+    ``max_events`` (scalar or [B]) applies the per-lane run_dynamic stop."""
     _check_kinds(cfg, params)
     _check_weights(cfg, params)
     seeds = jnp.asarray(seeds)
     keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
     state = _init_fn(cfg, True)(params, adj, keys)
+    if max_events is not None:
+        B = keys.shape[0]
+        state = state.replace(
+            budget=jnp.broadcast_to(
+                jnp.asarray(max_events, jnp.int32), (B,)
+            )
+        )
     log, state = _drive(
         cfg, params, adj, state, _chunk_fn(cfg, True), max_chunks, True
     )
@@ -202,7 +220,7 @@ def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
 
 
 def resume(cfg: SimConfig, params: SourceParams, adj, state: SimState,
-           max_chunks: int = 100):
+           max_chunks: int = 100, max_events: Optional[int] = None):
     """Continue a simulation from a carried ``SimState`` (obtained via
     ``return_state=True``), e.g. after extending the horizon with a new
     ``SimConfig``. Valid because every policy schedules its TRUE next event
@@ -210,9 +228,19 @@ def resume(cfg: SimConfig, params: SourceParams, adj, state: SimState,
     under a later ``end_time`` with the correct distribution — the oracle's
     re-entrant ``Manager.run_till`` contract (SURVEY.md section 3.1).
 
+    ``max_events`` bounds the events of THIS call (the oracle's re-entrant
+    ``run_till(max_events=...)`` counts per call); None clears any budget a
+    previous run_dynamic left on the carry.
+
     Returns (EventLog-of-the-extension, final state). Batched states resume
     batched."""
     batched = state.t_next.ndim == 2
+    if max_events is not None:
+        state = state.replace(
+            budget=state.n_events + jnp.asarray(max_events, jnp.int32)
+        )
+    else:
+        state = state.replace(budget=None)
     return _drive(
         cfg, params, adj, state, _chunk_fn(cfg, batched), max_chunks, batched
     )

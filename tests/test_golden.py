@@ -7,6 +7,12 @@ change, in which case regenerate these constants and say so in the commit.
 Values generated on the CPU backend (the test backend per conftest.py);
 float comparisons use 1e-4 — loose enough for cross-platform fastmath
 reassociation, tight enough that any stream change trips it.
+
+Scan-engine constants regenerated 2026-07-29 for the fused per-step draw
+panel (counter-addressed threefry words keyed on (component key, global
+event index, slot) — ops/scan_core._panel_pairs): a deliberate
+PRNG-discipline change, statistically validated by the closed-form and
+oracle-parity suites. Star-engine constants were unaffected.
 """
 
 import numpy as np
@@ -43,21 +49,21 @@ def _star():
 def test_golden_scan_single():
     cfg, p0, a0, me = _component()
     log = simulate(cfg, p0, a0, seed=42)
-    assert int(log.n_events) == 105
+    assert int(log.n_events) == 109
     np.testing.assert_allclose(
         np.asarray(log.times)[:5],
-        [0.259291, 0.378744, 0.41326, 0.420472, 0.447331], atol=1e-4)
-    assert np.asarray(log.srcs)[:5].tolist() == [1, 2, 0, 1, 3]
+        [0.259291, 0.378744, 0.447331, 0.503016, 0.588099], atol=1e-4)
+    assert np.asarray(log.srcs)[:5].tolist() == [1, 2, 3, 0, 4]
     m = feed_metrics(log.times, log.srcs, a0, me, T)
     np.testing.assert_allclose(
-        float(m.mean_time_in_top_k()), 12.954633, atol=1e-4)
+        float(m.mean_time_in_top_k()), 14.652967, atol=1e-4)
 
 
 def test_golden_scan_batch():
     cfg, p0, a0, me = _component()
     params, adj = stack_components([p0] * 3, [a0] * 3)
     logb = simulate_batch(cfg, params, adj, np.array([7, 8, 9]))
-    assert np.asarray(logb.n_events).tolist() == [114, 102, 96]
+    assert np.asarray(logb.n_events).tolist() == [114, 95, 93]
     np.testing.assert_allclose(
         np.asarray(logb.times)[:, 0],
         [0.228758, 0.207175, 0.07253], atol=1e-4)

@@ -78,6 +78,155 @@ class TestDeterminism:
             simulate(cfg, params, adj, seed=0, max_chunks=2)
 
 
+class TestRunDynamic:
+    """Exact max_events stop — the oracle's ``Manager.run_dynamic``
+    (SURVEY.md section 2 item 9): per-EVENT granularity, not chunk."""
+
+    def test_exact_event_count(self):
+        cfg, params, adj, opt = config1(capacity=64)  # budget inside chunk 2
+        for n in (1, 50, 100):
+            log = simulate(cfg, params, adj, seed=0, max_events=n)
+            assert int(log.n_events) == n
+
+    def test_prefix_of_unbounded_run(self):
+        """run_dynamic(n) must emit exactly the first n events of the
+        unbounded run — a stop, never a different trajectory."""
+        cfg, params, adj, opt = config1()
+        full = simulate(cfg, params, adj, seed=11)
+        part = simulate(cfg, params, adj, seed=11, max_events=77)
+        np.testing.assert_array_equal(
+            np.asarray(part.times)[:77], np.asarray(full.times)[:77]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(part.srcs)[:77], np.asarray(full.srcs)[:77]
+        )
+        assert int(part.n_events) == 77
+
+    def test_matches_oracle_run_dynamic(self):
+        """Event counts match the oracle's run_dynamic at matched configs
+        (both stop early; both may stop even earlier at the horizon)."""
+        cfg, params, adj, opt = config1(end_time=30.0, capacity=256)
+        so = oracle_config1(end_time=30.0)
+        for n in (5, 40):
+            mgr = so.create_manager_with_opt(seed=3)
+            mgr.run_dynamic(n)
+            want = mgr.state.get_dataframe()["event_id"].nunique()
+            log = simulate(cfg, params, adj, seed=3, max_events=n)
+            assert int(log.n_events) == want == n
+
+    def test_resume_counts_per_call(self):
+        """The oracle's re-entrant run_till(max_events=...) counts events of
+        THIS call; resume(max_events=k) must add exactly k more."""
+        from redqueen_tpu.sim import resume
+
+        cfg, params, adj, opt = config1()
+        log1, st = simulate(cfg, params, adj, seed=2, max_events=30,
+                            return_state=True)
+        log2, st2 = resume(cfg, params, adj, st, max_events=20)
+        assert int(log1.n_events) == 30
+        assert int(log2.n_events) == 20
+        assert int(st2.n_events) == 50
+        # clearing the budget resumes to the horizon
+        log3, st3 = resume(cfg, params, adj, st2)
+        full = simulate(cfg, params, adj, seed=2)
+        assert int(st3.n_events) == int(full.n_events)
+
+    def test_batched_budget(self):
+        cfg, p0, a0, opt = config1(n_followers=4)
+        params, adj = stack_components([p0] * 3, [a0] * 3)
+        log = simulate_batch(cfg, params, adj, np.array([4, 5, 6]),
+                             max_events=np.array([10, 25, 40]))
+        np.testing.assert_array_equal(np.asarray(log.n_events), [10, 25, 40])
+
+
+class TestOptReactBranches:
+    """The Opt react hook has an unrolled path (few Opt rows) and a
+    vectorized masked fallback; both draw with identical (key, ctr) streams
+    so they must be BIT-equal, and multi-Opt coupled components must agree
+    with the oracle statistically."""
+
+    def _coupled(self, n_opt, n_followers=6, T=40.0, capacity=512):
+        gb = GraphBuilder(n_sinks=n_followers, end_time=T)
+        for _ in range(n_opt):
+            gb.add_opt(q=1.0)  # all Opts follow every feed -> fully coupled
+        for i in range(n_followers):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        return gb.build(capacity=capacity)
+
+    def _force_branch(self, monkeypatch, unroll: bool):
+        from redqueen_tpu.models import opt as opt_mod
+        from redqueen_tpu import sim as sim_mod
+        from redqueen_tpu.ops import scan_core
+
+        monkeypatch.setattr(
+            opt_mod, "UNROLL_MAX_OPT_ROWS", 10_000 if unroll else -1
+        )
+        # the jitted-chunk cache would otherwise serve a stale branch choice
+        sim_mod._chunk_fn_cached.cache_clear()
+        sim_mod._init_fn_cached.cache_clear()
+
+    @pytest.mark.parametrize("n_opt", [2, 6])
+    def test_unrolled_vs_vectorized_bit_equal(self, monkeypatch, n_opt):
+        cfg, params, adj = self._coupled(n_opt)
+        self._force_branch(monkeypatch, unroll=True)
+        a = simulate(cfg, params, adj, seed=7)
+        self._force_branch(monkeypatch, unroll=False)
+        b = simulate(cfg, params, adj, seed=7)
+        self._force_branch(monkeypatch, unroll=True)  # restore cache sanity
+        sim_cleanup(monkeypatch)
+        np.testing.assert_array_equal(np.asarray(a.times), np.asarray(b.times))
+        np.testing.assert_array_equal(np.asarray(a.srcs), np.asarray(b.srcs))
+        assert int(a.n_events) > 0
+
+    def test_multi_opt_parity_with_oracle(self):
+        """Two coupled Opt broadcasters sharing all followers: mean post
+        counts match the oracle's Manager at matched configs (4 sigma)."""
+        from redqueen_tpu.oracle import numpy_ref as oref
+
+        n_followers, T = 4, 50.0
+        cfg, params, adj = self._coupled(2, n_followers=n_followers, T=T,
+                                         capacity=1024)
+        seeds = range(12)
+        jax_posts = []
+        for s in seeds:
+            log = simulate(cfg, params, adj, seed=s)
+            srcs = np.asarray(log.srcs)
+            jax_posts.append([(srcs == 0).sum(), (srcs == 1).sum()])
+        jax_mean = np.mean(jax_posts, axis=0)
+
+        orc_posts = []
+        for s in seeds:
+            sinks = list(range(n_followers))
+            srcs_o = [
+                oref.Opt(0, seed=10_000 + s, q=1.0),
+                oref.Opt(1, seed=20_000 + s, q=1.0),
+            ] + [
+                oref.Poisson(100 + i, seed=30_000 + 100 * s + i, rate=1.0)
+                for i in range(n_followers)
+            ]
+            edges = {0: sinks, 1: sinks}
+            edges.update({100 + i: [i] for i in range(n_followers)})
+            mgr = oref.Manager(srcs_o, sinks, edges, end_time=T)
+            mgr.run_till()
+            df = mgr.state.get_dataframe()
+            per = df.drop_duplicates("event_id")["src_id"].value_counts()
+            orc_posts.append([per.get(0, 0), per.get(1, 0)])
+        orc_mean = np.mean(orc_posts, axis=0)
+        sd = np.std(orc_posts, axis=0) / np.sqrt(len(seeds))
+        for k in range(2):
+            assert abs(jax_mean[k] - orc_mean[k]) < 4 * sd[k] + 2.0
+
+
+def sim_cleanup(monkeypatch):
+    """Undo branch forcing and clear jit caches so later tests retrace with
+    the real heuristic."""
+    from redqueen_tpu import sim as sim_mod
+
+    monkeypatch.undo()
+    sim_mod._chunk_fn_cached.cache_clear()
+    sim_mod._init_fn_cached.cache_clear()
+
+
 class TestClosedForm:
     def test_poisson_count(self):
         T, rate, B = 200.0, 1.1, 64
