@@ -152,8 +152,12 @@ def offline_schedule(wall_rates, change_times, end_time: float,
     ``end_time``); ``wall_rates``: [F, S] or [S].
     """
     ct = np.asarray(change_times, np.float64)
-    assert np.all(np.diff(ct) > 0)
+    if not np.all(np.diff(ct) > 0):
+        raise ValueError("change_times must be strictly increasing")
     durations = np.diff(np.concatenate([ct, [float(end_time)]]))
-    assert np.all(durations > 0), "last change_time must precede end_time"
+    if not np.all(durations > 0):
+        raise ValueError(
+            f"last change_time ({ct[-1]}) must precede end_time ({end_time})"
+        )
     mu = offline_rates(wall_rates, durations, budget)
     return ct, np.asarray(mu, np.float64)

@@ -51,6 +51,7 @@ from jax import lax
 from jax import random as jr
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..config import check_piecewise
 from ..models.base import (
     KIND_HAWKES,
     KIND_OPT,
@@ -118,6 +119,9 @@ class CtrlParams(struct.PyTreeNode):
     pw_times: jnp.ndarray   # f[Kp] piecewise knots
     pw_rates: jnp.ndarray   # f[Kp]
     rd_times: jnp.ndarray   # f[Kr] replay timestamps
+    l0: Optional[jnp.ndarray] = None     # f[] Hawkes base rate
+    alpha: Optional[jnp.ndarray] = None  # f[] Hawkes jump
+    beta: Optional[jnp.ndarray] = None   # f[] Hawkes decay
     rmtpp: Optional[dict] = None
 
 
@@ -206,8 +210,35 @@ def _ctrl_stream(cfg: StarConfig, ctrl: CtrlParams, key):
     if k == KIND_PIECEWISE:
         return streams.piecewise_stream(key, ctrl.pw_times, ctrl.pw_rates,
                                         t0, T, K)
+    if k == KIND_HAWKES:
+        # Hawkes is self-history-only, so it is a legal controlled stream
+        # (the reference's vs-Hawkes posting comparison — SURVEY.md section 2
+        # item 5 — at big F).
+        if ctrl.l0 is None:
+            raise ValueError(
+                "ctrl_kind=HAWKES requires CtrlParams.l0/alpha/beta — build "
+                "via StarBuilder.ctrl_hawkes"
+            )
+        return streams.hawkes_stream(
+            key, ctrl.l0, ctrl.alpha, ctrl.beta, t0, T, K
+        )
     if k == KIND_REALDATA:
-        return streams.realdata_stream(ctrl.rd_times, t0, T)
+        # Pad/clip the replay row to the documented [post_cap] contract
+        # (StarResult.own_times is [post_cap]); keep the first post_cap
+        # in-window posts and flag truncation, mirroring b_realdata.
+        row = ctrl.rd_times
+        Kr = row.shape[-1]
+        if Kr < K:
+            row = jnp.concatenate(
+                [row, jnp.full((K - Kr,), jnp.inf, row.dtype)]
+            )
+        s = streams.realdata_stream(row, t0, T)
+        if Kr <= K:
+            return s
+        n_all = s.n
+        return streams.Stream(
+            s.times[:K], jnp.minimum(n_all, K), n_all > K
+        )
     if k == KIND_RMTPP:
         if ctrl.rmtpp is None:
             raise ValueError("ctrl_kind=RMTPP requires CtrlParams.rmtpp weights")
@@ -681,6 +712,15 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
         raise ValueError(
             f"batch dims disagree: seeds={B}, wall={wall.kind.shape[0]}"
         )
+    ctrl_q = jnp.asarray(ctrl.q)
+    if ctrl_q.ndim != 1 or ctrl_q.shape[0] != B:
+        # A stack_star/broadcast_star mismatch would otherwise surface as an
+        # opaque vmap shape error deep in the kernel.
+        raise ValueError(
+            f"batch dims disagree: seeds={B}, ctrl="
+            f"{ctrl_q.shape[0] if ctrl_q.ndim else 'unbatched'} — build the "
+            f"batch with stack_star/broadcast_star"
+        )
     _check_wall_kinds(cfg, wall)
     if feed_axis is not None and feed_axis != "feed":
         raise ValueError(f"the follower mesh axis must be named 'feed', got "
@@ -745,7 +785,11 @@ class StarBuilder:
             np.ones(n_feeds) if s_sink is None
             else np.asarray(s_sink, np.float64)
         )
-        assert self.s_sink.shape == (self.n_feeds,)
+        if self.s_sink.shape != (self.n_feeds,):
+            raise ValueError(
+                f"s_sink must have shape ({self.n_feeds},), got "
+                f"{self.s_sink.shape}"
+            )
         self._walls = [[] for _ in range(self.n_feeds)]
         self._ctrl = None
 
@@ -763,10 +807,9 @@ class StarBuilder:
         return self
 
     def wall_piecewise(self, feed: int, change_times, rates):
-        ct = np.asarray(change_times, np.float64)
-        r = np.asarray(rates, np.float64)
-        assert ct.shape == r.shape and np.all(np.diff(ct) > 0)
-        self._walls[feed].append(dict(kind=KIND_PIECEWISE, pw=(ct, r)))
+        self._walls[feed].append(
+            dict(kind=KIND_PIECEWISE, pw=check_piecewise(change_times, rates))
+        )
         return self
 
     def wall_replay(self, feed: int, times):
@@ -786,11 +829,26 @@ class StarBuilder:
         self._ctrl = dict(kind=KIND_POISSON, rate=float(rate))
         return self
 
+    def ctrl_hawkes(self, l0: float, alpha: float, beta: float):
+        """Hawkes posting as the CONTROLLED broadcaster (the reference's
+        vs-Hawkes comparison at big F) — legal because Hawkes depends only on
+        its own history. Stationary iff alpha < beta (expected posts
+        ~ l0*T/(1 - alpha/beta))."""
+        if not (l0 >= 0 and alpha >= 0 and beta > 0):
+            raise ValueError(
+                f"Hawkes requires l0 >= 0, alpha >= 0, beta > 0; got "
+                f"l0={l0}, alpha={alpha}, beta={beta}"
+            )
+        self._ctrl = dict(
+            kind=KIND_HAWKES, l0=float(l0), alpha=float(alpha),
+            beta=float(beta),
+        )
+        return self
+
     def ctrl_piecewise(self, change_times, rates):
-        ct = np.asarray(change_times, np.float64)
-        r = np.asarray(rates, np.float64)
-        assert ct.shape == r.shape and np.all(np.diff(ct) > 0)
-        self._ctrl = dict(kind=KIND_PIECEWISE, pw=(ct, r))
+        self._ctrl = dict(
+            kind=KIND_PIECEWISE, pw=check_piecewise(change_times, rates)
+        )
         return self
 
     def ctrl_replay(self, times):
@@ -882,6 +940,9 @@ class StarBuilder:
             pw_times=jnp.asarray(c_pw_t, dtype),
             pw_rates=jnp.asarray(c_pw_r, dtype),
             rd_times=jnp.asarray(c_rd, dtype),
+            l0=jnp.asarray(c.get("l0", 0.0), dtype),
+            alpha=jnp.asarray(c.get("alpha", 0.0), dtype),
+            beta=jnp.asarray(c.get("beta", 1.0), dtype),
             rmtpp=c.get("rmtpp"),
         )
         return cfg, wall, ctrl

@@ -221,16 +221,40 @@ def run_preset(bundle, seeds, mesh=None, max_chunks: int = 256,
             events = int(np.asarray(log.n_events).sum())
     elif kind == "star":
         _, cfg, wall, ctrl = bundle
-        from .parallel.bigf import simulate_star
+        seeds_arr = np.asarray(seeds).ravel()
+        mesh_axes = dict(mesh.shape) if mesh is not None else {}
+        if len(seeds_arr) == 1 or (mesh is not None
+                                   and "data" not in mesh_axes):
+            # One seed, or a feed-only mesh (a single 100k-feed component
+            # sharded over followers): the per-run star path.
+            from .parallel.bigf import simulate_star
 
-        tops, posts, events = [], [], 0
-        for s in np.asarray(seeds).ravel():
-            res = simulate_star(cfg, wall, ctrl, seed=int(s), mesh=mesh,
-                                metric_K=metric_K)
-            tops.append(float(np.asarray(res.metrics.mean_time_in_top_k())))
-            posts.append(res.n_posts)
-            events += int(res.wall_n.sum()) + res.n_posts
-        tops, posts = np.asarray(tops), np.asarray(posts)
+            tops, posts, events = [], [], 0
+            for s in seeds_arr:
+                res = simulate_star(cfg, wall, ctrl, seed=int(s), mesh=mesh,
+                                    metric_K=metric_K)
+                tops.append(
+                    float(np.asarray(res.metrics.mean_time_in_top_k()))
+                )
+                posts.append(res.n_posts)
+                events += int(res.wall_n.sum()) + res.n_posts
+            tops, posts = np.asarray(tops), np.asarray(posts)
+        else:
+            # Seed sweep = one vmapped batch (SURVEY.md section 3.5), not a
+            # host loop; per-seed results are bit-identical to the loop
+            # because lane PRNG streams depend only on the lane's seed.
+            from .parallel.bigf import broadcast_star, simulate_star_batch
+
+            B = len(seeds_arr)
+            wb, cb = broadcast_star(wall, ctrl, B)
+            res = simulate_star_batch(
+                cfg, wb, cb, seeds_arr, mesh=mesh,
+                feed_axis=("feed" if "feed" in mesh_axes else None),
+                metric_K=metric_K,
+            )
+            tops = np.asarray(res.metrics.mean_time_in_top_k())
+            posts = np.asarray(res.n_posts)
+            events = int(res.wall_n.sum()) + int(res.n_posts.sum())
     else:
         raise ValueError(f"unknown bundle kind {kind!r}")
     return {

@@ -229,6 +229,87 @@ class TestStarOtherCtrl:
             got, [want[f] for f in range(F)], rtol=1e-5, atol=1e-5
         )
 
+    def test_ctrl_hawkes_stationary_count(self):
+        """Hawkes posting as the CONTROLLED broadcaster (the reference's
+        vs-Hawkes comparison, SURVEY.md section 2 item 5, at big F):
+        E[#posts] ~ l0*T/(1 - alpha/beta), independent of the walls."""
+        F, T = 4, 100.0
+        l0, alpha, beta = 0.5, 0.5, 1.0
+        sb = StarBuilder(n_feeds=F, end_time=T)
+        for f in range(F):
+            sb.wall_poisson(f, 1.0)
+        sb.ctrl_hawkes(l0, alpha, beta)
+        cfg, wall, ctrl = sb.build(post_cap=512)
+        posts = [simulate_star(cfg, wall, ctrl, seed=s).n_posts
+                 for s in range(24)]
+        mean = np.mean(posts)
+        expect = l0 * T / (1 - alpha / beta)
+        assert abs(mean - expect) < 0.15 * expect
+
+    def test_ctrl_hawkes_vs_opt_comparison(self):
+        """The budget-matched Hawkes-vs-Opt comparison runs at big F: at a
+        MATCHED posting budget, RedQueen's rank-aware timing beats bursty
+        Hawkes posting on time-at-top (paper figure comparison)."""
+        F, T = 64, 60.0
+        sb = StarBuilder(n_feeds=F, end_time=T)
+        for f in range(F):
+            sb.wall_poisson(f, 1.0)
+        sb.ctrl_opt(q=8.0)
+        cfg_o, wall_o, ctrl_o = sb.build(post_cap=2048)
+        opt_tops, opt_posts = [], []
+        for s in range(6):
+            r = simulate_star(cfg_o, wall_o, ctrl_o, seed=s)
+            opt_tops.append(float(np.mean(
+                np.asarray(r.metrics.mean_time_in_top_k()))))
+            opt_posts.append(r.n_posts)
+        # Hawkes ctrl tuned to the same expected budget.
+        rate_match = np.mean(opt_posts) / T
+        l0, alpha, beta = rate_match / 2, 1.0, 2.0  # branching 0.5
+        sb2 = StarBuilder(n_feeds=F, end_time=T)
+        for f in range(F):
+            sb2.wall_poisson(f, 1.0)
+        sb2.ctrl_hawkes(l0, alpha, beta)
+        cfg_h, wall_h, ctrl_h = sb2.build(post_cap=2048)
+        hk_tops, hk_posts = [], []
+        for s in range(6):
+            r = simulate_star(cfg_h, wall_h, ctrl_h, seed=s)
+            hk_tops.append(float(np.mean(
+                np.asarray(r.metrics.mean_time_in_top_k()))))
+            hk_posts.append(r.n_posts)
+        # budgets within 25% of each other, Opt wins on time-at-top
+        assert abs(np.mean(hk_posts) - np.mean(opt_posts)) \
+            < 0.25 * np.mean(opt_posts)
+        assert np.mean(opt_tops) > np.mean(hk_tops)
+
+    def test_ctrl_replay_longer_than_post_cap_truncates_loudly(self):
+        """A replay ctrl stream longer than post_cap must honor the
+        [post_cap] own_times contract and raise, not silently truncate."""
+        F, T = 2, 100.0
+        sb = StarBuilder(n_feeds=F, end_time=T)
+        for f in range(F):
+            sb.wall_replay(f, [50.0])
+        sb.ctrl_replay(np.linspace(1.0, 90.0, 40))
+        cfg, wall, ctrl = sb.build(post_cap=16)
+        with pytest.raises(RuntimeError, match="posting buffer overflow"):
+            simulate_star(cfg, wall, ctrl, seed=0)
+        # with enough cap the same build runs and own_times is [post_cap]
+        cfg2, wall2, ctrl2 = sb.build(post_cap=64)
+        res = simulate_star(cfg2, wall2, ctrl2, seed=0)
+        assert res.own_times.shape == (64,)
+        assert res.n_posts == 40
+
+    def test_batch_ctrl_dim_mismatch_raises(self):
+        from redqueen_tpu.parallel.bigf import (
+            broadcast_star,
+            simulate_star_batch,
+        )
+
+        cfg, wall, ctrl = star_poisson(n_feeds=4, T=10.0)
+        wall_b, ctrl_b = broadcast_star(wall, ctrl, 4)
+        _, ctrl_wrong = broadcast_star(wall, ctrl, 2)
+        with pytest.raises(ValueError, match="batch dims disagree"):
+            simulate_star_batch(cfg, wall_b, ctrl_wrong, np.arange(4))
+
     def test_hawkes_walls_run(self):
         sb = StarBuilder(n_feeds=4, end_time=30.0)
         for f in range(4):

@@ -120,6 +120,38 @@ class TestPresets:
             out["per_seed_top_k"], out2["per_seed_top_k"], rtol=1e-6
         )
 
+    def test_star_preset_vmapped_sweep_matches_loop(self):
+        """The star seed sweep runs as ONE vmapped batch; per-seed results
+        must be bit-identical to the per-seed host loop (lane PRNG streams
+        depend only on the lane's seed)."""
+        from redqueen_tpu.parallel.bigf import simulate_star
+
+        bundle = build_preset(2, scale=0.008, end_time=12.0, wall_cap=256,
+                              post_cap=512)
+        _, cfg, wall, ctrl = bundle
+        seeds = np.arange(4)
+        out = run_preset(bundle, seeds)  # vmapped path (no mesh, 4 seeds)
+        loop_tops = [
+            float(np.asarray(
+                simulate_star(cfg, wall, ctrl, seed=int(s))
+                .metrics.mean_time_in_top_k()
+            ))
+            for s in seeds
+        ]
+        np.testing.assert_allclose(out["per_seed_top_k"], loop_tops,
+                                   rtol=1e-6)
+
+    def test_star_preset_sweep_with_data_mesh(self):
+        from redqueen_tpu.parallel import comm
+
+        bundle = build_preset(2, scale=0.008, end_time=12.0, wall_cap=256,
+                              post_cap=512)
+        mesh = comm.make_mesh({"data": 8})
+        out = run_preset(bundle, np.arange(8), mesh=mesh)
+        out2 = run_preset(bundle, np.arange(8))
+        np.testing.assert_allclose(out["per_seed_top_k"],
+                                   out2["per_seed_top_k"], rtol=1e-6)
+
     def test_unknown_preset_raises(self):
         with pytest.raises(KeyError):
             build_preset("nope")
