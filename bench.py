@@ -30,27 +30,29 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def _default_backend_alive(log, deadline_s: float = 120.0) -> bool:
+def _default_backend_alive(log, deadlines=(120.0, 45.0),
+                           backoff_s: float = 20.0) -> bool:
     """True iff the default JAX backend (the tunneled TPU here) initializes
-    within a deadline. Probed in a subprocess because a wedged tunnel HANGS
-    jax.devices() rather than raising."""
-    import subprocess
+    within a deadline. Probed in a subprocess (shared helper,
+    redqueen_tpu/utils/backend.py) because a wedged tunnel HANGS
+    jax.devices() rather than raising. The tunnel was down for the whole of
+    round 1 and can recover between hangs, so one failed probe gets one
+    shorter retry — total worst case ~185s, bounded so a dead tunnel can
+    never eat the driver's whole timeout before the CPU fallback runs."""
+    import time as _time
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            timeout=deadline_s, capture_output=True, text=True,
-        )
-        if r.returncode == 0 and "ok" in r.stdout:
+    from redqueen_tpu.utils.backend import probe_default_backend
+
+    for attempt, deadline_s in enumerate(deadlines):
+        alive, n, plat = probe_default_backend(deadline_s, log=log)
+        if alive:
+            log(f"default backend alive: {n} x {plat}")
             return True
-        log(f"default backend probe failed (rc={r.returncode}): "
-            f"{r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ''}")
-        return False
-    except subprocess.TimeoutExpired:
-        log(f"default backend probe hung > {deadline_s}s; assuming TPU "
-            f"tunnel is down")
-        return False
+        if attempt + 1 < len(deadlines):
+            log(f"probe attempt {attempt + 1}/{len(deadlines)} failed; "
+                f"retrying in {backoff_s:.0f}s")
+            _time.sleep(backoff_s)
+    return False
 
 
 def build_component(n_followers: int, T: float, q: float, wall_rate: float,
@@ -181,6 +183,10 @@ def main():
     ap.add_argument("--broadcasters", type=int, default=None)
     ap.add_argument("--followers", type=int, default=10)
     ap.add_argument("--horizon", type=float, default=None)
+    ap.add_argument("--capacity", type=int, default=None,
+                    help="scan-engine chunk capacity (events per chunk); "
+                         "default sizes to ~1.1x the mean per-chunk event "
+                         "count so absorbed no-op steps stay rare")
     ap.add_argument("--q", type=float, default=1.0)
     ap.add_argument("--wall-rate", type=float, default=1.0)
     ap.add_argument("--config", type=int, default=None, choices=[1, 2, 3, 4, 5],
@@ -200,13 +206,20 @@ def main():
     if args.quick:
         B = args.broadcasters or 64
         T = args.horizon or 20.0
-        capacity = 512
         oracle_comps = 2
     else:
         B = args.broadcasters or 10_000
         T = args.horizon or 100.0
-        capacity = 2048
-        oracle_comps = 4
+        oracle_comps = 32  # ~0.75s of oracle wall time: a steady denominator
+    if args.capacity:
+        capacity = args.capacity
+    else:
+        # Chunks much smaller than the run absorb almost no past-horizon
+        # steps (the measured ~40% waste of a run-sized chunk); chunks much
+        # smaller than ~mean/10 pay per-chunk dispatch + host-sync instead.
+        # Measured optimum on the headline shape is ~mean_events/10.
+        mean_ev = T * args.wall_rate * args.followers * 1.25
+        capacity = int(min(2048, max(64, 1 << int(np.log2(max(mean_ev / 8, 1)) + 0.5))))
 
     import jax
 
@@ -228,6 +241,7 @@ def main():
         from benchmarks.run import bench_config
 
         out = bench_config(args.config, quick=args.quick, log=log)
+        out["platform"] = jax.devices()[0].platform
         print(json.dumps(out))
         return
 
@@ -235,18 +249,30 @@ def main():
         f"(= {B * args.followers} feed edges), horizon T={T}, "
         f"engine={args.engine}")
 
-    def star():
+    def star(post_cap_mult: int = 1):
         # Capacity: Poisson(rate*T) wall events per feed; mean + 9 sigma
         # headroom rounded up so 100k+ streams cannot overflow.
         mean_w = args.wall_rate * T
         wall_cap = int(mean_w + 9 * max(mean_w, 1.0) ** 0.5 + 16)
-        # Opt posting scales ~ sqrt(1/q)-weighted with the wall volume;
-        # 4x headroom (overflow raises loudly rather than truncating).
-        post_cap = max(int(4 * mean_w * max(1.0, args.q ** -0.5)), 64)
+        # RedQueen's posting volume grows ~ T * sqrt(F * wall_rate / q) (the
+        # intensity sums sqrt(s_f/q) clocks across all F feeds), so the cap
+        # must scale with the follower count — a flat 4x-the-wall-mean cap
+        # always overflowed at the 100k-feed scale. 4x headroom; overflow
+        # still raises loudly and is retried with a doubled cap.
+        est = T * (args.followers * args.wall_rate / max(args.q, 1e-9)) ** 0.5
+        post_cap = max(int(4 * est), 64) * post_cap_mult
         post_cap = 1 << (post_cap - 1).bit_length()  # round to pow2
-        return run_jax_star(
-            B, args.followers, T, args.q, args.wall_rate, wall_cap, post_cap
-        )
+        try:
+            return run_jax_star(
+                B, args.followers, T, args.q, args.wall_rate, wall_cap,
+                post_cap,
+            )
+        except RuntimeError as e:
+            if "post_cap" in str(e) and post_cap_mult <= 8:
+                log(f"star engine overflowed post_cap={post_cap}; retrying "
+                    f"with a doubled cap")
+                return star(post_cap_mult * 2)
+            raise
 
     def scan():
         return run_jax(B, args.followers, T, args.q, args.wall_rate, capacity)
@@ -301,6 +327,9 @@ def main():
         "value": round(eps, 1),
         "unit": "events/s",
         "vs_baseline": round(speedup, 2),
+        # Self-describing backend: a CPU fallback (wedged TPU tunnel) must
+        # never be mistaken for a TPU measurement (round-1 verdict item 2).
+        "platform": jax.devices()[0].platform,
     }))
 
 

@@ -49,6 +49,35 @@ def supports(cfg: SimConfig) -> bool:
     return bool(kinds) and kinds <= {KIND_POISSON, KIND_OPT}
 
 
+def vmem_bytes(cfg: SimConfig, S: int, F: int) -> int:
+    """Per-grid-step VMEM footprint estimate of the kernel's blocks (4-byte
+    words x 128 lanes): the [S, F, T] adjacency cube dominates, plus the
+    [S, T] state/param rows, [F, T] rows, and the [capacity, T] event log
+    pair."""
+    rows_S = 7       # rate, q, is_opt, k0, k1, t_next, ctr
+    rows_F = 2       # ssink, feeds_hit scratch
+    return 4 * _TILE * (S * F + rows_S * S + rows_F * F + 2 * cfg.capacity + 4)
+
+
+# v5e VMEM is 16 MiB/core; leave headroom for Mosaic's own scratch.
+_VMEM_BUDGET = 12 * 2**20
+
+
+def _check_vmem(cfg: SimConfig, S: int, F: int):
+    """Host-side shape guard: the state-resident design bounds S*F and
+    capacity; fail with a clear message instead of a Mosaic OOM deep in
+    compilation (the scan/star engines cover larger shapes)."""
+    need = vmem_bytes(cfg, S, F)
+    if need > _VMEM_BUDGET:
+        raise ValueError(
+            f"pallas engine VMEM estimate {need / 2**20:.1f} MiB exceeds the "
+            f"{_VMEM_BUDGET / 2**20:.0f} MiB budget (S={S}, F={F}, "
+            f"capacity={cfg.capacity}; the [S, F, 128] adjacency block "
+            f"dominates) — use the scan engine (sim.simulate_batch) or the "
+            f"star engine (parallel.bigf) for this shape"
+        )
+
+
 def _kernel_body(cfg: SimConfig, opt_rows, rate_ref, q_ref, is_opt_ref,
                  adj_ref, ssink_ref, k0_ref, k1_ref, tnext_ref, ctr_ref,
                  t_ref, nev_ref, tnext_out, ctr_out, t_out, nev_out,
@@ -240,6 +269,7 @@ def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
         interpret = jax.devices()[0].platform != "tpu"
     B, S = params.kind.shape
     F = adj.shape[-1]
+    _check_vmem(cfg, S, F)
     B_pad = -(-B // _TILE) * _TILE
 
     state = _init_state(cfg, params, jnp.asarray(seeds))

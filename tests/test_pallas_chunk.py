@@ -182,3 +182,28 @@ class TestPallasEngine:
         log = simulate_pallas(cfg, params, adj, np.array([0, 0]))
         n = np.asarray(log.n_events)
         assert n[1] > 3 * n[0]
+
+
+class TestVmemGuard:
+    def test_large_shape_refused_host_side(self):
+        """Shapes whose [S, F, 128] adjacency block cannot fit VMEM must be
+        refused with a clear message, not a Mosaic OOM mid-compile."""
+        F = 1000
+        gb = GraphBuilder(n_sinks=F, end_time=1.0)
+        gb.add_opt(q=1.0)
+        for _ in range(29):
+            gb.add_poisson(rate=0.1)
+        cfg, p0, a0 = gb.build(capacity=64)
+        params, adj = stack_components([p0], [a0])
+        with pytest.raises(ValueError, match="VMEM"):
+            simulate_pallas(cfg, params, adj, np.array([0]))
+
+    def test_headline_shape_within_budget(self):
+        from redqueen_tpu.ops.pallas_chunk import _VMEM_BUDGET, vmem_bytes
+
+        gb = GraphBuilder(n_sinks=10, end_time=1.0)
+        gb.add_opt(q=1.0)
+        for i in range(10):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        cfg, *_ = gb.build(capacity=2048)
+        assert vmem_bytes(cfg, 11, 10) < _VMEM_BUDGET
