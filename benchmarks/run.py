@@ -37,7 +37,12 @@ _FULL = {
     1: dict(scale=1.0, end_time=100.0),
     2: dict(scale=1.0, end_time=100.0, wall_cap=1024, post_cap=8192),
     3: dict(scale=1.0, end_time=100.0),
-    4: dict(scale=1.0, end_time=100.0, post_cap=16384),
+    # q scales the posting cost with the follower count: at q=1 RedQueen
+    # against 100k unit-rate feeds posts ~100*sqrt(1e5) ~ 31.6k times (no
+    # real broadcaster's budget); q=2500 gives ~630 posts over the horizon,
+    # the paper's few-posts-per-unit-time regime, and keeps the post buffer
+    # (and the [F, post_cap] metric blocks) sane.
+    4: dict(scale=1.0, end_time=100.0, q=2500.0, post_cap=4096),
     5: dict(scale=1.0, end_time=100.0),
 }
 _QUICK = {

@@ -8,6 +8,8 @@ compare, at MATCHED posting budget,
 
 - ``opt``      — RedQueen online policy (budget set by its own realized posts),
 - ``poisson``  — budget-matched constant-rate posting,
+- ``hawkes``   — budget-matched self-exciting (bursty) posting, the paper's
+                 vs-Hawkes broadcaster comparison,
 - ``offline``  — the Karimi-style offline water-filling schedule
                  (redqueen_tpu.baselines) fitted to the true wall profile,
 - ``replay``   — a "real user" trace: posts clustered into the busy half of
@@ -91,6 +93,15 @@ def run(n_seeds=16, F=10, T=96.0, q=0.4, lo=0.3, hi=2.5, capacity=4096):
     rate = baselines.budget_matched_poisson_rate(budget, T)
     cfg, params, adj, me = build(lambda gb: gb.add_poisson(rate=rate))
     results["poisson"] = evaluate(cfg, params, adj, me, seeds + 1000)
+
+    # 2b) Budget-matched Hawkes posting (branching ratio 1/2: bursty but
+    # stationary; l0 chosen so E[#posts] matches the budget).
+    beta_h = 2.0
+    alpha_h = 1.0
+    l0_h = (budget / T) * (1 - alpha_h / beta_h)
+    cfg, params, adj, me = build(
+        lambda gb: gb.add_hawkes(l0=l0_h, alpha=alpha_h, beta=beta_h))
+    results["hawkes"] = evaluate(cfg, params, adj, me, seeds + 4000)
 
     # 3) Karimi-style offline schedule at the same budget.
     ct_off, mu = baselines.offline_schedule(

@@ -138,7 +138,18 @@ def make_run_chunk(cfg: SimConfig):
     end_time = cfg.end_time
     pairs = _panel_pairs(cfg, bool(react_hooks))
     reg = get_registry()
-    needs_fire_key = any(reg[k].fire_uses_key for k in _kinds_for(cfg))
+    kinds = set(_kinds_for(cfg))
+    needs_fire_key = any(reg[k].fire_uses_key for k in kinds)
+    # Only per-source state fields the compiled policy mix can touch get
+    # scattered + absorb-gated each step; the rest pass through untouched
+    # (a Poisson+Opt component never pays Hawkes/replay/RMTPP state
+    # traffic). Bit-preserving: untouched branches only ever echoed the
+    # old values back.
+    from ..models.base import KIND_HAWKES, KIND_REALDATA, KIND_RMTPP
+
+    has_hawkes = KIND_HAWKES in kinds
+    has_realdata = KIND_REALDATA in kinds
+    has_rmtpp = KIND_RMTPP in kinds
 
     def run_chunk(params: SourceParams, adj, state: SimState):
         kind_local = _local_kind(cfg, params.kind)
@@ -198,35 +209,50 @@ def make_run_chunk(cfg: SimConfig):
                 params, state, s_star, t_ev, key_fire, us[0],
             )
 
-            new = state.replace(
-                t=t_ev,
-                t_next=state.t_next.at[s_star].set(upd.t_next),
-                exc=state.exc.at[s_star].set(upd.exc),
-                exc_t=state.exc_t.at[s_star].set(upd.exc_t),
-                rd_ptr=state.rd_ptr.at[s_star].set(upd.rd_ptr),
-                h=state.h.at[s_star].set(upd.h),
-                ctr=state.ctr.at[s_star].add(1),
-                n_events=state.n_events + 1,
-            )
+            t_next = state.t_next.at[s_star].set(upd.t_next)
+            ctr = state.ctr.at[s_star].add(1)
 
             # -- react hooks: non-fired sources re-decide (RedQueen trick) --
             for hook in react_hooks:
                 t_next, bumped = hook(
-                    cfg, params, new, adj, feeds, s_star, t_ev, valid, us[1:]
+                    cfg, params, state.replace(t_next=t_next), adj, feeds,
+                    s_star, t_ev, valid, us[1:],
                 )
-                new = new.replace(
-                    t_next=t_next, ctr=new.ctr + bumped.astype(new.ctr.dtype)
-                )
+                ctr = ctr + bumped.astype(ctr.dtype)
 
             # Past-horizon steps absorb: emit a sentinel, keep state frozen.
-            state = jax.tree.map(
-                lambda a, b: jnp.where(valid, a, b), new, state
+            # Only the fields this policy mix can change are gated/written.
+            def sel(a, b):
+                return jnp.where(valid, a, b)
+
+            fields = dict(
+                t=sel(t_ev, state.t),
+                t_next=sel(t_next, state.t_next),
+                ctr=sel(ctr, state.ctr),
+                n_events=state.n_events + valid.astype(state.n_events.dtype),
             )
-            ev = (
+            if has_hawkes:
+                fields["exc"] = sel(
+                    state.exc.at[s_star].set(upd.exc), state.exc
+                )
+            if has_hawkes or has_rmtpp:
+                # exc_t doubles as RMTPP's last-own-event time (its tau
+                # input is t - exc_t), not just the Hawkes fold time.
+                fields["exc_t"] = sel(
+                    state.exc_t.at[s_star].set(upd.exc_t), state.exc_t
+                )
+            if has_realdata:
+                fields["rd_ptr"] = sel(
+                    state.rd_ptr.at[s_star].set(upd.rd_ptr), state.rd_ptr
+                )
+            if has_rmtpp:
+                fields["h"] = sel(state.h.at[s_star].set(upd.h), state.h)
+            state = state.replace(**fields)
+            ev_out = (
                 jnp.where(valid, t_ev, jnp.inf),
                 jnp.where(valid, s_star, -1).astype(jnp.int32),
             )
-            return state, ev
+            return state, ev_out
 
         state, (times, srcs) = lax.scan(
             step, state, None, length=cfg.capacity

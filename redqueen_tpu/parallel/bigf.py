@@ -328,7 +328,12 @@ def _feed_metrics_star(cfg: StarConfig, feed_times, own_times, K: int):
 
     Tie rule (matches the oracle's argmin-lowest-index pop): an own post at
     exactly a wall-event time applies FIRST, so the wall event counts into
-    the window STARTED by that own post."""
+    the window STARTED by that own post.
+
+    Memory: the own-post side materializes [feed_block, post_cap+1]
+    intermediates, so feeds are processed in ``lax.map`` blocks of
+    ``_METRIC_FEED_BLOCK`` — at 100k feeds an unchunked vmap allocated
+    O(F x post_cap) x several arrays (tens of GB)."""
     Fl, E = feed_times.shape
     dtype = feed_times.dtype
     start = jnp.asarray(cfg.start_time, dtype)
@@ -373,11 +378,31 @@ def _feed_metrics_star(cfg: StarConfig, feed_times, own_times, K: int):
         )
         return topk.sum(), ir, ir2
 
-    top, ir, ir2 = jax.vmap(one_feed)(feed_times)
+    if Fl <= _METRIC_FEED_BLOCK:
+        top, ir, ir2 = jax.vmap(one_feed)(feed_times)
+    else:
+        nb = -(-Fl // _METRIC_FEED_BLOCK)
+        padded = jnp.concatenate([
+            feed_times,
+            jnp.full((nb * _METRIC_FEED_BLOCK - Fl, E), jnp.inf, dtype),
+        ]) if nb * _METRIC_FEED_BLOCK != Fl else feed_times
+        blocks = padded.reshape(nb, _METRIC_FEED_BLOCK, E)
+        top, ir, ir2 = lax.map(
+            lambda b: jax.vmap(one_feed)(b), blocks
+        )
+        top = top.reshape(-1)[:Fl]
+        ir = ir.reshape(-1)[:Fl]
+        ir2 = ir2.reshape(-1)[:Fl]
     return FeedMetrics(
         time_in_top_k=top, int_rank=ir, int_rank2=ir2,
         follows=jnp.ones((Fl,), bool), start_time=start, end_time=end,
     )
+
+
+# Feeds per metrics block: bounds the closed form's peak memory at
+# block x (post_cap+1) floats per intermediate while keeping blocks wide
+# enough to saturate the vector units.
+_METRIC_FEED_BLOCK = 8192
 
 
 def _feed_metrics_star_scan(cfg: StarConfig, feed_times, own_times, K: int):

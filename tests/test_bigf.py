@@ -396,6 +396,21 @@ class TestClosedFormMetrics:
                     np.asarray(a.int_rank2), np.asarray(b.int_rank2),
                     rtol=1e-5, atol=1e-3, err_msg=f"ir2 trial={trial}")
 
+    def test_feed_block_chunking_exact(self, monkeypatch):
+        """The big-F lax.map blocking (memory bound at 100k feeds) must be
+        bit-exact vs the unchunked vmap, including the padded tail block."""
+        from redqueen_tpu.parallel import bigf
+
+        rng = np.random.RandomState(3)
+        cfg, w, own = self._random_case(rng)  # F=5
+        unchunked = bigf._feed_metrics_star(cfg, w, own, 1)
+        monkeypatch.setattr(bigf, "_METRIC_FEED_BLOCK", 2)  # 3 blocks, 1 pad
+        chunked = bigf._feed_metrics_star(cfg, w, own, 1)
+        for field in ("time_in_top_k", "int_rank", "int_rank2"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(unchunked, field)),
+                np.asarray(getattr(chunked, field)), err_msg=field)
+
     def test_tie_own_post_at_wall_time(self):
         import jax.numpy as jnp
 

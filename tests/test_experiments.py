@@ -14,7 +14,7 @@ def test_compare_policies_smoke():
     from experiments.compare_policies import run
 
     results, budget, T = run(n_seeds=6, F=4, T=40.0, q=0.5, capacity=1024)
-    assert set(results) == {"opt", "poisson", "offline", "replay"}
+    assert set(results) == {"opt", "poisson", "hawkes", "offline", "replay"}
     assert budget > 0
     for name, (top, rank, posts) in results.items():
         assert top.shape == (6,)
@@ -22,6 +22,10 @@ def test_compare_policies_smoke():
         assert np.all(rank >= 0)
     # The headline claim, at matched budget, mean over seeds.
     assert results["opt"][0].mean() > results["poisson"][0].mean()
+    # Bursty posting wastes budget on clustered posts: RedQueen beats it too,
+    # and the Hawkes budget actually matched.
+    assert results["opt"][0].mean() > results["hawkes"][0].mean()
+    assert abs(results["hawkes"][2].mean() - budget) < 0.5 * budget
 
 
 def test_tradeoff_smoke():

@@ -90,6 +90,27 @@ class TestSeamIntegration:
         assert int(num_posts(log.srcs, src)) > 0
         assert (srcs > 0).sum() > 0
 
+    def test_last_own_event_time_persists(self):
+        """Regression: exc_t doubles as RMTPP's last-own-event time (the tau
+        input is t - exc_t). A state-field pruning pass once dropped its
+        scatter for RMTPP-without-Hawkes components, silently feeding the
+        RNN absolute times instead of inter-event gaps."""
+        w = rmtpp.init_weights(jr.PRNGKey(5), hidden=8)
+        gb = GraphBuilder(n_sinks=3, end_time=20.0)
+        src = gb.add_rmtpp()
+        for i in range(3):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        cfg, params, adj = gb.build(capacity=512, rmtpp_hidden=8)
+        params = rmtpp.attach(params, w)
+        log, st = simulate(cfg, params, adj, seed=0, return_state=True)
+        times = np.asarray(log.times)
+        srcs = np.asarray(log.srcs)
+        own = times[srcs == src]
+        assert len(own) > 0
+        np.testing.assert_allclose(
+            float(np.asarray(st.exc_t)[src]), own.max(), rtol=1e-6
+        )
+
     def test_missing_weights_clear_error(self):
         gb = GraphBuilder(n_sinks=1, end_time=5.0)
         gb.add_rmtpp()
