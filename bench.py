@@ -8,11 +8,22 @@ The 10k x 100k graph decomposes into 10k independent per-broadcaster
 components of 10 followers each (RedQueen broadcasters do not couple), run as
 one vmapped batch on the device — SURVEY.md section 6 / section 7.
 
-Prints EXACTLY ONE JSON line on stdout:
-    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
-Diagnostics (quality gate, sizes, timings) go to stderr.
+Capture architecture (round-2 verdict item 1 — the result must be
+UN-LOSEABLE): the parent process never initializes a JAX backend. The NumPy
+oracle denominator runs first, then each engine runs in its own
+deadline-bounded subprocess (``--as-engine``), and a COMPLETE result line is
+printed to stdout the moment the FIRST engine finishes — later engines can
+only improve it (a faster engine re-prints). A hang, tunnel wedge, or kill of
+any later engine therefore cannot erase the round's number: whatever is on
+stdout when the driver's clock expires is a valid result.
+
+stdout protocol: one or more JSON result lines
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...}
+each complete and valid; the LAST line printed is the authoritative (best)
+result. Diagnostics go to stderr.
 
 Usage: python bench.py [--quick] [--broadcasters N] [--horizon T]
+                       [--deadline S] [--engine-deadline S]
   --quick: small shapes for CPU smoke verification (seconds, not minutes).
 """
 
@@ -20,25 +31,29 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+_START = time.monotonic()
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def _default_backend_alive(log, deadlines=(120.0, 45.0),
-                           backoff_s: float = 20.0) -> bool:
+def _default_backend_alive(log, deadlines=(90.0, 40.0),
+                           backoff_s: float = 15.0) -> bool:
     """True iff the default JAX backend (the tunneled TPU here) initializes
     within a deadline. Probed in a subprocess (shared helper,
     redqueen_tpu/utils/backend.py) because a wedged tunnel HANGS
-    jax.devices() rather than raising. The tunnel was down for the whole of
-    round 1 and can recover between hangs, so one failed probe gets one
-    shorter retry — total worst case ~185s, bounded so a dead tunnel can
-    never eat the driver's whole timeout before the CPU fallback runs."""
+    jax.devices() rather than raising. The tunnel was down for all of rounds
+    1-2 and can recover between hangs, so one failed probe gets one shorter
+    retry — total worst case ~145s, bounded so a dead tunnel can never eat
+    the driver's whole timeout before the CPU fallback runs."""
     import time as _time
 
     from redqueen_tpu.utils.backend import probe_default_backend
@@ -170,6 +185,278 @@ def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
     return events, secs, float(np.mean(tops))
 
 
+def _shapes(args):
+    """Shared between parent and --as-engine children so both sides agree."""
+    if args.quick:
+        B = args.broadcasters or 64
+        T = args.horizon or 20.0
+        oracle_comps = 2
+    else:
+        B = args.broadcasters or 10_000
+        T = args.horizon or 100.0
+        oracle_comps = 32  # ~0.75s of oracle wall time: a steady denominator
+    if args.capacity:
+        capacity = args.capacity
+    else:
+        # Chunks much smaller than the run absorb almost no past-horizon
+        # steps (the measured ~40% waste of a run-sized chunk); chunks much
+        # smaller than ~mean/10 pay per-chunk dispatch + host-sync instead.
+        # Measured optimum on the headline shape is ~mean_events/10.
+        mean_ev = T * args.wall_rate * args.followers * 1.25
+        capacity = int(min(2048, max(64, 1 << int(np.log2(max(mean_ev / 8, 1)) + 0.5))))
+    return B, T, capacity, oracle_comps
+
+
+def _star_with_retry(args, B, T, post_cap_mult: int = 1):
+    # Capacity: Poisson(rate*T) wall events per feed; mean + 9 sigma
+    # headroom rounded up so 100k+ streams cannot overflow.
+    mean_w = args.wall_rate * T
+    wall_cap = int(mean_w + 9 * max(mean_w, 1.0) ** 0.5 + 16)
+    # RedQueen's posting volume grows ~ T * sqrt(F * wall_rate / q) (the
+    # intensity sums sqrt(s_f/q) clocks across all F feeds), so the cap
+    # must scale with the follower count — a flat 4x-the-wall-mean cap
+    # always overflowed at the 100k-feed scale. 4x headroom; overflow
+    # still raises loudly and is retried with a doubled cap.
+    est = T * (args.followers * args.wall_rate / max(args.q, 1e-9)) ** 0.5
+    post_cap = max(int(4 * est), 64) * post_cap_mult
+    post_cap = 1 << (post_cap - 1).bit_length()  # round to pow2
+    try:
+        return run_jax_star(
+            B, args.followers, T, args.q, args.wall_rate, wall_cap, post_cap,
+        )
+    except RuntimeError as e:
+        if "post_cap" in str(e) and post_cap_mult <= 8:
+            log(f"star engine overflowed post_cap={post_cap}; retrying "
+                f"with a doubled cap")
+            return _star_with_retry(args, B, T, post_cap_mult * 2)
+        raise
+
+
+# ---------------------------------------------------------------------------
+# Child mode: run exactly one engine (or the oracle / a preset config) in
+# THIS process and print one JSON dict as the last stdout line. The parent
+# wraps each child in subprocess.run(timeout=...) so a hang is bounded.
+# ---------------------------------------------------------------------------
+
+def child_main(args) -> None:
+    B, T, capacity, oracle_comps = _shapes(args)
+
+    if args.as_engine == "oracle":
+        # Pure NumPy/pandas — never touches a JAX backend, cannot hang.
+        ev, secs, top1 = run_oracle(oracle_comps, args.followers, T, args.q,
+                                    args.wall_rate)
+        print(json.dumps({"ok": True, "events": ev, "secs": secs,
+                          "top1": top1, "comps": oracle_comps,
+                          "platform": "cpu"}), flush=True)
+        return
+
+    import jax
+
+    if args.backend == "cpu":
+        # The axon TPU-tunnel plugin ignores JAX_PLATFORMS; the config API is
+        # the reliable switch. A killed TPU run can wedge the tunnel, so the
+        # CPU path must never touch it.
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.as_engine == "config":
+        from benchmarks.run import bench_config
+
+        out = bench_config(args.config, quick=args.quick, log=log)
+        out["ok"] = True
+        out["platform"] = jax.devices()[0].platform
+        print(json.dumps(out), flush=True)
+        return
+
+    log(f"[child {args.as_engine}] devices: {jax.devices()}")
+    if args.as_engine == "star":
+        ev, secs, top1, posts = _star_with_retry(args, B, T)
+    elif args.as_engine == "scan":
+        ev, secs, top1, posts = run_jax(B, args.followers, T, args.q,
+                                        args.wall_rate, capacity)
+    elif args.as_engine == "pallas":
+        ev, secs, top1, posts = run_jax_pallas(B, args.followers, T, args.q,
+                                               args.wall_rate, capacity)
+    else:
+        raise SystemExit(f"unknown engine {args.as_engine!r}")
+    print(json.dumps({"ok": True, "events": ev, "secs": secs, "top1": top1,
+                      "posts": posts,
+                      "platform": jax.devices()[0].platform}), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent mode: orchestrate children under deadlines; never initialize JAX.
+# ---------------------------------------------------------------------------
+
+def _remaining(args) -> float:
+    return args.deadline - (time.monotonic() - _START)
+
+
+def _run_child(args, engine: str, backend: str, timeout_s: float):
+    """Run one --as-engine child; return its parsed JSON dict or None."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--as-engine", engine, "--backend", backend,
+           "--followers", str(args.followers),
+           "--q", str(args.q), "--wall-rate", str(args.wall_rate)]
+    if args.quick:
+        cmd.append("--quick")
+    if args.broadcasters:
+        cmd += ["--broadcasters", str(args.broadcasters)]
+    if args.horizon:
+        cmd += ["--horizon", str(args.horizon)]
+    if args.capacity:
+        cmd += ["--capacity", str(args.capacity)]
+    if args.config is not None:
+        cmd += ["--config", str(args.config)]
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
+                           text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        log(f"engine {engine} ({backend}) TIMED OUT after {timeout_s:.0f}s")
+        return None
+    took = time.monotonic() - t0
+    if r.stderr:
+        for line in r.stderr.strip().splitlines()[-6:]:
+            log(f"  [{engine}] {line}")
+    from redqueen_tpu.utils.backend import parse_last_json_line
+
+    obj = parse_last_json_line(r.stdout, require_ok=True)
+    if obj is not None:
+        log(f"engine {engine} ({backend}) done in {took:.1f}s wall")
+        return obj
+    log(f"engine {engine} ({backend}) FAILED (rc={r.returncode}) "
+        f"after {took:.1f}s")
+    return None
+
+
+def parent_main(args) -> None:
+    # Children recompute their own capacity/oracle_comps via _shapes; the
+    # parent only needs the display shape.
+    B, T, _, _ = _shapes(args)
+
+    # --- backend decision (no JAX in this process) ---
+    if (args.cpu or args.quick) and not args.tpu:
+        backend = "cpu"
+    elif _default_backend_alive(log):
+        backend = "default"
+    else:
+        # TPU tunnel down. Two observed failure modes: axon init raises
+        # UNAVAILABLE, or it hangs for minutes — so the probe runs in a
+        # SUBPROCESS with a deadline and we fall back to CPU rather than
+        # dying without the JSON line the driver records.
+        backend = "cpu"
+    log(f"backend: {backend}; total deadline {args.deadline:.0f}s "
+        f"({_remaining(args):.0f}s remaining)")
+    if args.engine == "pallas" and backend == "cpu":
+        raise RuntimeError(
+            "--engine pallas requires the TPU backend (Mosaic lowering); "
+            "interpret mode exists for tests, not timing — run with --tpu "
+            "and a live tunnel, or pick --engine scan/star"
+        )
+
+    # --- preset-config mode: one child, deadline-bounded, CPU retry ---
+    if args.config is not None:
+        for bk in ([backend, "cpu"] if backend == "default" else [backend]):
+            rem = _remaining(args)
+            if rem < 45.0:
+                log(f"deadline nearly exhausted ({rem:.0f}s left); "
+                    f"not starting config child on {bk}")
+                break
+            out = _run_child(args, "config", bk,
+                             min(args.engine_deadline, rem - 15.0))
+            if out is not None:
+                out.pop("ok", None)
+                print(json.dumps(out), flush=True)
+                return
+        raise RuntimeError("config bench failed on all backends")
+
+    log(f"graph: {B} broadcasters x {args.followers} followers "
+        f"(= {B * args.followers} feed edges), horizon T={T}, "
+        f"engine={args.engine}")
+
+    # --- oracle denominator first: fast, pure NumPy, cannot hang ---
+    rem = _remaining(args)
+    if rem < 60.0:
+        raise RuntimeError(
+            f"only {rem:.0f}s of the --deadline left after backend probing; "
+            f"no time to produce any result"
+        )
+    o = _run_child(args, "oracle", "cpu", min(600.0, rem * 0.5))
+    if o is None:
+        raise RuntimeError("NumPy oracle failed — no baseline denominator")
+    o_eps = o["events"] / o["secs"]
+    log(f"numpy ref: {o['events']} events in {o['secs']:.3f}s -> "
+        f"{o_eps:,.0f} events/s (on {o['comps']} components); "
+        f"time-in-top-1 {o['top1']:.2f}")
+
+    # --- engines, fastest-known-first, each in a bounded subprocess ---
+    if args.engine == "auto":
+        engines = ["scan", "star"]
+        if backend == "default":  # pallas needs a real TPU (Mosaic)
+            engines.append("pallas")
+    else:
+        engines = [args.engine]
+
+    best = None
+
+    def emit(res, engine_name):
+        eps = res["events"] / res["secs"]
+        line = {
+            "metric": f"simulated events/sec ({B}x{B * args.followers} graph)",
+            "value": round(eps, 1),
+            "unit": "events/s",
+            "vs_baseline": round(eps / o_eps, 2),
+            # Self-describing backend: a CPU fallback (wedged TPU tunnel)
+            # must never be mistaken for a TPU measurement.
+            "platform": res["platform"],
+            "engine": engine_name,
+        }
+        print(json.dumps(line), flush=True)
+        log(f"quality gate: |jax - numpy| = {abs(res['top1'] - o['top1']):.2f} "
+            f"(MC tolerance; see tests/test_sim_jax.py for the 4-sigma gate)")
+        log(f"speedup vs NumPy path: {eps / o_eps:,.1f}x "
+            f"(north-star target: >=100x)")
+
+    def sweep(bk: str) -> bool:
+        nonlocal best
+        any_ok = False
+        for name in engines:
+            if name == "pallas" and bk == "cpu":
+                continue  # interpret mode exists for tests, not timing
+            rem = _remaining(args)
+            if rem < 45.0:
+                log(f"deadline nearly exhausted ({rem:.0f}s left); "
+                    f"skipping engine {name}")
+                break
+            res = _run_child(args, name, bk,
+                             min(args.engine_deadline, rem - 15.0))
+            if res is None:
+                continue
+            any_ok = True
+            eps = res["events"] / res["secs"]
+            log(f"engine {name}: {res['events']} events in "
+                f"{res['secs']:.3f}s -> {eps:,.0f} events/s")
+            # Print a COMPLETE result line as soon as the first engine
+            # lands, and again only when a later engine beats it — the last
+            # line on stdout is always the best known result, and a later
+            # hang can no longer zero the round.
+            if best is None or eps > best["events"] / best["secs"]:
+                best = res
+                emit(res, name)
+        return any_ok
+
+    ok = sweep(backend)
+    if not ok and backend == "default" and _remaining(args) > 90.0:
+        log("all engines failed/timed out on the default (TPU) backend; "
+            "retrying on CPU so the round still records a number")
+        ok = sweep("cpu")
+    if best is None:
+        raise RuntimeError(
+            "all engines failed (see per-engine errors above) — no "
+            "benchmark result to report"
+        )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -177,7 +464,7 @@ def main():
                          "the CPU backend; see --tpu to override)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (JAX_PLATFORMS is ignored "
-                         "by the axon plugin; this uses the config API)")
+                         "by the axon plugin; the config API is used)")
     ap.add_argument("--tpu", action="store_true",
                     help="keep the default (TPU) backend even with --quick")
     ap.add_argument("--broadcasters", type=int, default=None)
@@ -201,138 +488,26 @@ def main():
                          "scan: the general event-scan kernel (arbitrary "
                          "graphs/policy mixes); pallas: the VMEM-resident "
                          "fused chunk kernel (TPU only); auto (default): "
-                         "time the engines available on this backend and "
-                         "report the fastest")
+                         "run the engines available on this backend "
+                         "fastest-known-first and report the best")
+    ap.add_argument("--deadline", type=float, default=900.0,
+                    help="total wall-clock budget (s); chosen well under "
+                         "the driver's capture timeout so bench always "
+                         "prints its result line before being killed")
+    ap.add_argument("--engine-deadline", type=float, default=420.0,
+                    help="per-engine subprocess budget (s)")
+    # Internal: child-process protocol (see child_main).
+    ap.add_argument("--as-engine",
+                    choices=["scan", "star", "pallas", "oracle", "config"],
+                    default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--backend", choices=["cpu", "default"], default="cpu",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
 
-    if args.quick:
-        B = args.broadcasters or 64
-        T = args.horizon or 20.0
-        oracle_comps = 2
+    if args.as_engine is not None:
+        child_main(args)
     else:
-        B = args.broadcasters or 10_000
-        T = args.horizon or 100.0
-        oracle_comps = 32  # ~0.75s of oracle wall time: a steady denominator
-    if args.capacity:
-        capacity = args.capacity
-    else:
-        # Chunks much smaller than the run absorb almost no past-horizon
-        # steps (the measured ~40% waste of a run-sized chunk); chunks much
-        # smaller than ~mean/10 pay per-chunk dispatch + host-sync instead.
-        # Measured optimum on the headline shape is ~mean_events/10.
-        mean_ev = T * args.wall_rate * args.followers * 1.25
-        capacity = int(min(2048, max(64, 1 << int(np.log2(max(mean_ev / 8, 1)) + 0.5))))
-
-    import jax
-
-    if (args.cpu or args.quick) and not args.tpu:
-        # The axon TPU-tunnel plugin ignores JAX_PLATFORMS; the config API is
-        # the reliable switch. A killed TPU run can wedge the tunnel, so the
-        # smoke path must never touch it.
-        jax.config.update("jax_platforms", "cpu")
-    elif not _default_backend_alive(log):
-        # TPU tunnel down. Two observed failure modes: axon init raises
-        # UNAVAILABLE, or it hangs for minutes — so the probe runs in a
-        # SUBPROCESS with a deadline (an in-process try/except cannot catch a
-        # hang) and we fall back to CPU rather than dying without the JSON
-        # line the driver records.
-        jax.config.update("jax_platforms", "cpu")
-    log(f"devices: {jax.devices()}")
-
-    if args.config is not None:
-        from benchmarks.run import bench_config
-
-        out = bench_config(args.config, quick=args.quick, log=log)
-        out["platform"] = jax.devices()[0].platform
-        print(json.dumps(out))
-        return
-
-    log(f"graph: {B} broadcasters x {args.followers} followers "
-        f"(= {B * args.followers} feed edges), horizon T={T}, "
-        f"engine={args.engine}")
-
-    def star(post_cap_mult: int = 1):
-        # Capacity: Poisson(rate*T) wall events per feed; mean + 9 sigma
-        # headroom rounded up so 100k+ streams cannot overflow.
-        mean_w = args.wall_rate * T
-        wall_cap = int(mean_w + 9 * max(mean_w, 1.0) ** 0.5 + 16)
-        # RedQueen's posting volume grows ~ T * sqrt(F * wall_rate / q) (the
-        # intensity sums sqrt(s_f/q) clocks across all F feeds), so the cap
-        # must scale with the follower count — a flat 4x-the-wall-mean cap
-        # always overflowed at the 100k-feed scale. 4x headroom; overflow
-        # still raises loudly and is retried with a doubled cap.
-        est = T * (args.followers * args.wall_rate / max(args.q, 1e-9)) ** 0.5
-        post_cap = max(int(4 * est), 64) * post_cap_mult
-        post_cap = 1 << (post_cap - 1).bit_length()  # round to pow2
-        try:
-            return run_jax_star(
-                B, args.followers, T, args.q, args.wall_rate, wall_cap,
-                post_cap,
-            )
-        except RuntimeError as e:
-            if "post_cap" in str(e) and post_cap_mult <= 8:
-                log(f"star engine overflowed post_cap={post_cap}; retrying "
-                    f"with a doubled cap")
-                return star(post_cap_mult * 2)
-            raise
-
-    def scan():
-        return run_jax(B, args.followers, T, args.q, args.wall_rate, capacity)
-
-    def pallas():
-        return run_jax_pallas(B, args.followers, T, args.q, args.wall_rate,
-                              capacity)
-
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if args.engine == "auto":
-        entries = [("scan", scan), ("star", star)]
-        if on_tpu:  # interpret mode exists for tests, not timing
-            entries.append(("pallas", pallas))
-        candidates = {}
-        for name, fn in entries:
-            try:
-                ev, secs, top1, posts = fn()
-            except Exception as e:  # an engine failing must not kill bench
-                log(f"engine {name} FAILED: {e}")
-                continue
-            candidates[name] = (ev, secs, top1, posts)
-            log(f"engine {name}: {ev} events in {secs:.3f}s "
-                f"-> {ev / secs:,.0f} events/s")
-        if not candidates:
-            raise RuntimeError(
-                "all engines failed (see per-engine errors above) — no "
-                "benchmark result to report"
-            )
-        winner = max(candidates, key=lambda n: candidates[n][0] / candidates[n][1])
-        log(f"engine auto -> {winner}")
-        events, secs, top1, posts = candidates[winner]
-    else:
-        fn = {"star": star, "scan": scan, "pallas": pallas}[args.engine]
-        events, secs, top1, posts = fn()
-    eps = events / secs
-    log(f"jax: {events} events in {secs:.3f}s -> {eps:,.0f} events/s; "
-        f"time-in-top-1 {top1:.2f}/{T}, posts/broadcaster {posts:.1f}")
-
-    o_events, o_secs, o_top1 = run_oracle(
-        oracle_comps, args.followers, T, args.q, args.wall_rate
-    )
-    o_eps = o_events / o_secs
-    speedup = eps / o_eps
-    log(f"numpy ref: {o_events} events in {o_secs:.3f}s -> {o_eps:,.0f} "
-        f"events/s (on {oracle_comps} components); time-in-top-1 {o_top1:.2f}")
-    log(f"quality gate: |jax - numpy| = {abs(top1 - o_top1):.2f} "
-        f"(MC tolerance; see tests/test_sim_jax.py for the 4-sigma gate)")
-    log(f"speedup vs NumPy path: {speedup:,.1f}x (north-star target: >=100x)")
-
-    print(json.dumps({
-        "metric": f"simulated events/sec ({B}x{B * args.followers} graph)",
-        "value": round(eps, 1),
-        "unit": "events/s",
-        "vs_baseline": round(speedup, 2),
-        # Self-describing backend: a CPU fallback (wedged TPU tunnel) must
-        # never be mistaken for a TPU measurement (round-1 verdict item 2).
-        "platform": jax.devices()[0].platform,
-    }))
+        parent_main(args)
 
 
 if __name__ == "__main__":

@@ -9,8 +9,10 @@ seed/q sweep loops of SURVEY.md section 3.5. The TPU shape of it:
   bipartite graph). ``redqueen_tpu.parallel`` shards this axis over a mesh.
 
 Long horizons run as repeated fixed-capacity chunks with the full carry
-(SURVEY.md section 5 "long-context" analogue); the driver loops on the host
-at *chunk* granularity only, and overflow is detected, never silent: if
+(SURVEY.md section 5 "long-context" analogue); chunks execute k at a time
+inside a device-side ``lax.while_loop`` ("superchunk", early-exiting when
+every lane is done), so the host loops — and pays a device round-trip —
+only once per k chunks. Overflow is detected, never silent: if
 ``max_chunks`` elapse with active sources, a RuntimeError reports progress.
 """
 
@@ -22,6 +24,7 @@ from typing import Optional, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax import random as jr
 
 from .config import SimConfig, SimState, SourceParams
@@ -64,18 +67,77 @@ class EventLog:
 
 
 @functools.lru_cache(maxsize=None)
-def _chunk_fn_cached(cfg: SimConfig, batched: bool, n_kinds: int):
+def _chunk_fn_cached(cfg: SimConfig, batched: bool, n_kinds: int, k: int = 8):
     # n_kinds keys the cache to the policy registry: registering a new
     # policy after a simulate() with the same SimConfig must re-trace, or
     # lax.switch would silently clamp the new kind onto a stale branch list.
-    fn = make_run_chunk(cfg)
-    if batched:
-        fn = jax.vmap(fn)
-    return jax.jit(fn)
+    #
+    # The returned "superchunk" advances the simulation by UP TO ``k`` chunks
+    # of ``cfg.capacity`` events entirely on device (lax.while_loop), writing
+    # each chunk into a preallocated [k * capacity] buffer and early-exiting
+    # the moment every lane is past its horizon/budget — so the host loop in
+    # ``_drive`` syncs once per k chunks instead of once per chunk. Over the
+    # axon TPU tunnel each host sync is a network round-trip, so this divides
+    # the dominant non-compute cost by k (round-2 verdict item 3). Dead lanes
+    # are masked by vmap-of-while_loop, which is bit-identical to running
+    # their absorbing chunks: an absorbed chunk is a true no-op on the carry
+    # (every SimState field is ``valid``-gated in scan_core.step and the PRNG
+    # is counter-addressed, never key-split per chunk) and its output equals
+    # the buffer's (+inf, -1) fill.
+    run_chunk = jax.vmap(make_run_chunk(cfg)) if batched else make_run_chunk(cfg)
+    cap = cfg.capacity
+    end_time = cfg.end_time
+
+    def alive_fn(st):
+        # Per-lane liveness; [B] when batched, scalar otherwise.
+        a = st.t_next.min(axis=-1) <= end_time
+        if st.budget is not None:
+            a &= st.n_events < st.budget
+        return a
+
+    # The while_loop sits OUTSIDE the vmap with one GLOBAL chunk counter
+    # (all lanes advance in lockstep, exactly like the old host loop): a
+    # per-lane while_loop under vmap would turn every buffer write into a
+    # select over the whole [k*cap] staging buffer (measured 26% slower on
+    # the CPU headline shape), whereas a shared counter keeps it one
+    # in-place dynamic_update_slice per chunk. Lanes already past their
+    # horizon run absorbing chunks — true no-ops emitting the buffer's own
+    # (+inf, -1) fill, so lockstep is bit-identical to masking.
+    def superchunk(params, adj, state, rem):
+        # ``rem`` (dynamic operand — no retrace across calls) is the chunk
+        # budget left before ``max_chunks``: the loop never runs past it, so
+        # the driver's overflow contract stays exact at chunk granularity,
+        # not superchunk granularity.
+        dtype = state.t_next.dtype
+        lead = state.t_next.shape[:-1]  # () or (B,)
+        times0 = jnp.full(lead + (k * cap,), jnp.inf, dtype)
+        srcs0 = jnp.full(lead + (k * cap,), -1, jnp.int32)
+        offset = (0,) * len(lead)
+
+        def cond(carry):
+            c, st, _, _ = carry
+            # c == 0: always run at least one chunk per superchunk call,
+            # matching the previous driver's run-then-check loop (an
+            # already-absorbed state still emits one padding chunk).
+            return (c < k) & (c < rem) & ((c == 0) | jnp.any(alive_fn(st)))
+
+        def body(carry):
+            c, st, times, srcs = carry
+            st, (t_c, s_c) = run_chunk(params, adj, st)
+            times = lax.dynamic_update_slice(times, t_c, offset + (c * cap,))
+            srcs = lax.dynamic_update_slice(srcs, s_c, offset + (c * cap,))
+            return c + 1, st, times, srcs
+
+        c, state, times, srcs = lax.while_loop(
+            cond, body, (jnp.zeros((), jnp.int32), state, times0, srcs0)
+        )
+        return state, times, srcs, c, alive_fn(state)
+
+    return jax.jit(superchunk)
 
 
-def _chunk_fn(cfg: SimConfig, batched: bool):
-    return _chunk_fn_cached(cfg, batched, base.n_kinds())
+def _chunk_fn(cfg: SimConfig, batched: bool, k: int = 8):
+    return _chunk_fn_cached(cfg, batched, base.n_kinds(), k)
 
 
 @functools.lru_cache(maxsize=None)
@@ -139,20 +201,31 @@ def _check_weights(cfg: SimConfig, params: SourceParams):
         )
 
 
-def _drive(cfg, params, adj, state, chunk, max_chunks, batched):
+def _drive(cfg, params, adj, state, superchunk, max_chunks, batched):
+    """Host loop at SUPERCHUNK granularity: one device->host sync per k
+    chunks (the superchunk's internal while_loop early-exits when every lane
+    is done, so no absorbed-chunk compute is wasted). Measured on the CPU
+    headline shape (10k lanes, 12 chunks/run, best-of-5): syncs drop 12 -> 2
+    per simulation at sync_every=8 for ~3% throughput cost (11.2M vs 11.6M
+    events/s at sync_every=1); the win is the axon TPU tunnel, where each
+    sync is a network round-trip."""
     times_chunks, srcs_chunks = [], []
     n_chunks = 0
     n_before = state.n_events  # resume(): count only this drive's events
+    cap = cfg.capacity
     while True:
-        state, (t_c, s_c) = chunk(params, adj, state)
-        times_chunks.append(t_c)
-        srcs_chunks.append(s_c)
-        n_chunks += 1
-        # Host sync at chunk granularity only (SURVEY.md section 7 design).
-        alive = state.t_next.min(axis=-1) <= cfg.end_time
-        if state.budget is not None:
-            alive &= state.n_events < state.budget
-        if not bool(jnp.any(alive)):
+        state, t_sc, s_sc, c, alive = superchunk(
+            params, adj, state, np.int32(max_chunks - n_chunks)
+        )
+        # The ONE host sync per superchunk: chunks executed + liveness.
+        c_max = int(np.max(np.asarray(c)))
+        alive_any = bool(np.any(np.asarray(alive)))
+        # Trim unused chunk slots so the returned buffers are bit-identical
+        # to the per-chunk driver's (goldens/parity unchanged).
+        times_chunks.append(t_sc[..., : c_max * cap])
+        srcs_chunks.append(s_sc[..., : c_max * cap])
+        n_chunks += c_max
+        if not alive_any:
             break
         if n_chunks >= max_chunks:
             done = np.asarray(state.n_events)
@@ -169,12 +242,15 @@ def _drive(cfg, params, adj, state, chunk, max_chunks, batched):
 
 def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
              max_chunks: int = 100, return_state: bool = False,
-             max_events: Optional[int] = None):
+             max_events: Optional[int] = None, sync_every: int = 8):
     """Run one component to its horizon. ``seed`` is an int or a PRNG key.
 
     ``max_events`` stops after exactly that many events (the oracle's
     ``Manager.run_dynamic`` semantics — SURVEY.md section 2 item 9), not at
     chunk granularity: the scan absorbs mid-chunk once the budget is spent.
+
+    ``sync_every`` is the device-side superchunk width: chunks run per
+    host sync (memory: a [sync_every * capacity] staging buffer per lane).
 
     Returns an ``EventLog`` (and the final ``SimState`` if
     ``return_state=True`` — the carry is resumable: pass it to
@@ -186,14 +262,15 @@ def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
     if max_events is not None:
         state = state.replace(budget=jnp.asarray(max_events, jnp.int32))
     log, state = _drive(
-        cfg, params, adj, state, _chunk_fn(cfg, False), max_chunks, False
+        cfg, params, adj, state, _chunk_fn(cfg, False, sync_every),
+        max_chunks, False
     )
     return (log, state) if return_state else log
 
 
 def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
                    max_chunks: int = 100, return_state: bool = False,
-                   max_events: Optional[int] = None):
+                   max_events: Optional[int] = None, sync_every: int = 8):
     """Run B same-shape components in lockstep (params/adj have a leading
     batch axis; ``seeds`` is an int array [B] or a key array [B, 2]).
 
@@ -214,13 +291,15 @@ def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
             )
         )
     log, state = _drive(
-        cfg, params, adj, state, _chunk_fn(cfg, True), max_chunks, True
+        cfg, params, adj, state, _chunk_fn(cfg, True, sync_every),
+        max_chunks, True
     )
     return (log, state) if return_state else log
 
 
 def resume(cfg: SimConfig, params: SourceParams, adj, state: SimState,
-           max_chunks: int = 100, max_events: Optional[int] = None):
+           max_chunks: int = 100, max_events: Optional[int] = None,
+           sync_every: int = 8):
     """Continue a simulation from a carried ``SimState`` (obtained via
     ``return_state=True``), e.g. after extending the horizon with a new
     ``SimConfig``. Valid because every policy schedules its TRUE next event
@@ -242,5 +321,6 @@ def resume(cfg: SimConfig, params: SourceParams, adj, state: SimState,
     else:
         state = state.replace(budget=None)
     return _drive(
-        cfg, params, adj, state, _chunk_fn(cfg, batched), max_chunks, batched
+        cfg, params, adj, state, _chunk_fn(cfg, batched, sync_every),
+        max_chunks, batched
     )

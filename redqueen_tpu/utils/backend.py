@@ -15,7 +15,25 @@ import subprocess
 import sys
 from typing import Callable, Optional, Tuple
 
-__all__ = ["probe_default_backend"]
+__all__ = ["probe_default_backend", "parse_last_json_line"]
+
+
+def parse_last_json_line(text: Optional[str], require_ok: bool = False):
+    """Parse the child-subprocess stdout protocol shared by ``bench.py``
+    (``--as-engine`` children) and ``tools/tpu_watcher.py``: the last stdout
+    line that is a JSON dict is the result. Returns that dict or ``None``.
+    With ``require_ok``, only dicts carrying a truthy ``"ok"`` key count —
+    one parser so the two callers cannot drift."""
+    import json
+
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(obj, dict) and (not require_ok or obj.get("ok")):
+            return obj
+    return None
 
 
 def probe_default_backend(

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Session-long TPU-tunnel watcher (round-2 verdict item 2).
+
+The axon TPU tunnel has been observed to hang ``jax.devices()`` for hours and
+then recover unannounced (it came alive exactly when the round-2 driver ran
+the bench, after the builder's sole 17:20 probe). This watcher closes that
+gap: it probes the default backend every ``--interval`` minutes in a
+deadline-bounded subprocess (redqueen_tpu.utils.backend.probe_default_backend
+-- an in-process probe cannot catch a hang), appends every attempt to
+TPU_PROBE_LOG.md, and on the FIRST success immediately captures evidence
+while the tunnel is known-alive:
+
+  1. ``python bench.py --quick --tpu``  -> BENCH_tpu_quick_r03.json
+  2. exits 0 so the driving session is notified and can attempt the full
+     headline shape / Pallas compile while the tunnel is still up.
+
+Exits 1 after ``--max-probes`` failures (~ the session length) so the
+background process never outlives the round.
+
+Usage: python tools/tpu_watcher.py [--interval MIN] [--max-probes N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as _dt
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG_MD = os.path.join(REPO, "TPU_PROBE_LOG.md")
+QUICK_JSON = os.path.join(REPO, "BENCH_tpu_quick_r03.json")
+QUICK_LOG = os.path.join(REPO, "benchmarks", "tpu_quick_r03.log")
+
+
+def utcnow() -> str:
+    return _dt.datetime.now(_dt.timezone.utc).strftime("%Y-%m-%d %H:%M")
+
+
+def append_log(line: str) -> None:
+    with open(LOG_MD, "a") as f:
+        f.write(line + "\n")
+
+
+def capture_quick_bench(deadline_s: float = 1200.0) -> bool:
+    """Run the quick TPU bench in a bounded subprocess; record JSON + log."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--quick", "--tpu"]
+    try:
+        r = subprocess.run(cmd, timeout=deadline_s, capture_output=True,
+                           text=True, cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        with open(QUICK_LOG, "w") as f:
+            f.write(f"TIMEOUT after {deadline_s}s\n")
+            f.write((e.stderr or b"").decode() if isinstance(e.stderr, bytes)
+                    else (e.stderr or ""))
+        append_log(f"| {utcnow()} | quick TPU bench TIMED OUT after "
+                   f"{deadline_s:.0f}s (stderr tail in {QUICK_LOG}) |")
+        return False
+    with open(QUICK_LOG, "w") as f:
+        f.write(f"$ {' '.join(cmd)}  (rc={r.returncode})\n--- stdout ---\n")
+        f.write(r.stdout or "")
+        f.write("\n--- stderr ---\n")
+        f.write(r.stderr or "")
+    import json
+
+    from redqueen_tpu.utils.backend import parse_last_json_line
+
+    parsed = parse_last_json_line(r.stdout)
+    if parsed is None:
+        append_log(f"| {utcnow()} | quick TPU bench rc={r.returncode}, no "
+                   f"JSON line (full output in {QUICK_LOG}) |")
+        return False
+    if parsed.get("platform") != "tpu":
+        # bench.py fell back to CPU mid-run (tunnel wedged between the
+        # watcher's probe and bench's own): a CPU line must NEVER be filed
+        # as TPU evidence (round-1 verdict rule). Keep probing.
+        append_log(f"| {utcnow()} | tunnel flaked: bench fell back to "
+                   f"platform={parsed.get('platform')!r}; NOT recording as "
+                   f"TPU evidence |")
+        return False
+    with open(QUICK_JSON, "w") as f:
+        json.dump(parsed, f)
+        f.write("\n")
+    append_log(f"| {utcnow()} | quick TPU bench OK: {parsed} |")
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=10.0,
+                    help="minutes between probes")
+    ap.add_argument("--max-probes", type=int, default=80)
+    ap.add_argument("--probe-deadline", type=float, default=90.0)
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    from redqueen_tpu.utils.backend import probe_default_backend
+
+    for attempt in range(1, args.max_probes + 1):
+        alive, n, plat = probe_default_backend(args.probe_deadline)
+        if alive and plat == "tpu":
+            append_log(f"| {utcnow()} | ALIVE — {n} x {plat} "
+                       f"(probe {attempt}); capturing quick bench |")
+            if capture_quick_bench():
+                print(f"TPU ALIVE at probe {attempt}; quick bench captured")
+                return 0
+            # Capture fell back to CPU / failed: the tunnel flaked between
+            # probe and bench. Keep probing — a later window may hold.
+            status = "alive at probe but capture failed (see log)"
+        else:
+            status = (f"alive but platform={plat!r}" if alive else
+                      f"down (no response in {args.probe_deadline:.0f}s)")
+        append_log(f"| {utcnow()} | {status} (probe {attempt}) |")
+        if attempt < args.max_probes:
+            time.sleep(args.interval * 60.0)
+    print(f"TPU never came up in {args.max_probes} probes")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
