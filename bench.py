@@ -70,6 +70,14 @@ def _default_backend_alive(log, deadlines=(90.0, 40.0),
     return False
 
 
+# Timed measurement = best of N identical runs (after one warm-up run that
+# pays compilation). This one-core machine shows 10-60% run-to-run noise
+# from unrelated load; the MIN of 3 is the stable estimator of the engine's
+# actual cost (events are identical across reps — same seeds), and it is
+# what the committed artifacts record, stated in their provenance notes.
+TIMED_REPS = 3
+
+
 def build_component(n_followers: int, T: float, q: float, wall_rate: float,
                     capacity: int):
     from redqueen_tpu.config import GraphBuilder
@@ -104,9 +112,11 @@ def run_jax_star(B: int, n_followers: int, T: float, q: float,
     wall_b, ctrl_b = broadcast_star(wall, ctrl, B)
 
     warm = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B))
-    t0 = time.perf_counter()
-    res = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B) + 10_000)
-    secs = time.perf_counter() - t0  # block_until_ready inside
+    secs = np.inf
+    for _ in range(TIMED_REPS):  # best-of-N: see TIMED_REPS note
+        t0 = time.perf_counter()
+        res = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B) + 10_000)
+        secs = min(secs, time.perf_counter() - t0)  # block_until_ready inside
 
     events = int(res.wall_n.sum()) + int(res.n_posts.sum())
     top1 = float(np.asarray(res.metrics.mean_time_in_top_k()).mean())
@@ -129,16 +139,28 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
 
     warm = simulate_fn(cfg, params, adj, np.arange(B))
     jax.block_until_ready(warm.times)
-    t0 = time.perf_counter()
-    logb = simulate_fn(cfg, params, adj, np.arange(B) + 10_000)
-    jax.block_until_ready(logb.times)
-    secs = time.perf_counter() - t0
+    secs = np.inf
+    for _ in range(TIMED_REPS):  # best-of-N: see TIMED_REPS note
+        t0 = time.perf_counter()
+        logb = simulate_fn(cfg, params, adj, np.arange(B) + 10_000)
+        jax.block_until_ready(logb.times)
+        secs = min(secs, time.perf_counter() - t0)
 
     events = int(np.asarray(logb.n_events).sum())
     m = feed_metrics_batch(logb.times, logb.srcs, adj_b, opt, T)
     top1 = float(np.asarray(m.mean_time_in_top_k()).mean())
     posts = float(np.asarray(num_posts(logb.srcs, opt)).mean())
     return events, secs, top1, posts
+
+
+def _max_chunks(n_followers: int, T: float, wall_rate: float,
+                capacity: int) -> int:
+    """Chunk allowance sized to the workload: ~4x the expected event count
+    (wall mean x 1.25 for posts) over the chunk capacity, floored at 64. A
+    flat 64 silently capped the scan engine at ~130k events/lane, making
+    big-F comparison cells fail on a harness artifact instead of measuring."""
+    mean_ev = T * wall_rate * n_followers * 1.25
+    return max(64, int(4 * mean_ev / capacity) + 1)
 
 
 def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
@@ -148,7 +170,8 @@ def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
     only — interpret mode exists for tests, not timing."""
     from redqueen_tpu.ops.pallas_chunk import simulate_pallas
 
-    fn = lambda cfg, p, a, s: simulate_pallas(cfg, p, a, s, max_chunks=64)
+    mc = _max_chunks(n_followers, T, wall_rate, capacity)
+    fn = lambda cfg, p, a, s: simulate_pallas(cfg, p, a, s, max_chunks=mc)
     return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate, capacity)
 
 
@@ -156,7 +179,8 @@ def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
             capacity: int):
     from redqueen_tpu.sim import simulate_batch
 
-    fn = lambda cfg, p, a, s: simulate_batch(cfg, p, a, s, max_chunks=64)
+    mc = _max_chunks(n_followers, T, wall_rate, capacity)
+    fn = lambda cfg, p, a, s: simulate_batch(cfg, p, a, s, max_chunks=mc)
     return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate, capacity)
 
 
@@ -165,23 +189,31 @@ def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
     from redqueen_tpu.oracle.numpy_ref import SimOpts
     from redqueen_tpu.utils import metrics_pandas as mp
 
-    events = 0
-    tops = []
-    t0 = time.perf_counter()
-    for c in range(n_comps):
-        others = [
-            ("poisson", dict(src_id=100 + i, seed=40_000 + 1000 * c + i,
-                             rate=wall_rate, sink_ids=[i]))
-            for i in range(n_followers)
-        ]
-        so = SimOpts(src_id=0, sink_ids=list(range(n_followers)),
-                     other_sources=others, end_time=T, q=q)
-        mgr = so.create_manager_with_opt(seed=c)
-        mgr.run_till()
-        df = mgr.state.get_dataframe()
-        events += df["event_id"].nunique()
-        tops.append(mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=so.sink_ids))
-    secs = time.perf_counter() - t0
+    # Best-of-TIMED_REPS like the engines: vs_baseline must divide two
+    # same-estimator quantities, or load noise in a single oracle draw
+    # biases the headline speedup (each rep replays identical seeds, so
+    # events/tops are identical across reps).
+    secs = np.inf
+    for _ in range(TIMED_REPS):
+        events = 0
+        tops = []
+        t0 = time.perf_counter()
+        for c in range(n_comps):
+            others = [
+                ("poisson", dict(src_id=100 + i, seed=40_000 + 1000 * c + i,
+                                 rate=wall_rate, sink_ids=[i]))
+                for i in range(n_followers)
+            ]
+            so = SimOpts(src_id=0, sink_ids=list(range(n_followers)),
+                         other_sources=others, end_time=T, q=q)
+            mgr = so.create_manager_with_opt(seed=c)
+            mgr.run_till()
+            df = mgr.state.get_dataframe()
+            events += df["event_id"].nunique()
+            tops.append(
+                mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=so.sink_ids)
+            )
+        secs = min(secs, time.perf_counter() - t0)
     return events, secs, float(np.mean(tops))
 
 
@@ -199,11 +231,13 @@ def _shapes(args):
         capacity = args.capacity
     else:
         # Chunks much smaller than the run absorb almost no past-horizon
-        # steps (the measured ~40% waste of a run-sized chunk); chunks much
-        # smaller than ~mean/10 pay per-chunk dispatch + host-sync instead.
-        # Measured optimum on the headline shape is ~mean_events/10.
+        # steps (the measured ~40% waste of a run-sized chunk). With the
+        # superchunk driver amortizing host syncs (sim._drive), the re-swept
+        # optimum moved smaller: ~mean_events/16 (cap 64 on the headline
+        # shape, 12.2M vs 11.1M ev/s at mean/8) — per-chunk dispatch is now
+        # cheap enough that absorbing less wins.
         mean_ev = T * args.wall_rate * args.followers * 1.25
-        capacity = int(min(2048, max(64, 1 << int(np.log2(max(mean_ev / 8, 1)) + 0.5))))
+        capacity = int(min(2048, max(64, 1 << int(np.log2(max(mean_ev / 16, 1)) + 0.5))))
     return B, T, capacity, oracle_comps
 
 
@@ -381,13 +415,19 @@ def parent_main(args) -> None:
             f"only {rem:.0f}s of the --deadline left after backend probing; "
             f"no time to produce any result"
         )
-    o = _run_child(args, "oracle", "cpu", min(600.0, rem * 0.5))
-    if o is None:
-        raise RuntimeError("NumPy oracle failed — no baseline denominator")
-    o_eps = o["events"] / o["secs"]
-    log(f"numpy ref: {o['events']} events in {o['secs']:.3f}s -> "
-        f"{o_eps:,.0f} events/s (on {o['comps']} components); "
-        f"time-in-top-1 {o['top1']:.2f}")
+    if args.no_oracle:
+        # Engine-vs-engine comparisons (tools/star_vs_scan.py) don't need
+        # the NumPy denominator — which is O(sources) per event and
+        # infeasible at F >= 1k followers; vs_baseline is reported null.
+        o, o_eps = None, None
+    else:
+        o = _run_child(args, "oracle", "cpu", min(600.0, rem * 0.5))
+        if o is None:
+            raise RuntimeError("NumPy oracle failed — no baseline denominator")
+        o_eps = o["events"] / o["secs"]
+        log(f"numpy ref: {o['events']} events in {o['secs']:.3f}s -> "
+            f"{o_eps:,.0f} events/s (on {o['comps']} components); "
+            f"time-in-top-1 {o['top1']:.2f}")
 
     # --- engines, fastest-known-first, each in a bounded subprocess ---
     if args.engine == "auto":
@@ -405,17 +445,19 @@ def parent_main(args) -> None:
             "metric": f"simulated events/sec ({B}x{B * args.followers} graph)",
             "value": round(eps, 1),
             "unit": "events/s",
-            "vs_baseline": round(eps / o_eps, 2),
+            "vs_baseline": round(eps / o_eps, 2) if o_eps else None,
             # Self-describing backend: a CPU fallback (wedged TPU tunnel)
             # must never be mistaken for a TPU measurement.
             "platform": res["platform"],
             "engine": engine_name,
         }
         print(json.dumps(line), flush=True)
-        log(f"quality gate: |jax - numpy| = {abs(res['top1'] - o['top1']):.2f} "
-            f"(MC tolerance; see tests/test_sim_jax.py for the 4-sigma gate)")
-        log(f"speedup vs NumPy path: {eps / o_eps:,.1f}x "
-            f"(north-star target: >=100x)")
+        if o is not None:
+            log(f"quality gate: |jax - numpy| = "
+                f"{abs(res['top1'] - o['top1']):.2f} (MC tolerance; see "
+                f"tests/test_sim_jax.py for the 4-sigma gate)")
+            log(f"speedup vs NumPy path: {eps / o_eps:,.1f}x "
+                f"(north-star target: >=100x)")
 
     def sweep(bk: str) -> bool:
         nonlocal best
@@ -472,10 +514,11 @@ def main():
     ap.add_argument("--horizon", type=float, default=None)
     ap.add_argument("--capacity", type=int, default=None,
                     help="scan-engine chunk capacity (scan steps per "
-                         "chunk); default sizes to ~mean_total_events/8 "
+                         "chunk); default sizes to ~mean_total_events/16 "
                          "(pow2, clamped [64, 2048]) — the measured "
                          "optimum between absorbed-step waste and "
-                         "per-chunk dispatch cost")
+                         "per-chunk dispatch cost under the superchunk "
+                         "driver")
     ap.add_argument("--q", type=float, default=1.0)
     ap.add_argument("--wall-rate", type=float, default=1.0)
     ap.add_argument("--config", type=int, default=None, choices=[1, 2, 3, 4, 5],
@@ -496,6 +539,11 @@ def main():
                          "prints its result line before being killed")
     ap.add_argument("--engine-deadline", type=float, default=420.0,
                     help="per-engine subprocess budget (s)")
+    ap.add_argument("--no-oracle", action="store_true",
+                    help="skip the NumPy-oracle denominator (engine-vs-"
+                         "engine comparisons; O(sources)-per-event makes it "
+                         "infeasible at big follower counts) — "
+                         "vs_baseline is reported null")
     # Internal: child-process protocol (see child_main).
     ap.add_argument("--as-engine",
                     choices=["scan", "star", "pallas", "oracle", "config"],

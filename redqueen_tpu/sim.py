@@ -201,22 +201,32 @@ def _check_weights(cfg: SimConfig, params: SourceParams):
         )
 
 
-def _drive(cfg, params, adj, state, superchunk, max_chunks, batched):
+def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
+           sync_every):
     """Host loop at SUPERCHUNK granularity: one device->host sync per k
     chunks (the superchunk's internal while_loop early-exits when every lane
-    is done, so no absorbed-chunk compute is wasted). Measured on the CPU
-    headline shape (10k lanes, 12 chunks/run, best-of-5): syncs drop 12 -> 2
-    per simulation at sync_every=8 for ~3% throughput cost (11.2M vs 11.6M
-    events/s at sync_every=1); the win is the axon TPU tunnel, where each
-    sync is a network round-trip."""
+    is done, so no absorbed-chunk compute is wasted).
+
+    The FIRST superchunk always runs k=1: a run that fits one chunk (the
+    common case when capacity >= the run's event count, e.g. the presets'
+    capacity=2048) pays zero staging-buffer overhead — a fixed k=8 start
+    costs it ~30% on CPU (7.5M vs 11.0M events/s, config-3 shape) filling
+    and carrying a k*capacity buffer it never uses. Runs that survive chunk
+    1 switch to k=sync_every for the tail. Measured on the CPU headline
+    shape (10k lanes, 24 chunks/run at capacity 64, best-of-3): syncs drop
+    24 -> 4 per simulation with throughput within noise of the per-chunk
+    driver; the win is the axon TPU tunnel, where each sync is a network
+    round-trip."""
     times_chunks, srcs_chunks = [], []
     n_chunks = 0
     n_before = state.n_events  # resume(): count only this drive's events
     cap = cfg.capacity
+    k = 1
     while True:
-        state, t_sc, s_sc, c, alive = superchunk(
+        state, t_sc, s_sc, c, alive = chunk_fn_for(k)(
             params, adj, state, np.int32(max_chunks - n_chunks)
         )
+        k = sync_every
         # The ONE host sync per superchunk: chunks executed + liveness.
         c_max = int(np.max(np.asarray(c)))
         alive_any = bool(np.any(np.asarray(alive)))
@@ -262,8 +272,8 @@ def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
     if max_events is not None:
         state = state.replace(budget=jnp.asarray(max_events, jnp.int32))
     log, state = _drive(
-        cfg, params, adj, state, _chunk_fn(cfg, False, sync_every),
-        max_chunks, False
+        cfg, params, adj, state, lambda k: _chunk_fn(cfg, False, k),
+        max_chunks, False, sync_every
     )
     return (log, state) if return_state else log
 
@@ -291,8 +301,8 @@ def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
             )
         )
     log, state = _drive(
-        cfg, params, adj, state, _chunk_fn(cfg, True, sync_every),
-        max_chunks, True
+        cfg, params, adj, state, lambda k: _chunk_fn(cfg, True, k),
+        max_chunks, True, sync_every
     )
     return (log, state) if return_state else log
 
@@ -321,6 +331,6 @@ def resume(cfg: SimConfig, params: SourceParams, adj, state: SimState,
     else:
         state = state.replace(budget=None)
     return _drive(
-        cfg, params, adj, state, _chunk_fn(cfg, batched, sync_every),
-        max_chunks, batched
+        cfg, params, adj, state, lambda k: _chunk_fn(cfg, batched, k),
+        max_chunks, batched, sync_every
     )

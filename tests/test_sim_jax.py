@@ -78,6 +78,48 @@ class TestDeterminism:
             simulate(cfg, params, adj, seed=0, max_chunks=2)
 
 
+class TestSuperchunkDriver:
+    """The device-side superchunk loop (sim._chunk_fn_cached): k chunks per
+    host sync must change NOTHING observable — streams, budgets, and the
+    overflow contract are all pinned against the k=1 (per-chunk) driver."""
+
+    def test_sync_every_bit_identical(self):
+        cfg, params, adj, opt = config1(end_time=50.0, capacity=64)
+        base = simulate(cfg, params, adj, seed=11, sync_every=1)
+        n = int(base.n_events)
+        assert n > 64  # the run must actually span several chunks
+        for k in (2, 3, 8, 16):
+            lg = simulate(cfg, params, adj, seed=11, sync_every=k)
+            assert int(lg.n_events) == n
+            np.testing.assert_array_equal(
+                np.asarray(lg.times)[:n], np.asarray(base.times)[:n]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(lg.srcs)[:n], np.asarray(base.srcs)[:n]
+            )
+
+    def test_max_chunks_exact_at_any_sync_every(self):
+        """The overflow guard must fire at exact CHUNK granularity even when
+        max_chunks is not a multiple of sync_every (the loop takes a dynamic
+        remaining-budget operand; a superchunk-granular check would let a
+        run finish—or overshoot—inside the in-flight superchunk)."""
+        cfg, params, adj, opt = config1(end_time=50.0, capacity=16)
+        for k in (1, 8):
+            with pytest.raises(RuntimeError, match="after 2 chunks"):
+                simulate(cfg, params, adj, seed=0, max_chunks=2, sync_every=k)
+
+    def test_batched_budgets_cross_superchunk(self):
+        """Per-lane run_dynamic budgets that land in different superchunks
+        (lane budgets 1 vs 200 at capacity 64, sync_every 2) must each stop
+        exactly on budget."""
+        cfg, p0, a0, opt = config1(end_time=50.0, capacity=64)
+        params, adj = stack_components([p0] * 4, [a0] * 4)
+        budgets = np.array([10, 200, 60, 1])
+        logb = simulate_batch(cfg, params, adj, np.arange(4),
+                              max_events=budgets, sync_every=2)
+        assert np.asarray(logb.n_events).tolist() == budgets.tolist()
+
+
 class TestRunDynamic:
     """Exact max_events stop — the oracle's ``Manager.run_dynamic``
     (SURVEY.md section 2 item 9): per-EVENT granularity, not chunk."""

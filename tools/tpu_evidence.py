@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""One-command TPU evidence capture, for the moment the tunnel comes alive.
+
+Runs, in order, each in a deadline-bounded subprocess (a wedged tunnel hangs
+rather than raising — every stage is survivable), writing artifacts as it
+goes so a mid-sequence wedge keeps everything captured so far:
+
+  1. quick headline bench on TPU      -> BENCH_tpu_quick_r03.json
+  2. FULL headline bench on TPU       -> BENCH_tpu_full_r03.json
+  3. Pallas engine on the chip        -> BENCH_tpu_pallas_r03.json
+     (first real Mosaic compile of ops/pallas_chunk.py)
+  4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu.json
+
+Stages that fail/time out are recorded as such and the sequence continues.
+
+Usage: python tools/tpu_evidence.py [--stage N] [--deadline S per stage]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_stage(name, cmd, out_json, deadline_s, log_path):
+    print(f"== stage {name}: {' '.join(cmd)} (deadline {deadline_s:.0f}s)",
+          flush=True)
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(cmd, timeout=deadline_s, capture_output=True,
+                           text=True, cwd=REPO)
+        rc, out, err = r.returncode, r.stdout or "", r.stderr or ""
+    except subprocess.TimeoutExpired as e:
+        # Keep whatever stdout the child printed BEFORE the kill: bench.py's
+        # whole protocol is that an already-printed result line survives.
+        def _s(x):
+            return x.decode(errors="replace") if isinstance(x, bytes) else (x or "")
+        rc, out, err = 124, _s(e.stdout), _s(e.stderr)
+    wall = time.monotonic() - t0
+    with open(log_path, "w") as f:
+        f.write(f"$ {' '.join(cmd)}\nrc={rc} wall={wall:.1f}s\n"
+                f"--- stdout ---\n{out}\n--- stderr ---\n{err}\n")
+
+    sys.path.insert(0, REPO)
+    from redqueen_tpu.utils.backend import parse_last_json_line
+
+    parsed = parse_last_json_line(out)
+    if out_json and parsed is not None:
+        with open(out_json, "w") as f:
+            json.dump({"rc": rc, "wall_s": round(wall, 1), "result": parsed,
+                       "command": " ".join(cmd)}, f, indent=1)
+            f.write("\n")
+    status = "OK" if (rc == 0 and parsed is not None) else f"FAILED rc={rc}"
+    print(f"== stage {name}: {status} in {wall:.0f}s -> "
+          f"{parsed if parsed else log_path}", flush=True)
+    return parsed
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", type=int, default=None,
+                    help="run only this stage (1-4)")
+    ap.add_argument("--deadline", type=float, default=1500.0)
+    args = ap.parse_args()
+    py = sys.executable
+    bench = os.path.join(REPO, "bench.py")
+    # Stage 4 runs 6 bench cells (3 shapes x 2 engines), each allowed up to
+    # sweep_cell deadline + overhead — its stage budget must cover the worst
+    # case, not the single-bench default (the sweep also writes its artifact
+    # incrementally per cell, so even a mid-sweep kill keeps finished cells).
+    sweep_cell = args.deadline / 2
+    sweep_budget = 6 * (sweep_cell + 240.0) + 120.0
+    stages = [
+        (1, "quick", [py, bench, "--quick", "--tpu"],
+         os.path.join(REPO, "BENCH_tpu_quick_r03.json"),
+         os.path.join(REPO, "benchmarks", "tpu_quick_r03.log"),
+         args.deadline),
+        (2, "full", [py, bench, "--tpu",
+                     "--deadline", str(args.deadline - 60)],
+         os.path.join(REPO, "BENCH_tpu_full_r03.json"),
+         os.path.join(REPO, "benchmarks", "tpu_full_r03.log"),
+         args.deadline),
+        (3, "pallas", [py, bench, "--tpu", "--engine", "pallas",
+                       "--deadline", str(args.deadline - 60)],
+         os.path.join(REPO, "BENCH_tpu_pallas_r03.json"),
+         os.path.join(REPO, "benchmarks", "tpu_pallas_r03.log"),
+         args.deadline),
+        (4, "star-vs-scan", [py, os.path.join(REPO, "tools", "star_vs_scan.py"),
+                             "--tpu", "--engine-deadline", str(sweep_cell)],
+         None,  # star_vs_scan writes its own artifact (incrementally)
+         os.path.join(REPO, "benchmarks", "tpu_star_vs_scan_r03.log"),
+         sweep_budget),
+    ]
+    any_ok = False
+    for n, name, cmd, out_json, log_path, deadline_s in stages:
+        if args.stage is not None and n != args.stage:
+            continue
+        parsed = run_stage(name, cmd, out_json, deadline_s, log_path)
+        if parsed is not None and parsed.get("platform") == "tpu":
+            any_ok = True
+        elif parsed is not None:
+            print(f"== stage {name}: result is platform="
+                  f"{parsed.get('platform')!r}, NOT tpu — tunnel likely "
+                  f"flaked mid-stage; artifact kept but not TPU evidence",
+                  flush=True)
+    return 0 if any_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
