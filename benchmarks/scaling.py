@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Batch-scaling curve: scan-engine throughput vs component count B on the
+headline component shape (1 Opt x 10 Poisson feeds, T=100).
+
+The sweep axis of the reference (seeds x q x policies, SURVEY.md section
+3.5) is this framework's vmap batch axis; this harness measures how far
+batching amortizes per-dispatch cost — the number that justifies "the sweep
+is the unit of work" — and, on TPU, how much batch the chip needs to reach
+peak. Best-of-3 timing per point (bench.py's TIMED_REPS protocol).
+
+Usage: python benchmarks/scaling.py [--cpu] [--out scaling.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--batches", type=int, nargs="*",
+                    default=[1, 10, 100, 1000, 10_000])
+    ap.add_argument("--horizon", type=float, default=100.0)
+    ap.add_argument("--reps", type=int, default=None,
+                    help="timed reps per point (default: bench.TIMED_REPS)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    # Shared shape, chunk-allowance formula, and timing protocol with the
+    # headline bench — one source of truth for each.
+    from bench import TIMED_REPS, _max_chunks, build_component
+    from redqueen_tpu.config import stack_components
+    from redqueen_tpu.sim import simulate_batch
+
+    if args.reps is None:
+        args.reps = TIMED_REPS
+    log(f"devices: {jax.devices()}")
+    cfg, p0, a0, opt = build_component(10, args.horizon, 1.0, 1.0, 64)
+    rows = []
+    for B in args.batches:
+        params, adj = stack_components([p0] * B, [a0] * B)
+        mc = _max_chunks(10, args.horizon, 1.0, 64)
+        lg = simulate_batch(cfg, params, adj, np.arange(B), max_chunks=mc)
+        jax.block_until_ready(lg.times)  # warm-up compiles this B
+        secs = np.inf
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            lg = simulate_batch(cfg, params, adj, np.arange(B) + 10_000,
+                                max_chunks=mc)
+            jax.block_until_ready(lg.times)
+            secs = min(secs, time.perf_counter() - t0)
+        ev = int(np.asarray(lg.n_events).sum())
+        eps = ev / secs
+        rows.append({"B": B, "events": ev, "secs": round(secs, 4),
+                     "events_per_sec": round(eps, 1)})
+        log(f"B={B:>6}: {ev:>9} events in {secs:.4f}s -> {eps:,.0f} ev/s "
+            f"({eps / max(B, 1):,.0f} per-lane)")
+    out = {"platform": jax.devices()[0].platform,
+           "shape": "1 Opt x 10 Poisson feeds, T=100, capacity=64",
+           "reps": args.reps, "rows": rows}
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+        log(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,14 +59,22 @@ def main() -> int:
     T = 20.0 if args.quick else args.horizon
 
     rows = []
-    out_path = args.out  # resolved after the first cell reveals the platform
+    out_path = args.out
 
     def flush(platform):
         # Incremental artifact write after EVERY cell (un-loseable protocol:
-        # a later cell's hang/kill cannot erase completed measurements).
+        # a later cell's hang/kill cannot erase completed measurements). An
+        # auto-named path follows the platform: if the first cell failed
+        # entirely (platform "none") and a later cell succeeds, the file is
+        # renamed to the real platform so STAR_VS_SCAN_tpu.json actually
+        # appears for the evidence harness.
         nonlocal out_path
-        if out_path is None:
-            out_path = os.path.join(REPO, f"STAR_VS_SCAN_{platform}.json")
+        if args.out is None:
+            want = os.path.join(REPO, f"STAR_VS_SCAN_{platform}.json")
+            if out_path is not None and out_path != want and \
+                    os.path.exists(out_path):
+                os.replace(out_path, want)
+            out_path = want
         with open(out_path, "w") as f:
             json.dump({"date_utc": time.strftime("%Y-%m-%d", time.gmtime()),
                        "platform": platform, "cells": rows}, f, indent=1)
