@@ -124,32 +124,64 @@ def run_jax_star(B: int, n_followers: int, T: float, q: float,
     return events, secs, top1, posts
 
 
+# CPU cache-locality optimum for the scan engine's lane count (measured on
+# the headline shape via benchmarks/scaling.py: throughput peaks at
+# B~1000-2500 lanes and falls ~25% by B=10k as the working set outgrows
+# cache). The batch is therefore processed in slabs of ~this many lanes on
+# CPU — identical seeds, so the work is bit-the-same as one big batch. On
+# TPU the full batch runs as one dispatch (the chip wants the parallelism).
+CPU_SLAB = 2000
+
+
+def _slab_size(B: int, target: int) -> int:
+    """Largest divisor of B in (target/2, target]; B itself (unslabbed)
+    when no divisor lands in that window — equal slabs only, so the timed
+    loop never pays a ragged remainder-slab recompile."""
+    if target >= B:
+        return B
+    for s in range(target, target // 2, -1):
+        if B % s == 0:
+            return s
+    return B
+
+
 def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
                           q: float, wall_rate: float, capacity: int):
     """Shared harness for engines with the EventLog contract: build the
-    component batch, one warm-up run (compilation), one timed run, metrics.
+    component batch, one warm-up run (compilation), timed best-of-N over
+    the (possibly slabbed) batch, metrics.
     ``simulate_fn(cfg, params, adj, seeds)`` -> EventLog."""
     import jax
     from redqueen_tpu.config import stack_components
     from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
 
+    on_cpu = jax.devices()[0].platform == "cpu"
+    slab = _slab_size(B, CPU_SLAB) if on_cpu else B
     cfg, p0, a0, opt = build_component(n_followers, T, q, wall_rate, capacity)
-    params, adj = stack_components([p0] * B, [a0] * B)
-    adj_b = jax.numpy.broadcast_to(a0, (B,) + a0.shape)
+    params, adj = stack_components([p0] * slab, [a0] * slab)
+    adj_b = jax.numpy.broadcast_to(a0, (slab,) + a0.shape)
 
-    warm = simulate_fn(cfg, params, adj, np.arange(B))
+    warm = simulate_fn(cfg, params, adj, np.arange(slab))
     jax.block_until_ready(warm.times)
     secs = np.inf
     for _ in range(TIMED_REPS):  # best-of-N: see TIMED_REPS note
+        logs = []
         t0 = time.perf_counter()
-        logb = simulate_fn(cfg, params, adj, np.arange(B) + 10_000)
-        jax.block_until_ready(logb.times)
+        for s0 in range(0, B, slab):
+            # Seed layout matches the unslabbed batch exactly.
+            logb = simulate_fn(cfg, params, adj, np.arange(slab) + 10_000 + s0)
+            jax.block_until_ready(logb.times)
+            logs.append(logb)
         secs = min(secs, time.perf_counter() - t0)
 
-    events = int(np.asarray(logb.n_events).sum())
-    m = feed_metrics_batch(logb.times, logb.srcs, adj_b, opt, T)
-    top1 = float(np.asarray(m.mean_time_in_top_k()).mean())
-    posts = float(np.asarray(num_posts(logb.srcs, opt)).mean())
+    events = sum(int(np.asarray(lg.n_events).sum()) for lg in logs)
+    tops, posts_l = [], []
+    for lg in logs:
+        m = feed_metrics_batch(lg.times, lg.srcs, adj_b, opt, T)
+        tops.append(float(np.asarray(m.mean_time_in_top_k()).mean()))
+        posts_l.append(float(np.asarray(num_posts(lg.srcs, opt)).mean()))
+    top1 = float(np.mean(tops))  # equal-size slabs: plain mean is exact
+    posts = float(np.mean(posts_l))
     return events, secs, top1, posts
 
 
@@ -192,13 +224,16 @@ def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
     # Best-of-TIMED_REPS like the engines: vs_baseline must divide two
     # same-estimator quantities, or load noise in a single oracle draw
     # biases the headline speedup (each rep replays identical seeds, so
-    # events/tops are identical across reps). Long oracle passes (>60s —
-    # mid-size --followers, where per-event cost is O(sources)) stop after
-    # one rep: transient load noise is amortized over a long pass anyway,
-    # and repeating would blow the oracle child's subprocess deadline.
+    # events/tops are identical across reps). Reps stop once cumulative
+    # oracle wall exceeds 150s (mid-size --followers, where per-event cost
+    # is O(sources)): passes <= 150s still get at least min-of-2 so the
+    # estimator stays comparable to the engines', only very long passes —
+    # where transient load noise is amortized across the pass itself — drop
+    # to a single draw rather than blowing the oracle child's deadline.
     secs = np.inf
+    spent = 0.0
     for _ in range(TIMED_REPS):
-        if secs > 60.0 and np.isfinite(secs):
+        if spent > 150.0:
             break
         events = 0
         tops = []
@@ -218,7 +253,9 @@ def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
             tops.append(
                 mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=so.sink_ids)
             )
-        secs = min(secs, time.perf_counter() - t0)
+        took = time.perf_counter() - t0
+        spent += took
+        secs = min(secs, took)
     return events, secs, float(np.mean(tops))
 
 
