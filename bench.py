@@ -217,23 +217,22 @@ def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
 
 
 def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
-               wall_rate: float):
+               wall_rate: float, budget_s: float = 380.0):
     from redqueen_tpu.oracle.numpy_ref import SimOpts
     from redqueen_tpu.utils import metrics_pandas as mp
 
     # Best-of-TIMED_REPS like the engines: vs_baseline must divide two
     # same-estimator quantities, or load noise in a single oracle draw
     # biases the headline speedup (each rep replays identical seeds, so
-    # events/tops are identical across reps). Reps stop once cumulative
-    # oracle wall exceeds 150s (mid-size --followers, where per-event cost
-    # is O(sources)): passes <= 150s still get at least min-of-2 so the
-    # estimator stays comparable to the engines', only very long passes —
-    # where transient load noise is amortized across the pass itself — drop
-    # to a single draw rather than blowing the oracle child's deadline.
+    # events/tops are identical across reps). Reps stop when the NEXT pass
+    # would overrun ``budget_s`` — the caller passes its own subprocess
+    # deadline (scaled down) so the rep loop can never blow it: mid-size
+    # --followers (per-event cost is O(sources)) drop to fewer reps or one,
+    # where transient load noise is amortized across the long pass anyway.
     secs = np.inf
     spent = 0.0
     for _ in range(TIMED_REPS):
-        if spent > 150.0:
+        if np.isfinite(secs) and spent + 1.15 * secs > budget_s:
             break
         events = 0
         tops = []
@@ -319,8 +318,11 @@ def child_main(args) -> None:
 
     if args.as_engine == "oracle":
         # Pure NumPy/pandas — never touches a JAX backend, cannot hang.
+        # The parent forwards this child's subprocess timeout as --deadline;
+        # 0.85 leaves headroom for build + DataFrame overhead per pass.
         ev, secs, top1 = run_oracle(oracle_comps, args.followers, T, args.q,
-                                    args.wall_rate)
+                                    args.wall_rate,
+                                    budget_s=args.deadline * 0.85)
         print(json.dumps({"ok": True, "events": ev, "secs": secs,
                           "top1": top1, "comps": oracle_comps,
                           "platform": "cpu"}), flush=True)
@@ -372,7 +374,10 @@ def _run_child(args, engine: str, backend: str, timeout_s: float):
     cmd = [sys.executable, os.path.abspath(__file__),
            "--as-engine", engine, "--backend", backend,
            "--followers", str(args.followers),
-           "--q", str(args.q), "--wall-rate", str(args.wall_rate)]
+           "--q", str(args.q), "--wall-rate", str(args.wall_rate),
+           # The child's own subprocess timeout, so budget-aware loops
+           # (run_oracle's rep rule) can stop short of it.
+           "--deadline", str(timeout_s)]
     if args.quick:
         cmd.append("--quick")
     if args.broadcasters:
