@@ -113,7 +113,9 @@ def _kernel_body(cfg: SimConfig, opt_rows, rate_ref, q_ref, is_opt_ref,
         valid = (tmin <= end) & (s_star < S)                 # [T]
 
         # ---- fired source resamples (Poisson -> new Exp; Opt -> inf) ----
-        ffu = ff.astype(jnp.uint32)
+        # int32 detours: Mosaic lowers f32->i32, bool->i32 and i32->u32 but
+        # not f32->u32 / bool->u32 directly.
+        ffu = ff.astype(jnp.int32).astype(jnp.uint32)
         k0f = jnp.sum(k0 * ffu, axis=0)                      # [T] fired key
         k1f = jnp.sum(k1 * ffu, axis=0)
         ctrf = jnp.sum(ctr * ffu, axis=0)
@@ -126,7 +128,7 @@ def _kernel_body(cfg: SimConfig, opt_rows, rate_ref, q_ref, is_opt_ref,
         )
         sel = (ff > 0.5) & valid[None, :]
         t_next = jnp.where(sel, t_new[None, :], t_next)
-        ctr = ctr + (ffu * valid.astype(jnp.uint32))
+        ctr = ctr + (ffu * valid.astype(jnp.int32).astype(jnp.uint32))
 
         # ---- react: each Opt row spawns a superposition clock ----
         feeds_hit = jnp.sum(adj * ff[:, None, :], axis=0)    # [F, T]
@@ -141,7 +143,7 @@ def _kernel_body(cfg: SimConfig, opt_rows, rate_ref, q_ref, is_opt_ref,
             t_next = t_next.at[r].set(
                 jnp.where(react, jnp.minimum(t_next[r], cand), t_next[r])
             )
-            ctr = ctr.at[r].set(ctr[r] + react.astype(jnp.uint32))
+            ctr = ctr.at[r].set(ctr[r] + react.astype(jnp.int32).astype(jnp.uint32))
 
         # ---- emit event, advance clock (absorbing past horizon) ----
         times_ref[i, :] = jnp.where(valid, tmin, inf)

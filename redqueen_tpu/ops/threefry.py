@@ -55,8 +55,10 @@ def threefry2x32(k0, k1, x0, x1):
 def uniform_from_bits(bits):
     """uint32 bits -> float32 uniform in [0, 1): top 24 bits scaled by 2^-24.
     (Arithmetic rather than the bitcast mantissa trick so the same code
-    lowers in Pallas/Mosaic, interpret mode, and plain XLA.)"""
-    return (bits >> 8).astype(jnp.float32) * 2.0**-24
+    lowers in Pallas/Mosaic, interpret mode, and plain XLA. The int32 detour
+    is exact — the shifted value fits in 24 bits — and avoids the
+    uint32->float32 cast Mosaic does not lower.)"""
+    return (bits >> 8).astype(jnp.int32).astype(jnp.float32) * 2.0**-24
 
 
 def exponential_from_bits(bits):

@@ -280,6 +280,10 @@ def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset,
     Fl, E = feed_times.shape
     dtype = feed_times.dtype
     inf = jnp.asarray(jnp.inf, dtype)
+    # Compaction into [Fl, R] slots only pays when R < E; at small E the
+    # record buffer would be as large as the raw input and the cummin +
+    # min-scatter passes are pure overhead (results are exact either way).
+    compress = compress and E > _rec_cap(E)
 
     # One Exp clock per wall event — the reference's exact draw count, keyed
     # by GLOBAL feed index so mesh layout cannot change the streams.
@@ -632,25 +636,27 @@ def _check_wall_kinds(cfg: StarConfig, wall: WallParams):
 _COMPRESS_BLOCKLIST: set = set()
 
 
-def _regime_key(ctrl: CtrlParams):
+def _regime_key(ctrl: CtrlParams, wall: WallParams):
     """Coarse clock-regime signature for the compression blocklist: the
-    record-count regime is set by rate_f = sqrt(s/q), so a q-sweep reusing
-    one StarConfig must not let one short-clock q disable compression for
-    every other q (3-significant-figure bucket of the mean q)."""
+    record-count regime is set by rate_f = sqrt(s_sink/q), so a sweep
+    reusing one StarConfig must not let one short-clock (q, s_sink) point
+    disable compression for every other point (3-significant-figure bucket
+    of the mean clock rate — q alone misses the s_sink half of the rate)."""
     q = np.asarray(ctrl.q)
-    if q.size == 0:
+    s = np.asarray(wall.s_sink)
+    if q.size == 0 or s.size == 0:
         return None
-    m = float(q.mean())
+    m = float(np.sqrt(s.mean() / max(q.mean(), 1e-30)))
     return float(f"{m:.3g}") if np.isfinite(m) else None
 
 
 def _run_with_fallback(cfg: StarConfig, metric_K: int, ctrl: CtrlParams,
-                       run):
+                       wall: WallParams, run):
     """Run the star kernel compressed-first with the uncompressed fallback
     (shared by simulate_star and simulate_star_batch so the retry semantics
     cannot drift). ``run(compress) -> kernel out tuple``; overflow checks
     happen here, rec-first (see _check_overflow)."""
-    key = (cfg, metric_K, _regime_key(ctrl))
+    key = (cfg, metric_K, _regime_key(ctrl, wall))
     if key not in _COMPRESS_BLOCKLIST:
         try:
             out = run(True)
@@ -732,7 +738,7 @@ def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
                       comm.replicate(ctrl, mesh), comm.replicate(key, mesh))
 
     (own, n_posts, feed_times, wall_n, metrics, *_flags) = \
-        _run_with_fallback(cfg, metric_K, ctrl, run)
+        _run_with_fallback(cfg, metric_K, ctrl, wall, run)
     return StarResult(
         own_times=np.asarray(own), n_posts=int(n_posts),
         wall_times=np.asarray(feed_times), wall_n=np.asarray(wall_n),
@@ -896,7 +902,7 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
                       comm.shard_leading(keys, mesh, axis))
 
     (own, n_posts, _feed_times, wall_n, metrics, *_flags) = \
-        _run_with_fallback(cfg, metric_K, ctrl, run)
+        _run_with_fallback(cfg, metric_K, ctrl, wall, run)
     return StarBatchResult(
         own_times=np.asarray(own), n_posts=np.asarray(n_posts),
         wall_n=np.asarray(wall_n), metrics=metrics, cfg=cfg,

@@ -626,3 +626,98 @@ class TestStarBatch:
         mesh1 = comm.make_mesh({"f": 8})
         with pytest.raises(ValueError, match="must be named 'feed'"):
             simulate_star(cfg, wall, ctrl, seed=0, mesh=mesh1, axis="f")
+
+
+class TestSuffixRecordCompression:
+    """The compressed fire path (bigf._opt_fires suffix-record compaction)
+    must be EXACT vs the full-sort path, and the short-clock overflow must
+    fall back loudly-then-successfully (round-3 review findings)."""
+
+    def _fires_inputs(self, F=6, E=128, rate=2.0, T=40.0, seed=3):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(seed)
+        times = np.sort(rng.exponential(1.0 / rate, (F, E)).cumsum(axis=1),
+                        axis=1)
+        times[times > T] = np.inf
+        return jnp_arr(times), jr.PRNGKey(seed + 1)
+
+    def test_compressed_equals_uncompressed(self):
+        """Long-clock regime, E=128 > _rec_cap: identical posting times,
+        flags clear, on both paths."""
+        from redqueen_tpu.parallel.bigf import StarConfig, _opt_fires, _rec_cap
+
+        F, E = 6, 128
+        assert E > _rec_cap(E), "shape must actually engage compression"
+        feed_times, key = self._fires_inputs(F=F, E=E)
+        cfg = StarConfig(n_feeds=F, walls_per_feed=1, end_time=40.0,
+                         wall_cap=E, post_cap=256)
+        rate_f = jnp_arr(np.full(F, 0.5))  # long clocks: few records
+        off = np.zeros((), np.int32)
+        own_c, tr_c, rec_c = _opt_fires(cfg, feed_times, rate_f, key, off,
+                                        compress=True)
+        own_u, tr_u, rec_u = _opt_fires(cfg, feed_times, rate_f, key, off,
+                                        compress=False)
+        assert not bool(rec_c) and not bool(rec_u)
+        assert bool(tr_c) == bool(tr_u)
+        np.testing.assert_array_equal(np.asarray(own_c), np.asarray(own_u))
+        assert np.isfinite(np.asarray(own_c)).sum() > 3
+
+    def test_small_E_skips_compression_exactly(self):
+        """E <= _rec_cap: the guard makes compress a no-op flag; results
+        must still be identical (trivially, same code path)."""
+        from redqueen_tpu.parallel.bigf import StarConfig, _opt_fires, _rec_cap
+
+        F, E = 4, 32
+        assert E <= _rec_cap(E)
+        feed_times, key = self._fires_inputs(F=F, E=E, rate=0.5)
+        cfg = StarConfig(n_feeds=F, walls_per_feed=1, end_time=40.0,
+                         wall_cap=E, post_cap=128)
+        rate_f = jnp_arr(np.full(F, 0.5))
+        off = np.zeros((), np.int32)
+        own_c, _, rec_c = _opt_fires(cfg, feed_times, rate_f, key, off,
+                                     compress=True)
+        own_u, _, _ = _opt_fires(cfg, feed_times, rate_f, key, off,
+                                 compress=False)
+        assert not bool(rec_c)
+        np.testing.assert_array_equal(np.asarray(own_c), np.asarray(own_u))
+
+    def test_short_clock_fallback_end_to_end(self):
+        """Short clocks (huge s_sink) overflow the record budget; the
+        caller must retry uncompressed, produce a valid trajectory, and
+        blocklist ONLY this clock regime — a later long-clock run with the
+        same cfg/q must keep its compressed path (the old q-only key
+        cross-contaminated across s_sink)."""
+        from redqueen_tpu.parallel import bigf
+
+        F, T, rate = 4, 25.0, 5.0  # ~125 wall events/feed > _rec_cap(256)=64
+        sb = StarBuilder(n_feeds=F, end_time=T, s_sink=[1e6] * F)
+        for f in range(F):
+            sb.wall_poisson(f, rate)
+        sb.ctrl_opt(q=1.0)
+        cfg, wall, ctrl = sb.build(wall_cap=256, post_cap=2048)
+
+        bigf._COMPRESS_BLOCKLIST.clear()
+        res = simulate_star(cfg, wall, ctrl, seed=11)
+        own = res.own_times[np.isfinite(res.own_times)]
+        assert len(own) > 50 and mp.is_sorted(own)
+        key_short = (cfg, 1, bigf._regime_key(ctrl, wall))
+        assert key_short in bigf._COMPRESS_BLOCKLIST, (
+            "short-clock run must have tripped the record budget and "
+            "blocklisted its regime"
+        )
+
+        sb2 = StarBuilder(n_feeds=F, end_time=T, s_sink=[1.0] * F)
+        for f in range(F):
+            sb2.wall_poisson(f, rate)
+        sb2.ctrl_opt(q=1.0)
+        cfg2, wall2, ctrl2 = sb2.build(wall_cap=256, post_cap=2048)
+        key_long = (cfg2, 1, bigf._regime_key(ctrl2, wall2))
+        assert key_long != key_short, (
+            "regime key must separate s_sink regimes at equal q"
+        )
+        res2 = simulate_star(cfg2, wall2, ctrl2, seed=11)
+        assert key_long not in bigf._COMPRESS_BLOCKLIST, (
+            "long-clock run must NOT be blocklisted (compressed path holds)"
+        )
+        assert res2.n_posts > 0

@@ -101,6 +101,19 @@ def main() -> int:
     for attempt in range(1, args.max_probes + 1):
         alive, n, plat = probe_default_backend(args.probe_deadline)
         if alive and plat == "tpu":
+            if os.path.exists(QUICK_JSON):
+                # Quick evidence already captured earlier in the round: the
+                # valuable thing now is the ALIVE signal itself — exit
+                # immediately so the driving session can launch the full
+                # capture (tools/tpu_evidence.py --stage 2..4) while the
+                # window holds (observed windows are minutes long; a quick
+                # bench here would spend the window re-proving a known fact).
+                append_log(f"| {utcnow()} | ALIVE — {n} x {plat} "
+                           f"(probe {attempt}); quick evidence already on "
+                           f"disk, exiting to trigger full capture |")
+                print(f"TPU ALIVE at probe {attempt}; quick evidence exists "
+                      f"— launch full capture now")
+                return 0
             append_log(f"| {utcnow()} | ALIVE — {n} x {plat} "
                        f"(probe {attempt}); capturing quick bench |")
             if capture_quick_bench():
