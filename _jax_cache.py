@@ -1,0 +1,31 @@
+"""One shared persistent XLA compilation cache for every entry point.
+
+A short TPU-tunnel-alive window should pay each kernel's ~20-40s compile at
+most once per round: bench children, the driver's compile checks
+(__graft_entry__.py), and the preset harness (benchmarks/run.py) all point
+JAX_COMPILATION_CACHE_DIR at the same repo-local ``.jax_cache/``, so
+whichever process compiles first leaves the executable on disk for the
+rest. Harmless on CPU — cache keys include the platform.
+
+Repo-root module, stdlib-only, on purpose: it must run BEFORE the first
+``import jax`` (jax reads the env var at config creation), and importing
+anything under ``redqueen_tpu`` triggers the package __init__, which
+imports jax — so the helper cannot live inside the package.
+"""
+
+from __future__ import annotations
+
+import os
+
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+
+__all__ = ["enable_persistent_cache", "CACHE_DIR"]
+
+
+def enable_persistent_cache() -> str:
+    """Point JAX at the shared on-disk compilation cache (setdefault, so an
+    operator's explicit override always wins). Returns the directory used.
+    Child processes inherit the setting through os.environ."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]

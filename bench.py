@@ -38,7 +38,13 @@ import time
 
 import numpy as np
 
+import _jax_cache
+
 _START = time.monotonic()
+
+# Engine children inherit this through os.environ (the parent itself never
+# imports jax); see _jax_cache.py for the one definition of the policy.
+_jax_cache.enable_persistent_cache()
 
 
 def log(*a):
@@ -195,6 +201,15 @@ def _max_chunks(n_followers: int, T: float, wall_rate: float,
     return max(64, int(4 * mean_ev / capacity) + 1)
 
 
+def _sync_every() -> int:
+    """Superchunk width (chunks per device->host sync). Each sync over the
+    axon tunnel is a network round-trip that dwarfs a chunk's compute, so
+    TPU runs sync rarely; CPU keeps the measured 8-chunk optimum."""
+    import jax
+
+    return 8 if jax.devices()[0].platform == "cpu" else 32
+
+
 def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
                    wall_rate: float, capacity: int):
     """Headline graph on the Pallas event-scan engine: the whole chunk is one
@@ -203,7 +218,9 @@ def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
     from redqueen_tpu.ops.pallas_chunk import simulate_pallas
 
     mc = _max_chunks(n_followers, T, wall_rate, capacity)
-    fn = lambda cfg, p, a, s: simulate_pallas(cfg, p, a, s, max_chunks=mc)
+    sync = _sync_every()
+    fn = lambda cfg, p, a, s: simulate_pallas(cfg, p, a, s, max_chunks=mc,
+                                              sync_every=sync)
     return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate, capacity)
 
 
@@ -212,7 +229,9 @@ def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
     from redqueen_tpu.sim import simulate_batch
 
     mc = _max_chunks(n_followers, T, wall_rate, capacity)
-    fn = lambda cfg, p, a, s: simulate_batch(cfg, p, a, s, max_chunks=mc)
+    sync = _sync_every()
+    fn = lambda cfg, p, a, s: simulate_batch(cfg, p, a, s, max_chunks=mc,
+                                             sync_every=sync)
     return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate, capacity)
 
 
@@ -420,6 +439,17 @@ def parent_main(args) -> None:
         backend = "cpu"
     elif _default_backend_alive(log):
         backend = "default"
+    elif args.tpu:
+        # An explicit --tpu run is a TPU-EVIDENCE capture (see the
+        # evidence_run note below): its consumers reject CPU lines, so a
+        # CPU sweep here would spend the capture window producing output
+        # the caller throws away. Fail fast; the watcher keeps probing.
+        raise RuntimeError(
+            "--tpu evidence run, but the default backend did not "
+            "initialize within the probe deadlines (tunnel down/wedged) — "
+            "refusing to substitute CPU results; retry on the next "
+            "tunnel-alive window"
+        )
     else:
         # TPU tunnel down. Two observed failure modes: axon init raises
         # UNAVAILABLE, or it hangs for minutes — so the probe runs in a

@@ -170,6 +170,13 @@ def main():
                          "(64 for configs 1/5, else 4)")
     args = ap.parse_args()
 
+    # Shared persistent compilation cache (one policy: _jax_cache.py at the
+    # repo root, which the path insert above makes importable); must precede
+    # the first jax import.
+    import _jax_cache
+
+    _jax_cache.enable_persistent_cache()
+
     import jax
 
     if args.cpu or args.quick:

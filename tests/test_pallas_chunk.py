@@ -207,3 +207,24 @@ class TestVmemGuard:
             gb.add_poisson(rate=1.0, sinks=[i])
         cfg, *_ = gb.build(capacity=2048)
         assert vmem_bytes(cfg, 11, 10) < _VMEM_BUDGET
+
+
+class TestSyncEvery:
+    def test_sync_cadence_preserves_events(self):
+        """sync_every only changes WHEN the liveness round-trip happens;
+        the valid event stream and counts must be identical (extra
+        absorbed chunks append +inf/-1 padding only)."""
+        cfg, p0, a0, _ = _component(F=4, T=30.0, capacity=64)
+        B = 3
+        params, adj = stack_components([p0] * B, [a0] * B)
+        a = simulate_pallas(cfg, params, adj, np.arange(B), sync_every=1)
+        b = simulate_pallas(cfg, params, adj, np.arange(B), sync_every=4)
+        np.testing.assert_array_equal(
+            np.asarray(a.n_events), np.asarray(b.n_events)
+        )
+        ta, tb = np.asarray(a.times), np.asarray(b.times)
+        sa, sb = np.asarray(a.srcs), np.asarray(b.srcs)
+        for lane in range(B):
+            va, vb = sa[lane] >= 0, sb[lane] >= 0
+            np.testing.assert_array_equal(ta[lane][va], tb[lane][vb])
+            np.testing.assert_array_equal(sa[lane][va], sb[lane][vb])
