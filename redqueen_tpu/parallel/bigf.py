@@ -259,12 +259,24 @@ def _rec_cap(E: int) -> int:
 
 
 def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset,
-               compress: bool = True):
+               compress: bool = True, fire_mode: str = "auto"):
     """RedQueen posting times via the sorted suffix-min formulation.
 
     ``feed_times`` [F_local, E] ascending wall events per feed; ``rate_f``
     [F_local] = sqrt(s_f / q). Returns (own_times [post_cap], truncated,
     rec_trunc).
+
+    ``fire_mode`` selects how the posting trajectory is extracted from the
+    sorted (wall time, candidate) arrays: ``"loop"`` is the adaptive
+    ``while_loop`` (one searchsorted + suffix lookup per post; under feed
+    sharding also one ``pmin`` per post); ``"doubling"`` is the pointer-
+    doubling formulation (see ``_fires_by_doubling``) — the SAME fires,
+    bit for bit, in O(log post_cap) parallel gather passes with no
+    sequential dependence on the number of posts. ``"auto"`` picks
+    doubling on non-CPU backends when the feed axis is unsharded (the
+    TPU's latency-bound regime) and the loop otherwise (CPU: the loop does
+    ~10x less total work; sharded: the loop's pmin keeps records
+    device-local).
 
     Suffix-record compression (``compress``): the fire loop only ever
     queries min{cand_e : w_e > t}. Within a feed, an event e1 with a later
@@ -329,6 +341,26 @@ def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset,
     suffix = jnp.flip(lax.cummin(jnp.flip(c_sorted)))
     suffix = jnp.concatenate([suffix, jnp.full((1,), jnp.inf, dtype)])
 
+    sharded = comm.axis_present("feed")
+    if fire_mode == "auto":
+        use_doubling = (not sharded) and jax.default_backend() != "cpu"
+    elif fire_mode == "doubling":
+        if sharded:
+            raise ValueError(
+                "fire_mode='doubling' needs the full sorted record arrays "
+                "on every device; it does not support a sharded feed axis "
+                "(use 'loop'/'auto')"
+            )
+        use_doubling = True
+    elif fire_mode == "loop":
+        use_doubling = False
+    else:
+        raise ValueError(f"unknown fire_mode {fire_mode!r}")
+
+    if use_doubling:
+        own, truncated = _fires_by_doubling(cfg, t_sorted, suffix)
+        return own, truncated, rec_trunc
+
     # Adaptive fire loop: post_cap bounds the buffer, but the while_loop
     # exits as soon as the trajectory absorbs (a vmapped while runs until
     # every lane is done — with 4x-headroom caps that is typically a ~4x
@@ -359,6 +391,53 @@ def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset,
     more = comm.pmin(suffix[idx], "feed") <= cfg.end_time
     truncated = jnp.isfinite(t_last) & more
     return own, truncated, rec_trunc
+
+
+def _fires_by_doubling(cfg: StarConfig, t_sorted, suffix):
+    """The posting trajectory as pointer doubling — the while_loop's fires,
+    bit for bit, with no sequential dependence on the post count.
+
+    The fire map is f(t) = suffix[sp(t)] with sp(t) = searchsorted(t_sorted,
+    t, 'right') (the strict ``w > t`` query); every reachable fire value is
+    a ``suffix`` element, so the orbit lives on POSITIONS: p_1 = sp(start),
+    p_{k+1} = nxt[p_k] with nxt[i] = sp(suffix[i]), and own_k =
+    suffix[p_k]. ``nxt`` is strictly forward (every candidate satisfies
+    c >= its own wall time, and 'right' skips equals), so position N — the
+    appended +inf suffix slot, a fixed point of nxt — absorbs every
+    trajectory. Jump tables J_p = nxt^(2^p) then materialize all post_cap
+    positions in ceil(log2(post_cap)) gather passes: the second half of the
+    filled prefix is J_p applied to the first half. Work is
+    O((N + post_cap) log post_cap) fully parallel gathers — vs the loop's
+    O(posts) sequential searchsorted steps, which on a latency-bound
+    backend (the TPU, especially through the tunnel) dominate the star
+    engine's critical path.
+
+    Horizon clipping happens AFTER the orbit: fires increase strictly, so
+    where(raw <= end, raw, inf) is densely packed exactly like the loop's
+    incremental buffer. The truncation flag mirrors the loop's: post_cap
+    in-horizon fires AND one more would still fit."""
+    Kp = cfg.post_cap
+    end = cfg.end_time
+    N = t_sorted.shape[0]
+
+    nxt = jnp.searchsorted(t_sorted, suffix, side="right").astype(jnp.int32)
+    p1 = jnp.searchsorted(
+        t_sorted, jnp.asarray(cfg.start_time, t_sorted.dtype), side="right"
+    ).astype(jnp.int32)
+    pos = jnp.full((Kp,), N, jnp.int32).at[0].set(p1)
+    jump = nxt
+    filled = 1
+    while filled < Kp:  # static unroll: ceil(log2(Kp)) levels
+        take = min(filled, Kp - filled)
+        pos = pos.at[filled:filled + take].set(jump[pos[:take]])
+        filled += take
+        if filled < Kp:
+            jump = jump[jump]
+    raw = suffix[pos]
+    own = jnp.where(raw <= end, raw, jnp.inf)
+    f_next = suffix[nxt[pos[Kp - 1]]]
+    truncated = jnp.isfinite(own[Kp - 1]) & (f_next <= end)
+    return own, truncated
 
 
 def _feed_metrics_star(cfg: StarConfig, feed_times, own_times, K: int):
@@ -511,7 +590,7 @@ def _feed_metrics_star_scan(cfg: StarConfig, feed_times, own_times, K: int):
 
 
 def _make_kernel(cfg: StarConfig, metric_K: int,
-                 compress: bool = True):
+                 compress: bool = True, fire_mode: str = "auto"):
     codes, branches = _wall_branches(cfg)
     lookup = np.full(max(codes) + 2, 0, np.int32)  # +1 shift for _EMPTY
     for i, c in enumerate(codes):
@@ -560,6 +639,7 @@ def _make_kernel(cfg: StarConfig, metric_K: int,
             own, post_trunc, rec_trunc = _opt_fires(
                 cfg, feed_times, rate_f.astype(feed_times.dtype),
                 key_tau, feed_offset, compress=compress,
+                fire_mode=fire_mode,
             )
         else:
             s = _ctrl_stream(cfg, ctrl, key_own)
@@ -584,15 +664,16 @@ _FN_CACHE: dict = {}
 
 
 def _get_fn(cfg: StarConfig, metric_K: int, mesh: Optional[Mesh], axis: str,
-            wall: WallParams, ctrl: CtrlParams, compress: bool = True):
+            wall: WallParams, ctrl: CtrlParams, compress: bool = True,
+            fire_mode: str = "auto"):
     """Jitted-kernel cache keyed on everything that forces a retrace
     (StarConfig is hashable for exactly this — the sim.py convention)."""
-    cache_key = (cfg, metric_K, mesh, axis, compress,
+    cache_key = (cfg, metric_K, mesh, axis, compress, fire_mode,
                  jax.tree.structure((wall, ctrl)))
     fn = _FN_CACHE.get(cache_key)
     if fn is not None:
         return fn
-    kernel = _make_kernel(cfg, metric_K, compress)
+    kernel = _make_kernel(cfg, metric_K, compress, fire_mode)
     if mesh is None:
         fn = jax.jit(kernel)
     else:
@@ -706,13 +787,18 @@ def _check_overflow(cfg: StarConfig, wall_trunc, post_trunc, rec_trunc=None):
 
 def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
                   seed, mesh: Optional[Mesh] = None, axis: str = "feed",
-                  metric_K: int = 1) -> StarResult:
+                  metric_K: int = 1, fire_mode: str = "auto") -> StarResult:
     """Simulate one star component to its horizon.
 
     With ``mesh``, the feed axis shards over ``mesh.shape[axis]`` devices
     (F must divide evenly); results are bit-identical to the unsharded run
     at matched seeds (PRNG streams key off GLOBAL feed indices). Raises on
-    wall-buffer or post-buffer overflow instead of truncating."""
+    wall-buffer or post-buffer overflow instead of truncating.
+
+    ``fire_mode``: how the Opt posting trajectory is extracted —
+    ``"loop"`` (sequential while_loop), ``"doubling"`` (parallel pointer
+    doubling; unsharded only), or ``"auto"`` (doubling on accelerators,
+    loop on CPU/sharded — see _opt_fires for the measured tradeoff)."""
     key = jr.PRNGKey(seed) if isinstance(seed, (int, np.integer)) else seed
     _check_wall_kinds(cfg, wall)
     if mesh is not None and axis != "feed":
@@ -725,14 +811,15 @@ def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
     def run(compress):
         if mesh is None:
             return _get_fn(cfg, metric_K, None, axis, wall, ctrl,
-                           compress)(wall, ctrl, key)
+                           compress, fire_mode)(wall, ctrl, key)
         n_dev = mesh.shape[axis]
         if cfg.n_feeds % n_dev != 0:
             raise ValueError(
                 f"n_feeds={cfg.n_feeds} not divisible by mesh axis "
                 f"{axis}={n_dev}"
             )
-        fn = _get_fn(cfg, metric_K, mesh, axis, wall, ctrl, compress)
+        fn = _get_fn(cfg, metric_K, mesh, axis, wall, ctrl, compress,
+                     fire_mode)
         with mesh:
             return fn(comm.shard_leading(wall, mesh, axis),
                       comm.replicate(ctrl, mesh), comm.replicate(key, mesh))
@@ -822,7 +909,8 @@ def _batch_specs(wall: WallParams, ctrl: CtrlParams, dp: str, fp):
 def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
                         seeds, mesh: Optional[Mesh] = None,
                         axis: str = "data", feed_axis: Optional[str] = None,
-                        metric_K: int = 1) -> StarBatchResult:
+                        metric_K: int = 1,
+                        fire_mode: str = "auto") -> StarBatchResult:
     """Run B star components in lockstep — the loop-free engine for the
     bipartite sweep (BASELINE configs 1/3 and the headline 10k x 100k
     graph): every lane is one broadcaster vs its follower feeds, the whole
@@ -866,10 +954,10 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
 
     def get_fn(compress):
         cache_key = (cfg, metric_K, mesh, axis, feed_axis, compress,
-                     jax.tree.structure((wall, ctrl)))
+                     fire_mode, jax.tree.structure((wall, ctrl)))
         fn = _BATCH_FN_CACHE.get(cache_key)
         if fn is None:
-            vk = jax.vmap(_make_kernel(cfg, metric_K, compress))
+            vk = jax.vmap(_make_kernel(cfg, metric_K, compress, fire_mode))
             if mesh is not None and feed_axis is not None:
                 in_specs, out_specs = _batch_specs(wall, ctrl, axis, feed_axis)
                 vk = jax.shard_map(vk, mesh=mesh, in_specs=in_specs,

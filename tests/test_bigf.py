@@ -721,3 +721,93 @@ class TestSuffixRecordCompression:
             "long-clock run must NOT be blocklisted (compressed path holds)"
         )
         assert res2.n_posts > 0
+
+
+class TestFireDoubling:
+    """Pointer-doubling fire extraction (bigf._fires_by_doubling) must
+    reproduce the while_loop's trajectory bit for bit — fires, horizon
+    clipping, and the truncation flag — in every regime."""
+
+    def _run(self, F=6, E=128, T=40.0, post_cap=256, rate=2.0, rate_f=0.5,
+             seed=3, compress=True):
+        from redqueen_tpu.parallel.bigf import StarConfig, _opt_fires
+
+        rng = np.random.default_rng(seed)
+        times = np.sort(rng.exponential(1.0 / rate, (F, E)).cumsum(axis=1),
+                        axis=1)
+        times[times > T] = np.inf
+        cfg = StarConfig(n_feeds=F, walls_per_feed=1, end_time=T,
+                         wall_cap=E, post_cap=post_cap)
+        args = (cfg, jnp_arr(times), jnp_arr(np.full(F, rate_f)),
+                jr.PRNGKey(seed + 1), np.zeros((), np.int32))
+        out = {}
+        for mode in ("loop", "doubling"):
+            out[mode] = _opt_fires(*args, compress=compress, fire_mode=mode)
+        return out
+
+    @pytest.mark.parametrize("compress", [True, False])
+    def test_bit_equal_normal_regime(self, compress):
+        out = self._run(compress=compress)
+        np.testing.assert_array_equal(
+            np.asarray(out["loop"][0]), np.asarray(out["doubling"][0])
+        )
+        assert bool(out["loop"][1]) == bool(out["doubling"][1])
+        n = np.isfinite(np.asarray(out["loop"][0])).sum()
+        assert 3 < n < 256, "regime sanity: some fires, no buffer fill"
+
+    def test_bit_equal_truncated(self):
+        """post_cap smaller than the trajectory: both modes must fill the
+        buffer identically and raise the truncation flag."""
+        out = self._run(post_cap=8, rate_f=50.0)
+        np.testing.assert_array_equal(
+            np.asarray(out["loop"][0]), np.asarray(out["doubling"][0])
+        )
+        assert bool(out["loop"][1]) and bool(out["doubling"][1])
+        assert np.isfinite(np.asarray(out["doubling"][0])).all()
+
+    def test_bit_equal_absorbing(self):
+        """Tiny horizon: trajectory absorbs immediately on both paths."""
+        out = self._run(T=0.5, rate_f=0.01)
+        np.testing.assert_array_equal(
+            np.asarray(out["loop"][0]), np.asarray(out["doubling"][0])
+        )
+        assert not bool(out["doubling"][1])
+
+    def test_sharded_doubling_rejected(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from redqueen_tpu.parallel import bigf
+
+        F = 8
+        mesh = comm.make_mesh({"feed": 8})
+        cfg = bigf.StarConfig(n_feeds=F, walls_per_feed=1, end_time=20.0,
+                              wall_cap=64, post_cap=256)
+        rate_f = jnp_arr(np.ones(1))
+
+        def shard_fires(ft):
+            return bigf._opt_fires(cfg, ft, rate_f, jr.PRNGKey(0),
+                                   0, fire_mode="doubling")
+
+        with pytest.raises(ValueError, match="sharded feed axis"):
+            ft = jnp_arr(np.sort(np.random.default_rng(0)
+                                 .exponential(1.0, (F, 64)), axis=1))
+            jax.shard_map(shard_fires, mesh=mesh, in_specs=P("feed"),
+                          out_specs=P(), check_vma=False)(ft)
+
+    def test_fire_mode_plumbed_to_batch_api(self):
+        """simulate_star_batch(fire_mode=...) must reach the kernel: both
+        explicit modes produce identical results (and differ from nothing —
+        the override is user-facing per the round-3 review)."""
+        from redqueen_tpu.parallel.bigf import broadcast_star, simulate_star_batch
+
+        cfg, wall, ctrl = star_poisson(n_feeds=6)
+        wb, cb = broadcast_star(wall, ctrl, 4)
+        a = simulate_star_batch(cfg, wb, cb, np.arange(4), fire_mode="loop")
+        b = simulate_star_batch(cfg, wb, cb, np.arange(4),
+                                fire_mode="doubling")
+        np.testing.assert_array_equal(a.own_times, b.own_times)
+        np.testing.assert_array_equal(a.n_posts, b.n_posts)
+        np.testing.assert_array_equal(
+            np.asarray(a.metrics.time_in_top_k),
+            np.asarray(b.metrics.time_in_top_k),
+        )
