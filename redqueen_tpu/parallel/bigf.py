@@ -342,20 +342,11 @@ def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset,
     suffix = jnp.concatenate([suffix, jnp.full((1,), jnp.inf, dtype)])
 
     sharded = comm.axis_present("feed")
+    _check_fire_mode(fire_mode, feed_sharded=sharded)
     if fire_mode == "auto":
         use_doubling = (not sharded) and jax.default_backend() != "cpu"
-    elif fire_mode == "doubling":
-        if sharded:
-            raise ValueError(
-                "fire_mode='doubling' needs the full sorted record arrays "
-                "on every device; it does not support a sharded feed axis "
-                "(use 'loop'/'auto')"
-            )
-        use_doubling = True
-    elif fire_mode == "loop":
-        use_doubling = False
     else:
-        raise ValueError(f"unknown fire_mode {fire_mode!r}")
+        use_doubling = fire_mode == "doubling"
 
     if use_doubling:
         own, truncated = _fires_by_doubling(cfg, t_sorted, suffix)
@@ -785,6 +776,25 @@ def _check_overflow(cfg: StarConfig, wall_trunc, post_trunc, rec_trunc=None):
         )
 
 
+_FIRE_MODES = ("auto", "loop", "doubling")
+
+
+def _check_fire_mode(fire_mode: str, feed_sharded: bool):
+    """Early public-API validation: non-Opt control policies never reach
+    _opt_fires, so without this a typo'd mode (or doubling on a sharded
+    feed axis) would be silently ignored on those configs."""
+    if fire_mode not in _FIRE_MODES:
+        raise ValueError(
+            f"unknown fire_mode {fire_mode!r} (choose from {_FIRE_MODES})"
+        )
+    if fire_mode == "doubling" and feed_sharded:
+        raise ValueError(
+            "fire_mode='doubling' needs the full sorted record arrays on "
+            "every device; it does not support a sharded feed axis "
+            "(use 'loop'/'auto')"
+        )
+
+
 def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
                   seed, mesh: Optional[Mesh] = None, axis: str = "feed",
                   metric_K: int = 1, fire_mode: str = "auto") -> StarResult:
@@ -800,6 +810,7 @@ def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
     doubling; unsharded only), or ``"auto"`` (doubling on accelerators,
     loop on CPU/sharded — see _opt_fires for the measured tradeoff)."""
     key = jr.PRNGKey(seed) if isinstance(seed, (int, np.integer)) else seed
+    _check_fire_mode(fire_mode, feed_sharded=mesh is not None)
     _check_wall_kinds(cfg, wall)
     if mesh is not None and axis != "feed":
         # The kernel's collectives (pmin/pany and the global-feed-index PRNG
@@ -946,6 +957,8 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
             f"{ctrl_q.shape[0] if ctrl_q.ndim else 'unbatched'} — build the "
             f"batch with stack_star/broadcast_star"
         )
+    _check_fire_mode(fire_mode,
+                     feed_sharded=mesh is not None and feed_axis is not None)
     _check_wall_kinds(cfg, wall)
     if feed_axis is not None and feed_axis != "feed":
         raise ValueError(f"the follower mesh axis must be named 'feed', got "

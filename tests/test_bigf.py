@@ -811,3 +811,21 @@ class TestFireDoubling:
             np.asarray(a.metrics.time_in_top_k),
             np.asarray(b.metrics.time_in_top_k),
         )
+
+    def test_fire_mode_validated_on_non_opt_configs(self):
+        """The early public-API check must reject bad fire_mode values even
+        when the control policy never reaches _opt_fires."""
+        from redqueen_tpu.parallel.bigf import simulate_star
+
+        F = 8
+        sb = StarBuilder(n_feeds=F, end_time=20.0)
+        for f in range(F):
+            sb.wall_poisson(f, 1.0)
+        sb.ctrl_poisson(rate=0.5)
+        cfg, wall, ctrl = sb.build(wall_cap=64, post_cap=128)
+        with pytest.raises(ValueError, match="unknown fire_mode"):
+            simulate_star(cfg, wall, ctrl, seed=0, fire_mode="dobling")
+        mesh = comm.make_mesh({"feed": 8})
+        with pytest.raises(ValueError, match="sharded feed axis"):
+            simulate_star(cfg, wall, ctrl, seed=0, mesh=mesh,
+                          fire_mode="doubling")

@@ -1,0 +1,110 @@
+"""Opt single-follower stationary closed form (SURVEY.md section 4.2).
+
+With ONE follower whose feed receives wall posts at Poisson rate lam, the
+RedQueen policy posts at intensity u(t) = a * r(t), a = sqrt(s/q). The rank
+r(t) is then a Markov chain — up at rate lam from any state, reset to 0 at
+rate a*k from state k — whose stationary law follows from flow balance:
+
+    pi_k = pi_{k-1} * lam / (lam + a k)   (normalized)
+
+giving closed forms for BOTH headline metrics on one feed:
+
+    time_in_top_1 / T  ->  pi_0            (fraction of time at rank 0)
+    average rank       ->  E[r] = sum k pi_k
+
+Every engine (NumPy oracle, scan, star) is pinned against the same numbers
+— an analytical anchor none of the cross-engine parity tests provide (they
+could all share one bias; this test cannot)."""
+
+import numpy as np
+import pytest
+from jax import random as jr
+
+from redqueen_tpu.config import GraphBuilder, stack_components
+from redqueen_tpu.oracle.numpy_ref import SimOpts
+from redqueen_tpu.parallel.bigf import (
+    StarBuilder,
+    broadcast_star,
+    simulate_star_batch,
+)
+from redqueen_tpu.sim import simulate_batch
+from redqueen_tpu.utils import metrics_pandas as mp
+from redqueen_tpu.utils.metrics import feed_metrics_batch
+
+T = 600.0
+SEEDS = 6
+CASES = [(1.0, 1.0), (1.0, 0.25)]  # (wall rate lam, q); s = 1 -> a = q**-0.5
+
+
+def stationary(lam, a, kmax=400):
+    w = np.ones(kmax)
+    for k in range(1, kmax):
+        w[k] = w[k - 1] * lam / (lam + a * k)
+    w /= w.sum()
+    return w[0], float(np.dot(np.arange(kmax), w))
+
+
+def check(tops, ranks, lam, a):
+    """tops/ranks: per-seed time-averages; compare to pi_0 / E[r] within
+    4 standard errors of the seed spread (+ a small absolute floor for the
+    finite-horizon transient)."""
+    pi0, er = stationary(lam, a)
+    for got, want, name in ((np.asarray(tops), pi0, "top1 fraction"),
+                            (np.asarray(ranks), er, "mean rank")):
+        se = got.std(ddof=1) / np.sqrt(len(got))
+        assert abs(got.mean() - want) < 4 * se + 0.02, (
+            f"{name}: got {got.mean():.4f} (se {se:.4f}), closed form "
+            f"{want:.4f} at lam={lam}, a={a}"
+        )
+
+
+@pytest.mark.parametrize("lam,q", CASES)
+def test_oracle_matches_stationary(lam, q):
+    a = q ** -0.5
+    tops, ranks = [], []
+    for seed in range(SEEDS):
+        so = SimOpts(
+            src_id=0, sink_ids=[0],
+            other_sources=[("poisson", dict(src_id=100, seed=7000 + seed,
+                                            rate=lam, sink_ids=[0]))],
+            end_time=T, q=q,
+        )
+        mgr = so.create_manager_with_opt(seed=seed)
+        mgr.run_till()
+        df = mgr.state.get_dataframe()
+        tops.append(mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=[0]) / T)
+        ranks.append(mp.average_rank(df, T, src_id=0, sink_ids=[0]))
+    check(tops, ranks, lam, a)
+
+
+@pytest.mark.parametrize("lam,q", CASES)
+def test_scan_engine_matches_stationary(lam, q):
+    a = q ** -0.5
+    gb = GraphBuilder(n_sinks=1, end_time=T)
+    me = gb.add_opt(q=q)
+    gb.add_poisson(rate=lam, sinks=[0])
+    cfg, p0, a0 = gb.build(capacity=2048)
+    params, adj = stack_components([p0] * SEEDS, [a0] * SEEDS)
+    log = simulate_batch(cfg, params, adj, np.arange(SEEDS) + 40,
+                         max_chunks=64)
+    import jax.numpy as jnp
+
+    adj_b = jnp.broadcast_to(a0, (SEEDS,) + a0.shape)
+    m = feed_metrics_batch(log.times, log.srcs, adj_b, me, T)
+    tops = np.asarray(m.time_in_top_k).reshape(SEEDS) / T
+    ranks = np.asarray(m.int_rank).reshape(SEEDS) / T
+    check(tops, ranks, lam, a)
+
+
+@pytest.mark.parametrize("lam,q", CASES)
+def test_star_engine_matches_stationary(lam, q):
+    a = q ** -0.5
+    sb = StarBuilder(n_feeds=1, end_time=T)
+    sb.wall_poisson(0, lam)
+    sb.ctrl_opt(q=q)
+    cfg, wall, ctrl = sb.build(wall_cap=1024, post_cap=2048)
+    wb, cb = broadcast_star(wall, ctrl, SEEDS)
+    res = simulate_star_batch(cfg, wb, cb, np.arange(SEEDS) + 90)
+    tops = np.asarray(res.metrics.time_in_top_k).reshape(SEEDS) / T
+    ranks = np.asarray(res.metrics.int_rank).reshape(SEEDS) / T
+    check(tops, ranks, lam, a)
