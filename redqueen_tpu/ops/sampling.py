@@ -56,7 +56,8 @@ def hawkes_intensity(t, l0, exc, exc_t, beta):
     return l0 + exc * jnp.exp(-beta * (t - exc_t))
 
 
-def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max):
+def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max,
+                     bound_scale=1.0):
     """Next event time of an exponential-kernel Hawkes process after
     ``t_from``, via Ogata thinning (reference: ``Hawkes.get_next_event_time``;
     SURVEY.md section 3.3).
@@ -68,11 +69,27 @@ def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max):
     terminates almost surely. ``t_max`` caps the search (proposals beyond it
     exit the loop and return +inf) so all-masked vmap lanes cannot spin.
 
+    ``bound_scale`` (>= 1) inflates every upper bound by that factor. The
+    accepted-time DISTRIBUTION is invariant to it — that is the defining
+    correctness property of thinning (SURVEY.md section 4.3; a biased
+    accept test would shift with the bound) — only the expected number of
+    proposals changes. The default 1.0 multiplies bounds by exactly 1
+    (IEEE identity), leaving existing streams bit-identical; tests pin the
+    invariance statistically at scale 3.
+
     Returns the accepted absolute time, or +inf if none before ``t_max``.
     """
+    if isinstance(bound_scale, (int, float)) and bound_scale < 1.0:
+        # A deflated bound silently biases acceptance early (probability
+        # clamps at 1); catch the common static-float misuse host-side.
+        raise ValueError(
+            f"bound_scale must be >= 1 (got {bound_scale}): a bound below "
+            f"the true intensity biases the thinning accept test"
+        )
     dtype = jnp.result_type(t_from, l0, jnp.float32)
     t_from = jnp.asarray(t_from, dtype)
-    lbd0 = hawkes_intensity(t_from, l0, exc, exc_t, beta)
+    scale = jnp.asarray(bound_scale, dtype)
+    lbd0 = hawkes_intensity(t_from, l0, exc, exc_t, beta) * scale
 
     def cond(c):
         _, t, accepted, lbd_bar = c
@@ -84,7 +101,7 @@ def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max):
         t_new = t + jr.exponential(k_w, dtype=dtype) / lbd_bar
         lbd_new = hawkes_intensity(t_new, l0, exc, exc_t, beta)
         accept = jr.uniform(k_u, dtype=dtype) * lbd_bar <= lbd_new
-        return (key, t_new, accept, lbd_new)
+        return (key, t_new, accept, lbd_new * scale)
 
     _, t_out, accepted, _ = lax.while_loop(
         cond, body, (key, t_from, jnp.asarray(False), lbd0)

@@ -829,3 +829,51 @@ class TestFireDoubling:
         with pytest.raises(ValueError, match="sharded feed axis"):
             simulate_star(cfg, wall, ctrl, seed=0, mesh=mesh,
                           fire_mode="doubling")
+
+
+class TestThinningInvariance:
+    def test_accepted_time_invariant_under_bound_inflation(self):
+        """Ogata thinning's defining property (SURVEY.md section 4.3): the
+        accepted-time distribution must not move when every upper bound is
+        inflated — only the proposal count does. A biased accept test
+        (e.g. comparing against the wrong bound) fails this immediately."""
+        import jax
+        from redqueen_tpu.ops.sampling import hawkes_next_time
+
+        l0, alpha, beta = 1.0, 2.0, 1.0
+        exc, exc_t, t_max = 3.0, 0.0, 50.0  # hot excitation: bound matters
+        n = 4000
+
+        def draw(scale):
+            ts = jax.vmap(
+                lambda k: hawkes_next_time(
+                    k, 0.0, l0, alpha, beta, exc, exc_t, t_max,
+                    bound_scale=scale,
+                )
+            )(jr.split(jr.PRNGKey(42), n))
+            t = np.asarray(ts)
+            assert np.isfinite(t).all(), "t_max ample: every lane accepts"
+            return t
+
+        a, b = draw(1.0), draw(3.0)
+        # Same law, different streams: compare mean and quartiles at 4 sigma.
+        se = np.sqrt(a.var() / n + b.var() / n)
+        assert abs(a.mean() - b.mean()) < 4 * se, (a.mean(), b.mean())
+        for qtl in (0.25, 0.5, 0.75):
+            qa, qb = np.quantile(a, qtl), np.quantile(b, qtl)
+            # quantile SE via the density-free conservative bound
+            qse = 1.0 / (2 * np.sqrt(n)) * (a.std() + b.std())
+            assert abs(qa - qb) < 4 * qse + 0.02, (qtl, qa, qb)
+
+    def test_scale_one_is_bit_identical_to_default(self):
+        """bound_scale=1.0 must not perturb existing streams (golden-test
+        compatibility): multiplying a bound by 1.0 is an IEEE identity."""
+        import jax
+        from redqueen_tpu.ops.sampling import hawkes_next_time
+
+        keys = jr.split(jr.PRNGKey(7), 256)
+        f = jax.vmap(lambda k: hawkes_next_time(
+            k, 0.0, 1.0, 2.0, 1.5, 1.0, 0.0, 30.0))
+        g = jax.vmap(lambda k: hawkes_next_time(
+            k, 0.0, 1.0, 2.0, 1.5, 1.0, 0.0, 30.0, bound_scale=1.0))
+        np.testing.assert_array_equal(np.asarray(f(keys)), np.asarray(g(keys)))

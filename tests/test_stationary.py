@@ -18,7 +18,6 @@ could all share one bias; this test cannot)."""
 
 import numpy as np
 import pytest
-from jax import random as jr
 
 from redqueen_tpu.config import GraphBuilder, stack_components
 from redqueen_tpu.oracle.numpy_ref import SimOpts
