@@ -2,7 +2,16 @@
 collective wrappers that no-op at mesh size 1 (comm), component-batch SPMD
 sharding (shard), and the follower-sharded big-F kernel (bigf)."""
 
-from .comm import make_mesh, psum, pmin, pmax, pany, shard_leading, replicate  # noqa: F401
+from .comm import (  # noqa: F401
+    axis_total,
+    make_mesh,
+    pany,
+    pmax,
+    pmin,
+    psum,
+    replicate,
+    shard_leading,
+)
 from .shard import simulate_sharded  # noqa: F401
 from .bigf import (  # noqa: F401
     StarBuilder,

@@ -36,6 +36,7 @@ __all__ = [
     "pany",
     "shard_leading",
     "replicate",
+    "axis_total",
 ]
 
 
@@ -93,16 +94,26 @@ def pany(x, axis_name: str = "data"):
     return lax.pmax(x.astype(jnp.int32), axis_name) > 0
 
 
-def shard_leading(tree, mesh: Mesh, axis: str = "data"):
+def shard_leading(tree, mesh: Mesh, axis="data"):
     """Place every array in ``tree`` with its LEADING dim sharded over
-    ``axis`` (rest replicated) — the component-batch layout. Leading dims
-    must divide the axis size evenly."""
+    ``axis`` (rest replicated) — the component-batch layout. ``axis`` may be
+    a tuple of mesh axis names to shard one dim over several axes at once —
+    the multi-slice pattern (e.g. ``("dcn", "data")``: slices over the DCN
+    axis x chips within a slice). Leading dims must divide the total axis
+    size evenly."""
     def put(x):
         x = jnp.asarray(x)
         spec = P(axis, *([None] * (x.ndim - 1)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, tree)
+
+
+def axis_total(mesh: Mesh, axis) -> int:
+    """Device count behind ``axis`` — a name or tuple of names."""
+    if isinstance(axis, str):
+        return mesh.shape[axis]
+    return int(np.prod([mesh.shape[a] for a in axis]))
 
 
 def replicate(tree, mesh: Mesh):

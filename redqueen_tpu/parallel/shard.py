@@ -29,14 +29,19 @@ __all__ = ["simulate_sharded"]
 
 
 def simulate_sharded(cfg: SimConfig, params: SourceParams, adj, seeds,
-                     mesh: Mesh, axis: str = "data",
+                     mesh: Mesh, axis="data",
                      max_chunks: int = 100, return_state: bool = False):
     """Run a component batch sharded over ``mesh`` axis ``axis``.
 
+    ``axis`` may be a tuple of axis names to shard the batch over several
+    mesh axes at once — the multi-slice layout (``("dcn", "data")``): the
+    batch spreads over slices x chips-per-slice with zero hot-loop
+    communication, exactly the regime DCN's lower bandwidth wants.
+
     ``params``/``adj``/``seeds`` carry a leading batch dim divisible by the
-    axis size. Results are identical (bit-for-bit at matched seeds) to
-    ``simulate_batch`` on one device: sharding only changes placement, and
-    the per-source PRNG streams are layout-independent by construction
+    (total) axis size. Results are identical (bit-for-bit at matched seeds)
+    to ``simulate_batch`` on one device: sharding only changes placement,
+    and the per-source PRNG streams are layout-independent by construction
     (SURVEY.md section 7 PRNG discipline; pinned by
     tests/test_sharding.py)."""
     B = jnp.asarray(seeds).shape[0]
@@ -46,7 +51,7 @@ def simulate_sharded(cfg: SimConfig, params: SourceParams, adj, seeds,
         raise ValueError(
             f"batch dims disagree: seeds={B}, params={B_params}, adj={B_adj}"
         )
-    ax_size = mesh.shape[axis]
+    ax_size = comm.axis_total(mesh, axis)
     if B % ax_size != 0:
         raise ValueError(f"batch {B} not divisible by mesh axis {axis}={ax_size}")
     seeds = jnp.asarray(seeds)

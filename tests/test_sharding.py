@@ -87,3 +87,21 @@ def test_collectives_inside_shard_map():
             f, mesh=mesh, in_specs=P("data"), out_specs=P("data")
         )(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))
+
+
+def test_multislice_dcn_axis_bitwise():
+    """The multi-slice layout: batch sharded over ("dcn", "data") — slices
+    over the (reserved) DCN axis x chips within a slice — must stay
+    bit-identical to the unsharded run (placement-only sharding; no
+    hot-loop communication crosses either axis)."""
+    cfg, p0, a0, opt, T = _component()
+    B = 16
+    params, adj = stack_components([p0] * B, [a0] * B)
+    seeds = np.arange(B)
+    ref = simulate_batch(cfg, params, adj, seeds)
+    mesh = comm.make_mesh({"dcn": 2, "data": 4})
+    assert comm.axis_total(mesh, ("dcn", "data")) == 8
+    log = simulate_sharded(cfg, params, adj, seeds, mesh,
+                           axis=("dcn", "data"))
+    np.testing.assert_array_equal(np.asarray(ref.times), np.asarray(log.times))
+    np.testing.assert_array_equal(np.asarray(ref.srcs), np.asarray(log.srcs))

@@ -64,8 +64,9 @@ def run_stage(name, cmd, out_json, deadline_s, log_path):
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--stage", type=int, default=None,
-                    help="run only this stage (1-4)")
+    ap.add_argument("--stage", type=int, action="append", default=None,
+                    help="run only the given stage(s) (1-4; repeatable, "
+                         "in the listed order)")
     ap.add_argument("--deadline", type=float, default=1500.0)
     args = ap.parse_args()
     py = sys.executable
@@ -98,9 +99,10 @@ def main() -> int:
          sweep_budget),
     ]
     any_ok = False
-    for n, name, cmd, out_json, log_path, deadline_s in stages:
-        if args.stage is not None and n != args.stage:
-            continue
+    by_n = {s[0]: s for s in stages}
+    ordered = (stages if args.stage is None
+               else [by_n[n] for n in args.stage])
+    for n, name, cmd, out_json, log_path, deadline_s in ordered:
         parsed = run_stage(name, cmd, out_json, deadline_s, log_path)
         if parsed is not None and parsed.get("platform") == "tpu":
             any_ok = True
