@@ -7,6 +7,9 @@ realized budget. The whole sweep is ONE vmapped batch on device: (q grid x
 seeds) components run in lockstep (SURVEY.md section 3.5: the reference's
 nested seed/q host loops become a batch axis).
 
+Built on ``redqueen_tpu.sweep.run_sweep`` (the library's one-dispatch
+sweep API); this script only adds the budget-matching and the figure.
+
 Usage:
     python experiments/tradeoff.py [--qgrid 0.1 0.3 1 3] [--seeds N]
         [--fig out.png] [--cpu]
@@ -24,41 +27,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run(q_grid, n_seeds=8, F=10, T=100.0, wall_rate=1.0, capacity=4096):
-    import jax.numpy as jnp
+    from redqueen_tpu import GraphBuilder, baselines
+    from redqueen_tpu.sweep import run_sweep
 
-    from redqueen_tpu import GraphBuilder, baselines, simulate_batch, stack_components
-    from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
-
-    def components(make):
-        """One component per (q, seed) lane; returns cfg, params, adj.
-        ``make(gb, qi, q)`` adds the controlled broadcaster for grid slot
-        qi and returns its source row."""
-        ps, ads = [], []
+    def points(make):
+        """One sweep point per q-grid slot; ``make(gb, qi, q)`` adds the
+        controlled broadcaster (source row 0 in every layout here)."""
+        pts = []
         for qi, q in enumerate(q_grid):
             gb = GraphBuilder(n_sinks=F, end_time=T)
-            me = make(gb, qi, q)
+            make(gb, qi, q)
             for i in range(F):
                 gb.add_poisson(rate=wall_rate, sinks=[i])
-            cfg, p0, a0 = gb.build(capacity=capacity)
-            ps += [p0] * n_seeds
-            ads += [a0] * n_seeds
-        params, adj = stack_components(ps, ads)
-        return cfg, params, adj, me
+            pts.append(gb.build(capacity=capacity))
+        return pts
 
-    def evaluate(cfg, params, adj, me, seed0):
-        B = len(q_grid) * n_seeds
-        seeds = np.arange(B) + seed0
-        log = simulate_batch(cfg, params, adj, seeds, max_chunks=64)
-        adj_b = adj if adj.ndim == 3 else jnp.broadcast_to(adj, (B,) + adj.shape)
-        m = feed_metrics_batch(log.times, log.srcs, adj_b, me, T)
-        top = np.asarray(m.mean_time_in_top_k()).reshape(len(q_grid), n_seeds)
-        posts = np.asarray(num_posts(log.srcs, me)).reshape(len(q_grid), n_seeds)
-        return top, posts
-
-    top_o, posts_o = evaluate(
-        *components(lambda gb, qi, q: gb.add_opt(q=q)), 0
-    )
-    budgets = posts_o.mean(axis=1)
+    res_o = run_sweep(points(lambda gb, qi, q: gb.add_opt(q=q)),
+                      n_seeds, seed0=0)
+    budgets = res_o.n_posts.mean(axis=1)
 
     # Budget-matched Poisson per q lane (rate varies per lane: same config,
     # params carry the rate, so one compilation covers the whole grid).
@@ -67,8 +53,8 @@ def run(q_grid, n_seeds=8, F=10, T=100.0, wall_rate=1.0, capacity=4096):
     def add_poisson(gb, qi, q):
         return gb.add_poisson(rate=float(rates[qi]))
 
-    top_p, posts_p = evaluate(*components(add_poisson), 10_000)
-    return budgets, top_o, top_p, posts_p
+    res_p = run_sweep(points(add_poisson), n_seeds, seed0=10_000)
+    return budgets, res_o.time_in_top_k, res_p.time_in_top_k, res_p.n_posts
 
 
 def main():

@@ -33,6 +33,7 @@ __version__ = "0.1.0"
 from .config import GraphBuilder, SimConfig, SourceParams, stack_components
 from .sim import EventLog, resume, simulate, simulate_batch
 from .presets import PRESETS, build_preset, run_preset
+from .sweep import SweepResult, run_sweep
 
 # Subpackages re-exported for discoverability. models/ops load eagerly (the
 # driver registers the built-in policies); oracle, parallel, and data stay
@@ -52,5 +53,7 @@ __all__ = [
     "PRESETS",
     "build_preset",
     "run_preset",
+    "SweepResult",
+    "run_sweep",
     "utils",
 ]

@@ -1,0 +1,112 @@
+"""Parameter sweeps as ONE device dispatch — the reference's experiment
+pattern (nested ``for seed: for q:`` host loops around
+``SimOpts.update({...}) -> create_manager -> run_till -> metrics``,
+SURVEY.md section 3.5) promoted from a script idiom to a library API.
+
+A sweep point is one component (cfg, params, adj) from
+:class:`~redqueen_tpu.config.GraphBuilder`; all points must share the same
+STATIC config (shapes/kinds/horizon — the jit cache key), while traced
+parameters (q, rates, significances) vary freely. The (point x seed) grid
+flattens to one ``simulate_batch`` — optionally sharded over a mesh via the
+same placement-only path as :func:`~redqueen_tpu.parallel.shard
+.simulate_sharded` — and the feed-rank metrics reduce on device, so nothing
+of size O(events) ever reaches the host.
+
+``experiments/tradeoff.py`` is the figure-level consumer of this API.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .config import SimConfig, stack_components
+from .parallel.shard import simulate_sharded
+from .sim import simulate_batch
+from .utils.metrics import feed_metrics_batch, num_posts
+
+__all__ = ["SweepResult", "run_sweep"]
+
+
+class SweepResult(NamedTuple):
+    """Per-(point, seed) scalars, shape [n_points, n_seeds] (numpy, on
+    host — these are O(grid) summaries, not O(events) logs)."""
+
+    time_in_top_k: np.ndarray   # mean over followed feeds, absolute time
+    average_rank: np.ndarray    # time-averaged rank, mean over feeds
+    n_posts: np.ndarray         # tracked source's posting budget spent
+    int_rank2: np.ndarray       # int r^2 dt, mean over feeds (loss term)
+
+    @property
+    def n_points(self) -> int:
+        return self.time_in_top_k.shape[0]
+
+    @property
+    def n_seeds(self) -> int:
+        return self.time_in_top_k.shape[1]
+
+
+def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
+              metric_K: int = 1, seed0: int = 0,
+              mesh: Optional[Mesh] = None, axis="data",
+              max_chunks: int = 100) -> SweepResult:
+    """Run every sweep point across ``n_seeds`` Monte-Carlo seeds in one
+    batch and return per-lane metric summaries.
+
+    ``points`` — sequence of ``(cfg, params, adj)`` triples (the
+    ``GraphBuilder.build()`` output); every point's ``cfg`` must be EQUAL
+    (one compiled kernel serves the whole sweep — vary traced params, not
+    shapes). ``src_index`` is the tracked broadcaster's source row (the
+    GraphBuilder ``add_opt`` return value in the usual layout).
+
+    Seeds are ``seed0 + arange(n_points * n_seeds)`` laid out point-major,
+    so APPENDING POINTS extends — never reshuffles — earlier points'
+    streams (growing ``n_seeds`` re-seeds every point after the first;
+    grow a Monte-Carlo run by sweeping a fresh ``seed0`` range instead).
+    With ``mesh``, the batch shards over ``axis`` (a name or tuple of
+    names, e.g. ``("dcn", "data")``) with bit-identical results.
+    """
+    if n_seeds < 1:
+        raise ValueError(f"n_seeds must be >= 1, got {n_seeds}")
+    points = list(points)
+    if not points:
+        raise ValueError("empty sweep: no points given")
+    cfg0: SimConfig = points[0][0]
+    for i, (cfg, _, _) in enumerate(points):
+        if cfg != cfg0:
+            raise ValueError(
+                f"sweep point {i} has a different static config than point "
+                f"0 — all points must share shapes/kinds/horizon (vary "
+                f"traced SourceParams instead, or run separate sweeps)"
+            )
+    P = len(points)
+    params, adj = stack_components(
+        [p for _, p, _ in points for _ in range(n_seeds)],
+        [a for _, _, a in points for _ in range(n_seeds)],
+    )
+    seeds = np.arange(P * n_seeds) + seed0
+    if mesh is None:
+        log = simulate_batch(cfg0, params, adj, seeds, max_chunks=max_chunks)
+    else:
+        log = simulate_sharded(cfg0, params, adj, seeds, mesh, axis=axis,
+                               max_chunks=max_chunks)
+    m = feed_metrics_batch(log.times, log.srcs, adj, src_index,
+                           cfg0.end_time, K=metric_K,
+                           start_time=cfg0.start_time)
+    # Window normalization comes from the FeedMetrics object itself (it
+    # carries the window its integrals used) — never recomputed here.
+    follows_n = jnp.maximum(m.follows.sum(-1), 1)
+    ir2 = (m.int_rank2 * m.follows).sum(-1) / follows_n
+
+    def grid(x):
+        return np.asarray(x).reshape(P, n_seeds)
+
+    return SweepResult(
+        time_in_top_k=grid(m.mean_time_in_top_k()),
+        average_rank=grid(m.mean_average_rank()),
+        n_posts=grid(num_posts(log.srcs, src_index)),
+        int_rank2=grid(ir2),
+    )

@@ -1,0 +1,78 @@
+"""Sweep API (redqueen_tpu.sweep): the reference's nested seed/parameter
+host loops (SURVEY.md section 3.5) as one device dispatch."""
+
+import numpy as np
+import pytest
+
+from redqueen_tpu.config import GraphBuilder
+from redqueen_tpu.parallel import comm
+from redqueen_tpu.sweep import run_sweep
+
+
+def q_points(q_grid, F=6, T=60.0, capacity=1024):
+    pts = []
+    for q in q_grid:
+        gb = GraphBuilder(n_sinks=F, end_time=T)
+        gb.add_opt(q=q)
+        for i in range(F):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        pts.append(gb.build(capacity=capacity))
+    return pts
+
+
+class TestRunSweep:
+    def test_budget_monotone_in_q(self):
+        """Smaller q -> cheaper posting -> more posts and more time at top
+        (the paper's core tradeoff); means over seeds must order."""
+        grid = [0.2, 1.0, 5.0]
+        res = run_sweep(q_points(grid), n_seeds=8)
+        assert res.n_points == 3 and res.n_seeds == 8
+        posts = res.n_posts.mean(axis=1)
+        tops = res.time_in_top_k.mean(axis=1)
+        assert posts[0] > posts[1] > posts[2], posts
+        assert tops[0] > tops[1] > tops[2], tops
+        assert np.all(res.average_rank >= 0)
+        assert np.all(res.int_rank2 >= 0)
+
+    def test_sharded_sweep_bit_identical(self):
+        res = run_sweep(q_points([0.5, 2.0]), n_seeds=8)
+        mesh = comm.make_mesh({"dcn": 2, "data": 4})
+        res_sh = run_sweep(q_points([0.5, 2.0]), n_seeds=8, mesh=mesh,
+                           axis=("dcn", "data"))
+        for a, b in zip(res, res_sh):
+            np.testing.assert_array_equal(a, b)
+
+    def test_seed_layout_extends_without_reshuffle(self):
+        """Point-major seed layout: lane (p, s) keeps its stream when
+        n_seeds is the same and points are appended."""
+        small = run_sweep(q_points([1.0]), n_seeds=4)
+        both = run_sweep(q_points([1.0, 3.0]), n_seeds=4)
+        np.testing.assert_array_equal(small.n_posts[0], both.n_posts[0])
+        np.testing.assert_array_equal(small.time_in_top_k[0],
+                                      both.time_in_top_k[0])
+
+    def test_mismatched_static_config_rejected(self):
+        a = q_points([1.0], F=4)
+        b = q_points([1.0], F=5)
+        with pytest.raises(ValueError, match="different static config"):
+            run_sweep(a + b, n_seeds=2)
+
+    def test_empty_and_bad_args_rejected(self):
+        with pytest.raises(ValueError, match="empty sweep"):
+            run_sweep([], n_seeds=2)
+        with pytest.raises(ValueError, match="n_seeds"):
+            run_sweep(q_points([1.0]), n_seeds=0)
+
+    def test_nonzero_start_time_window(self):
+        """Metrics must integrate over [start_time, end_time], not [0, end]
+        (the window comes from the FeedMetrics object, never recomputed).
+        With zero-rate walls the rank never leaves 0, so time-in-top-1 is
+        exactly the window length and the average rank is exactly 0."""
+        t0, t1, F = 5.0, 20.0, 3
+        gb = GraphBuilder(n_sinks=F, end_time=t1, start_time=t0)
+        gb.add_opt(q=1.0)
+        for i in range(F):
+            gb.add_poisson(rate=0.0, sinks=[i])
+        res = run_sweep([gb.build(capacity=64)], n_seeds=3)
+        np.testing.assert_allclose(res.time_in_top_k, t1 - t0, rtol=1e-6)
+        np.testing.assert_allclose(res.average_rank, 0.0, atol=1e-9)
