@@ -33,7 +33,7 @@ __version__ = "0.1.0"
 from .config import GraphBuilder, SimConfig, SourceParams, stack_components
 from .sim import EventLog, resume, simulate, simulate_batch
 from .presets import PRESETS, build_preset, run_preset
-from .sweep import SweepResult, run_sweep
+from .sweep import SweepResult, run_sweep, run_sweep_star
 
 # Subpackages re-exported for discoverability. models/ops load eagerly (the
 # driver registers the built-in policies); oracle, parallel, and data stay
@@ -55,5 +55,6 @@ __all__ = [
     "run_preset",
     "SweepResult",
     "run_sweep",
+    "run_sweep_star",
     "utils",
 ]

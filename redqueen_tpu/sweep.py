@@ -10,7 +10,9 @@ parameters (q, rates, significances) vary freely. The (point x seed) grid
 flattens to one ``simulate_batch`` — optionally sharded over a mesh via the
 same placement-only path as :func:`~redqueen_tpu.parallel.shard
 .simulate_sharded` — and the feed-rank metrics reduce on device, so nothing
-of size O(events) ever reaches the host.
+of size O(events) ever reaches the host. :func:`run_sweep_star` is the
+star-engine twin over :class:`~redqueen_tpu.parallel.bigf.StarBuilder`
+components.
 
 ``experiments/tradeoff.py`` is the figure-level consumer of this API.
 """
@@ -19,16 +21,18 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from .config import SimConfig, stack_components
+from .config import stack_components
+from .parallel.bigf import simulate_star_batch
 from .parallel.shard import simulate_sharded
 from .sim import simulate_batch
 from .utils.metrics import feed_metrics_batch, num_posts
 
-__all__ = ["SweepResult", "run_sweep"]
+__all__ = ["SweepResult", "run_sweep", "run_sweep_star"]
 
 
 class SweepResult(NamedTuple):
@@ -47,6 +51,42 @@ class SweepResult(NamedTuple):
     @property
     def n_seeds(self) -> int:
         return self.time_in_top_k.shape[1]
+
+
+def _validate_points(points, n_seeds, vary_hint: str):
+    """Shared sweep-grid validation; returns (points list, shared cfg)."""
+    if n_seeds < 1:
+        raise ValueError(f"n_seeds must be >= 1, got {n_seeds}")
+    points = list(points)
+    if not points:
+        raise ValueError("empty sweep: no points given")
+    cfg0 = points[0][0]
+    for i, (cfg, _, _) in enumerate(points):
+        if cfg != cfg0:
+            raise ValueError(
+                f"sweep point {i} has a different static config than point "
+                f"0 — all points must share shapes/kinds/horizon (vary "
+                f"traced {vary_hint} instead, or run separate sweeps)"
+            )
+    return points, cfg0
+
+
+def _reduce_to_grid(m, n_posts, P: int, n_seeds: int) -> SweepResult:
+    """FeedMetrics [B, F] + per-lane post counts -> [P, n_seeds] grids.
+    Window normalization comes from the FeedMetrics object itself (it
+    carries the window its integrals used) — never recomputed here."""
+    follows_n = jnp.maximum(m.follows.sum(-1), 1)
+    ir2 = (m.int_rank2 * m.follows).sum(-1) / follows_n
+
+    def grid(x):
+        return np.asarray(x).reshape(P, n_seeds)
+
+    return SweepResult(
+        time_in_top_k=grid(m.mean_time_in_top_k()),
+        average_rank=grid(m.mean_average_rank()),
+        n_posts=grid(n_posts),
+        int_rank2=grid(ir2),
+    )
 
 
 def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
@@ -69,19 +109,7 @@ def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
     With ``mesh``, the batch shards over ``axis`` (a name or tuple of
     names, e.g. ``("dcn", "data")``) with bit-identical results.
     """
-    if n_seeds < 1:
-        raise ValueError(f"n_seeds must be >= 1, got {n_seeds}")
-    points = list(points)
-    if not points:
-        raise ValueError("empty sweep: no points given")
-    cfg0: SimConfig = points[0][0]
-    for i, (cfg, _, _) in enumerate(points):
-        if cfg != cfg0:
-            raise ValueError(
-                f"sweep point {i} has a different static config than point "
-                f"0 — all points must share shapes/kinds/horizon (vary "
-                f"traced SourceParams instead, or run separate sweeps)"
-            )
+    points, cfg0 = _validate_points(points, n_seeds, "SourceParams")
     P = len(points)
     params, adj = stack_components(
         [p for _, p, _ in points for _ in range(n_seeds)],
@@ -96,17 +124,38 @@ def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
     m = feed_metrics_batch(log.times, log.srcs, adj, src_index,
                            cfg0.end_time, K=metric_K,
                            start_time=cfg0.start_time)
-    # Window normalization comes from the FeedMetrics object itself (it
-    # carries the window its integrals used) — never recomputed here.
-    follows_n = jnp.maximum(m.follows.sum(-1), 1)
-    ir2 = (m.int_rank2 * m.follows).sum(-1) / follows_n
+    return _reduce_to_grid(m, num_posts(log.srcs, src_index), P, n_seeds)
 
-    def grid(x):
-        return np.asarray(x).reshape(P, n_seeds)
 
-    return SweepResult(
-        time_in_top_k=grid(m.mean_time_in_top_k()),
-        average_rank=grid(m.mean_average_rank()),
-        n_posts=grid(num_posts(log.srcs, src_index)),
-        int_rank2=grid(ir2),
-    )
+def run_sweep_star(points: Sequence, n_seeds: int, metric_K: int = 1,
+                   seed0: int = 0, mesh: Optional[Mesh] = None,
+                   axis: str = "data", feed_axis: Optional[str] = None,
+                   fire_mode: str = "auto") -> SweepResult:
+    """The star-engine twin of :func:`run_sweep`: sweep points are
+    ``(cfg, wall, ctrl)`` triples from
+    :class:`~redqueen_tpu.parallel.bigf.StarBuilder` (one controlled
+    broadcaster vs its feeds), crossed with ``n_seeds`` into one
+    ``simulate_star_batch`` dispatch. Same grid layout and seed rule as
+    ``run_sweep`` (point-major; appending points preserves earlier points'
+    streams). With ``mesh``, the grid shards over ``axis``; pass
+    ``feed_axis`` as well for the 2-D (grid x follower) mesh at big F —
+    both forwarded to ``simulate_star_batch`` unchanged. Memory scales
+    with n_points x n_seeds x the wall leaves — at the 100k-feed scale
+    keep the grid small or shard the feed axis.
+    """
+    points, cfg0 = _validate_points(points, n_seeds, "Wall/CtrlParams")
+    P = len(points)
+
+    def batch(trees):
+        # [P] point trees -> [P * n_seeds] lanes, point-major.
+        return jax.tree.map(
+            lambda *xs: jnp.repeat(jnp.stack(xs), n_seeds, axis=0), *trees
+        )
+
+    wall_b = batch([w for _, w, _ in points])
+    ctrl_b = batch([jax.tree.map(jnp.asarray, c) for _, _, c in points])
+    seeds = np.arange(P * n_seeds) + seed0
+    res = simulate_star_batch(cfg0, wall_b, ctrl_b, seeds, mesh=mesh,
+                              axis=axis, feed_axis=feed_axis,
+                              metric_K=metric_K, fire_mode=fire_mode)
+    return _reduce_to_grid(res.metrics, res.n_posts, P, n_seeds)

@@ -76,3 +76,48 @@ class TestRunSweep:
         res = run_sweep([gb.build(capacity=64)], n_seeds=3)
         np.testing.assert_allclose(res.time_in_top_k, t1 - t0, rtol=1e-6)
         np.testing.assert_allclose(res.average_rank, 0.0, atol=1e-9)
+
+
+def star_q_points(q_grid, F=6, T=60.0):
+    from redqueen_tpu.parallel.bigf import StarBuilder
+
+    pts = []
+    for q in q_grid:
+        sb = StarBuilder(n_feeds=F, end_time=T)
+        for f in range(F):
+            sb.wall_poisson(f, 1.0)
+        sb.ctrl_opt(q=q)
+        pts.append(sb.build(wall_cap=256, post_cap=1024))
+    return pts
+
+
+class TestRunSweepStar:
+    def test_budget_monotone_in_q(self):
+        from redqueen_tpu.sweep import run_sweep_star
+
+        res = run_sweep_star(star_q_points([0.2, 1.0, 5.0]), n_seeds=8)
+        posts = res.n_posts.mean(axis=1)
+        tops = res.time_in_top_k.mean(axis=1)
+        assert posts[0] > posts[1] > posts[2], posts
+        assert tops[0] > tops[1] > tops[2], tops
+
+    def test_engines_agree_statistically(self):
+        """The scan-engine and star-engine sweeps of the SAME q grid must
+        agree on the headline metric within Monte-Carlo tolerance (they
+        sample different streams; the laws are identical)."""
+        from redqueen_tpu.sweep import run_sweep_star
+
+        grid, S = [0.5, 2.0], 12
+        scan = run_sweep(q_points(grid, F=6), n_seeds=S)
+        star = run_sweep_star(star_q_points(grid, F=6), n_seeds=S, seed0=777)
+        for p in range(len(grid)):
+            a, b = scan.time_in_top_k[p], star.time_in_top_k[p]
+            se = np.sqrt(a.var() / S + b.var() / S)
+            assert abs(a.mean() - b.mean()) < 4 * se + 0.5, (p, a.mean(), b.mean())
+
+    def test_mismatched_config_rejected(self):
+        from redqueen_tpu.sweep import run_sweep_star
+
+        with pytest.raises(ValueError, match="different static config"):
+            run_sweep_star(star_q_points([1.0], F=4) +
+                           star_q_points([1.0], F=5), n_seeds=2)
