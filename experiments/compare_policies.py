@@ -55,76 +55,63 @@ def _human_trace(rng, change_times, rates, T, n_posts):
 
 
 def run(n_seeds=16, F=10, T=96.0, q=0.4, lo=0.3, hi=2.5, capacity=4096):
-    import jax.numpy as jnp
-
-    from redqueen_tpu import GraphBuilder, baselines, simulate_batch, stack_components
-    from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
+    from redqueen_tpu import GraphBuilder, baselines, run_sweep
 
     ct, wall_rates = diurnal_profile(T, lo, hi)
 
-    def build(add_me):
+    def point(add_me):
+        """One sweep point: the policy under test vs F diurnal walls."""
         gb = GraphBuilder(n_sinks=F, end_time=T)
-        me = add_me(gb)
+        add_me(gb)
         for i in range(F):
             gb.add_piecewise(ct, wall_rates, sinks=[i])
-        cfg, p0, a0 = gb.build(capacity=capacity)
-        params, adj = stack_components([p0] * n_seeds, [a0] * n_seeds)
-        return cfg, params, adj, me
+        return gb.build(capacity=capacity)
 
-    def evaluate(cfg, params, adj, me, seeds):
-        log = simulate_batch(cfg, params, adj, seeds, max_chunks=64)
-        adj_b = adj if adj.ndim == 3 else jnp.broadcast_to(
-            adj, (n_seeds,) + adj.shape)
-        m = feed_metrics_batch(log.times, log.srcs, adj_b, me, T)
-        return (np.asarray(m.mean_time_in_top_k()),
-                np.asarray(m.mean_average_rank()),
-                np.asarray(num_posts(log.srcs, me)))
+    def evaluate(points, seed0, n=n_seeds):
+        res = run_sweep(points, n_seeds=n, seed0=seed0, max_chunks=64)
+        # one policy per call: flatten the [P, S] grids to per-lane arrays
+        return (res.time_in_top_k.reshape(-1),
+                res.average_rank.reshape(-1),
+                res.n_posts.reshape(-1))
 
-    seeds = np.arange(n_seeds)
     results = {}
 
     # 1) RedQueen fixes the budget everyone else must match.
-    cfg, params, adj, me = build(lambda gb: gb.add_opt(q=q))
-    top, rank, posts = evaluate(cfg, params, adj, me, seeds)
+    top, rank, posts = evaluate([point(lambda gb: gb.add_opt(q=q))], 0)
     budget = float(posts.mean())
     results["opt"] = (top, rank, posts)
 
     # 2) Budget-matched Poisson.
     rate = baselines.budget_matched_poisson_rate(budget, T)
-    cfg, params, adj, me = build(lambda gb: gb.add_poisson(rate=rate))
-    results["poisson"] = evaluate(cfg, params, adj, me, seeds + 1000)
+    results["poisson"] = evaluate(
+        [point(lambda gb: gb.add_poisson(rate=rate))], 1000)
 
     # 2b) Budget-matched Hawkes posting (branching ratio 1/2: bursty but
     # stationary; l0 chosen so E[#posts] matches the budget).
     beta_h = 2.0
     alpha_h = 1.0
     l0_h = (budget / T) * (1 - alpha_h / beta_h)
-    cfg, params, adj, me = build(
-        lambda gb: gb.add_hawkes(l0=l0_h, alpha=alpha_h, beta=beta_h))
-    results["hawkes"] = evaluate(cfg, params, adj, me, seeds + 4000)
+    results["hawkes"] = evaluate(
+        [point(lambda gb: gb.add_hawkes(l0=l0_h, alpha=alpha_h,
+                                        beta=beta_h))], 4000)
 
     # 3) Karimi-style offline schedule at the same budget.
     ct_off, mu = baselines.offline_schedule(
         np.tile(wall_rates, (F, 1)), ct, T, budget)
-    cfg, params, adj, me = build(lambda gb: gb.add_piecewise(ct_off, mu))
-    results["offline"] = evaluate(cfg, params, adj, me, seeds + 2000)
+    results["offline"] = evaluate(
+        [point(lambda gb: gb.add_piecewise(ct_off, mu))], 2000)
 
-    # 4) "Real user" replay: busy-hours posting at the same budget (one
-    # distinct trace per seed lane, so traces vary like the other policies'
-    # randomness does).
+    # 4) "Real user" replay: busy-hours posting at the same budget. Each
+    # seed lane replays a DISTINCT trace, so the lanes are sweep POINTS
+    # (params differ), crossed with one seed each.
     rng = np.random.RandomState(7)
     n_posts = max(int(round(budget)), 1)
-    gb_list = []
-    for s in range(n_seeds):
-        gb = GraphBuilder(n_sinks=F, end_time=T)
-        me = gb.add_realdata(_human_trace(rng, ct, wall_rates, T, n_posts))
-        for i in range(F):
-            gb.add_piecewise(ct, wall_rates, sinks=[i])
-        gb_list.append(gb.build(capacity=capacity))
-    cfg = gb_list[0][0]
-    params, adj = stack_components([g[1] for g in gb_list],
-                                   [g[2] for g in gb_list])
-    results["replay"] = evaluate(cfg, params, adj, me, seeds + 3000)
+    replay_pts = [
+        point(lambda gb: gb.add_realdata(
+            _human_trace(rng, ct, wall_rates, T, n_posts)))
+        for _ in range(n_seeds)
+    ]
+    results["replay"] = evaluate(replay_pts, 3000, n=1)
 
     return results, budget, T
 
