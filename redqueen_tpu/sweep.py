@@ -21,13 +21,12 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
 from .config import stack_components
-from .parallel.bigf import simulate_star_batch
+from .parallel.bigf import simulate_star_batch, stack_star
 from .parallel.shard import simulate_sharded
 from .sim import simulate_batch
 from .utils.metrics import feed_metrics_batch, num_posts
@@ -145,15 +144,12 @@ def run_sweep_star(points: Sequence, n_seeds: int, metric_K: int = 1,
     """
     points, cfg0 = _validate_points(points, n_seeds, "Wall/CtrlParams")
     P = len(points)
-
-    def batch(trees):
-        # [P] point trees -> [P * n_seeds] lanes, point-major.
-        return jax.tree.map(
-            lambda *xs: jnp.repeat(jnp.stack(xs), n_seeds, axis=0), *trees
-        )
-
-    wall_b = batch([w for _, w, _ in points])
-    ctrl_b = batch([jax.tree.map(jnp.asarray, c) for _, _, c in points])
+    # Point-major [P * n_seeds] lanes via the engine's own stacker (the
+    # same list-repeat idiom run_sweep uses with stack_components).
+    wall_b, ctrl_b = stack_star(
+        [w for _, w, _ in points for _ in range(n_seeds)],
+        [c for _, _, c in points for _ in range(n_seeds)],
+    )
     seeds = np.arange(P * n_seeds) + seed0
     res = simulate_star_batch(cfg0, wall_b, ctrl_b, seeds, mesh=mesh,
                               axis=axis, feed_axis=feed_axis,

@@ -121,3 +121,13 @@ class TestRunSweepStar:
         with pytest.raises(ValueError, match="different static config"):
             run_sweep_star(star_q_points([1.0], F=4) +
                            star_q_points([1.0], F=5), n_seeds=2)
+
+    def test_sharded_star_sweep_bit_identical(self):
+        from redqueen_tpu.sweep import run_sweep_star
+
+        pts = star_q_points([0.5, 2.0])
+        ref = run_sweep_star(pts, n_seeds=4)
+        mesh = comm.make_mesh({"data": 8})
+        sh = run_sweep_star(pts, n_seeds=4, mesh=mesh)
+        for a, b in zip(ref, sh):
+            np.testing.assert_array_equal(a, b)

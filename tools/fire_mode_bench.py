@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Measure the star engine's fire-extraction modes (loop vs pointer
+doubling) on the CURRENT backend, at the shapes where the choice matters.
+
+DESIGN.md's mode-selection policy ("auto": loop on CPU, doubling on
+accelerators) rests on CPU measurements plus a latency argument for the
+TPU; this tool turns the TPU half into data the moment the tunnel is
+alive:
+
+    python tools/fire_mode_bench.py [--out FIRE_MODE_<platform>.json]
+
+Writes its artifact incrementally (one JSON dump per finished cell), so a
+mid-run tunnel wedge keeps every completed measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import _jax_cache  # noqa: E402
+
+_jax_cache.enable_persistent_cache()
+
+# (label, B lanes, F feeds, T horizon, wall_cap, post_cap). RedQueen's
+# posting volume grows ~ T * sqrt(F * rate / q), so post_cap needs ~4x
+# that (the bench.py cap rule): F=10 -> ~316 posts, F=1k -> ~3.2k,
+# F=10k -> ~10k.
+CELLS = [
+    ("batch B=2000 F=10", 2000, 10, 100.0, 256, 2048),
+    ("batch B=64 F=1k", 64, 1000, 100.0, 256, 16384),
+    ("single F=10k", 1, 10_000, 100.0, 256, 65536),
+]
+REPS = 3
+
+
+def bench_cell(label, B, F, T, wall_cap, post_cap, mode):
+    import numpy as np
+
+    from redqueen_tpu.parallel.bigf import (
+        StarBuilder,
+        broadcast_star,
+        simulate_star_batch,
+    )
+
+    sb = StarBuilder(n_feeds=F, end_time=T)
+    for f in range(F):
+        sb.wall_poisson(f, 1.0)
+    sb.ctrl_opt(q=1.0)
+    cfg, wall, ctrl = sb.build(wall_cap=wall_cap, post_cap=post_cap)
+    wb, cb = broadcast_star(wall, ctrl, B)
+    warm = simulate_star_batch(cfg, wb, cb, np.arange(B), fire_mode=mode)
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        r = simulate_star_batch(cfg, wb, cb, np.arange(B) + B,
+                                fire_mode=mode)
+        best = min(best, time.perf_counter() - t0)
+    events = int(r.wall_n.sum()) + int(r.n_posts.sum())
+    return {"label": label, "mode": mode, "secs": round(best, 4),
+            "events": events, "events_per_sec": round(events / best, 1)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    out = args.out or os.path.join(REPO, f"FIRE_MODE_{platform}.json")
+    results = {"platform": platform, "timed": "best of "
+               f"{REPS} after one warm-up (compile) run", "cells": []}
+    print(f"platform: {platform} -> {out}", file=sys.stderr, flush=True)
+    for cell in CELLS:
+        for mode in ("loop", "doubling"):
+            r = bench_cell(*cell, mode)
+            results["cells"].append(r)
+            print(f"  {r['label']:20s} {mode:9s}: {r['secs']:8.3f}s "
+                  f"({r['events_per_sec']:,.0f} ev/s)",
+                  file=sys.stderr, flush=True)
+            with open(out, "w") as f:  # incremental: survive a wedge
+                json.dump(results, f, indent=1)
+                f.write("\n")
+    print(json.dumps({"ok": True, "platform": platform, "out": out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
