@@ -491,3 +491,21 @@ class TestKindGuards:
         srcs = np.asarray(log.srcs)
         fired_opts = {int(s) for s in srcs[srcs >= 0] if s < n_opt}
         assert len(fired_opts) == n_opt  # every competing broadcaster posted
+
+
+class TestTieBreaking:
+    def test_scan_engine_tie_break_lowest_source_index(self):
+        """Exactly-equal next-event times (two replay sources with identical
+        timestamps) must fire in source-index order — the scan step's
+        argmin tie rule, matching the oracle's Manager pop
+        (tests/test_oracle.py::test_tie_break_lowest_source_index)."""
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb.add_realdata(times=[1.0, 2.0], sinks=[0])
+        gb.add_realdata(times=[1.0, 2.0], sinks=[0])
+        cfg, params, adj = gb.build(capacity=16)
+        log = simulate(cfg, params, adj, seed=0)
+        srcs = np.asarray(log.srcs)
+        times = np.asarray(log.times)
+        valid = srcs >= 0
+        np.testing.assert_array_equal(srcs[valid], [0, 1, 0, 1])
+        np.testing.assert_allclose(times[valid], [1.0, 1.0, 2.0, 2.0])
