@@ -27,7 +27,6 @@ import numpy as np
 import optax
 from flax import linen as nn
 from jax import lax
-from jax import random as jr
 
 from ..ops.sampling import rmtpp_cum_hazard, rmtpp_log_intensity, rmtpp_next_delta
 from .base import KIND_RMTPP, PolicyDef, SourceUpdate, register_policy

@@ -11,7 +11,6 @@ dtype-follow their float inputs.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 from jax import random as jr

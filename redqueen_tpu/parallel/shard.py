@@ -14,15 +14,13 @@ unsharded paths cannot drift apart.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax import random as jr
 from jax.sharding import Mesh
 
 from ..config import SimConfig, SourceParams
-from ..sim import EventLog, simulate_batch
+from ..sim import simulate_batch
 from . import comm
 
 __all__ = ["simulate_sharded"]

@@ -167,7 +167,6 @@ def run_preset(bundle, seeds, mesh=None, max_chunks: int = 256,
     per-seed values. Batch bundles treat an int-array ``seeds`` as the
     component batch (must match the stacked batch dim if any); star bundles
     loop seeds host-side (each run is one big component)."""
-    import jax
     import jax.numpy as jnp
 
     kind = bundle[0]

@@ -15,7 +15,6 @@ import os
 from typing import Any, Optional
 
 import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
 __all__ = ["save", "restore", "latest_step"]

@@ -20,7 +20,7 @@ Conventions (shared with the JAX metric kernels in
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict
 
 import numpy as np
 import pandas as pd

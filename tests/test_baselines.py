@@ -4,7 +4,6 @@ ordering (offline schedule >= budget-matched uniform Poisson on diurnal
 walls) via the NumPy oracle."""
 
 import numpy as np
-import pytest
 
 from redqueen_tpu import baselines
 

@@ -9,7 +9,7 @@ from redqueen_tpu.config import GraphBuilder, stack_components
 from redqueen_tpu.parallel import comm
 from redqueen_tpu.parallel.shard import simulate_sharded
 from redqueen_tpu.sim import simulate_batch
-from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
+from redqueen_tpu.utils.metrics import feed_metrics_batch
 
 
 def _component(n=4, T=60.0, q=1.0):

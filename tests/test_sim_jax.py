@@ -7,7 +7,7 @@ import pytest
 
 from redqueen_tpu.config import GraphBuilder, stack_components
 from redqueen_tpu.sim import simulate, simulate_batch
-from redqueen_tpu.utils.metrics import feed_metrics, feed_metrics_batch, num_posts
+from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
 from redqueen_tpu.oracle.numpy_ref import SimOpts
 from redqueen_tpu.utils import metrics_pandas as mp
 
@@ -198,7 +198,6 @@ class TestOptReactBranches:
     def _force_branch(self, monkeypatch, unroll: bool):
         from redqueen_tpu.models import opt as opt_mod
         from redqueen_tpu import sim as sim_mod
-        from redqueen_tpu.ops import scan_core
 
         monkeypatch.setattr(
             opt_mod, "UNROLL_MAX_OPT_ROWS", 10_000 if unroll else -1
