@@ -3,9 +3,19 @@
 A short TPU-tunnel-alive window should pay each kernel's ~20-40s compile at
 most once per round: bench children, the driver's compile checks
 (__graft_entry__.py), and the preset harness (benchmarks/run.py) all point
-JAX_COMPILATION_CACHE_DIR at the same repo-local ``.jax_cache/``, so
-whichever process compiles first leaves the executable on disk for the
-rest. Harmless on CPU — cache keys include the platform.
+JAX_COMPILATION_CACHE_DIR at the same repo-local cache, so whichever
+process compiles first leaves the executable on disk for the rest.
+
+The cache directory is keyed by a HOST FINGERPRINT (arch + CPU-feature
+flags), because the repo can be mounted on machines with different CPU
+features: round 3 observed XLA loading AOT executables compiled with
+``+amx-*``/``+prefer-no-gather`` onto a host without them — a ~4KB
+``cpu_aot_loader`` warning per process today and a latent SIGILL tomorrow.
+Same-host reuse (the point: a tunnel window, the driver's end-of-round
+bench, repeated test runs) is unaffected; a different host simply builds
+its own subdirectory. TPU executables ride the same per-host keying — the
+chip is identical behind the tunnel, so only cross-host CPU reuse is
+(deliberately) given up.
 
 Repo-root module, stdlib-only, on purpose: it must run BEFORE the first
 ``import jax`` (jax reads the env var at config creation), and importing
@@ -15,17 +25,36 @@ imports jax — so the helper cannot live inside the package.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+__all__ = ["enable_persistent_cache", "CACHE_DIR", "host_fingerprint"]
+
+
+def host_fingerprint() -> str:
+    """Short stable id for (machine arch, CPU feature flags): an executable
+    AOT-compiled under one fingerprint is never loaded under another."""
+    bits = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith(("flags", "features")):
+                    bits.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        pass  # non-Linux: arch alone still separates the observed failure
+    digest = hashlib.sha256("|".join(bits).encode()).hexdigest()[:10]
+    return f"{platform.machine()}-{digest}"
+
 
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_cache")
-
-__all__ = ["enable_persistent_cache", "CACHE_DIR"]
+                         ".jax_cache", host_fingerprint())
 
 
 def enable_persistent_cache() -> str:
-    """Point JAX at the shared on-disk compilation cache (setdefault, so an
-    operator's explicit override always wins). Returns the directory used.
-    Child processes inherit the setting through os.environ."""
+    """Point JAX at the per-host on-disk compilation cache (setdefault, so
+    an operator's explicit override always wins). Returns the directory
+    used. Child processes inherit the setting through os.environ."""
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
     return os.environ["JAX_COMPILATION_CACHE_DIR"]

@@ -17,10 +17,25 @@ only improve it (a faster engine re-prints). A hang, tunnel wedge, or kill of
 any later engine therefore cannot erase the round's number: whatever is on
 stdout when the driver's clock expires is a valid result.
 
-stdout protocol: one or more JSON result lines
+Output protocol (round-3 verdict item 1 — the driver records the MERGED
+stdout+stderr tail, not stdout alone): one or more JSON result lines
     {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...}
 each complete and valid; the LAST line printed is the authoritative (best)
-result. Diagnostics go to stderr.
+result. Three mechanisms make that last line un-loseable on the combined
+stream: (a) a complete line prints the moment the first engine lands (a
+later hang cannot zero the round), (b) every emit also writes
+``BENCH_RESULT.json`` at the repo root (the file the final print echoes),
+and (c) an ``atexit`` hook flushes stderr and re-emits the best line as the
+process's literal final output, so trailing diagnostics from slow engines
+or XLA warnings can never push the result out of the captured tail
+(the exact r03 failure shape; pinned by tests/test_bench_orchestration.py).
+Diagnostics go to stderr.
+
+Every result line is self-auditing (round-3 verdict item 6): it carries the
+oracle denominator (``oracle_events_per_sec``), both time-in-top-1 values,
+the quality-gate deviation and its 4-sigma tolerance, and ``gate_ok`` —
+and the process exits 3 when the gate fails, so a quality regression
+cannot ship a throughput number silently.
 
 Usage: python bench.py [--quick] [--broadcasters N] [--horizon T]
                        [--deadline S] [--engine-deadline S]
@@ -30,6 +45,7 @@ Usage: python bench.py [--quick] [--broadcasters N] [--horizon T]
 from __future__ import annotations
 
 import argparse
+import atexit
 import json
 import os
 import subprocess
@@ -41,6 +57,41 @@ import numpy as np
 import _jax_cache
 
 _START = time.monotonic()
+
+RESULT_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_RESULT.json")
+
+# The best result line emitted so far (parent mode only). Mutated by
+# _emit_result_line; re-printed by the atexit hook so the merged
+# stdout+stderr stream the driver captures ALWAYS ends with it.
+_FINAL = {"line": None}
+
+
+def _emit_result_line(obj: dict) -> None:
+    """Print a complete result line now, remember it for the atexit
+    re-emit, and echo it to RESULT_FILE (survives even a SIGKILL that
+    skips atexit)."""
+    _FINAL["line"] = obj
+    try:
+        with open(RESULT_FILE, "w") as f:
+            json.dump(obj, f)
+            f.write("\n")
+    except OSError as e:
+        log(f"warning: could not write {RESULT_FILE}: {e}")
+    print(json.dumps(obj), flush=True)
+
+
+@atexit.register
+def _reemit_final_line() -> None:
+    # Runs after normal return AND after an unhandled exception's traceback
+    # has been printed. Flush stderr first so no diagnostic can interleave
+    # after the result on the merged stream.
+    line = _FINAL["line"]
+    if line is None:
+        return
+    sys.stderr.flush()
+    sys.stdout.write(json.dumps(line) + "\n")
+    sys.stdout.flush()
 
 # Engine children inherit this through os.environ (the parent itself never
 # imports jax); see _jax_cache.py for the one definition of the policy.
@@ -125,9 +176,9 @@ def run_jax_star(B: int, n_followers: int, T: float, q: float,
         secs = min(secs, time.perf_counter() - t0)  # block_until_ready inside
 
     events = int(res.wall_n.sum()) + int(res.n_posts.sum())
-    top1 = float(np.asarray(res.metrics.mean_time_in_top_k()).mean())
+    tops = np.asarray(res.metrics.mean_time_in_top_k()).reshape(-1)
     posts = float(res.n_posts.mean())
-    return events, secs, top1, posts
+    return events, secs, float(tops.mean()), float(tops.std()), posts
 
 
 # CPU cache-locality optimum for the scan engine's lane count (measured on
@@ -186,11 +237,11 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     tops, posts_l = [], []
     for lg in logs:
         m = feed_metrics_batch(lg.times, lg.srcs, adj_b, opt, T)
-        tops.append(float(np.asarray(m.mean_time_in_top_k()).mean()))
+        tops.append(np.asarray(m.mean_time_in_top_k()).reshape(-1))
         posts_l.append(float(np.asarray(num_posts(lg.srcs, opt)).mean()))
-    top1 = float(np.mean(tops))  # equal-size slabs: plain mean is exact
+    tops = np.concatenate(tops)  # per-lane values across all B lanes
     posts = float(np.mean(posts_l))
-    return events, secs, top1, posts
+    return events, secs, float(tops.mean()), float(tops.std()), posts
 
 
 def _max_chunks(n_followers: int, T: float, wall_rate: float,
@@ -276,7 +327,7 @@ def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
         took = time.perf_counter() - t0
         spent += took
         secs = min(secs, took)
-    return events, secs, float(np.mean(tops))
+    return events, secs, float(np.mean(tops)), float(np.std(tops))
 
 
 def _shapes(args):
@@ -341,11 +392,12 @@ def child_main(args) -> None:
         # Pure NumPy/pandas — never touches a JAX backend, cannot hang.
         # The parent forwards this child's subprocess timeout as --deadline;
         # 0.85 leaves headroom for build + DataFrame overhead per pass.
-        ev, secs, top1 = run_oracle(oracle_comps, args.followers, T, args.q,
-                                    args.wall_rate,
-                                    budget_s=args.deadline * 0.85)
+        ev, secs, top1, top1_std = run_oracle(
+            oracle_comps, args.followers, T, args.q, args.wall_rate,
+            budget_s=args.deadline * 0.85)
         print(json.dumps({"ok": True, "events": ev, "secs": secs,
-                          "top1": top1, "comps": oracle_comps,
+                          "top1": top1, "top1_std": top1_std,
+                          "top1_n": oracle_comps, "comps": oracle_comps,
                           "platform": "cpu"}), flush=True)
         return
 
@@ -368,17 +420,17 @@ def child_main(args) -> None:
 
     log(f"[child {args.as_engine}] devices: {jax.devices()}")
     if args.as_engine == "star":
-        ev, secs, top1, posts = _star_with_retry(args, B, T)
+        ev, secs, top1, top1_std, posts = _star_with_retry(args, B, T)
     elif args.as_engine == "scan":
-        ev, secs, top1, posts = run_jax(B, args.followers, T, args.q,
-                                        args.wall_rate, capacity)
+        ev, secs, top1, top1_std, posts = run_jax(
+            B, args.followers, T, args.q, args.wall_rate, capacity)
     elif args.as_engine == "pallas":
-        ev, secs, top1, posts = run_jax_pallas(B, args.followers, T, args.q,
-                                               args.wall_rate, capacity)
+        ev, secs, top1, top1_std, posts = run_jax_pallas(
+            B, args.followers, T, args.q, args.wall_rate, capacity)
     else:
         raise SystemExit(f"unknown engine {args.as_engine!r}")
     print(json.dumps({"ok": True, "events": ev, "secs": secs, "top1": top1,
-                      "posts": posts,
+                      "top1_std": top1_std, "top1_n": B, "posts": posts,
                       "platform": jax.devices()[0].platform}), flush=True)
 
 
@@ -507,7 +559,7 @@ def parent_main(args) -> None:
             out = _run_child(args, "config", bk, budget)
             if out is not None:
                 out.pop("ok", None)
-                print(json.dumps(out), flush=True)
+                _emit_result_line(out)
                 return
         raise RuntimeError("config bench failed on all backends")
 
@@ -546,6 +598,31 @@ def parent_main(args) -> None:
 
     best = None
 
+    def gate_fields(res):
+        """Quality-gate block for a result line: |engine - oracle| top-1
+        deviation vs a 4-sigma Monte-Carlo tolerance (independent seeds on
+        both sides, so the standard errors add in quadrature). None-valued
+        when there is no oracle (--no-oracle) or a side lacks the stats
+        (scripted test children)."""
+        if o is None:
+            return {"top1": res.get("top1"), "oracle_top1": None,
+                    "gate": None, "gate_tol": None, "gate_ok": None}
+        gate = abs(res["top1"] - o["top1"])
+        tol = None
+        ok = None
+        if all(k in r for r in (o, res) for k in ("top1_std", "top1_n")):
+            se2 = sum((r["top1_std"] ** 2) / max(r["top1_n"], 1)
+                      for r in (o, res))
+            # Floor: with few oracle components the sample std itself is
+            # noisy; 2% of the horizon guards against a degenerate tol=0.
+            tol = max(4.0 * se2 ** 0.5, 0.02 * T)
+            ok = bool(gate <= tol)
+        return {"top1": round(res["top1"], 4),
+                "oracle_top1": round(o["top1"], 4),
+                "gate": round(gate, 4),
+                "gate_tol": round(tol, 4) if tol is not None else None,
+                "gate_ok": ok}
+
     def emit(res, engine_name):
         eps = res["events"] / res["secs"]
         line = {
@@ -553,16 +630,19 @@ def parent_main(args) -> None:
             "value": round(eps, 1),
             "unit": "events/s",
             "vs_baseline": round(eps / o_eps, 2) if o_eps else None,
+            # Self-auditing denominator (round-3 verdict item 6): the
+            # ratio's noisy oracle draw is decomposable by any reader.
+            "oracle_events_per_sec": round(o_eps, 1) if o_eps else None,
             # Self-describing backend: a CPU fallback (wedged TPU tunnel)
             # must never be mistaken for a TPU measurement.
             "platform": res["platform"],
             "engine": engine_name,
         }
-        print(json.dumps(line), flush=True)
+        line.update(gate_fields(res))
+        _emit_result_line(line)
         if o is not None:
-            log(f"quality gate: |jax - numpy| = "
-                f"{abs(res['top1'] - o['top1']):.2f} (MC tolerance; see "
-                f"tests/test_sim_jax.py for the 4-sigma gate)")
+            log(f"quality gate: |jax - numpy| = {line['gate']} "
+                f"(tol {line['gate_tol']}, ok={line['gate_ok']})")
             log(f"speedup vs NumPy path: {eps / o_eps:,.1f}x "
                 f"(north-star target: >=100x)")
 
@@ -623,6 +703,14 @@ def parent_main(args) -> None:
             "all engines failed (see per-engine errors above) — no "
             "benchmark result to report"
         )
+    final = _FINAL["line"]
+    if final is not None and final.get("gate_ok") is False:
+        # The line (with gate_ok:false and both top-1 values) is already on
+        # stdout and in RESULT_FILE; the nonzero exit makes the regression
+        # impossible to miss in any rc-checking harness.
+        log(f"QUALITY GATE FAILED: |engine - oracle| top-1 = "
+            f"{final['gate']} > tol {final['gate_tol']} — exiting 3")
+        raise SystemExit(3)
 
 
 def main():

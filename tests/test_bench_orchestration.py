@@ -8,11 +8,25 @@ replay the observed failure shapes (round 2: tunnel alive at the probe,
 wedged during the engines) without a TPU or subprocesses."""
 
 import json
+import os
+import subprocess
+import sys
 import types
 
 import pytest
 
 import bench  # conftest puts the repo root on sys.path
+
+
+@pytest.fixture(autouse=True)
+def _reset_final_line(tmp_path, monkeypatch):
+    """Each test starts with no remembered best line and a throwaway
+    RESULT_FILE; without this the module-level atexit hook would re-emit a
+    stale line after the pytest session."""
+    monkeypatch.setattr(bench, "RESULT_FILE", str(tmp_path / "result.json"))
+    bench._FINAL["line"] = None
+    yield
+    bench._FINAL["line"] = None
 
 
 def _args(**kw):
@@ -28,12 +42,13 @@ def _args(**kw):
 
 
 ORACLE = {"ok": True, "events": 1000, "secs": 1.0, "top1": 16.0,
-          "comps": 2, "platform": "cpu"}
+          "top1_std": 1.0, "top1_n": 2, "comps": 2, "platform": "cpu"}
 
 
-def _engine_res(platform, eps):
-    return {"ok": True, "events": int(eps), "secs": 1.0, "top1": 16.1,
-            "posts": 50.0, "platform": platform}
+def _engine_res(platform, eps, top1=16.1):
+    return {"ok": True, "events": int(eps), "secs": 1.0, "top1": top1,
+            "top1_std": 1.0, "top1_n": 64, "posts": 50.0,
+            "platform": platform}
 
 
 class Runner:
@@ -159,3 +174,105 @@ def test_default_budget_preserves_cpu_reserve(monkeypatch, rem,
         assert calls[("scan", "default")][0] == pytest.approx(
             expected_scan_budget, abs=5.0
         )
+
+
+# ---------------------------------------------------------------------------
+# Round-3 failure shape + the self-auditing gate (round-3 verdict items 1, 6)
+# ---------------------------------------------------------------------------
+
+
+def test_result_line_is_self_auditing(monkeypatch, capsys):
+    """Every result line carries the oracle denominator and the quality
+    gate (round-3 verdict item 6), and is echoed to RESULT_FILE."""
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000),
+                     ("star", "cpu"): _engine_res("cpu", 800_000)})
+    _patch(monkeypatch, runner, alive=False)
+    bench.parent_main(_args())
+    line = _last_json(capsys)
+    assert line["oracle_events_per_sec"] == pytest.approx(1000.0)
+    assert line["vs_baseline"] == pytest.approx(3000.0)
+    assert line["top1"] == pytest.approx(16.1)
+    assert line["oracle_top1"] == pytest.approx(16.0)
+    assert line["gate"] == pytest.approx(0.1)
+    assert line["gate_ok"] is True
+    with open(bench.RESULT_FILE) as f:
+        assert json.load(f) == bench._FINAL["line"]
+
+
+def test_gate_failure_exits_nonzero_with_line_emitted(monkeypatch, capsys):
+    """A quality regression must still publish its (self-incriminating)
+    line but exit 3 — a regression cannot ship a number silently."""
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000, top1=8.0)})
+    _patch(monkeypatch, runner, alive=False)
+    with pytest.raises(SystemExit) as exc:
+        bench.parent_main(_args(engine="scan"))
+    assert exc.value.code == 3
+    line = _last_json(capsys)
+    assert line["gate_ok"] is False
+    assert line["gate"] == pytest.approx(8.0)
+    assert line["value"] == pytest.approx(3_000_000)
+
+
+def test_no_oracle_line_has_null_gate(monkeypatch, capsys):
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000)})
+    _patch(monkeypatch, runner, alive=False)
+    bench.parent_main(_args(no_oracle=True, engine="scan"))
+    line = _last_json(capsys)
+    assert line["vs_baseline"] is None
+    assert line["oracle_events_per_sec"] is None
+    assert line["gate_ok"] is None
+
+
+def test_merged_stream_tail_parses_under_trailing_stderr(tmp_path):
+    """The r03 failure shape, end to end: the winner's JSON lands first,
+    then a slower engine spews multi-KB stderr (the XLA cpu_aot_loader
+    spam), with more stderr after the sweep returns. The LAST line of the
+    COMBINED stdout+stderr stream — what the driver actually records —
+    must parse as the result (the atexit re-emit contract)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "driver.py"
+    script.write_text(
+        f"""
+import json, sys, types
+sys.path.insert(0, {repo!r})
+import bench
+
+ORACLE = json.loads({json.dumps(ORACLE)!r})
+
+def fake_run_child(args, engine, backend, timeout_s):
+    if engine == "oracle":
+        return dict(ORACLE)
+    if engine == "scan":
+        return {{"ok": True, "events": 3_000_000, "secs": 1.0,
+                 "top1": 16.1, "top1_std": 1.0, "top1_n": 64,
+                 "posts": 50.0, "platform": "cpu"}}
+    # star: the slow loser — lands AFTER the winner's line is on stdout
+    for i in range(120):
+        print(f"E0730 cpu_aot_loader: executable compiled with +amx-bf16 "
+              f"+amx-int8 +prefer-no-gather but host lacks them ({{i}})",
+              file=sys.stderr)
+    return {{"ok": True, "events": 800_000, "secs": 1.0, "top1": 16.1,
+             "top1_std": 1.0, "top1_n": 64, "posts": 50.0,
+             "platform": "cpu"}}
+
+bench.RESULT_FILE = {str(tmp_path / "result.json")!r}
+bench._run_child = fake_run_child
+bench._default_backend_alive = lambda log: False
+args = types.SimpleNamespace(
+    quick=False, cpu=True, tpu=False, broadcasters=64, followers=10,
+    horizon=20.0, capacity=None, q=1.0, wall_rate=1.0, config=None,
+    engine="auto", deadline=900.0, engine_deadline=420.0, no_oracle=False)
+bench.parent_main(args)
+print("late diagnostic after the sweep returned", file=sys.stderr)
+""")
+    r = subprocess.run([sys.executable, str(script)], stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout[-2000:]
+    combined = r.stdout.strip().splitlines()
+    assert len(combined) > 100, "the stderr spam must actually be present"
+    last = json.loads(combined[-1])  # would raise on a diagnostic line
+    assert last["value"] == pytest.approx(3_000_000)
+    assert last["gate_ok"] is True
+    # and the file echo survived too
+    with open(tmp_path / "result.json") as f:
+        assert json.load(f)["value"] == pytest.approx(3_000_000)

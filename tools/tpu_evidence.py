@@ -5,9 +5,9 @@ Runs, in order, each in a deadline-bounded subprocess (a wedged tunnel hangs
 rather than raising — every stage is survivable), writing artifacts as it
 goes so a mid-sequence wedge keeps everything captured so far:
 
-  1. quick headline bench on TPU      -> BENCH_tpu_quick_r03.json
-  2. FULL headline bench on TPU       -> BENCH_tpu_full_r03.json
-  3. Pallas engine on the chip        -> BENCH_tpu_pallas_r03.json
+  1. quick headline bench on TPU      -> BENCH_tpu_quick_r04.json
+  2. FULL headline bench on TPU       -> BENCH_tpu_full_r04.json
+  3. Pallas engine on the chip        -> BENCH_tpu_pallas_r04.json
      (first real Mosaic compile of ops/pallas_chunk.py)
   4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu.json
 
@@ -65,6 +65,7 @@ def run_stage(name, cmd, out_json, deadline_s, log_path):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", type=int, action="append", default=None,
+                    choices=[1, 2, 3, 4],
                     help="run only the given stage(s) (1-4; repeatable, "
                          "in the listed order)")
     ap.add_argument("--deadline", type=float, default=1500.0)
@@ -79,23 +80,23 @@ def main() -> int:
     sweep_budget = 6 * (sweep_cell + 240.0) + 120.0
     stages = [
         (1, "quick", [py, bench, "--quick", "--tpu"],
-         os.path.join(REPO, "BENCH_tpu_quick_r03.json"),
-         os.path.join(REPO, "benchmarks", "tpu_quick_r03.log"),
+         os.path.join(REPO, "BENCH_tpu_quick_r04.json"),
+         os.path.join(REPO, "benchmarks", "tpu_quick_r04.log"),
          args.deadline),
         (2, "full", [py, bench, "--tpu",
                      "--deadline", str(args.deadline - 60)],
-         os.path.join(REPO, "BENCH_tpu_full_r03.json"),
-         os.path.join(REPO, "benchmarks", "tpu_full_r03.log"),
+         os.path.join(REPO, "BENCH_tpu_full_r04.json"),
+         os.path.join(REPO, "benchmarks", "tpu_full_r04.log"),
          args.deadline),
         (3, "pallas", [py, bench, "--tpu", "--engine", "pallas",
                        "--deadline", str(args.deadline - 60)],
-         os.path.join(REPO, "BENCH_tpu_pallas_r03.json"),
-         os.path.join(REPO, "benchmarks", "tpu_pallas_r03.log"),
+         os.path.join(REPO, "BENCH_tpu_pallas_r04.json"),
+         os.path.join(REPO, "benchmarks", "tpu_pallas_r04.log"),
          args.deadline),
         (4, "star-vs-scan", [py, os.path.join(REPO, "tools", "star_vs_scan.py"),
                              "--tpu", "--engine-deadline", str(sweep_cell)],
          None,  # star_vs_scan writes its own artifact (incrementally)
-         os.path.join(REPO, "benchmarks", "tpu_star_vs_scan_r03.log"),
+         os.path.join(REPO, "benchmarks", "tpu_star_vs_scan_r04.log"),
          sweep_budget),
     ]
     any_ok = False
