@@ -36,8 +36,9 @@ from .presets import PRESETS, build_preset, run_preset
 from .sweep import SweepResult, run_sweep, run_sweep_star
 
 # Subpackages re-exported for discoverability. models/ops load eagerly (the
-# driver registers the built-in policies); oracle, parallel, and data stay
-# import-on-use.
+# driver registers the built-in policies), and the sweep re-export above
+# pulls in parallel.bigf/shard at package import too (the price of a
+# flat `redqueen_tpu.run_sweep`); oracle and data stay import-on-use.
 from . import utils  # noqa: F401
 
 __all__ = [

@@ -28,6 +28,7 @@ __all__ = [
     "normalize_traces",
     "pad_traces",
     "bucket_traces",
+    "gaps_from_traces",
     "synthetic_twitter",
     "star_from_traces",
 ]
@@ -127,6 +128,31 @@ def bucket_traces(traces: Traces, edges: Sequence[int] = (16, 64, 256, 1024)
             out.append((idx, padded, ls))
         lo = hi
     return out
+
+
+def gaps_from_traces(traces: Traces, length: Optional[int] = None
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-user inter-event-gap sequences for likelihood training
+    (``models.rmtpp.fit``): ``(taus [U, L], mask [U, L])``, zero-padded
+    with a boolean validity mask.
+
+    The first gap is measured from t=0, matching the simulation kernel's
+    convention (the RMTPP policy's recurrent state starts at the component
+    origin, models/rmtpp.py on_init). Empty traces become all-masked rows."""
+    gaps = [np.diff(t, prepend=0.0) if len(t) else np.empty(0) for t in traces]
+    lens = np.array([len(g) for g in gaps], np.int64)
+    L = int(max(lens.max() if len(lens) else 0, 1)) if length is None else int(length)
+    if lens.max(initial=0) > L:
+        raise ValueError(
+            f"trace with {int(lens.max())} events exceeds requested length "
+            f"{L} — refusing to truncate silently"
+        )
+    taus = np.zeros((len(traces), L), np.float64)
+    mask = np.zeros((len(traces), L), bool)
+    for i, g in enumerate(gaps):
+        taus[i, : len(g)] = g
+        mask[i, : len(g)] = True
+    return taus, mask
 
 
 def synthetic_twitter(seed: int, n_users: int, end_time: float,

@@ -37,6 +37,8 @@ __all__ = [
     "attach",
     "nll_loss",
     "fit",
+    "fit_traces",
+    "calibrate_budget",
     "sequence_nll",
 ]
 
@@ -198,3 +200,118 @@ def fit(key, taus, mask, hidden: int = 16, steps: int = 300,
         weights, opt_state, loss = train_step(weights, opt_state)
         losses.append(float(loss))
     return weights, opt_state, np.asarray(losses)
+
+
+def _per_event_nll(weights, taus, mask, hidden: int) -> float:
+    """Total NLL / total events over a batch — the per-event score two
+    weight sets are comparable on (sequence lengths vary per user)."""
+    per = jax.vmap(lambda t, m: sequence_nll(weights, t, m, hidden))(taus, mask)
+    return float(per.sum() / max(int(mask.sum()), 1))
+
+
+def fit_traces(key, traces, hidden: int = 16, steps: int = 300,
+               lr: float = 1e-2, holdout_frac: float = 0.25):
+    """Fit RMTPP to a posting corpus (list of ascending time arrays, e.g.
+    ``data.traces.synthetic_twitter``) with a held-out split — the
+    learned-broadcasting training loop (BASELINE config 5 / SURVEY.md
+    section 7 step 7).
+
+    Every ``holdout_frac`` fraction of users (every 4th by default, an
+    interleaved split so heavy/light posters land on both sides of the
+    heavy-tailed corpus) is held out of training; the returned ``info``
+    scores BOTH the fitted and the freshly initialized weights on those
+    held-out users, so "training helped" is a measured per-event NLL drop,
+    not an assumption. Returns ``(weights, losses, info)``.
+    """
+    from ..data.traces import gaps_from_traces
+
+    taus, mask = gaps_from_traces(traces)
+    stride = max(int(round(1.0 / max(holdout_frac, 1e-9))), 2)
+    hold = np.zeros(len(traces), bool)
+    hold[::stride] = True
+    if hold.all() or not hold.any():
+        raise ValueError(f"degenerate holdout split for {len(traces)} users")
+    w0 = init_weights(key, hidden)
+    weights, _, losses = fit(key, taus[~hold], mask[~hold], hidden=hidden,
+                             steps=steps, lr=lr, weights=w0)
+    info = {
+        "heldout_nll": _per_event_nll(weights, taus[hold], mask[hold], hidden),
+        "heldout_nll_init": _per_event_nll(w0, taus[hold], mask[hold], hidden),
+        "train_users": int((~hold).sum()),
+        "heldout_users": int(hold.sum()),
+        "heldout_events": int(mask[hold].sum()),
+    }
+    return weights, losses, info
+
+
+def calibrate_budget(weights, target_posts: float, T: float, n_seeds: int = 32,
+                     iters: int = 10, seed0: int = 77_000):
+    """Scale the fitted intensity so the policy's realized posting budget
+    over ``[0, T]`` matches ``target_posts`` (budget-matched comparisons:
+    experiments/compare_policies.py matches every baseline to RedQueen's
+    realized budget, so the learned line must be matched too).
+
+    lambda(tau) = exp(v.h + b + w tau): shifting the head bias ``b``
+    multiplies the intensity while preserving the learned temporal SHAPE.
+    The policy consumes its own gaps, so the realized-posts response to a
+    bias shift is nonlinear and feedback-amplified (a bursty fit maps
+    shorter gaps to still-higher intensity — naive fixed-point iteration
+    on log(target/realized) diverges); it IS monotone in the shift, so the
+    shift is found by geometric bracketing + bisection against one fixed
+    seed set. The policy is open-loop (its law never depends on walls), so
+    a bare one-sink component measures the budget exactly and every eval
+    reuses one compiled kernel."""
+    from ..config import GraphBuilder, stack_components
+    from ..sim import simulate_batch
+    from ..utils.metrics import num_posts as _num_posts
+
+    hidden = weights["v"]["kernel"].shape[0]
+    cap = 1 << max(int(np.ceil(np.log2(max(8.0 * target_posts, 64.0)))), 6)
+    gb = GraphBuilder(n_sinks=1, end_time=T)
+    src = gb.add_rmtpp()
+    cfg, params, adj = gb.build(capacity=min(cap, 4096), rmtpp_hidden=hidden)
+    seeds = np.arange(n_seeds) + seed0  # fixed: realized(shift) deterministic
+
+    def shifted(s):
+        return {**weights, "v": {**weights["v"],
+                                 "bias": weights["v"]["bias"] + s}}
+
+    def realized(s):
+        p_b, a_b = stack_components([attach(params, shifted(s))] * n_seeds,
+                                    [adj] * n_seeds)
+        lg = simulate_batch(cfg, p_b, a_b, seeds)
+        return float(np.asarray(_num_posts(lg.srcs, src)).mean())
+
+    lo, hi = 0.0, 0.0
+    r = realized(0.0)
+    step = 0.5
+    if r < target_posts:
+        while r < target_posts and hi < 8.0:
+            lo, hi = hi, hi + step
+            step *= 2.0
+            r = realized(hi)
+        bracketed = r >= target_posts
+    else:
+        while r > target_posts and lo > -8.0:
+            lo, hi = lo - step, lo
+            step *= 2.0
+            r = realized(lo)
+        bracketed = r <= target_posts
+    if not bracketed:
+        # Bisection onto a clamped endpoint would silently return an
+        # uncalibrated policy — the matched-budget comparison depends on
+        # this, so fail loudly instead.
+        raise ValueError(
+            f"calibrate_budget could not bracket target_posts="
+            f"{target_posts:g} within a +/-8 log-intensity shift "
+            f"(realized {r:g} at the bound) — the fitted intensity is too "
+            f"far from the target budget for a pure scale shift; retrain "
+            f"on a corpus whose mean rate is nearer target_posts/T"
+        )
+    for _ in range(iters):
+        mid = 0.5 * (lo + hi)
+        if realized(mid) < target_posts:
+            lo = mid
+        else:
+            hi = mid
+    return shifted(0.5 * (lo + hi))

@@ -343,10 +343,9 @@ def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset,
 
     sharded = comm.axis_present("feed")
     _check_fire_mode(fire_mode, feed_sharded=sharded)
-    if fire_mode == "auto":
-        use_doubling = (not sharded) and jax.default_backend() != "cpu"
-    else:
-        use_doubling = fire_mode == "doubling"
+    # One policy, one place: entry points resolve 'auto' before keying
+    # their kernel caches; this delegate covers direct _make_kernel users.
+    use_doubling = _resolve_fire_mode(fire_mode, sharded) == "doubling"
 
     if use_doubling:
         own, truncated = _fires_by_doubling(cfg, t_sorted, suffix)
@@ -654,11 +653,25 @@ def _make_kernel(cfg: StarConfig, metric_K: int,
 _FN_CACHE: dict = {}
 
 
+def _resolve_fire_mode(fire_mode: str, feed_sharded: bool) -> str:
+    """Resolve 'auto' to the concrete mode BEFORE any kernel cache is
+    keyed: the choice depends on jax.default_backend(), so caching under
+    the literal 'auto' would reuse a kernel whose loop-vs-doubling
+    decision was made for a different backend after a mid-process platform
+    flip (results stay bit-identical either way; only the measured
+    performance policy would silently be the wrong one)."""
+    if fire_mode != "auto":
+        return fire_mode
+    return ("loop" if feed_sharded or jax.default_backend() == "cpu"
+            else "doubling")
+
+
 def _get_fn(cfg: StarConfig, metric_K: int, mesh: Optional[Mesh], axis: str,
             wall: WallParams, ctrl: CtrlParams, compress: bool = True,
             fire_mode: str = "auto"):
     """Jitted-kernel cache keyed on everything that forces a retrace
     (StarConfig is hashable for exactly this — the sim.py convention)."""
+    fire_mode = _resolve_fire_mode(fire_mode, feed_sharded=mesh is not None)
     cache_key = (cfg, metric_K, mesh, axis, compress, fire_mode,
                  jax.tree.structure((wall, ctrl)))
     fn = _FN_CACHE.get(cache_key)
@@ -959,6 +972,8 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
         )
     _check_fire_mode(fire_mode,
                      feed_sharded=mesh is not None and feed_axis is not None)
+    fire_mode = _resolve_fire_mode(
+        fire_mode, feed_sharded=mesh is not None and feed_axis is not None)
     _check_wall_kinds(cfg, wall)
     if feed_axis is not None and feed_axis != "feed":
         raise ValueError(f"the follower mesh axis must be named 'feed', got "

@@ -13,8 +13,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def test_compare_policies_smoke():
     from experiments.compare_policies import run
 
-    results, budget, T = run(n_seeds=6, F=4, T=40.0, q=0.5, capacity=1024)
-    assert set(results) == {"opt", "poisson", "hawkes", "offline", "replay"}
+    results, budget, T = run(n_seeds=6, F=4, T=40.0, q=0.5, capacity=1024,
+                             rmtpp_steps=40)
+    assert set(results) == {"opt", "poisson", "hawkes", "offline", "replay",
+                            "rmtpp"}
     assert budget > 0
     for name, (top, rank, posts) in results.items():
         assert top.shape == (6,)
@@ -22,6 +24,10 @@ def test_compare_policies_smoke():
         assert np.all(rank >= 0)
     # The headline claim, at matched budget, mean over seeds.
     assert results["opt"][0].mean() > results["poisson"][0].mean()
+    # The learned policy actually posts (weights attached and firing) and
+    # the online optimum still beats the learned open-loop intensity.
+    assert results["rmtpp"][2].mean() > 0
+    assert results["opt"][0].mean() > results["rmtpp"][0].mean()
     # Bursty posting wastes budget on clustered posts: RedQueen beats it too,
     # and the Hawkes budget actually matched.
     assert results["opt"][0].mean() > results["hawkes"][0].mean()
