@@ -1,0 +1,118 @@
+"""tools/tpu_watcher.py capture-loop rules, unit-tested without a TPU.
+
+The watcher is the only path from a minutes-long tunnel-alive window to
+committed TPU evidence (round-3 verdict item 3), so its loop invariants —
+keep probing after a capture that produced no TPU artifact, clean up a
+stale sentinel from a killed run, always remove the sentinel after a
+capture — are pinned here with a monkeypatched prober/capturer."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def watcher(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watcher_under_test",
+        os.path.join(REPO, "tools", "tpu_watcher.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "LOG_MD", str(tmp_path / "probe_log.md"))
+    monkeypatch.setattr(mod, "SENTINEL", str(tmp_path / "sentinel"))
+    monkeypatch.setattr(mod, "CAPTURE_LOG", str(tmp_path / "capture.log"))
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    return mod
+
+
+def _run(watcher, monkeypatch, probes, capture_rcs, argv_extra=()):
+    """Drive main() with scripted probe results and capture rcs."""
+    probes = iter(probes)
+    rcs = iter(capture_rcs)
+    calls = {"probes": 0, "captures": 0}
+
+    def fake_probe(deadline, log=None):
+        calls["probes"] += 1
+        return next(probes)
+
+    def fake_capture(deadline):
+        calls["captures"] += 1
+        return next(rcs)
+
+    import redqueen_tpu.utils.backend as backend
+
+    monkeypatch.setattr(backend, "probe_default_backend", fake_probe)
+    monkeypatch.setattr(watcher, "capture_evidence", fake_capture)
+    monkeypatch.setattr(sys, "argv",
+                        ["tpu_watcher.py", "--max-probes", "4",
+                         "--interval", "0.001"] + list(argv_extra))
+    rc = watcher.main()
+    return rc, calls
+
+
+def test_failed_capture_resumes_probing(watcher, monkeypatch):
+    """The r03-observed shape: tunnel alive at the probe, wedged during
+    the capture (no TPU artifact, rc!=0) — the watcher must keep probing
+    instead of dying for the rest of the round."""
+    rc, calls = _run(
+        watcher, monkeypatch,
+        probes=[(True, 1, "tpu"), (False, 0, ""), (True, 1, "tpu")],
+        capture_rcs=[1, 0])
+    assert rc == 0
+    assert calls["captures"] == 2, "must retry the capture on a later window"
+    assert calls["probes"] == 3
+
+
+def test_successful_capture_exits_zero(watcher, monkeypatch):
+    rc, calls = _run(watcher, monkeypatch,
+                     probes=[(False, 0, ""), (True, 1, "tpu")],
+                     capture_rcs=[0])
+    assert rc == 0 and calls["captures"] == 1
+
+
+def test_all_probes_down_exits_one(watcher, monkeypatch):
+    rc, calls = _run(watcher, monkeypatch,
+                     probes=[(False, 0, "")] * 4, capture_rcs=[])
+    assert rc == 1 and calls["captures"] == 0 and calls["probes"] == 4
+
+
+def test_stale_sentinel_removed_fresh_one_kept(watcher, monkeypatch,
+                                               tmp_path):
+    """A SIGKILLed capture leaves the sentinel behind; anything older than
+    one capture deadline is stale and removed at startup, a fresh one is
+    not (another watcher may genuinely be capturing)."""
+    sent = tmp_path / "sentinel"
+    sent.write_text("old\n")
+    old = os.path.getmtime(sent) - 10_000.0
+    os.utime(sent, (old, old))
+    rc, _ = _run(watcher, monkeypatch, probes=[(False, 0, "")] * 4,
+                 capture_rcs=[], argv_extra=["--capture-deadline", "5400"])
+    assert rc == 1
+    assert not sent.exists(), "stale sentinel must be cleaned up"
+
+    sent.write_text("fresh\n")
+    rc, _ = _run(watcher, monkeypatch, probes=[(False, 0, "")] * 4,
+                 capture_rcs=[])
+    assert sent.exists(), "a fresh sentinel must be left alone"
+
+
+def test_capture_evidence_always_removes_sentinel(watcher, monkeypatch,
+                                                  tmp_path):
+    """The real capture_evidence: sentinel exists during the run, is
+    removed afterwards even when the subprocess times out."""
+    sent = tmp_path / "sentinel"
+    seen = {}
+
+    def fake_run(cmd, timeout, capture_output, text, cwd):
+        seen["sentinel_during"] = sent.exists()
+        raise watcher.subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(watcher.subprocess, "run", fake_run)
+    rc = watcher.capture_evidence(1.0)
+    assert rc == 124
+    assert seen["sentinel_during"] is True
+    assert not sent.exists()
