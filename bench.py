@@ -102,29 +102,16 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def _default_backend_alive(log, deadlines=(90.0, 40.0),
-                           backoff_s: float = 15.0) -> bool:
+def _default_backend_alive(log) -> bool:
     """True iff the default JAX backend (the tunneled TPU here) initializes
-    within a deadline. Probed in a subprocess (shared helper,
-    redqueen_tpu/utils/backend.py) because a wedged tunnel HANGS
-    jax.devices() rather than raising. The tunnel was down for all of rounds
-    1-2 and can recover between hangs, so one failed probe gets one shorter
-    retry — total worst case ~145s, bounded so a dead tunnel can never eat
-    the driver's whole timeout before the CPU fallback runs."""
-    import time as _time
+    within the shared liveness policy's deadlines — the policy itself
+    (probe-in-subprocess, retry, backoff) lives in
+    redqueen_tpu/utils/backend.default_backend_alive so bench and the
+    harness entry points can never disagree about liveness."""
+    from redqueen_tpu.utils.backend import default_backend_alive
 
-    from redqueen_tpu.utils.backend import probe_default_backend
-
-    for attempt, deadline_s in enumerate(deadlines):
-        alive, n, plat = probe_default_backend(deadline_s, log=log)
-        if alive:
-            log(f"default backend alive: {n} x {plat}")
-            return True
-        if attempt + 1 < len(deadlines):
-            log(f"probe attempt {attempt + 1}/{len(deadlines)} failed; "
-                f"retrying in {backoff_s:.0f}s")
-            _time.sleep(backoff_s)
-    return False
+    alive, _, _ = default_backend_alive(log=log)
+    return alive
 
 
 # Timed measurement = best of N identical runs (after one warm-up run that

@@ -42,6 +42,10 @@ def main():
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from redqueen_tpu.utils.backend import ensure_live_backend
+
+        ensure_live_backend(log=log)
     import numpy as np
 
     # Shared shape, chunk-allowance formula, and timing protocol with the

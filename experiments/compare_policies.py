@@ -204,6 +204,10 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from redqueen_tpu.utils.backend import ensure_live_backend
+
+        ensure_live_backend()
 
     results, budget, T = run(args.seeds, args.followers, args.horizon, args.q,
                              rmtpp_ckpt=args.rmtpp_ckpt)

@@ -70,6 +70,10 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from redqueen_tpu.utils.backend import ensure_live_backend
+
+        ensure_live_backend()
 
     budgets, top_o, top_p, _ = run(args.qgrid, args.seeds, args.followers,
                                    args.horizon)

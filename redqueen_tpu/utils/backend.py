@@ -13,9 +13,15 @@ from __future__ import annotations
 
 import subprocess
 import sys
-from typing import Callable, Optional, Tuple
+import time
+from typing import Callable, Optional, Sequence, Tuple
 
-__all__ = ["probe_default_backend", "parse_last_json_line"]
+__all__ = ["probe_default_backend", "parse_last_json_line",
+           "default_backend_alive", "ensure_live_backend"]
+
+
+def _stderr_log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
 
 
 def parse_last_json_line(text: Optional[str], require_ok: bool = False):
@@ -34,6 +40,58 @@ def parse_last_json_line(text: Optional[str], require_ok: bool = False):
         if isinstance(obj, dict) and (not require_ok or obj.get("ok")):
             return obj
     return None
+
+
+def default_backend_alive(
+    log: Optional[Callable] = None,
+    deadlines: Sequence[float] = (90.0, 40.0),
+    backoff_s: float = 15.0,
+) -> Tuple[bool, int, str]:
+    """THE liveness policy for the default backend, shared by bench.py and
+    every harness entry point (one policy, one place — two entry points
+    must never disagree about liveness at the same moment). The tunnel was
+    down for all of rounds 1-2 and can recover between hangs, so one
+    failed probe gets one shorter retry — total worst case ~145s, bounded
+    so a dead tunnel can never eat a driver timeout. Returns the last
+    probe's ``(alive, n_devices, platform)``."""
+    for attempt, deadline_s in enumerate(deadlines):
+        alive, n, plat = probe_default_backend(deadline_s, log=log)
+        if alive:
+            if log:
+                log(f"default backend alive: {n} x {plat}")
+            return True, n, plat
+        if attempt + 1 < len(deadlines):
+            if log:
+                log(f"probe attempt {attempt + 1}/{len(deadlines)} failed; "
+                    f"retrying in {backoff_s:.0f}s")
+            time.sleep(backoff_s)
+    return False, 0, ""
+
+
+def ensure_live_backend(log: Callable = _stderr_log,
+                        deadlines: Sequence[float] = (90.0, 40.0)) -> str:
+    """Probe the DEFAULT jax backend (``default_backend_alive`` policy) and
+    flip this process to CPU when it is down
+    (``jax.config.update("jax_platforms", "cpu")``).
+
+    Every harness/experiment entry point that would otherwise touch the
+    default backend unguarded calls this first: a wedged axon tunnel HANGS
+    ``jax.devices()`` forever, so without the probe a script launched
+    without ``--cpu`` simply never starts (observed: benchmarks/run.py
+    wedged for 20 minutes on one axon-init line). Must run before the
+    first backend touch in the process. Returns the platform that will be
+    used ("cpu" after a fallback) — record it in any artifact the caller
+    writes, so a fallback can never pass as a TPU measurement."""
+    import jax
+
+    alive, n, plat = default_backend_alive(log=log, deadlines=deadlines)
+    if alive:
+        return plat
+    if log:
+        log("default backend did not initialize within the probe deadlines "
+            "(tunnel down/wedged); falling back to CPU")
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
 
 
 def probe_default_backend(

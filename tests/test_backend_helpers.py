@@ -1,0 +1,50 @@
+"""redqueen_tpu.utils.backend helpers: the default-backend liveness probe
+contract shared by bench.py, the watcher, and (new) every harness entry
+point's CPU fallback (a wedged axon tunnel HANGS jax.devices(), so an
+unguarded script never starts)."""
+
+import pytest
+
+from redqueen_tpu.utils import backend
+
+
+def test_parse_last_json_line_basics():
+    text = 'noise\n{"a": 1}\nmore noise\n{"ok": true, "b": 2}\ntrailing'
+    assert backend.parse_last_json_line(text) == {"ok": True, "b": 2}
+    assert backend.parse_last_json_line(text, require_ok=True)["b"] == 2
+    assert backend.parse_last_json_line('{"ok": false}',
+                                        require_ok=True) is None
+    assert backend.parse_last_json_line("") is None
+    assert backend.parse_last_json_line(None) is None
+
+
+def test_ensure_live_backend_alive_no_flip(monkeypatch):
+    calls = []
+    monkeypatch.setattr(backend, "probe_default_backend",
+                        lambda d, log=None: (True, 1, "tpu"))
+
+    import jax
+
+    monkeypatch.setattr(jax.config, "update",
+                        lambda *a: calls.append(a))
+    assert backend.ensure_live_backend() == "tpu"
+    assert calls == [], "an alive backend must not be overridden"
+
+
+def test_ensure_live_backend_dead_flips_to_cpu(monkeypatch):
+    calls = []
+    probes = []
+    monkeypatch.setattr(backend, "probe_default_backend",
+                        lambda d, log=None: probes.append(d) or (False, 0, ""))
+    monkeypatch.setattr(backend.time, "sleep", lambda s: None)
+
+    import jax
+
+    monkeypatch.setattr(jax.config, "update",
+                        lambda *a: calls.append(a))
+    logged = []
+    assert backend.ensure_live_backend(log=logged.append) == "cpu"
+    assert calls == [("jax_platforms", "cpu")]
+    assert any("falling back to CPU" in m for m in logged)
+    # the shared liveness policy: one probe + one shorter retry
+    assert probes == [90.0, 40.0]

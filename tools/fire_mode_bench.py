@@ -77,6 +77,10 @@ def main() -> int:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from redqueen_tpu.utils.backend import ensure_live_backend
+
+        ensure_live_backend()
     platform = jax.devices()[0].platform
     out = args.out or os.path.join(REPO, f"FIRE_MODE_{platform}.json")
     results = {"platform": platform, "timed": "best of "
