@@ -10,6 +10,7 @@ goes so a mid-sequence wedge keeps everything captured so far:
   3. Pallas engine on the chip        -> BENCH_tpu_pallas_r04.json
      (first real Mosaic compile of ops/pallas_chunk.py)
   4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu.json
+  5. fire-mode crossover on TPU       -> FIRE_MODE_tpu_r04.json
 
 Stages that fail/time out are recorded as such and the sequence continues.
 
@@ -65,8 +66,8 @@ def run_stage(name, cmd, out_json, deadline_s, log_path):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", type=int, action="append", default=None,
-                    choices=[1, 2, 3, 4],
-                    help="run only the given stage(s) (1-4; repeatable, "
+                    choices=[1, 2, 3, 4, 5],
+                    help="run only the given stage(s) (1-5; repeatable, "
                          "in the listed order)")
     ap.add_argument("--deadline", type=float, default=1500.0)
     args = ap.parse_args()
@@ -98,6 +99,18 @@ def main() -> int:
          None,  # star_vs_scan writes its own artifact (incrementally)
          os.path.join(REPO, "benchmarks", "tpu_star_vs_scan_r04.log"),
          sweep_budget),
+        # Fire-extraction-mode crossover on the chip: DESIGN.md's
+        # "doubling on accelerators" policy is CPU-measured + argued, not
+        # TPU-measured. The tool writes its artifact incrementally; the
+        # explicit --out keeps a flaked-to-CPU fallback run from
+        # overwriting the committed FIRE_MODE_cpu.json (the artifact's own
+        # platform field says what it measured).
+        (5, "fire-mode", [py, os.path.join(REPO, "tools",
+                                           "fire_mode_bench.py"),
+                          "--out", os.path.join(REPO, "FIRE_MODE_tpu_r04.json")],
+         None,  # fire_mode_bench writes its own artifact (incrementally)
+         os.path.join(REPO, "benchmarks", "tpu_fire_mode_r04.log"),
+         args.deadline),
     ]
     any_ok = False
     by_n = {s[0]: s for s in stages}

@@ -10,11 +10,12 @@ deadline-bounded subprocess (redqueen_tpu.utils.backend.probe_default_backend
 TPU_PROBE_LOG.md, and on the FIRST success immediately launches the full
 evidence capture itself::
 
-    python tools/tpu_evidence.py --stage 2 --stage 3 --stage 4 --stage 1
+    python tools/tpu_evidence.py --stage 2 --stage 3 --stage 4 \
+        --stage 1 --stage 5
 
 Artifacts land incrementally (BENCH_tpu_full_r04.json first — the most
-valuable number — then pallas, star-vs-scan, quick), so a mid-sequence
-wedge keeps everything captured up to that point.  While the capture runs
+valuable number — then pallas, star-vs-scan, quick, fire-mode), so a
+mid-sequence wedge keeps everything captured up to that point.  While the capture runs
 a sentinel file ``.tpu_capture_in_progress`` exists at the repo root so
 the driving session can avoid launching heavy CPU work on this 1-core box
 (host contention distorts on-chip timings ~10x).
@@ -55,7 +56,7 @@ def capture_evidence(total_deadline_s: float) -> int:
     by tpu_evidence.py so even a timeout here keeps completed stages."""
     cmd = [sys.executable, os.path.join(REPO, "tools", "tpu_evidence.py"),
            "--stage", "2", "--stage", "3", "--stage", "4", "--stage", "1",
-           "--deadline", "600"]
+           "--stage", "5", "--deadline", "600"]
     with open(SENTINEL, "w") as f:
         f.write(utcnow() + "\n")
     try:
@@ -86,7 +87,10 @@ def main() -> int:
                     help="minutes between probes")
     ap.add_argument("--max-probes", type=int, default=160)
     ap.add_argument("--probe-deadline", type=float, default=75.0)
-    ap.add_argument("--capture-deadline", type=float, default=5400.0,
+    # Must cover the staged capture's worst case: with --deadline 600 the
+    # stages budget 600*4 + the star-vs-scan sweep's 6*(300+240)+120 =
+    # 5760s; headroom on top so the outer kill can only mean a real hang.
+    ap.add_argument("--capture-deadline", type=float, default=6600.0,
                     help="total seconds allowed for the staged capture")
     args = ap.parse_args()
 
