@@ -13,7 +13,6 @@ import os
 import re
 
 import numpy as np
-import pytest
 
 TUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "docs", "TUTORIAL.md")
