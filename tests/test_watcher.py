@@ -103,15 +103,18 @@ def test_stale_sentinel_removed_fresh_one_kept(watcher, monkeypatch,
 def test_capture_evidence_always_removes_sentinel(watcher, monkeypatch,
                                                   tmp_path):
     """The real capture_evidence: sentinel exists during the run, is
-    removed afterwards even when the subprocess times out."""
+    removed afterwards even when the subprocess times out (run_logged
+    reports a timeout as rc=124)."""
+    import proc_util  # tools/ is on sys.path once the watcher module loads
+
     sent = tmp_path / "sentinel"
     seen = {}
 
     def fake_run(cmd, timeout, capture_output, text, cwd):
         seen["sentinel_during"] = sent.exists()
-        raise watcher.subprocess.TimeoutExpired(cmd, timeout)
+        raise proc_util.subprocess.TimeoutExpired(cmd, timeout)
 
-    monkeypatch.setattr(watcher.subprocess, "run", fake_run)
+    monkeypatch.setattr(proc_util.subprocess, "run", fake_run)
     rc = watcher.capture_evidence(1.0)
     assert rc == 124
     assert seen["sentinel_during"] is True

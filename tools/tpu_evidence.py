@@ -22,33 +22,26 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(REPO, "tools")
+if _TOOLS not in sys.path:  # proc_util when loaded by path
+    sys.path.insert(0, _TOOLS)
+
+from proc_util import run_logged  # noqa: E402
 
 
 def run_stage(name, cmd, out_json, deadline_s, log_path):
     print(f"== stage {name}: {' '.join(cmd)} (deadline {deadline_s:.0f}s)",
           flush=True)
-    t0 = time.monotonic()
-    try:
-        r = subprocess.run(cmd, timeout=deadline_s, capture_output=True,
-                           text=True, cwd=REPO)
-        rc, out, err = r.returncode, r.stdout or "", r.stderr or ""
-    except subprocess.TimeoutExpired as e:
-        # Keep whatever stdout the child printed BEFORE the kill: bench.py's
-        # whole protocol is that an already-printed result line survives.
-        def _s(x):
-            return x.decode(errors="replace") if isinstance(x, bytes) else (x or "")
-        rc, out, err = 124, _s(e.stdout), _s(e.stderr)
-    wall = time.monotonic() - t0
-    with open(log_path, "w") as f:
-        f.write(f"$ {' '.join(cmd)}\nrc={rc} wall={wall:.1f}s\n"
-                f"--- stdout ---\n{out}\n--- stderr ---\n{err}\n")
+    # run_logged keeps whatever stdout the child printed BEFORE a timeout
+    # kill: bench.py's whole protocol is that an already-printed result
+    # line survives.
+    rc, out, err, wall = run_logged(cmd, deadline_s, log_path, cwd=REPO)
 
-    sys.path.insert(0, REPO)
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
     from redqueen_tpu.utils.backend import parse_last_json_line
 
     parsed = parse_last_json_line(out)

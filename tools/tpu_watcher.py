@@ -32,11 +32,13 @@ from __future__ import annotations
 import argparse
 import datetime as _dt
 import os
-import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(REPO, "tools")
+if _TOOLS not in sys.path:  # proc_util when loaded by path
+    sys.path.insert(0, _TOOLS)
 LOG_MD = os.path.join(REPO, "TPU_PROBE_LOG.md")
 SENTINEL = os.path.join(REPO, ".tpu_capture_in_progress")
 CAPTURE_LOG = os.path.join(REPO, "benchmarks", "tpu_capture_r04.log")
@@ -54,28 +56,21 @@ def append_log(line: str) -> None:
 def capture_evidence(total_deadline_s: float) -> int:
     """Run the staged evidence capture; artifacts are written incrementally
     by tpu_evidence.py so even a timeout here keeps completed stages."""
+    from proc_util import run_logged
+
     cmd = [sys.executable, os.path.join(REPO, "tools", "tpu_evidence.py"),
            "--stage", "2", "--stage", "3", "--stage", "4", "--stage", "1",
            "--stage", "5", "--deadline", "600"]
     with open(SENTINEL, "w") as f:
         f.write(utcnow() + "\n")
     try:
-        r = subprocess.run(cmd, timeout=total_deadline_s,
-                           capture_output=True, text=True, cwd=REPO)
-        rc, out, err = r.returncode, r.stdout or "", r.stderr or ""
-    except subprocess.TimeoutExpired as e:
-        def _s(x):
-            return (x.decode(errors="replace") if isinstance(x, bytes)
-                    else (x or ""))
-        rc, out, err = 124, _s(e.stdout), _s(e.stderr)
+        rc, _, _, _ = run_logged(cmd, total_deadline_s, CAPTURE_LOG,
+                                 cwd=REPO)
     finally:
         try:
             os.remove(SENTINEL)
         except OSError:
             pass
-    with open(CAPTURE_LOG, "w") as f:
-        f.write(f"$ {' '.join(cmd)}\nrc={rc}\n--- stdout ---\n{out}\n"
-                f"--- stderr ---\n{err}\n")
     append_log(f"| {utcnow()} | evidence capture finished rc={rc} "
                f"(stage log: {CAPTURE_LOG}) |")
     return rc
@@ -94,7 +89,8 @@ def main() -> int:
                     help="total seconds allowed for the staged capture")
     args = ap.parse_args()
 
-    sys.path.insert(0, REPO)
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
     from redqueen_tpu.utils.backend import probe_default_backend
 
     # A SIGKILLed previous capture can leave the sentinel behind (finally
