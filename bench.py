@@ -81,17 +81,20 @@ def _emit_result_line(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-@atexit.register
-def _reemit_final_line() -> None:
-    # Runs after normal return AND after an unhandled exception's traceback
-    # has been printed. Flush stderr first so no diagnostic can interleave
-    # after the result on the merged stream.
+def _reprint_best() -> None:
+    """Re-print the standing best line (no file rewrite) so the merged
+    stream's tail returns to valid JSON after interleaved diagnostics."""
     line = _FINAL["line"]
     if line is None:
         return
     sys.stderr.flush()
     sys.stdout.write(json.dumps(line) + "\n")
     sys.stdout.flush()
+
+
+# Runs after normal return AND after an unhandled exception's traceback has
+# been printed — the merged stream's literal last output is the result.
+atexit.register(_reprint_best)
 
 # Engine children inherit this through os.environ (the parent itself never
 # imports jax); see _jax_cache.py for the one definition of the policy.
@@ -655,19 +658,24 @@ def parent_main(args) -> None:
                         f"the CPU fallback reserve")
                     break
             res = _run_child(args, name, bk, budget)
-            if res is None:
-                continue
-            any_ok = True
-            eps = res["events"] / res["secs"]
-            log(f"engine {name}: {res['events']} events in "
-                f"{res['secs']:.3f}s -> {eps:,.0f} events/s")
             # Print a COMPLETE result line as soon as the first engine
-            # lands, and again only when a later engine beats it — the last
+            # lands, and again when a later engine beats it — the last
             # line on stdout is always the best known result, and a later
-            # hang can no longer zero the round.
-            if best is None or eps > best["events"] / best["secs"]:
-                best = res
-                emit(res, name)
+            # hang can no longer zero the round. Every OTHER outcome
+            # (failed child, slower engine) re-prints the standing best:
+            # each child relays stderr above, and atexit covers normal
+            # exit but not a SIGKILL between engines, so the JSON-last
+            # invariant is restored after every iteration.
+            if res is not None:
+                any_ok = True
+                eps = res["events"] / res["secs"]
+                log(f"engine {name}: {res['events']} events in "
+                    f"{res['secs']:.3f}s -> {eps:,.0f} events/s")
+                if best is None or eps > best["events"] / best["secs"]:
+                    best = res
+                    emit(res, name)
+                    continue
+            _reprint_best()
         return any_ok
 
     ok = sweep(backend)

@@ -223,6 +223,25 @@ def test_no_oracle_line_has_null_gate(monkeypatch, capsys):
     assert line["gate_ok"] is None
 
 
+@pytest.mark.parametrize("star_res", [None, "slower"],
+                         ids=["failed-engine", "slower-engine"])
+def test_best_line_reprinted_after_every_engine(monkeypatch, capsys,
+                                                star_res):
+    """Between the early emit and process exit the tail must stay JSON:
+    after EACH later engine — failed OR merely slower — the standing best
+    line is re-printed, so even a SIGKILL between engines (which skips
+    atexit) leaves a parseable tail."""
+    star = None if star_res is None else _engine_res("cpu", 800_000)
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000),
+                     ("star", "cpu"): star})
+    _patch(monkeypatch, runner, alive=False)
+    bench.parent_main(_args())
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert json.loads(out[-1])["value"] == pytest.approx(3_000_000)
+    # best emitted once for scan, re-printed once after the star outcome
+    assert len([ln for ln in out if ln.startswith("{")]) == 2
+
+
 def test_merged_stream_tail_parses_under_trailing_stderr(tmp_path):
     """The r03 failure shape, end to end: the winner's JSON lands first,
     then a slower engine spews multi-KB stderr (the XLA cpu_aot_loader
