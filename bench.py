@@ -125,6 +125,18 @@ def _default_backend_alive(log) -> bool:
 TIMED_REPS = 3
 
 
+def _more_reps_fit(best_secs: float, deadline_abs) -> bool:
+    """False when the next timed rep (≈ the best observed rep, +15%
+    headroom) would overrun the child's absolute deadline. The first rep
+    always runs — one rep is the irreducible result. The engine-side twin
+    of run_oracle's rep rule: on an unknown-speed backend (the first TPU
+    full-shape run) warm-up + 3 reps can overrun the subprocess deadline,
+    and a killed child reports NOTHING — fewer reps beat no result."""
+    if deadline_abs is None or not np.isfinite(best_secs):
+        return True
+    return time.monotonic() + 1.15 * best_secs <= deadline_abs
+
+
 def build_component(n_followers: int, T: float, q: float, wall_rate: float,
                     capacity: int):
     from redqueen_tpu.config import GraphBuilder
@@ -138,7 +150,8 @@ def build_component(n_followers: int, T: float, q: float, wall_rate: float,
 
 
 def run_jax_star(B: int, n_followers: int, T: float, q: float,
-                 wall_rate: float, wall_cap: int, post_cap: int):
+                 wall_rate: float, wall_cap: int, post_cap: int,
+                 deadline_abs=None):
     """Headline graph on the loop-free star-batch engine: each broadcaster
     component is (1 Opt vs n_followers Poisson walls); the 10k-lane batch is
     one vmap — streams + sort + suffix-min, no per-event loop at all."""
@@ -161,6 +174,9 @@ def run_jax_star(B: int, n_followers: int, T: float, q: float,
     warm = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B))
     secs = np.inf
     for _ in range(TIMED_REPS):  # best-of-N: see TIMED_REPS note
+        if not _more_reps_fit(secs, deadline_abs):
+            log("stopping timed reps early: child deadline")
+            break
         t0 = time.perf_counter()
         res = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B) + 10_000)
         secs = min(secs, time.perf_counter() - t0)  # block_until_ready inside
@@ -195,11 +211,12 @@ def _slab_size(B: int, target: int) -> int:
 
 
 def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
-                          q: float, wall_rate: float, capacity: int):
+                          q: float, wall_rate: float, capacity: int,
+                          deadline_abs=None):
     """Shared harness for engines with the EventLog contract: build the
     component batch, one warm-up run (compilation), timed best-of-N over
-    the (possibly slabbed) batch, metrics.
-    ``simulate_fn(cfg, params, adj, seeds)`` -> EventLog."""
+    the (possibly slabbed) batch (budget-aware — see _more_reps_fit),
+    metrics. ``simulate_fn(cfg, params, adj, seeds)`` -> EventLog."""
     import jax
     from redqueen_tpu.config import stack_components
     from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
@@ -214,6 +231,9 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     jax.block_until_ready(warm.times)
     secs = np.inf
     for _ in range(TIMED_REPS):  # best-of-N: see TIMED_REPS note
+        if not _more_reps_fit(secs, deadline_abs):
+            log("stopping timed reps early: child deadline")
+            break
         logs = []
         t0 = time.perf_counter()
         for s0 in range(0, B, slab):
@@ -254,7 +274,7 @@ def _sync_every() -> int:
 
 
 def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
-                   wall_rate: float, capacity: int):
+                   wall_rate: float, capacity: int, deadline_abs=None):
     """Headline graph on the Pallas event-scan engine: the whole chunk is one
     fused kernel with state resident in VMEM (ops/pallas_chunk.py). TPU
     only — interpret mode exists for tests, not timing."""
@@ -264,18 +284,20 @@ def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
     sync = _sync_every()
     fn = lambda cfg, p, a, s: simulate_pallas(cfg, p, a, s, max_chunks=mc,
                                               sync_every=sync)
-    return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate, capacity)
+    return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate,
+                                 capacity, deadline_abs)
 
 
 def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
-            capacity: int):
+            capacity: int, deadline_abs=None):
     from redqueen_tpu.sim import simulate_batch
 
     mc = _max_chunks(n_followers, T, wall_rate, capacity)
     sync = _sync_every()
     fn = lambda cfg, p, a, s: simulate_batch(cfg, p, a, s, max_chunks=mc,
                                              sync_every=sync)
-    return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate, capacity)
+    return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate,
+                                 capacity, deadline_abs)
 
 
 def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
@@ -287,14 +309,15 @@ def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
     # same-estimator quantities, or load noise in a single oracle draw
     # biases the headline speedup (each rep replays identical seeds, so
     # events/tops are identical across reps). Reps stop when the NEXT pass
-    # would overrun ``budget_s`` — the caller passes its own subprocess
-    # deadline (scaled down) so the rep loop can never blow it: mid-size
-    # --followers (per-event cost is O(sources)) drop to fewer reps or one,
-    # where transient load noise is amortized across the long pass anyway.
+    # would overrun ``budget_s`` (the shared _more_reps_fit rule) — the
+    # caller passes its own subprocess deadline (scaled down) so the rep
+    # loop can never blow it: mid-size --followers (per-event cost is
+    # O(sources)) drop to fewer reps or one, where transient load noise is
+    # amortized across the long pass anyway.
+    deadline_abs = time.monotonic() + budget_s
     secs = np.inf
-    spent = 0.0
     for _ in range(TIMED_REPS):
-        if np.isfinite(secs) and spent + 1.15 * secs > budget_s:
+        if not _more_reps_fit(secs, deadline_abs):
             break
         events = 0
         tops = []
@@ -315,7 +338,6 @@ def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
                 mp.time_in_top_k(df, 1, T, src_id=0, sink_ids=so.sink_ids)
             )
         took = time.perf_counter() - t0
-        spent += took
         secs = min(secs, took)
     return events, secs, float(np.mean(tops)), float(np.std(tops))
 
@@ -344,7 +366,7 @@ def _shapes(args):
     return B, T, capacity, oracle_comps
 
 
-def _star_with_retry(args, B, T, post_cap_mult: int = 1):
+def _star_with_retry(args, B, T, post_cap_mult: int = 1, deadline_abs=None):
     # Capacity: Poisson(rate*T) wall events per feed; mean + 9 sigma
     # headroom rounded up so 100k+ streams cannot overflow.
     mean_w = args.wall_rate * T
@@ -360,12 +382,14 @@ def _star_with_retry(args, B, T, post_cap_mult: int = 1):
     try:
         return run_jax_star(
             B, args.followers, T, args.q, args.wall_rate, wall_cap, post_cap,
+            deadline_abs=deadline_abs,
         )
     except RuntimeError as e:
         if "post_cap" in str(e) and post_cap_mult <= 8:
             log(f"star engine overflowed post_cap={post_cap}; retrying "
                 f"with a doubled cap")
-            return _star_with_retry(args, B, T, post_cap_mult * 2)
+            return _star_with_retry(args, B, T, post_cap_mult * 2,
+                                    deadline_abs=deadline_abs)
         raise
 
 
@@ -409,14 +433,21 @@ def child_main(args) -> None:
         return
 
     log(f"[child {args.as_engine}] devices: {jax.devices()}")
+    # Absolute rep-loop deadline: 92% of the child's subprocess timeout
+    # (measured from process start — build/compile time counts), leaving
+    # headroom for the metrics pass + the final print.
+    deadline_abs = _START + args.deadline * 0.92
     if args.as_engine == "star":
-        ev, secs, top1, top1_std, posts = _star_with_retry(args, B, T)
+        ev, secs, top1, top1_std, posts = _star_with_retry(
+            args, B, T, deadline_abs=deadline_abs)
     elif args.as_engine == "scan":
         ev, secs, top1, top1_std, posts = run_jax(
-            B, args.followers, T, args.q, args.wall_rate, capacity)
+            B, args.followers, T, args.q, args.wall_rate, capacity,
+            deadline_abs=deadline_abs)
     elif args.as_engine == "pallas":
         ev, secs, top1, top1_std, posts = run_jax_pallas(
-            B, args.followers, T, args.q, args.wall_rate, capacity)
+            B, args.followers, T, args.q, args.wall_rate, capacity,
+            deadline_abs=deadline_abs)
     else:
         raise SystemExit(f"unknown engine {args.as_engine!r}")
     print(json.dumps({"ok": True, "events": ev, "secs": secs, "top1": top1,

@@ -242,6 +242,21 @@ def test_best_line_reprinted_after_every_engine(monkeypatch, capsys,
     assert len([ln for ln in out if ln.startswith("{")]) == 2
 
 
+def test_more_reps_fit_rule():
+    """The engine-side rep-budget rule: first rep always runs; later reps
+    only when ~one more best-observed rep (+15%) fits the deadline."""
+    import time
+
+    now = time.monotonic()
+    assert bench._more_reps_fit(float("inf"), None)
+    assert bench._more_reps_fit(float("inf"), now)  # first rep always runs
+    assert bench._more_reps_fit(10.0, None)          # no deadline: no limit
+    assert bench._more_reps_fit(10.0, now + 100.0)
+    assert not bench._more_reps_fit(10.0, now + 5.0)
+    # the 15% headroom: a rep that exactly fits without margin is refused
+    assert not bench._more_reps_fit(10.0, now + 10.5)
+
+
 def test_merged_stream_tail_parses_under_trailing_stderr(tmp_path):
     """The r03 failure shape, end to end: the winner's JSON lands first,
     then a slower engine spews multi-KB stderr (the XLA cpu_aot_loader
