@@ -160,6 +160,19 @@ def _as_key(seed: Union[int, jnp.ndarray]):
     return seed
 
 
+def _host_view(x) -> np.ndarray:
+    """NumPy view of ``x`` for host-side validation. An array sharded over
+    MULTIPLE PROCESSES (multihost runs) cannot be materialized whole; its
+    locally-addressable shards are enough — every process validates the
+    rows it owns, which collectively covers all of them (the SPMD
+    contract; exercised by tests/test_multihost.py)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return np.concatenate(
+            [np.asarray(s.data).reshape(-1) for s in x.addressable_shards]
+        )
+    return np.asarray(x)
+
+
 def _check_kinds(cfg: SimConfig, params: SourceParams):
     """A specialized config compiles switch branches only for
     cfg.present_kinds; a params row of any other kind would be silently
@@ -167,7 +180,7 @@ def _check_kinds(cfg: SimConfig, params: SourceParams):
     if not cfg.present_kinds:
         return
     present = set(cfg.present_kinds)
-    got = set(int(k) for k in np.unique(np.asarray(params.kind)))
+    got = set(int(k) for k in np.unique(_host_view(params.kind)))
     if not got.issubset(present):
         raise ValueError(
             f"params contain source kinds {sorted(got - present)} not in the "
@@ -181,7 +194,7 @@ def _check_weights(cfg: SimConfig, params: SourceParams):
     size matches the config's recurrent-state slot; catch both misuses
     host-side with clear messages instead of a never-firing source or a
     flax shape error deep in the scan."""
-    if not np.any(np.asarray(params.kind) == base.KIND_RMTPP):
+    if not np.any(_host_view(params.kind) == base.KIND_RMTPP):
         return
     if params.rmtpp is None:
         raise ValueError(
@@ -190,7 +203,9 @@ def _check_weights(cfg: SimConfig, params: SourceParams):
         )
     w = params.rmtpp
     try:
-        hidden = int(np.asarray(w["v"]["kernel"]).shape[-2])
+        # np.shape reads metadata only — no materialization, so this stays
+        # valid for weights sharded across processes
+        hidden = int(np.shape(w["v"]["kernel"])[-2])
     except (KeyError, TypeError, IndexError):
         return  # unexpected weight layout; let tracing report it
     if hidden != cfg.rmtpp_hidden:
@@ -199,6 +214,12 @@ def _check_weights(cfg: SimConfig, params: SourceParams):
             f"with rmtpp_hidden={cfg.rmtpp_hidden}; pass "
             f"GraphBuilder.build(rmtpp_hidden={hidden})"
         )
+
+
+@jax.jit
+def _sync_reduce(c, alive):
+    """Global (chunks-executed max, any-lane-alive) as replicated scalars."""
+    return jnp.max(c), jnp.any(alive)
 
 
 def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
@@ -228,8 +249,13 @@ def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
         )
         k = sync_every
         # The ONE host sync per superchunk: chunks executed + liveness.
-        c_max = int(np.max(np.asarray(c)))
-        alive_any = bool(np.any(np.asarray(alive)))
+        # Reduced to REPLICATED scalars on-device first: a fully-replicated
+        # value is readable on every process, so the same driver serves
+        # multihost runs (where the [B] lanes span processes and could not
+        # be fetched whole) — and only two scalars cross to the host.
+        c_max_dev, alive_dev = _sync_reduce(c, alive)
+        c_max = int(c_max_dev)
+        alive_any = bool(alive_dev)
         # Trim unused chunk slots so the returned buffers are bit-identical
         # to the per-chunk driver's (goldens/parity unchanged).
         times_chunks.append(t_sc[..., : c_max * cap])
@@ -238,7 +264,7 @@ def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
         if not alive_any:
             break
         if n_chunks >= max_chunks:
-            done = np.asarray(state.n_events)
+            done = _host_view(state.n_events)
             raise RuntimeError(
                 f"simulation still active after {n_chunks} chunks of "
                 f"{cfg.capacity} events (events so far: {done}); raise "

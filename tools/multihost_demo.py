@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Worker program for the multi-host integration test (one REAL process of
+an N-process coordinated run).
+
+Each instance: joins the run through ``parallel.multihost.initialize``,
+builds the process-aligned global mesh (``{"dcn": n_proc, "data": local}``),
+runs the sharded simulation over ``("dcn", "data")`` — the exact multi-slice
+layout the driver dryrun compiles single-process — gathers the global event
+log with one cross-host all-gather, and (process 0 only) writes the result
+as JSON for the spawning test to compare bit-for-bit against a
+single-process run of the same mesh shape.
+
+Run by ``tests/test_multihost.py``; standalone:
+
+    python tools/multihost_demo.py --coordinator localhost:9876 \
+        --num-procs 2 --proc-id 0 --local-devices 4 --out /tmp/p0.json &
+    python tools/multihost_demo.py --coordinator localhost:9876 \
+        --num-procs 2 --proc-id 1 --local-devices 4 --out /tmp/p1.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--num-procs", type=int, required=True)
+    ap.add_argument("--proc-id", type=int, required=True)
+    ap.add_argument("--local-devices", type=int, default=4)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    # Virtual CPU devices must be forced before jax import; the axon TPU
+    # plugin ignores JAX_PLATFORMS, so also pin the platform via config
+    # (same dance as tests/conftest.py).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={args.local_devices}"
+        ).strip()
+    import _jax_cache
+    _jax_cache.enable_persistent_cache()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from redqueen_tpu.parallel import multihost
+
+    pid, nproc = multihost.initialize(
+        coordinator=args.coordinator,
+        num_processes=args.num_procs,
+        process_id=args.proc_id,
+    )
+    assert nproc == args.num_procs, (pid, nproc)
+
+    import numpy as np
+    from redqueen_tpu.config import GraphBuilder, stack_components
+    from redqueen_tpu.parallel.shard import simulate_sharded
+    from redqueen_tpu.utils.metrics import feed_metrics_batch
+
+    n, T, q = 4, 60.0, 1.0
+    gb = GraphBuilder(n_sinks=n, end_time=T)
+    opt = gb.add_opt(q=q)
+    for i in range(n):
+        gb.add_poisson(rate=1.0, sinks=[i])
+    cfg, p0, a0 = gb.build(capacity=1024)
+
+    B = 16
+    params, adj = stack_components([p0] * B, [a0] * B)
+    seeds = np.arange(B)
+
+    mesh = multihost.process_mesh({"data": -1})
+    log = simulate_sharded(cfg, params, adj, seeds, mesh,
+                           axis=("dcn", "data"))
+
+    adj_b = np.broadcast_to(np.asarray(a0), (B,) + np.asarray(a0).shape)
+    with mesh:
+        m = feed_metrics_batch(log.times, log.srcs, adj_b, opt, T)
+        top1 = m.mean_time_in_top_k()
+
+    gathered = multihost.gather_global(
+        {"times": log.times, "srcs": log.srcs, "top1": top1}
+    )
+    summary = multihost.process_summary()
+    t64 = np.asarray(gathered["times"], np.float64)
+    summary.update(
+        mesh_shape={k: int(v) for k, v in mesh.shape.items()},
+        # finite entries only: the +inf pad tail would turn the checksum
+        # into a vacuous inf == inf comparison
+        times_sum=float(t64[np.isfinite(t64)].sum()),
+        srcs_sum=int(np.asarray(gathered["srcs"], np.int64).sum()),
+        top1_mean=float(np.asarray(gathered["top1"]).mean()),
+        times_shape=list(gathered["times"].shape),
+    )
+    if pid == 0:
+        with open(args.out, "w") as f:
+            json.dump(summary, f)
+    print(f"[proc {pid}/{nproc}] OK: {summary}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
