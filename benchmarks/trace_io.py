@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Trace-ingestion benchmark: native C++ loader vs the pure-Python path.
+
+Generates a synthetic (user, time) CSV corpus of ``--rows`` rows (the
+shape of the reference's Twitter input), then times
+``data.traces.load_csv`` with ``engine="python"`` and ``engine="native"``
+on the same file and verifies the outputs are identical before reporting.
+
+Writes one JSON artifact (``--out``) with rows/sec and MB/sec per engine
+and the native speedup — the data-loader analogue of the simulation
+bench's oracle-vs-engine decomposition.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from redqueen_tpu.data import traces  # noqa: E402
+from redqueen_tpu.native import loader  # noqa: E402
+
+
+def make_corpus(path: str, rows: int, users: int, seed: int = 0) -> None:
+    rng = np.random.RandomState(seed)
+    uid = rng.randint(0, users, rows)
+    t = rng.uniform(0, 1e6, rows)
+    with open(path, "w") as f:
+        f.write("user,time\n")
+        for i in range(rows):
+            f.write(f"u{uid[i]},{t[i]:.6f}\n")
+
+
+def timed(fn, reps: int):
+    best = float("inf")
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return out, best
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--users", type=int, default=50_000)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default=os.path.join(REPO, "benchmarks",
+                                                  "trace_io.json"))
+    args = ap.parse_args()
+
+    if not loader.available():
+        print("native loader unavailable on this machine", file=sys.stderr)
+        return 1
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "corpus.csv")
+        make_corpus(path, args.rows, args.users)
+        size_mb = os.path.getsize(path) / 1e6
+
+        py, t_py = timed(
+            lambda: traces.load_csv(path, engine="python"), args.reps
+        )
+        nat, t_nat = timed(
+            lambda: traces.load_csv(path, engine="native"), args.reps
+        )
+
+    assert len(py) == len(nat)
+    for a, b in zip(py, nat):
+        np.testing.assert_array_equal(a, b)
+
+    result = {
+        "metric": f"trace CSV ingestion ({args.rows} rows, "
+                  f"{args.users} users, {size_mb:.1f} MB)",
+        "python_rows_per_sec": round(args.rows / t_py, 1),
+        "native_rows_per_sec": round(args.rows / t_nat, 1),
+        "python_mb_per_sec": round(size_mb / t_py, 2),
+        "native_mb_per_sec": round(size_mb / t_nat, 2),
+        "native_speedup": round(t_py / t_nat, 2),
+        "outputs_identical": True,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,11 +37,35 @@ Traces = List[np.ndarray]  # one ascending float64 time array per user
 
 
 def load_csv(path: str, user_col: int = 0, time_col: int = 1,
-             delimiter: str = ",", skip_header: int = 1) -> Traces:
+             delimiter: str = ",", skip_header: int = 1,
+             engine: str = "auto") -> Traces:
     """Load (user, timestamp) rows into per-user ascending time arrays.
 
     Users are ordered by first appearance; times sort per user. This is the
-    rebuild's loader for the reference's Twitter-trace input format."""
+    rebuild's loader for the reference's Twitter-trace input format.
+
+    ``engine``: ``"auto"`` uses the native C++ parser
+    (redqueen_tpu.native.loader, ~an order of magnitude faster at
+    million-row corpora — benchmarks/trace_io.py) when it builds on this
+    machine and falls back to pure Python otherwise; ``"native"`` requires
+    it; ``"python"`` forces the interpreter path. Both engines produce
+    identical output (pinned by tests/test_native_loader.py)."""
+    if engine not in ("auto", "native", "python"):
+        raise ValueError(f"unknown engine {engine!r}")
+    # Arguments only the Python path supports (multi-char or non-ASCII
+    # delimiters, negative column indices) keep "auto" on the Python path;
+    # "native" means the caller requires the C++ parser, so let it reject
+    # them. The delimiter crosses the C ABI as ONE byte, hence encode().
+    native_ok = (len(delimiter.encode()) == 1
+                 and user_col >= 0 and time_col >= 0)
+    if engine == "native" or (engine == "auto" and native_ok):
+        from ..native import loader as _native
+
+        if engine == "native" or _native.available():
+            return _native.load_csv_native(
+                path, user_col=user_col, time_col=time_col,
+                delimiter=delimiter, skip_header=skip_header,
+            )
     users: Dict = {}
     order: List = []
     with open(path) as f:

@@ -1,0 +1,7 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+Import-on-use only — nothing here runs a compiler at package import. See
+``loader`` for the trace-ingestion component and build machinery.
+"""
+
+from .loader import NativeBuildError, available, load_csv_native  # noqa: F401

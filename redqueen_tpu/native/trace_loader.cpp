@@ -1,0 +1,210 @@
+// Native trace ingestion: the framework's C++ data-loader component.
+//
+// The reference feeds Twitter traces to its RealData broadcaster from
+// Python (SURVEY.md section 2 item 7); at the rebuild's target scale
+// (100k+ users, millions of rows) the pure-Python CSV path in
+// redqueen_tpu/data/traces.py::load_csv is minutes of interpreter loop
+// before the first device step. This file is the same contract --
+// (user, timestamp) rows -> per-user ascending time arrays, users ordered
+// by first appearance -- parsed natively. Python binds it with ctypes
+// (redqueen_tpu/native/loader.py); semantics are pinned row-for-row
+// against the Python loader by tests/test_native_loader.py.
+//
+// Deliberate C ABI (no pybind11 in this environment): an opaque handle
+// carries the parse result; the caller sizes NumPy buffers from
+// rq_n_users/rq_total_events and rq_fill copies into them; rq_free
+// releases. Every error path reports through errbuf -- no exceptions
+// cross the boundary.
+
+#include <algorithm>
+#include <cctype>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <locale.h>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct ParseResult {
+  std::vector<std::vector<double>> per_user;  // first-appearance order
+};
+
+void set_err(char* errbuf, int errlen, const std::string& msg) {
+  if (errbuf && errlen > 0) {
+    std::snprintf(errbuf, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+// Mirror of Python "not line.strip()": every char is whitespace.
+bool is_blank(const char* s, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    if (!std::isspace(static_cast<unsigned char>(s[i]))) return false;
+  }
+  return true;
+}
+
+locale_t c_locale() {
+  static locale_t loc = ::newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  return loc;
+}
+
+// Mirror of Python float(field): optional surrounding whitespace, ASCII
+// digit-separating underscores allowed, the full field must be consumed;
+// empty/invalid -> error (returns false). strtod's EXTRA envelope is
+// rejected explicitly -- hex literals ("0x10") and "nan(chars)" are valid
+// strtod input but ValueError in Python -- and parsing runs under an
+// explicit "C" locale (strtod_l) so an embedding process's LC_NUMERIC can
+// never change which corpora load. Non-ASCII numerals (which Python's
+// float() accepts) are out of scope for the native parser: they report as
+// a bad-float error rather than silently diverging.
+bool parse_time(const std::string& field, double* out) {
+  size_t b = 0, e = field.size();
+  while (b < e && std::isspace(static_cast<unsigned char>(field[b]))) ++b;
+  while (e > b && std::isspace(static_cast<unsigned char>(field[e - 1]))) --e;
+  if (b == e) return false;
+  std::string s;
+  s.reserve(e - b);
+  for (size_t i = b; i < e; ++i) {
+    char c = field[i];
+    if (c == '_') {
+      // Python: underscores only BETWEEN digits (also inside exponents)
+      if (i == b || i + 1 >= e ||
+          !std::isdigit(static_cast<unsigned char>(field[i - 1])) ||
+          !std::isdigit(static_cast<unsigned char>(field[i + 1]))) {
+        return false;
+      }
+      continue;  // drop the separator for strtod
+    }
+    if (c == 'x' || c == 'X' || c == '(') return false;  // hex / nan(...)
+    s.push_back(c);
+  }
+  const char* cs = s.c_str();
+  char* end = nullptr;
+  errno = 0;
+  double v = ::strtod_l(cs, &end, c_locale());
+  if (end == cs || *end != '\0') return false;
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse the CSV at `path`. Returns an opaque handle, or nullptr with
+// errbuf filled. Column semantics match data/traces.py::load_csv: rows
+// split on `delimiter`, `user_col`/`time_col` index the split fields, the
+// first `skip_header` lines are skipped, blank lines are skipped, the
+// user key is the raw (unstripped) field text.
+void* rq_parse_csv(const char* path, int user_col, int time_col,
+                   char delimiter, int skip_header, char* errbuf,
+                   int errlen) {
+  if (user_col < 0 || time_col < 0) {  // would index out of bounds below
+    set_err(errbuf, errlen, "column indices must be non-negative");
+    return nullptr;
+  }
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    set_err(errbuf, errlen, std::string("cannot open ") + path);
+    return nullptr;
+  }
+
+  auto* res = new ParseResult();
+  std::unordered_map<std::string, size_t> index;
+  index.reserve(1 << 16);
+
+  std::vector<std::string> fields;
+  char* line = nullptr;
+  size_t cap = 0;
+  long lineno = -1;
+  bool ok = true;
+
+  ssize_t got;
+  while ((got = ::getline(&line, &cap, f)) != -1) {
+    ++lineno;
+    size_t n = static_cast<size_t>(got);
+    if (n && line[n - 1] == '\n') --n;  // rstrip("\n") like the Python path
+    if (lineno < skip_header || is_blank(line, n)) continue;
+
+    fields.clear();
+    size_t start = 0;
+    for (size_t i = 0; i <= n; ++i) {
+      if (i == n || line[i] == delimiter) {
+        fields.emplace_back(line + start, i - start);
+        start = i + 1;
+      }
+    }
+    int needed = (user_col > time_col ? user_col : time_col) + 1;
+    if (static_cast<int>(fields.size()) < needed) {
+      set_err(errbuf, errlen,
+              "line " + std::to_string(lineno) + ": expected at least " +
+                  std::to_string(needed) + " fields, got " +
+                  std::to_string(fields.size()));
+      ok = false;
+      break;
+    }
+    double t;
+    if (!parse_time(fields[static_cast<size_t>(time_col)], &t)) {
+      set_err(errbuf, errlen,
+              "line " + std::to_string(lineno) + ": bad float '" +
+                  fields[static_cast<size_t>(time_col)] + "'");
+      ok = false;
+      break;
+    }
+    const std::string& u = fields[static_cast<size_t>(user_col)];
+    auto it = index.find(u);
+    size_t ui;
+    if (it == index.end()) {
+      ui = res->per_user.size();
+      index.emplace(u, ui);
+      res->per_user.emplace_back();
+    } else {
+      ui = it->second;
+    }
+    res->per_user[ui].push_back(t);
+  }
+
+  std::free(line);
+  std::fclose(f);
+  if (!ok) {
+    delete res;
+    return nullptr;
+  }
+  for (auto& v : res->per_user) std::sort(v.begin(), v.end());
+  return res;
+}
+
+long rq_n_users(void* h) {
+  return static_cast<long>(static_cast<ParseResult*>(h)->per_user.size());
+}
+
+long rq_total_events(void* h) {
+  long total = 0;
+  for (const auto& v : static_cast<ParseResult*>(h)->per_user)
+    total += static_cast<long>(v.size());
+  return total;
+}
+
+// times_out: rq_total_events doubles (per-user blocks, ascending within
+// each); offsets_out: rq_n_users + 1 longs, user u's times are
+// times_out[offsets_out[u] : offsets_out[u+1]].
+void rq_fill(void* h, double* times_out, long* offsets_out) {
+  auto* res = static_cast<ParseResult*>(h);
+  long pos = 0;
+  size_t u = 0;
+  for (; u < res->per_user.size(); ++u) {
+    offsets_out[u] = pos;
+    const auto& v = res->per_user[u];
+    std::memcpy(times_out + pos, v.data(), v.size() * sizeof(double));
+    pos += static_cast<long>(v.size());
+  }
+  offsets_out[u] = pos;
+}
+
+void rq_free(void* h) { delete static_cast<ParseResult*>(h); }
+
+}  // extern "C"
