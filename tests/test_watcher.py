@@ -39,8 +39,9 @@ def _run(watcher, monkeypatch, probes, capture_rcs, argv_extra=()):
         calls["probes"] += 1
         return next(probes)
 
-    def fake_capture(deadline):
+    def fake_capture(deadline, stages=None):
         calls["captures"] += 1
+        calls["stages"] = stages
         return next(rcs)
 
     import redqueen_tpu.utils.backend as backend
@@ -119,3 +120,38 @@ def test_capture_evidence_always_removes_sentinel(watcher, monkeypatch,
     assert rc == 124
     assert seen["sentinel_during"] is True
     assert not sent.exists()
+
+
+def test_stages_flag_reaches_capture(watcher, monkeypatch):
+    """A restarted watcher must be able to prioritize the stages a prior
+    window did NOT bank (--stages), and the flag must flow through main()
+    into capture_evidence."""
+    rc, calls = _run(watcher, monkeypatch,
+                     probes=[(True, 1, "tpu")], capture_rcs=[0],
+                     argv_extra=["--stages", "3", "4", "1", "5"])
+    assert rc == 0
+    assert calls["stages"] == [3, 4, 1, 5]
+
+
+def test_capture_evidence_builds_stage_args(watcher, monkeypatch, tmp_path):
+    """The stage order handed to capture_evidence is exactly the order of
+    --stage flags on the tpu_evidence.py command line."""
+    import proc_util
+
+    seen = {}
+
+    def fake_run(cmd, timeout, capture_output, text, cwd):
+        seen["cmd"] = list(cmd)
+
+        class R:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(proc_util.subprocess, "run", fake_run)
+    rc = watcher.capture_evidence(1.0, stages=[3, 1])
+    assert rc == 0
+    idx = [i for i, a in enumerate(seen["cmd"]) if a == "--stage"]
+    assert [seen["cmd"][i + 1] for i in idx] == ["3", "1"]

@@ -53,14 +53,23 @@ def append_log(line: str) -> None:
         f.write(line + "\n")
 
 
-def capture_evidence(total_deadline_s: float) -> int:
+DEFAULT_STAGES = (2, 3, 4, 1, 5)
+
+
+def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES) -> int:
     """Run the staged evidence capture; artifacts are written incrementally
-    by tpu_evidence.py so even a timeout here keeps completed stages."""
+    by tpu_evidence.py so even a timeout here keeps completed stages.
+
+    ``stages`` (ordered) lets a restarted watcher prioritize what a prior
+    window did NOT capture: alive windows are minutes long, so a stage
+    already banked (e.g. the full-shape headline) must not spend the next
+    window ahead of a missing one."""
     from proc_util import run_logged
 
-    cmd = [sys.executable, os.path.join(REPO, "tools", "tpu_evidence.py"),
-           "--stage", "2", "--stage", "3", "--stage", "4", "--stage", "1",
-           "--stage", "5", "--deadline", "600"]
+    cmd = [sys.executable, os.path.join(REPO, "tools", "tpu_evidence.py")]
+    for s in stages:
+        cmd += ["--stage", str(s)]
+    cmd += ["--deadline", "600"]
     with open(SENTINEL, "w") as f:
         f.write(utcnow() + "\n")
     try:
@@ -87,6 +96,12 @@ def main() -> int:
     # 5760s; headroom on top so the outer kill can only mean a real hang.
     ap.add_argument("--capture-deadline", type=float, default=6600.0,
                     help="total seconds allowed for the staged capture")
+    # choices validates each element at LAUNCH: a typo'd stage must fail
+    # here, not after hours of probing inside a rare alive window.
+    ap.add_argument("--stages", type=int, nargs="+",
+                    choices=[1, 2, 3, 4, 5],
+                    default=list(DEFAULT_STAGES),
+                    help="tpu_evidence stages, in priority order")
     args = ap.parse_args()
 
     if REPO not in sys.path:
@@ -109,7 +124,7 @@ def main() -> int:
         if alive and plat == "tpu":
             append_log(f"| {utcnow()} | ALIVE — {n} x {plat} "
                        f"(probe {attempt}); launching staged capture |")
-            rc = capture_evidence(args.capture_deadline)
+            rc = capture_evidence(args.capture_deadline, args.stages)
             if rc != 0:
                 # Tunnel flaked between the probe and the capture (the
                 # observed shape: alive for minutes, then wedged): no TPU
