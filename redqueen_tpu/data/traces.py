@@ -45,11 +45,12 @@ def load_csv(path: str, user_col: int = 0, time_col: int = 1,
     rebuild's loader for the reference's Twitter-trace input format.
 
     ``engine``: ``"auto"`` uses the native C++ parser
-    (redqueen_tpu.native.loader, ~an order of magnitude faster at
-    million-row corpora — benchmarks/trace_io.py) when it builds on this
-    machine and falls back to pure Python otherwise; ``"native"`` requires
-    it; ``"python"`` forces the interpreter path. Both engines produce
-    identical output (pinned by tests/test_native_loader.py)."""
+    (redqueen_tpu.native.loader; measured 3-5x faster at million-row
+    corpora, larger at low user cardinality — benchmarks/trace_io.py)
+    when it builds on this machine and falls back to pure Python
+    otherwise; ``"native"`` requires it; ``"python"`` forces the
+    interpreter path. Both engines produce identical output (pinned by
+    tests/test_native_loader.py)."""
     if engine not in ("auto", "native", "python"):
         raise ValueError(f"unknown engine {engine!r}")
     # Arguments only the Python path supports (multi-char or non-ASCII

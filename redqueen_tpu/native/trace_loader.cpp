@@ -10,6 +10,14 @@
 // (redqueen_tpu/native/loader.py); semantics are pinned row-for-row
 // against the Python loader by tests/test_native_loader.py.
 //
+// Parsing is allocation-light by design: the whole file is read once,
+// fields are std::string_view slices into that buffer, user keys hash as
+// views (materialized only on first appearance via the map's key), and
+// timestamps take a std::from_chars fast path with a strtod_l("C") slow
+// path for the cases from_chars can't express (leading '+', Python's
+// digit-separating underscores, out-of-range magnitudes that must round
+// to +-inf/0 the way Python float() does).
+//
 // Deliberate C ABI (no pybind11 in this environment): an opaque handle
 // carries the parse result; the caller sizes NumPy buffers from
 // rq_n_users/rq_total_events and rq_fill copies into them; rq_free
@@ -19,18 +27,91 @@
 #include <algorithm>
 #include <cctype>
 #include <cerrno>
+#include <charconv>
 #include <cstdio>
 #include <cstdlib>
+#include <cstdint>
 #include <cstring>
 #include <locale.h>
 #include <string>
-#include <unordered_map>
+#include <string_view>
 #include <vector>
 
 namespace {
 
 struct ParseResult {
+  std::string data;  // the whole file; field views point into it
   std::vector<std::vector<double>> per_user;  // first-appearance order
+};
+
+// Open-addressing user-key index (FNV-1a, linear probing, stored hashes,
+// power-of-two capacity, grow at 70% load). std::unordered_map's
+// node-per-key layout was the measured hot spot of the whole parse (50%+
+// of samples in _M_find_before_node; one heap node + pointer chase per
+// row): a flat probe array with the hash pre-compared costs one cache
+// line for almost every lookup.
+struct UserIndex {
+  struct Slot {
+    std::string_view key;
+    size_t val = 0;
+    uint64_t hash = 0;
+    bool used = false;
+  };
+  std::vector<Slot> slots;
+  size_t count = 0;
+
+  explicit UserIndex(size_t cap = 1 << 17) : slots(cap) {}
+
+  static uint64_t fnv1a(std::string_view s) {
+    uint64_t h = 1469598103934665603ull;
+    for (char c : s) {
+      h ^= static_cast<unsigned char>(c);
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+
+  // Returns the value slot for `k`, inserting `next_val` (and setting
+  // *inserted) when the key is new.
+  size_t find_or_insert(std::string_view k, size_t next_val,
+                        bool* inserted) {
+    uint64_t h = fnv1a(k);
+    size_t mask = slots.size() - 1;
+    size_t i = h & mask;
+    for (;;) {
+      Slot& s = slots[i];
+      if (!s.used) {
+        if ((count + 1) * 10 > slots.size() * 7) {
+          grow();
+          return find_or_insert(k, next_val, inserted);
+        }
+        s.used = true;
+        s.key = k;
+        s.val = next_val;
+        s.hash = h;
+        ++count;
+        *inserted = true;
+        return next_val;
+      }
+      if (s.hash == h && s.key == k) {
+        *inserted = false;
+        return s.val;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+
+  void grow() {
+    std::vector<Slot> old = std::move(slots);
+    slots.assign(old.size() * 2, {});
+    size_t mask = slots.size() - 1;
+    for (auto& s : old) {
+      if (!s.used) continue;
+      size_t i = s.hash & mask;
+      while (slots[i].used) i = (i + 1) & mask;
+      slots[i] = s;
+    }
+  }
 };
 
 void set_err(char* errbuf, int errlen, const std::string& msg) {
@@ -39,10 +120,17 @@ void set_err(char* errbuf, int errlen, const std::string& msg) {
   }
 }
 
+// ASCII whitespace (' ', \t \n \v \f \r) inlined — the corpora are ASCII
+// by contract (see parse_time) and std::isspace is an opaque call through
+// the locale table on the hottest per-field path.
+inline bool is_space(char c) {
+  return c == ' ' || (c >= '\t' && c <= '\r');
+}
+
 // Mirror of Python "not line.strip()": every char is whitespace.
-bool is_blank(const char* s, size_t n) {
-  for (size_t i = 0; i < n; ++i) {
-    if (!std::isspace(static_cast<unsigned char>(s[i]))) return false;
+bool is_blank(std::string_view s) {
+  for (char c : s) {
+    if (!is_space(c)) return false;
   }
   return true;
 }
@@ -52,34 +140,30 @@ locale_t c_locale() {
   return loc;
 }
 
-// Mirror of Python float(field): optional surrounding whitespace, ASCII
-// digit-separating underscores allowed, the full field must be consumed;
-// empty/invalid -> error (returns false). strtod's EXTRA envelope is
-// rejected explicitly -- hex literals ("0x10") and "nan(chars)" are valid
-// strtod input but ValueError in Python -- and parsing runs under an
-// explicit "C" locale (strtod_l) so an embedding process's LC_NUMERIC can
-// never change which corpora load. Non-ASCII numerals (which Python's
-// float() accepts) are out of scope for the native parser: they report as
-// a bad-float error rather than silently diverging.
-bool parse_time(const std::string& field, double* out) {
-  size_t b = 0, e = field.size();
-  while (b < e && std::isspace(static_cast<unsigned char>(field[b]))) ++b;
-  while (e > b && std::isspace(static_cast<unsigned char>(field[e - 1]))) --e;
-  if (b == e) return false;
+// Slow path: Python-float() features std::from_chars can't express.
+// Validates digit-separating underscores (dropping them), a single
+// leading '+', then strtod_l under an explicit "C" locale (an embedding
+// process's LC_NUMERIC must never change which corpora load) with
+// full-consumption required. strtod's overflow/underflow behavior
+// (+-HUGE_VAL / +-0 with ERANGE) matches Python float()'s.
+bool parse_time_slow(std::string_view sv, double* out) {
   std::string s;
-  s.reserve(e - b);
-  for (size_t i = b; i < e; ++i) {
-    char c = field[i];
+  s.reserve(sv.size());
+  for (size_t i = 0; i < sv.size(); ++i) {
+    char c = sv[i];
+    // strtod-only envelope Python float() rejects: hex literals and
+    // nan(...) payloads (the fast path rejects them too; this guard
+    // covers the slow-path-only inputs like "+0x10")
+    if (c == 'x' || c == 'X' || c == '(') return false;
     if (c == '_') {
       // Python: underscores only BETWEEN digits (also inside exponents)
-      if (i == b || i + 1 >= e ||
-          !std::isdigit(static_cast<unsigned char>(field[i - 1])) ||
-          !std::isdigit(static_cast<unsigned char>(field[i + 1]))) {
+      if (i == 0 || i + 1 >= sv.size() ||
+          !std::isdigit(static_cast<unsigned char>(sv[i - 1])) ||
+          !std::isdigit(static_cast<unsigned char>(sv[i + 1]))) {
         return false;
       }
       continue;  // drop the separator for strtod
     }
-    if (c == 'x' || c == 'X' || c == '(') return false;  // hex / nan(...)
     s.push_back(c);
   }
   const char* cs = s.c_str();
@@ -89,6 +173,42 @@ bool parse_time(const std::string& field, double* out) {
   if (end == cs || *end != '\0') return false;
   *out = v;
   return true;
+}
+
+// Mirror of Python float(field): optional surrounding whitespace, ASCII
+// digit-separating underscores allowed, the full field must be consumed;
+// empty/invalid -> error (returns false). The strtod envelope EXTRAS are
+// rejected to match Python -- hex literals ("0x10") stop at 'x' and fail
+// full consumption, "nan(chars)" is rejected explicitly. Non-ASCII
+// numerals (which Python's float() accepts) are out of scope for the
+// native parser: they report as a bad-float error rather than silently
+// diverging.
+bool parse_time(std::string_view sv, double* out) {
+  while (!sv.empty() && is_space(sv.front())) sv.remove_prefix(1);
+  while (!sv.empty() && is_space(sv.back())) sv.remove_suffix(1);
+  if (sv.empty()) return false;
+  if (sv.front() == '+') return parse_time_slow(sv, out);  // rare
+  double v;
+  auto [p, ec] = std::from_chars(sv.data(), sv.data() + sv.size(), v);
+  if (ec == std::errc() && p == sv.data() + sv.size()) {
+    // from_chars consumes "nan(charseq)"; Python float() rejects it —
+    // scan for the payload parens only on the nan hit itself
+    if (v != v && sv.find('(') != std::string_view::npos) return false;
+    *out = v;
+    return true;
+  }
+  if (ec == std::errc::result_out_of_range &&
+      p == sv.data() + sv.size()) {
+    // out-of-range magnitudes: strtod rounds to +-inf / +-0 exactly
+    // like Python float()
+    return parse_time_slow(sv, out);
+  }
+  // from_chars stopped early; the only Python-valid reason is a
+  // digit-separating underscore
+  if (sv.find('_') != std::string_view::npos) {
+    return parse_time_slow(sv, out);
+  }
+  return false;
 }
 
 }  // namespace
@@ -112,67 +232,80 @@ void* rq_parse_csv(const char* path, int user_col, int time_col,
     set_err(errbuf, errlen, std::string("cannot open ") + path);
     return nullptr;
   }
-
   auto* res = new ParseResult();
-  std::unordered_map<std::string, size_t> index;
-  index.reserve(1 << 16);
+  std::fseek(f, 0, SEEK_END);
+  long fsize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (fsize > 0) {
+    res->data.resize(static_cast<size_t>(fsize));
+    size_t got = std::fread(res->data.data(), 1, res->data.size(), f);
+    res->data.resize(got);
+  } else {
+    // Non-seekable (FIFO, /dev/stdin) or stat-size-0 (/proc) inputs:
+    // ftell reports -1/0 there, so stream in chunks instead of silently
+    // parsing an empty buffer.
+    char buf[1 << 16];
+    size_t got;
+    while ((got = std::fread(buf, 1, sizeof buf, f)) > 0) {
+      res->data.append(buf, got);
+    }
+  }
+  std::fclose(f);
 
-  std::vector<std::string> fields;
-  char* line = nullptr;
-  size_t cap = 0;
+  UserIndex index;
+
+  const size_t u_col = static_cast<size_t>(user_col);
+  const size_t t_col = static_cast<size_t>(time_col);
+  const size_t needed = std::max(u_col, t_col) + 1;
+  const char* base = res->data.data();
+  const size_t n = res->data.size();
+
+  size_t pos = 0;
   long lineno = -1;
-  bool ok = true;
-
-  ssize_t got;
-  while ((got = ::getline(&line, &cap, f)) != -1) {
+  while (pos < n) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(base + pos, '\n', n - pos));
+    size_t le = nl ? static_cast<size_t>(nl - base) : n;  // rstrip("\n")
+    std::string_view line(base + pos, le - pos);
+    size_t next = le + 1;
     ++lineno;
-    size_t n = static_cast<size_t>(got);
-    if (n && line[n - 1] == '\n') --n;  // rstrip("\n") like the Python path
-    if (lineno < skip_header || is_blank(line, n)) continue;
-
-    fields.clear();
-    size_t start = 0;
-    for (size_t i = 0; i <= n; ++i) {
-      if (i == n || line[i] == delimiter) {
-        fields.emplace_back(line + start, i - start);
+    if (lineno < skip_header || is_blank(line)) {
+      pos = next;
+      continue;
+    }
+    // Walk the fields in place; only the two interesting columns are kept.
+    std::string_view uf, tf;
+    size_t field_idx = 0, start = 0;
+    for (size_t i = 0; i <= line.size(); ++i) {
+      if (i == line.size() || line[i] == delimiter) {
+        if (field_idx == u_col) uf = line.substr(start, i - start);
+        if (field_idx == t_col) tf = line.substr(start, i - start);
+        ++field_idx;
         start = i + 1;
       }
     }
-    int needed = (user_col > time_col ? user_col : time_col) + 1;
-    if (static_cast<int>(fields.size()) < needed) {
+    if (field_idx < needed) {
       set_err(errbuf, errlen,
               "line " + std::to_string(lineno) + ": expected at least " +
                   std::to_string(needed) + " fields, got " +
-                  std::to_string(fields.size()));
-      ok = false;
-      break;
+                  std::to_string(field_idx));
+      delete res;
+      return nullptr;
     }
     double t;
-    if (!parse_time(fields[static_cast<size_t>(time_col)], &t)) {
+    if (!parse_time(tf, &t)) {
       set_err(errbuf, errlen,
               "line " + std::to_string(lineno) + ": bad float '" +
-                  fields[static_cast<size_t>(time_col)] + "'");
-      ok = false;
-      break;
+                  std::string(tf) + "'");
+      delete res;
+      return nullptr;
     }
-    const std::string& u = fields[static_cast<size_t>(user_col)];
-    auto it = index.find(u);
-    size_t ui;
-    if (it == index.end()) {
-      ui = res->per_user.size();
-      index.emplace(u, ui);
-      res->per_user.emplace_back();
-    } else {
-      ui = it->second;
-    }
+    bool inserted;
+    // key views into res->data: stable for the index's lifetime
+    size_t ui = index.find_or_insert(uf, res->per_user.size(), &inserted);
+    if (inserted) res->per_user.emplace_back();
     res->per_user[ui].push_back(t);
-  }
-
-  std::free(line);
-  std::fclose(f);
-  if (!ok) {
-    delete res;
-    return nullptr;
+    pos = next;
   }
   for (auto& v : res->per_user) std::sort(v.begin(), v.end());
   return res;

@@ -168,3 +168,53 @@ def test_stale_so_artifacts_swept_on_rebuild():
         f.write(b"junk")
     L.build(force=True)
     assert not os.path.exists(stale)
+
+
+def test_empty_corpus_returns_empty_list(tmp_path):
+    p = _write(tmp_path, "header only\n")
+    assert loader.load_csv_native(p) == []
+    assert traces.load_csv(p, engine="python") == []
+    assert traces.load_csv(p, engine="auto") == []
+
+
+def test_plus_prefixed_strtod_extras_rejected(tmp_path):
+    # '+' routes to the slow path, which must reject the same strtod-only
+    # envelope the fast path does
+    for bad in ("+0x10", "+nan(12)"):
+        pb = _write(tmp_path, f"h\nu,{bad}\n", name="bad.csv")
+        with pytest.raises(ValueError):
+            loader.load_csv_native(pb)
+        with pytest.raises(ValueError):
+            traces.load_csv(pb, engine="python")
+    p = _write(tmp_path, "h\nu,+1.5\nu,+inf\n")
+    _assert_same(
+        loader.load_csv_native(p), traces.load_csv(p, engine="python")
+    )
+
+
+def test_per_user_arrays_are_owning(tmp_path):
+    # One user's retained trace must not pin the whole corpus buffer
+    p = _write(tmp_path, "h\na,1\nb,2\nc,3\n")
+    out = loader.load_csv_native(p)
+    assert all(t.base is None for t in out)
+
+
+def test_non_seekable_input_is_read(tmp_path):
+    # FIFOs/stdin report no size via fseek/ftell; the loader must stream
+    import threading
+
+    fifo = str(tmp_path / "pipe")
+    os.mkfifo(fifo)
+
+    def writer():
+        with open(fifo, "w") as f:
+            f.write("user,time\nu,2\nu,1\nv,3\n")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        out = loader.load_csv_native(fifo)
+    finally:
+        t.join(timeout=10)
+    np.testing.assert_array_equal(out[0], [1.0, 2.0])
+    np.testing.assert_array_equal(out[1], [3.0])
