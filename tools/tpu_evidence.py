@@ -7,10 +7,15 @@ goes so a mid-sequence wedge keeps everything captured so far:
 
   1. quick headline bench on TPU      -> BENCH_tpu_quick_r04.json
   2. FULL headline bench on TPU       -> BENCH_tpu_full_r04.json
-  3. Pallas engine on the chip        -> BENCH_tpu_pallas_r04.json
-     (first real Mosaic compile of ops/pallas_chunk.py)
+  6. QUICK-shape Pallas on the chip   -> BENCH_tpu_pallas_quick_r04.json
+     (cheap Mosaic compile: banks "Pallas ran on real Mosaic" fast)
+  3. full-shape Pallas engine         -> BENCH_tpu_pallas_r04.json
   4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu.json
   5. fire-mode crossover on TPU       -> FIRE_MODE_tpu_r04.json
+
+(That is also the default no-``--stage`` execution order: the cheap
+Pallas evidence runs BEFORE the expensive full-shape/sweep stages, since
+alive windows have been ~10 minutes and first compiles dominate.)
 
 Stages that fail/time out are recorded as such and the sequence continues.
 
@@ -30,6 +35,10 @@ if _TOOLS not in sys.path:  # proc_util when loaded by path
     sys.path.insert(0, _TOOLS)
 
 from proc_util import run_logged  # noqa: E402
+
+# The one authoritative stage-number set; tools/tpu_watcher.py imports it
+# for its own --stages validation so the two lists cannot drift.
+STAGE_CHOICES = (1, 2, 3, 4, 5, 6)
 
 
 def run_stage(name, cmd, out_json, deadline_s, log_path):
@@ -59,8 +68,8 @@ def run_stage(name, cmd, out_json, deadline_s, log_path):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", type=int, action="append", default=None,
-                    choices=[1, 2, 3, 4, 5],
-                    help="run only the given stage(s) (1-5; repeatable, "
+                    choices=list(STAGE_CHOICES),
+                    help="run only the given stage(s) (1-6; repeatable, "
                          "in the listed order)")
     ap.add_argument("--deadline", type=float, default=1500.0)
     args = ap.parse_args()
@@ -81,6 +90,17 @@ def main() -> int:
                      "--deadline", str(args.deadline - 60)],
          os.path.join(REPO, "BENCH_tpu_full_r04.json"),
          os.path.join(REPO, "benchmarks", "tpu_full_r04.log"),
+         args.deadline),
+        # Quick-shape Pallas BEFORE the full-shape stages: the r04 window
+        # showed first compiles dominate a ~10-minute window (scan full:
+        # 137s compile, 1.4s execution; star: killed mid-compile). A
+        # 64-component quick run compiles the same Mosaic kernel in a
+        # fraction of the time, so a SHORT window still banks "Pallas
+        # compiled and timed on real Mosaic" (round-3 verdict item 4).
+        (6, "pallas-quick", [py, bench, "--quick", "--tpu",
+                             "--engine", "pallas"],
+         os.path.join(REPO, "BENCH_tpu_pallas_quick_r04.json"),
+         os.path.join(REPO, "benchmarks", "tpu_pallas_quick_r04.log"),
          args.deadline),
         (3, "pallas", [py, bench, "--tpu", "--engine", "pallas",
                        "--deadline", str(args.deadline - 60)],

@@ -7,15 +7,14 @@ TPU_PROBE_LOG.md).  This watcher closes the gap WITHOUT a human in the
 loop: it probes the default backend every ``--interval`` minutes in a
 deadline-bounded subprocess (redqueen_tpu.utils.backend.probe_default_backend
 -- an in-process probe cannot catch a hang), appends every attempt to
-TPU_PROBE_LOG.md, and on the FIRST success immediately launches the full
-evidence capture itself::
+TPU_PROBE_LOG.md, and on the FIRST success immediately launches the
+staged evidence capture itself (``tools/tpu_evidence.py``, one
+``--stage`` flag per entry of ``--stages`` in order; default
+``DEFAULT_STAGES``, override with ``--stages`` to put the artifacts a
+prior window missed first).
 
-    python tools/tpu_evidence.py --stage 2 --stage 3 --stage 4 \
-        --stage 1 --stage 5
-
-Artifacts land incrementally (BENCH_tpu_full_r04.json first — the most
-valuable number — then pallas, star-vs-scan, quick, fire-mode), so a
-mid-sequence wedge keeps everything captured up to that point.  While the capture runs
+Artifacts land incrementally, most valuable first, so a mid-sequence
+wedge keeps everything captured up to that point.  While the capture runs
 a sentinel file ``.tpu_capture_in_progress`` exists at the repo root so
 the driving session can avoid launching heavy CPU work on this 1-core box
 (host contention distorts on-chip timings ~10x).
@@ -53,7 +52,7 @@ def append_log(line: str) -> None:
         f.write(line + "\n")
 
 
-DEFAULT_STAGES = (2, 3, 4, 1, 5)
+DEFAULT_STAGES = (2, 6, 3, 4, 1, 5)
 
 
 def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES) -> int:
@@ -91,15 +90,20 @@ def main() -> int:
                     help="minutes between probes")
     ap.add_argument("--max-probes", type=int, default=160)
     ap.add_argument("--probe-deadline", type=float, default=75.0)
-    # Must cover the staged capture's worst case: with --deadline 600 the
-    # stages budget 600*4 + the star-vs-scan sweep's 6*(300+240)+120 =
-    # 5760s; headroom on top so the outer kill can only mean a real hang.
-    ap.add_argument("--capture-deadline", type=float, default=6600.0,
+    # Must cover the staged capture's worst case: with --deadline 600,
+    # DEFAULT_STAGES is five 600s stages + the star-vs-scan sweep's
+    # 6*(300+240)+120 = 3360s -> 6360s; headroom on top so the outer kill
+    # can only mean a real hang.
+    ap.add_argument("--capture-deadline", type=float, default=7500.0,
                     help="total seconds allowed for the staged capture")
-    # choices validates each element at LAUNCH: a typo'd stage must fail
-    # here, not after hours of probing inside a rare alive window.
+    # choices (imported from tpu_evidence, the owner of the stage table,
+    # so the two lists cannot drift) validates each element at LAUNCH: a
+    # typo'd stage must fail here, not after hours of probing inside a
+    # rare alive window.
+    from tpu_evidence import STAGE_CHOICES
+
     ap.add_argument("--stages", type=int, nargs="+",
-                    choices=[1, 2, 3, 4, 5],
+                    choices=list(STAGE_CHOICES),
                     default=list(DEFAULT_STAGES),
                     help="tpu_evidence stages, in priority order")
     args = ap.parse_args()
