@@ -39,9 +39,10 @@ def _run(watcher, monkeypatch, probes, capture_rcs, argv_extra=()):
         calls["probes"] += 1
         return next(probes)
 
-    def fake_capture(deadline, stages=None):
+    def fake_capture(deadline, stages=None, tag=None):
         calls["captures"] += 1
         calls["stages"] = stages
+        calls["tag"] = tag
         return next(rcs)
 
     import redqueen_tpu.utils.backend as backend
@@ -155,3 +156,33 @@ def test_capture_evidence_builds_stage_args(watcher, monkeypatch, tmp_path):
     assert rc == 0
     idx = [i for i, a in enumerate(seen["cmd"]) if a == "--stage"]
     assert [seen["cmd"][i + 1] for i in idx] == ["3", "1"]
+    assert "--tag" not in seen["cmd"], "no tag -> tpu_evidence's default"
+
+
+def test_tag_flag_flows_to_evidence_cmd_and_log(watcher, monkeypatch):
+    """--tag must reach the tpu_evidence command line AND retarget the
+    capture log, so a watcher that outlives a round boundary captures
+    under the new round's names instead of overwriting banked evidence."""
+    import proc_util
+
+    seen = {}
+
+    def fake_run(cmd, timeout, capture_output, text, cwd):
+        seen["cmd"] = list(cmd)
+
+        class R:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(proc_util.subprocess, "run", fake_run)
+    rc = watcher.capture_evidence(1.0, stages=[2], tag="r05")
+    assert rc == 0
+    i = seen["cmd"].index("--tag")
+    assert seen["cmd"][i + 1] == "r05"
+
+    rc, calls = _run(watcher, monkeypatch, probes=[(True, 1, "tpu")],
+                     capture_rcs=[0], argv_extra=["--tag", "r05"])
+    assert rc == 0 and calls["tag"] == "r05"

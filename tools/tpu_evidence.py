@@ -5,21 +5,24 @@ Runs, in order, each in a deadline-bounded subprocess (a wedged tunnel hangs
 rather than raising — every stage is survivable), writing artifacts as it
 goes so a mid-sequence wedge keeps everything captured so far:
 
-  1. quick headline bench on TPU      -> BENCH_tpu_quick_r04.json
-  2. FULL headline bench on TPU       -> BENCH_tpu_full_r04.json
-  6. QUICK-shape Pallas on the chip   -> BENCH_tpu_pallas_quick_r04.json
+  1. quick headline bench on TPU      -> BENCH_tpu_quick_<tag>.json
+  2. FULL headline bench on TPU       -> BENCH_tpu_full_<tag>.json
+  6. QUICK-shape Pallas on the chip   -> BENCH_tpu_pallas_quick_<tag>.json
      (cheap Mosaic compile: banks "Pallas ran on real Mosaic" fast)
-  3. full-shape Pallas engine         -> BENCH_tpu_pallas_r04.json
-  4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu.json
-  5. fire-mode crossover on TPU       -> FIRE_MODE_tpu_r04.json
+  3. full-shape Pallas engine         -> BENCH_tpu_pallas_<tag>.json
+  4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu_<tag>.json
+  5. fire-mode crossover on TPU       -> FIRE_MODE_tpu_<tag>.json
 
 (That is also the default no-``--stage`` execution order: the cheap
 Pallas evidence runs BEFORE the expensive full-shape/sweep stages, since
 alive windows have been ~10 minutes and first compiles dominate.)
 
-Stages that fail/time out are recorded as such and the sequence continues.
+``<tag>`` is the round tag (``--tag``, default r04): bump it each round
+so a new round's capture never overwrites banked evidence. Stages that
+fail/time out are recorded as such and the sequence continues.
 
 Usage: python tools/tpu_evidence.py [--stage N] [--deadline S per stage]
+                                    [--tag rNN]
 """
 
 from __future__ import annotations
@@ -72,7 +75,12 @@ def main() -> int:
                     help="run only the given stage(s) (1-6; repeatable, "
                          "in the listed order)")
     ap.add_argument("--deadline", type=float, default=1500.0)
+    ap.add_argument("--tag", default="r04",
+                    help="round tag baked into artifact/log names "
+                         "(BENCH_tpu_*_<tag>.json); bump per round so a "
+                         "new round never overwrites banked evidence")
     args = ap.parse_args()
+    tag = args.tag
     py = sys.executable
     bench = os.path.join(REPO, "bench.py")
     # Stage 4 runs 6 bench cells (3 shapes x 2 engines), each allowed up to
@@ -83,13 +91,13 @@ def main() -> int:
     sweep_budget = 6 * (sweep_cell + 240.0) + 120.0
     stages = [
         (1, "quick", [py, bench, "--quick", "--tpu"],
-         os.path.join(REPO, "BENCH_tpu_quick_r04.json"),
-         os.path.join(REPO, "benchmarks", "tpu_quick_r04.log"),
+         os.path.join(REPO, f"BENCH_tpu_quick_{tag}.json"),
+         os.path.join(REPO, "benchmarks", f"tpu_quick_{tag}.log"),
          args.deadline),
         (2, "full", [py, bench, "--tpu",
                      "--deadline", str(args.deadline - 60)],
-         os.path.join(REPO, "BENCH_tpu_full_r04.json"),
-         os.path.join(REPO, "benchmarks", "tpu_full_r04.log"),
+         os.path.join(REPO, f"BENCH_tpu_full_{tag}.json"),
+         os.path.join(REPO, "benchmarks", f"tpu_full_{tag}.log"),
          args.deadline),
         # Quick-shape Pallas BEFORE the full-shape stages: the r04 window
         # showed first compiles dominate a ~10-minute window (scan full:
@@ -99,18 +107,20 @@ def main() -> int:
         # compiled and timed on real Mosaic" (round-3 verdict item 4).
         (6, "pallas-quick", [py, bench, "--quick", "--tpu",
                              "--engine", "pallas"],
-         os.path.join(REPO, "BENCH_tpu_pallas_quick_r04.json"),
-         os.path.join(REPO, "benchmarks", "tpu_pallas_quick_r04.log"),
+         os.path.join(REPO, f"BENCH_tpu_pallas_quick_{tag}.json"),
+         os.path.join(REPO, "benchmarks", f"tpu_pallas_quick_{tag}.log"),
          args.deadline),
         (3, "pallas", [py, bench, "--tpu", "--engine", "pallas",
                        "--deadline", str(args.deadline - 60)],
-         os.path.join(REPO, "BENCH_tpu_pallas_r04.json"),
-         os.path.join(REPO, "benchmarks", "tpu_pallas_r04.log"),
+         os.path.join(REPO, f"BENCH_tpu_pallas_{tag}.json"),
+         os.path.join(REPO, "benchmarks", f"tpu_pallas_{tag}.log"),
          args.deadline),
         (4, "star-vs-scan", [py, os.path.join(REPO, "tools", "star_vs_scan.py"),
-                             "--tpu", "--engine-deadline", str(sweep_cell)],
+                             "--tpu", "--engine-deadline", str(sweep_cell),
+                             "--out",
+                             os.path.join(REPO, f"STAR_VS_SCAN_tpu_{tag}.json")],
          None,  # star_vs_scan writes its own artifact (incrementally)
-         os.path.join(REPO, "benchmarks", "tpu_star_vs_scan_r04.log"),
+         os.path.join(REPO, "benchmarks", f"tpu_star_vs_scan_{tag}.log"),
          sweep_budget),
         # Fire-extraction-mode crossover on the chip: DESIGN.md's
         # "doubling on accelerators" policy is CPU-measured + argued, not
@@ -120,9 +130,9 @@ def main() -> int:
         # platform field says what it measured).
         (5, "fire-mode", [py, os.path.join(REPO, "tools",
                                            "fire_mode_bench.py"),
-                          "--out", os.path.join(REPO, "FIRE_MODE_tpu_r04.json")],
+                          "--out", os.path.join(REPO, f"FIRE_MODE_tpu_{tag}.json")],
          None,  # fire_mode_bench writes its own artifact (incrementally)
-         os.path.join(REPO, "benchmarks", "tpu_fire_mode_r04.log"),
+         os.path.join(REPO, "benchmarks", f"tpu_fire_mode_{tag}.log"),
          args.deadline),
     ]
     any_ok = False

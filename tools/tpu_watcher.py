@@ -55,24 +55,33 @@ def append_log(line: str) -> None:
 DEFAULT_STAGES = (2, 6, 3, 4, 1, 5)
 
 
-def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES) -> int:
+def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES,
+                     tag: str = None) -> int:
     """Run the staged evidence capture; artifacts are written incrementally
     by tpu_evidence.py so even a timeout here keeps completed stages.
 
     ``stages`` (ordered) lets a restarted watcher prioritize what a prior
     window did NOT capture: alive windows are minutes long, so a stage
     already banked (e.g. the full-shape headline) must not spend the next
-    window ahead of a missing one."""
+    window ahead of a missing one. ``tag`` (--tag) names the round the
+    artifacts belong to — the watcher outlives round boundaries, so it
+    must be able to capture under the new round's names instead of
+    overwriting banked evidence."""
     from proc_util import run_logged
 
     cmd = [sys.executable, os.path.join(REPO, "tools", "tpu_evidence.py")]
     for s in stages:
         cmd += ["--stage", str(s)]
     cmd += ["--deadline", "600"]
+    capture_log = CAPTURE_LOG
+    if tag is not None:
+        cmd += ["--tag", tag]
+        capture_log = os.path.join(REPO, "benchmarks",
+                                   f"tpu_capture_{tag}.log")
     with open(SENTINEL, "w") as f:
         f.write(utcnow() + "\n")
     try:
-        rc, _, _, _ = run_logged(cmd, total_deadline_s, CAPTURE_LOG,
+        rc, _, _, _ = run_logged(cmd, total_deadline_s, capture_log,
                                  cwd=REPO)
     finally:
         try:
@@ -80,7 +89,7 @@ def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES) -> int:
         except OSError:
             pass
     append_log(f"| {utcnow()} | evidence capture finished rc={rc} "
-               f"(stage log: {CAPTURE_LOG}) |")
+               f"(stage log: {capture_log}) |")
     return rc
 
 
@@ -106,6 +115,10 @@ def main() -> int:
                     choices=list(STAGE_CHOICES),
                     default=list(DEFAULT_STAGES),
                     help="tpu_evidence stages, in priority order")
+    ap.add_argument("--tag", default=None,
+                    help="round tag passed through to tpu_evidence.py "
+                         "(default: its own, currently r04) — set when the "
+                         "watcher outlives a round boundary")
     args = ap.parse_args()
 
     if REPO not in sys.path:
@@ -128,7 +141,8 @@ def main() -> int:
         if alive and plat == "tpu":
             append_log(f"| {utcnow()} | ALIVE — {n} x {plat} "
                        f"(probe {attempt}); launching staged capture |")
-            rc = capture_evidence(args.capture_deadline, args.stages)
+            rc = capture_evidence(args.capture_deadline, args.stages,
+                                  args.tag)
             if rc != 0:
                 # Tunnel flaked between the probe and the capture (the
                 # observed shape: alive for minutes, then wedged): no TPU
