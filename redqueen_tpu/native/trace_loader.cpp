@@ -307,7 +307,16 @@ void* rq_parse_csv(const char* path, int user_col, int time_col,
     res->per_user[ui].push_back(t);
     pos = next;
   }
-  for (auto& v : res->per_user) std::sort(v.begin(), v.end());
+  for (auto& v : res->per_user) {
+    // np.sort semantics: NaNs order LAST. Raw operator< would be
+    // undefined behavior under std::sort the moment a corpus contains a
+    // parseable "nan" timestamp (not a strict weak order), so move NaNs
+    // to the tail first and sort only the numeric prefix — the common
+    // NaN-free case pays no per-comparison branches.
+    auto mid = std::partition(v.begin(), v.end(),
+                              [](double x) { return x == x; });
+    std::sort(v.begin(), mid);
+  }
   return res;
 }
 

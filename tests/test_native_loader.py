@@ -218,3 +218,47 @@ def test_non_seekable_input_is_read(tmp_path):
         t.join(timeout=10)
     np.testing.assert_array_equal(out[0], [1.0, 2.0])
     np.testing.assert_array_equal(out[1], [3.0])
+
+
+def test_nan_timestamps_sort_last_like_numpy(tmp_path):
+    # "nan" is a parseable timestamp in both engines; np.sort orders NaNs
+    # last and the native sort must match (raw std::sort would be UB)
+    p = _write(tmp_path, "h\nu,nan\nu,2\nu,nan\nu,1\nu,inf\n")
+    got = loader.load_csv_native(p)[0]
+    want = traces.load_csv(p, engine="python")[0]
+    np.testing.assert_array_equal(got, want)  # NaN-positional equality
+    assert np.isnan(got[-2:]).all() and got[0] == 1.0
+
+
+# unconditional, like tests/test_properties.py: hypothesis is a hard test
+# dependency of this repo — a silent disappearance of the parity fuzz
+# would read as green
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+_user = st.text(
+    alphabet=st.characters(min_codepoint=33, max_codepoint=126,
+                           exclude_characters=","),
+    min_size=1, max_size=6,
+)
+_time = st.one_of(
+    st.floats(allow_nan=True, allow_infinity=True).map(repr),
+    st.integers(-10**9, 10**9).map(str),
+    st.just("nan"), st.just("inf"), st.just("-inf"),
+)
+
+
+@given(rows=st.lists(st.tuples(_user, _time), max_size=60))
+@settings(max_examples=60, deadline=None)
+def test_fuzz_native_matches_python(tmp_path_factory, rows):
+    # Adversarial corpora: arbitrary printable user keys, the full
+    # float repr envelope incl. nan/inf/subnormals — the two engines
+    # must agree exactly (user order, per-user order, bit values).
+    d = tmp_path_factory.mktemp("fuzz")
+    p = str(d / "f.csv")
+    with open(p, "w") as f:
+        f.write("user,time\n")
+        for u, t in rows:
+            f.write(f"{u},{t}\n")
+    _assert_same(loader.load_csv_native(p),
+                 traces.load_csv(p, engine="python"))
