@@ -126,16 +126,23 @@ class CtrlParams(struct.PyTreeNode):
 
 
 class StarResult(NamedTuple):
-    """Host-side result of one star simulation.
+    """Result of one star simulation.
 
     ``own_times`` [post_cap] ascending +inf-padded; ``wall_times`` [F, M*cap]
     per-feed merged ascending +inf-padded; ``wall_n`` [F] valid wall events
-    per feed; ``metrics`` per-feed FeedMetrics over [start, T]."""
+    per feed; ``metrics`` per-feed FeedMetrics over [start, T].
+
+    Array fields are host NumPy in single-process runs. In a MULTIHOST run
+    the feed-sharded fields (``wall_times``/``wall_n``/``metrics``) stay
+    global ``jax.Array``s — no process can hold them whole — and
+    ``parallel.multihost.gather_global`` materializes them everywhere;
+    replicated fields (``own_times``, ``n_posts``) are NumPy/int as
+    usual."""
 
     own_times: np.ndarray
     n_posts: int
-    wall_times: np.ndarray
-    wall_n: np.ndarray
+    wall_times: "np.ndarray | jax.Array"
+    wall_n: "np.ndarray | jax.Array"
     metrics: FeedMetrics
     cfg: StarConfig
 
@@ -762,25 +769,51 @@ class RecordBudgetOverflow(RuntimeError):
     retry with compression disabled — results stay exact either way."""
 
 
+# module-level so repeated overflow checks hit jit's warm cache
+_sum_i32 = jax.jit(lambda a: jnp.sum(a.astype(jnp.int32)))
+
+
+def _host_int_sum(x) -> int:
+    """Total of ``x`` as a host int, valid when ``x`` is sharded across
+    PROCESSES (multihost batch runs): reduce on-device to a replicated
+    scalar first — a fully-replicated value is readable everywhere."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return int(_sum_i32(x))
+    return int(np.asarray(x).sum())
+
+
+def _materialize(x):
+    """Result materialization policy: NumPy when the array is locally
+    materializable (single-process — today's behavior, unchanged); the
+    global ``jax.Array`` when it spans processes, where a host copy is
+    impossible per-process — gather explicitly with
+    ``parallel.multihost.gather_global`` if the whole array is wanted."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        if x.is_fully_replicated:
+            return np.asarray(x)  # every process holds the whole value
+        return x
+    return np.asarray(x)
+
+
 def _check_overflow(cfg: StarConfig, wall_trunc, post_trunc, rec_trunc=None):
     """Raise (never truncate silently) when any lane's buffers filled.
     rec_trunc is checked FIRST: a record-budget overflow corrupts the
     compressed path's last slot and can spuriously fill the post buffer, so
     post_trunc is only meaningful once rec_trunc is clear."""
-    if rec_trunc is not None and int(np.asarray(rec_trunc).sum()):
+    if rec_trunc is not None and _host_int_sum(rec_trunc):
         raise RecordBudgetOverflow(
             "suffix-record budget overflow (a feed produced more "
             "right-to-left candidate minima than bigf._rec_cap allows — "
             "the short-clock regime); retrying with compression off"
         )
-    n_wall = int(np.asarray(wall_trunc).sum())
+    n_wall = _host_int_sum(wall_trunc)
     if n_wall:
         raise RuntimeError(
             f"wall stream overflow ({n_wall} lane(s) hit wall_cap="
             f"{cfg.wall_cap} before the horizon) — raise StarConfig.wall_cap "
             f"(refusing to truncate silently)"
         )
-    n_post = int(np.asarray(post_trunc).sum())
+    n_post = _host_int_sum(post_trunc)
     if n_post:
         raise RuntimeError(
             f"posting buffer overflow ({n_post} lane(s) hit post_cap="
@@ -850,20 +883,25 @@ def simulate_star(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
 
     (own, n_posts, feed_times, wall_n, metrics, *_flags) = \
         _run_with_fallback(cfg, metric_K, ctrl, wall, run)
+    # own/n_posts are replicated (readable on every process); the per-feed
+    # arrays stay global jax.Arrays when the feed axis spans processes
     return StarResult(
-        own_times=np.asarray(own), n_posts=int(n_posts),
-        wall_times=np.asarray(feed_times), wall_n=np.asarray(wall_n),
+        own_times=_materialize(own), n_posts=int(n_posts),
+        wall_times=_materialize(feed_times), wall_n=_materialize(wall_n),
         metrics=metrics, cfg=cfg,
     )
 
 
 class StarBatchResult(NamedTuple):
-    """Host-side result of a batched star run: leaves carry a leading [B]
-    axis (``metrics`` is a FeedMetrics of [B, F] arrays)."""
+    """Result of a batched star run: leaves carry a leading [B] axis
+    (``metrics`` is a FeedMetrics of [B, F] arrays). Host NumPy in
+    single-process runs; in a multihost run batch-sharded fields stay
+    global ``jax.Array``s (gather with
+    ``parallel.multihost.gather_global``)."""
 
-    own_times: np.ndarray   # [B, post_cap]
-    n_posts: np.ndarray     # [B]
-    wall_n: np.ndarray      # [B, F]
+    own_times: "np.ndarray | jax.Array"   # [B, post_cap]
+    n_posts: "np.ndarray | jax.Array"     # [B]
+    wall_n: "np.ndarray | jax.Array"      # [B, F]
     metrics: FeedMetrics
     cfg: StarConfig
 
@@ -1020,8 +1058,8 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
     (own, n_posts, _feed_times, wall_n, metrics, *_flags) = \
         _run_with_fallback(cfg, metric_K, ctrl, wall, run)
     return StarBatchResult(
-        own_times=np.asarray(own), n_posts=np.asarray(n_posts),
-        wall_n=np.asarray(wall_n), metrics=metrics, cfg=cfg,
+        own_times=_materialize(own), n_posts=_materialize(n_posts),
+        wall_n=_materialize(wall_n), metrics=metrics, cfg=cfg,
     )
 
 

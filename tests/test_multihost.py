@@ -74,12 +74,30 @@ def _reference_summary():
     with mesh:
         m = feed_metrics_batch(log.times, log.srcs, adj_b, opt, T)
         top1 = np.asarray(m.mean_time_in_top_k())
+    # star engine on the same global mesh shape, feed axis 8-wide (the
+    # demo's cross-process pmin run must reproduce this bit-for-bit)
+    from redqueen_tpu.parallel.bigf import StarBuilder, simulate_star
+
+    sb = StarBuilder(n_feeds=8, end_time=T)
+    for fidx in range(8):
+        sb.wall_poisson(fidx, 1.0)
+    sb.ctrl_opt(q=q)
+    scfg, swall, sctrl = sb.build(wall_cap=256, post_cap=512)
+    star = simulate_star(scfg, swall, sctrl, seed=3,
+                         mesh=comm.make_mesh({"feed": 8}), axis="feed")
+    own = np.asarray(star.own_times, np.float64)
+
     t64 = np.asarray(log.times, np.float64)
     return {
         "times_sum": float(t64[np.isfinite(t64)].sum()),
         "srcs_sum": int(np.asarray(log.srcs, np.int64).sum()),
         "top1_mean": float(top1.mean()),
         "times_shape": list(np.asarray(log.times).shape),
+        "star_n_posts": int(star.n_posts),
+        "star_own_sum": float(own[np.isfinite(own)].sum()),
+        "star_wall_n": [int(x) for x in np.asarray(star.wall_n)],
+        "star_top1": [round(float(x), 6)
+                      for x in np.asarray(star.metrics.time_in_top_k)],
     }
 
 
@@ -135,3 +153,10 @@ def test_two_process_run_matches_single_process(tmp_path):
     # float64 sum of identical float32 logs in a fixed order is exact
     assert got["times_sum"] == want["times_sum"], (got, want)
     assert got["top1_mean"] == pytest.approx(want["top1_mean"], rel=1e-6)
+    # star engine: the demo ran the feed axis ACROSS the process boundary
+    # (hot-loop pmin = real cross-host collective); must be bit-identical
+    # to the single-process 8-device feed mesh
+    assert got["star_n_posts"] == want["star_n_posts"], (got, want)
+    assert got["star_own_sum"] == want["star_own_sum"], (got, want)
+    assert got["star_wall_n"] == want["star_wall_n"], (got, want)
+    assert got["star_top1"] == want["star_top1"], (got, want)

@@ -90,6 +90,30 @@ def main() -> int:
     gathered = multihost.gather_global(
         {"times": log.times, "srcs": log.srcs, "top1": top1}
     )
+
+    # Star engine with the FEED AXIS SPANNING BOTH PROCESSES: the hot-loop
+    # pmin (RedQueen's global rank-in-feed clock reduction) becomes a real
+    # cross-host collective, not just intra-process SPMD. Device order is
+    # (process, local), so a flat 8-wide "feed" axis puts feeds 0-3 on
+    # process 0 and 4-7 on process 1.
+    from jax.sharding import Mesh
+    from redqueen_tpu.parallel.bigf import StarBuilder, simulate_star
+
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    feed_mesh = Mesh(np.array(devs, dtype=object), ("feed",))
+    sb = StarBuilder(n_feeds=8, end_time=T)
+    for fidx in range(8):
+        sb.wall_poisson(fidx, 1.0)
+    sb.ctrl_opt(q=q)
+    scfg, swall, sctrl = sb.build(wall_cap=256, post_cap=512)
+    star = simulate_star(scfg, swall, sctrl, seed=3, mesh=feed_mesh,
+                         axis="feed")
+    own64 = np.asarray(star.own_times, np.float64)
+    star_gathered = multihost.gather_global(
+        {"wall_n": star.wall_n,
+         "top1": star.metrics.time_in_top_k}
+    )
+
     summary = multihost.process_summary()
     t64 = np.asarray(gathered["times"], np.float64)
     summary.update(
@@ -100,6 +124,10 @@ def main() -> int:
         srcs_sum=int(np.asarray(gathered["srcs"], np.int64).sum()),
         top1_mean=float(np.asarray(gathered["top1"]).mean()),
         times_shape=list(gathered["times"].shape),
+        star_n_posts=int(star.n_posts),
+        star_own_sum=float(own64[np.isfinite(own64)].sum()),
+        star_wall_n=[int(x) for x in star_gathered["wall_n"]],
+        star_top1=[round(float(x), 6) for x in star_gathered["top1"]],
     )
     if pid == 0:
         with open(args.out, "w") as f:
