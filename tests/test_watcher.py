@@ -178,10 +178,22 @@ def test_tag_flag_flows_to_evidence_cmd_and_log(watcher, monkeypatch):
         return R()
 
     monkeypatch.setattr(proc_util.subprocess, "run", fake_run)
+    real_path = os.path.join(REPO, "benchmarks", "tpu_capture_r05.log")
+    real_before = os.path.exists(real_path)
     rc = watcher.capture_evidence(1.0, stages=[2], tag="r05")
     assert rc == 0
     i = seen["cmd"].index("--tag")
     assert seen["cmd"][i + 1] == "r05"
+    # the tagged log must land next to the (monkeypatched) CAPTURE_LOG —
+    # a REPO-derived path would leak real benchmarks/tpu_capture_r05.log
+    # from every test run (observed before this guard). Compare
+    # before/after rather than asserting absence: a GENUINE r05 capture
+    # may legitimately exist in the repo later.
+    sandbox_log = os.path.join(os.path.dirname(watcher.CAPTURE_LOG),
+                               "tpu_capture_r05.log")
+    assert os.path.exists(sandbox_log)
+    assert os.path.exists(real_path) == real_before, \
+        "capture_evidence wrote outside the sandboxed CAPTURE_LOG dir"
 
     rc, calls = _run(watcher, monkeypatch, probes=[(True, 1, "tpu")],
                      capture_rcs=[0], argv_extra=["--tag", "r05"])

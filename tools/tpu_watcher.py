@@ -76,7 +76,9 @@ def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES,
     capture_log = CAPTURE_LOG
     if tag is not None:
         cmd += ["--tag", tag]
-        capture_log = os.path.join(REPO, "benchmarks",
+        # derive from CAPTURE_LOG (not REPO) so tests that repoint the
+        # log keep the tagged variant in the same sandbox
+        capture_log = os.path.join(os.path.dirname(CAPTURE_LOG),
                                    f"tpu_capture_{tag}.log")
     with open(SENTINEL, "w") as f:
         f.write(utcnow() + "\n")
