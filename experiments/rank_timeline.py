@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Paper-style rank timeline: r(t) on one follower's feed, RedQueen vs
+budget-matched Poisson (SURVEY.md §2 item 15; the reference notebooks'
+signature per-run visual, complementing the aggregate bars/curves of
+compare_policies.py / tradeoff.py).
+
+One component each: the controlled broadcaster vs Poisson walls. The
+figure shows the rank step function of the chosen feed over time — a
+RedQueen trajectory hugs rank 0, re-posting exactly when pushed down,
+while the budget-matched Poisson drifts; the shaded area is the
+time-in-top-1 integral the headline metric measures.
+
+Usage:
+    python experiments/rank_timeline.py [--seed N] [--feed I] [--fig out.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(T: float = 100.0, F: int = 5, q: float = 1.0, wall_rate: float = 1.0,
+        seed: int = 0, capacity: int = 4096):
+    """Simulate the RedQueen component, budget-match a Poisson component at
+    RedQueen's realized post count, and return per-policy DataFrames plus
+    the budget. The two components share ``seed``, and wall sources occupy
+    the same rows, so the wall streams are BIT-IDENTICAL — a paired
+    comparison: only the controlled broadcaster differs between panels.
+    Aggregate ordering over many seeds is pinned by
+    experiments/compare_policies.py."""
+    from redqueen_tpu.baselines import budget_matched_poisson_rate
+    from redqueen_tpu.config import GraphBuilder
+    from redqueen_tpu.sim import simulate
+    from redqueen_tpu.utils.dataframe import events_to_dataframe
+    from redqueen_tpu.utils.metrics_pandas import num_posts_of_src
+
+    def component(add_ctrl):
+        gb = GraphBuilder(n_sinks=F, end_time=T)
+        ctrl = add_ctrl(gb)
+        for i in range(F):
+            gb.add_poisson(rate=wall_rate, sinks=[i])
+        cfg, params, adj = gb.build(capacity=capacity)
+        log = simulate(cfg, params, adj, seed=seed)
+        df = events_to_dataframe(log.times, log.srcs, np.asarray(adj))
+        return df, ctrl
+
+    df_opt, opt_id = component(lambda gb: gb.add_opt(q=q))
+    budget = num_posts_of_src(df_opt, opt_id)
+    rate = budget_matched_poisson_rate(budget, T)
+    df_poi, poi_id = component(lambda gb: gb.add_poisson(rate=rate))
+    return {"opt": (df_opt, opt_id), "poisson": (df_poi, poi_id)}, budget
+
+
+def rank_steps(df, src_id, sink_id, T: float):
+    """(times, ranks) step function of ``src_id``'s rank in ``sink_id``'s
+    feed over [0, T]: rank 0 before any feed activity (the metric layer's
+    convention), then one step per event touching the feed."""
+    from redqueen_tpu.utils.metrics_pandas import rank_of_src_in_df
+
+    times, ranks = rank_of_src_in_df(df, src_id).get(
+        sink_id, (np.empty(0), np.empty(0, np.int64))
+    )
+    t = np.concatenate([[0.0], times, [T]])
+    last = ranks[-1] if len(ranks) else 0
+    r = np.concatenate([[0], ranks, [last]])
+    return t, r
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--feed", type=int, default=0)
+    ap.add_argument("--followers", type=int, default=5)
+    ap.add_argument("--horizon", type=float, default=100.0)
+    ap.add_argument("--fig", default=None)
+    args = ap.parse_args()
+    if not 0 <= args.feed < args.followers:
+        # a missing sink would plot as a confidently flat rank-0 panel
+        ap.error(f"--feed {args.feed} out of range for "
+                 f"--followers {args.followers}")
+
+    from redqueen_tpu.utils.backend import ensure_live_backend
+    from redqueen_tpu.utils.metrics_pandas import time_in_top_k
+    ensure_live_backend()
+
+    results, budget = run(T=args.horizon, F=args.followers, seed=args.seed)
+    print(f"budget (RedQueen realized posts): {budget}")
+    steps = {
+        name: rank_steps(df, src, args.feed, args.horizon)
+        for name, (df, src) in results.items()
+    }
+    for name, (df, src) in results.items():
+        t, _r = steps[name]
+        # the committed headline metric, restricted to this feed
+        frac0 = time_in_top_k(df, 1, args.horizon, src,
+                              per_sink=True)[args.feed] / args.horizon
+        print(f"{name:8s} feed {args.feed}: {len(t) - 2} feed events, "
+              f"top-1 fraction {frac0:.3f}")
+
+    if args.fig:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, axes = plt.subplots(2, 1, figsize=(9, 5), sharex=True)
+        rmax = max(r.max() for _t, r in steps.values())
+        for ax, name in zip(axes, steps):
+            t, rc = steps[name]
+            ax.step(t, rc, where="post", lw=1.2,
+                    color="tab:red" if name == "opt" else "tab:blue")
+            ax.fill_between(t, 0, 0.999, where=rc == 0,
+                            step="post", alpha=0.25, color="tab:green")
+            ax.set_ylabel(f"{name}\nrank r(t)")
+            ax.set_ylim(-0.3, rmax + 0.5)
+        axes[0].set_title(
+            f"Rank in feed {args.feed} over time at matched budget "
+            f"({budget} posts): RedQueen re-posts on demotion"
+        )
+        axes[1].set_xlabel("time")
+        fig.tight_layout()
+        fig.savefig(args.fig, dpi=120)
+        print(f"wrote {args.fig}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -47,3 +48,26 @@ def test_tradeoff_smoke():
     assert np.allclose(posts_p.mean(1), budgets, rtol=0.35)
     # Opt dominates at every budget (mean over seeds).
     assert np.all(top_o.mean(1) >= top_p.mean(1))
+
+
+def test_rank_timeline_smoke():
+    from experiments.rank_timeline import rank_steps, run
+    from redqueen_tpu.utils.metrics_pandas import (
+        num_posts_of_src,
+        time_in_top_k,
+    )
+
+    results, budget = run(T=40.0, F=3, seed=1, capacity=1024)
+    assert budget > 0
+    for name, (df, src) in results.items():
+        # both controlled broadcasters actually post (rank-0-by-convention
+        # would make a time-at-top check pass even for a silent policy)
+        assert num_posts_of_src(df, src) > 0, name
+        t, r = rank_steps(df, src, 0, 40.0)
+        assert t[0] == 0.0 and t[-1] == 40.0
+        assert np.all(np.diff(t) >= 0) and np.all(r >= 0)
+        # the step function must integrate to the committed headline
+        # metric (same rank convention end to end)
+        frac_steps = float(np.sum(np.diff(t)[r[:-1] == 0]))
+        want = time_in_top_k(df, 1, 40.0, src, per_sink=True)[0]
+        assert frac_steps == pytest.approx(want, abs=1e-9)
