@@ -1,6 +1,7 @@
 """Sweep API (redqueen_tpu.sweep): the reference's nested seed/parameter
 host loops (SURVEY.md section 3.5) as one device dispatch."""
 
+import os
 import numpy as np
 import pytest
 
@@ -131,3 +132,80 @@ class TestRunSweepStar:
         sh = run_sweep_star(pts, n_seeds=4, mesh=mesh)
         for a, b in zip(ref, sh):
             np.testing.assert_array_equal(a, b)
+
+
+def test_checkpointed_sweep_matches_and_resumes(tmp_path, monkeypatch):
+    """run_sweep_checkpointed: bit-identical to the single-dispatch sweep,
+    recomputes only missing chunks on resume, and invalidates a chunk
+    whose inputs changed (never mixes stale results)."""
+    import redqueen_tpu.sweep as sweep_mod
+    from redqueen_tpu.sweep import run_sweep, run_sweep_checkpointed
+
+    pts = q_points([0.25, 0.5, 1.0, 2.0, 4.0])
+    want = run_sweep(pts, n_seeds=3)
+
+    calls = []
+    real_run = sweep_mod.run_sweep
+
+    def counting_run(p, n, **kw):
+        calls.append(len(p))
+        return real_run(p, n, **kw)
+
+    monkeypatch.setattr(sweep_mod, "run_sweep", counting_run)
+
+    d = str(tmp_path / "ck")
+    got = run_sweep_checkpointed(pts, 3, d, chunk_points=2)
+    for f in want._fields:
+        np.testing.assert_array_equal(getattr(got, f), getattr(want, f))
+    assert calls == [2, 2, 1]  # 5 points in chunks of 2
+
+    # full resume: every chunk banked, nothing recomputes
+    calls.clear()
+    got2 = run_sweep_checkpointed(pts, 3, d, chunk_points=2)
+    assert calls == []
+    np.testing.assert_array_equal(got2.time_in_top_k, want.time_in_top_k)
+
+    # interrupted resume: one chunk file lost -> only it recomputes
+    os.remove(os.path.join(d, "chunk_00001.npz"))
+    calls.clear()
+    got3 = run_sweep_checkpointed(pts, 3, d, chunk_points=2)
+    assert calls == [2]
+    np.testing.assert_array_equal(got3.time_in_top_k, want.time_in_top_k)
+
+    # input change: the affected chunk's fingerprint mismatches -> it
+    # recomputes; untouched chunks still load
+    pts2 = list(pts)
+    pts2[0] = q_points([0.3])[0]
+    calls.clear()
+    got4 = run_sweep_checkpointed(pts2, 3, d, chunk_points=2)
+    assert calls == [2]
+    want4 = real_run(pts2, n_seeds=3)
+    np.testing.assert_array_equal(got4.time_in_top_k, want4.time_in_top_k)
+
+
+def test_checkpointed_sweep_rejects_bad_chunk_points(tmp_path):
+    from redqueen_tpu.sweep import run_sweep_checkpointed
+
+    with pytest.raises(ValueError, match="chunk_points"):
+        run_sweep_checkpointed(q_points([1.0]), 2, str(tmp_path), chunk_points=0)
+
+
+def test_checkpointed_sweep_survives_corrupt_chunk(tmp_path):
+    from redqueen_tpu.sweep import run_sweep, run_sweep_checkpointed
+
+    pts = q_points([0.5, 2.0])
+    want = run_sweep(pts, n_seeds=2)
+    d = str(tmp_path / "ck")
+    run_sweep_checkpointed(pts, 2, d, chunk_points=1)
+    # truncated copy / foreign file: must recompute, not crash
+    with open(os.path.join(d, "chunk_00000.npz"), "wb") as f:
+        f.write(b"not a zipfile")
+    got = run_sweep_checkpointed(pts, 2, d, chunk_points=1)
+    np.testing.assert_array_equal(got.time_in_top_k, want.time_in_top_k)
+
+
+def test_checkpointed_sweep_rejects_empty_points(tmp_path):
+    from redqueen_tpu.sweep import run_sweep_checkpointed
+
+    with pytest.raises(ValueError, match="empty sweep"):
+        run_sweep_checkpointed([], 2, str(tmp_path / "x"))
