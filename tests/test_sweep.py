@@ -209,3 +209,32 @@ def test_checkpointed_sweep_rejects_empty_points(tmp_path):
 
     with pytest.raises(ValueError, match="empty sweep"):
         run_sweep_checkpointed([], 2, str(tmp_path / "x"))
+
+
+def test_checkpointed_sweep_star_engine(tmp_path, monkeypatch):
+    """star=True routes chunks through run_sweep_star with the same
+    bit-identity and resume-only-missing semantics as the scan engine."""
+    import redqueen_tpu.sweep as sweep_mod
+    from redqueen_tpu.sweep import run_sweep_checkpointed, run_sweep_star
+
+    pts = star_q_points([0.3, 1.0, 3.0], F=4, T=40.0)
+    want = run_sweep_star(pts, n_seeds=2)
+
+    calls = []
+    real = sweep_mod.run_sweep_star
+
+    def counting(p, n, **kw):
+        calls.append(len(p))
+        return real(p, n, **kw)
+
+    monkeypatch.setattr(sweep_mod, "run_sweep_star", counting)
+    d = str(tmp_path / "ck")
+    got = run_sweep_checkpointed(pts, 2, d, chunk_points=2, star=True)
+    for f in want._fields:
+        np.testing.assert_array_equal(getattr(got, f), getattr(want, f))
+    assert calls == [2, 1]
+
+    calls.clear()
+    got2 = run_sweep_checkpointed(pts, 2, d, chunk_points=2, star=True)
+    assert calls == []
+    np.testing.assert_array_equal(got2.n_posts, want.n_posts)
