@@ -125,3 +125,4 @@ def test_fuzz_device_metrics_match_pandas(events, K):
         np.asarray(m.int_rank2),
         [per_r2[f] for f in range(_F)], rtol=1e-5, atol=1e-4,
     )
+    assert int(num_posts(srcs, 0)) == mp.num_posts_of_src(df, 0)
