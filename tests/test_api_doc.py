@@ -10,6 +10,7 @@ DOC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "docs", "API.md")
 
 MODULES = [
+    "redqueen_tpu",
     "redqueen_tpu.sim", "redqueen_tpu.sweep", "redqueen_tpu.config",
     "redqueen_tpu.parallel.comm", "redqueen_tpu.parallel.multihost",
     "redqueen_tpu.parallel.bigf", "redqueen_tpu.parallel.shard",
@@ -29,6 +30,8 @@ def test_api_index_covers_all_exports():
         exports = getattr(mod, "__all__", None)
         assert exports, f"{m} should declare __all__"
         for name in exports:
+            if name == "__version__":
+                continue  # metadata, not API surface
             if name not in doc:
                 missing.append(f"{m}.{name}")
     assert not missing, (
