@@ -184,7 +184,10 @@ def run_jax_star(B: int, n_followers: int, T: float, q: float,
     events = int(res.wall_n.sum()) + int(res.n_posts.sum())
     tops = np.asarray(res.metrics.mean_time_in_top_k()).reshape(-1)
     posts = float(res.n_posts.mean())
-    return events, secs, float(tops.mean()), float(tops.std()), posts
+    # No sequential-step roofline for the star engine: it has no per-event
+    # scan step (streams + sort + suffix-min), so the scan utilization
+    # model does not apply.
+    return events, secs, float(tops.mean()), float(tops.std()), posts, {}
 
 
 # CPU cache-locality optimum for the scan engine's lane count (measured on
@@ -212,14 +215,23 @@ def _slab_size(B: int, target: int) -> int:
 
 def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
                           q: float, wall_rate: float, capacity: int,
-                          deadline_abs=None):
+                          deadline_abs=None, profile_dir=None):
     """Shared harness for engines with the EventLog contract: build the
     component batch, one warm-up run (compilation), timed best-of-N over
     the (possibly slabbed) batch (budget-aware — see _more_reps_fit),
-    metrics. ``simulate_fn(cfg, params, adj, seeds)`` -> EventLog."""
+    metrics. ``simulate_fn(cfg, params, adj, seeds)`` -> EventLog.
+
+    Returns ``(events, secs, top1, top1_std, posts, extras)`` where
+    ``extras`` is the utilization block (steps, step_ns, hbm_gbps, ...)
+    from redqueen_tpu.utils.roofline — the MFU analogue for an event
+    simulator (round-4 verdict item "missing 4")."""
     import jax
     from redqueen_tpu.config import stack_components
     from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
+    from redqueen_tpu.utils.roofline import (
+        roofline_fields,
+        scan_step_traffic_bytes,
+    )
 
     on_cpu = jax.devices()[0].platform == "cpu"
     slab = _slab_size(B, CPU_SLAB) if on_cpu else B
@@ -243,6 +255,34 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
             logs.append(logb)
         secs = min(secs, time.perf_counter() - t0)
 
+    if profile_dir:
+        # One extra (untimed) pass under the profiler: the on-chip trace
+        # the round-4 verdict asked for. DEFERRED — the caller invokes the
+        # callback AFTER printing the result line, so a wedged-tunnel hang
+        # inside the trace (which raises nothing and would dodge any
+        # except-clause) can cost only the trace, never the
+        # already-measured result.
+        def _profile_cb():
+            try:
+                os.makedirs(profile_dir, exist_ok=True)
+                with jax.profiler.trace(profile_dir):
+                    lg = simulate_fn(cfg, params, adj, np.arange(slab) + 10_000)
+                    jax.block_until_ready(lg.times)
+                log(f"profiler trace written to {profile_dir}")
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                log(f"profiler trace FAILED (non-fatal): {e!r}")
+    else:
+        _profile_cb = None
+
+    # Sequential scan steps executed = emitted buffer length per dispatch
+    # (chunks_run * capacity), summed over the slab dispatches of one rep.
+    n_steps = sum(lg.times.shape[-1] for lg in logs)
+    extras = roofline_fields(
+        n_steps, secs, scan_step_traffic_bytes(cfg, params, adj),
+        jax.devices()[0].platform, jax.devices()[0].device_kind)
+    if _profile_cb is not None:
+        extras["_profile_cb"] = _profile_cb  # popped by child_main pre-print
+
     events = sum(int(np.asarray(lg.n_events).sum()) for lg in logs)
     tops, posts_l = [], []
     for lg in logs:
@@ -251,7 +291,7 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
         posts_l.append(float(np.asarray(num_posts(lg.srcs, opt)).mean()))
     tops = np.concatenate(tops)  # per-lane values across all B lanes
     posts = float(np.mean(posts_l))
-    return events, secs, float(tops.mean()), float(tops.std()), posts
+    return events, secs, float(tops.mean()), float(tops.std()), posts, extras
 
 
 def _max_chunks(n_followers: int, T: float, wall_rate: float,
@@ -274,7 +314,8 @@ def _sync_every() -> int:
 
 
 def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
-                   wall_rate: float, capacity: int, deadline_abs=None):
+                   wall_rate: float, capacity: int, deadline_abs=None,
+                   profile_dir=None):
     """Headline graph on the Pallas event-scan engine: the whole chunk is one
     fused kernel with state resident in VMEM (ops/pallas_chunk.py). TPU
     only — interpret mode exists for tests, not timing."""
@@ -285,11 +326,11 @@ def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
     fn = lambda cfg, p, a, s: simulate_pallas(cfg, p, a, s, max_chunks=mc,
                                               sync_every=sync)
     return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate,
-                                 capacity, deadline_abs)
+                                 capacity, deadline_abs, profile_dir)
 
 
 def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
-            capacity: int, deadline_abs=None):
+            capacity: int, deadline_abs=None, profile_dir=None):
     from redqueen_tpu.sim import simulate_batch
 
     mc = _max_chunks(n_followers, T, wall_rate, capacity)
@@ -297,7 +338,7 @@ def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
     fn = lambda cfg, p, a, s: simulate_batch(cfg, p, a, s, max_chunks=mc,
                                              sync_every=sync)
     return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate,
-                                 capacity, deadline_abs)
+                                 capacity, deadline_abs, profile_dir)
 
 
 def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
@@ -438,21 +479,28 @@ def child_main(args) -> None:
     # headroom for the metrics pass + the final print.
     deadline_abs = _START + args.deadline * 0.92
     if args.as_engine == "star":
-        ev, secs, top1, top1_std, posts = _star_with_retry(
+        ev, secs, top1, top1_std, posts, extras = _star_with_retry(
             args, B, T, deadline_abs=deadline_abs)
     elif args.as_engine == "scan":
-        ev, secs, top1, top1_std, posts = run_jax(
+        ev, secs, top1, top1_std, posts, extras = run_jax(
             B, args.followers, T, args.q, args.wall_rate, capacity,
-            deadline_abs=deadline_abs)
+            deadline_abs=deadline_abs, profile_dir=args.profile)
     elif args.as_engine == "pallas":
-        ev, secs, top1, top1_std, posts = run_jax_pallas(
+        ev, secs, top1, top1_std, posts, extras = run_jax_pallas(
             B, args.followers, T, args.q, args.wall_rate, capacity,
-            deadline_abs=deadline_abs)
+            deadline_abs=deadline_abs, profile_dir=args.profile)
     else:
         raise SystemExit(f"unknown engine {args.as_engine!r}")
-    print(json.dumps({"ok": True, "events": ev, "secs": secs, "top1": top1,
-                      "top1_std": top1_std, "top1_n": B, "posts": posts,
-                      "platform": jax.devices()[0].platform}), flush=True)
+    profile_cb = extras.pop("_profile_cb", None)
+    out = {"ok": True, "events": ev, "secs": secs, "top1": top1,
+           "top1_std": top1_std, "top1_n": B, "posts": posts,
+           "platform": jax.devices()[0].platform}
+    out.update(extras)  # utilization block (roofline_fields); {} for star
+    print(json.dumps(out), flush=True)
+    if profile_cb is not None:
+        # After the result print on purpose: a tunnel wedge mid-trace can
+        # cost only the trace (parent timeout kills us post-result).
+        profile_cb()
 
 
 # ---------------------------------------------------------------------------
@@ -482,6 +530,8 @@ def _run_child(args, engine: str, backend: str, timeout_s: float):
         cmd += ["--capacity", str(args.capacity)]
     if args.config is not None:
         cmd += ["--config", str(args.config)]
+    if args.profile:
+        cmd += ["--profile", args.profile]
     t0 = time.monotonic()
     try:
         r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
@@ -659,6 +709,12 @@ def parent_main(args) -> None:
             "platform": res["platform"],
             "engine": engine_name,
         }
+        # Utilization block (the MFU analogue; see utils/roofline.py) —
+        # present for the scan/pallas engines, absent for star/config.
+        for k in ("steps", "step_ns", "bytes_per_step", "hbm_gbps",
+                  "hbm_peak_gbps", "hbm_frac"):
+            if k in res:
+                line[k] = res[k]
         line.update(gate_fields(res))
         _emit_result_line(line)
         if o is not None:
@@ -779,6 +835,11 @@ def main():
                          "prints its result line before being killed")
     ap.add_argument("--engine-deadline", type=float, default=420.0,
                     help="per-engine subprocess budget (s)")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="after the timed reps, run ONE extra engine pass "
+                         "under jax.profiler.trace(DIR) (scan/pallas "
+                         "engines only) — the on-chip profile capture; "
+                         "failure to trace is non-fatal to the result")
     ap.add_argument("--no-oracle", action="store_true",
                     help="skip the NumPy-oracle denominator (engine-vs-"
                          "engine comparisons; O(sources)-per-event makes it "

@@ -1,0 +1,143 @@
+"""Utilization model for the event-scan engines (the MFU analogue).
+
+An event simulator does almost no FLOPs — its roofline axis is HBM
+traffic, not matmul throughput. The quantity that says whether a measured
+events/s number is 5% or 95% of what the chip can do is the achieved
+bytes/s of the sequential scan against the device's peak memory bandwidth
+(SURVEY.md section 5: the profiling harness is first-class; round-4
+verdict item "what's missing" 4).
+
+Model (documented so every emitted number is decomposable):
+
+- A *step* is one sequential iteration of the event scan: every lane
+  advances by (at most) one event. The scan carry (``SimState``) must be
+  read and written once per step; the policy parameters (``SourceParams``)
+  and adjacency are read once per step; one (time f32, src i32) log slot
+  per lane is written per step. Counter-addressed PRNG draws touch no
+  memory. This is the MINIMUM traffic the algorithm requires if nothing
+  stays resident — XLA/Mosaic keeping the carry in registers/VMEM can
+  only *reduce* real HBM traffic below the model, so
+  ``hbm_frac = modeled_bytes/s / peak`` is an upper bound on how close
+  the scan is to the bandwidth wall, and ``1 - hbm_frac`` is a lower
+  bound on the latency/dispatch headroom. (That split is exactly the
+  DESIGN.md decomposition question: the full-shape TPU scan measured
+  8.99M ev/s in r04 — is it bandwidth-bound or per-step latency-bound?)
+
+Peak bandwidths are public per-generation figures; the device kind string
+comes from ``jax.Device.device_kind``. Unknown kinds (and the CPU
+fallback backend, whose DRAM peak this 1-core box does not advertise)
+report ``hbm_peak_gbps: null`` and ``hbm_frac: null`` rather than a
+made-up denominator.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "hbm_peak_gbps",
+    "pytree_nbytes",
+    "scan_step_traffic_bytes",
+    "roofline_fields",
+]
+
+# Public per-generation peak HBM bandwidth, GB/s (vendor-published specs).
+# Matched case-insensitively as substrings of jax.Device.device_kind
+# (e.g. "TPU v4", "TPU v5 lite", "TPU v5p"); longest match wins so
+# "v5p" is tried before "v5".
+_HBM_PEAK_GBPS = {
+    "v2": 700.0,
+    "v3": 900.0,
+    "v4": 1228.0,
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6 lite": 1638.0,
+    "v6e": 1638.0,
+}
+
+
+def hbm_peak_gbps(device_kind: str) -> Optional[float]:
+    """Peak HBM bandwidth for a device-kind string, or None if unknown."""
+    kind = (device_kind or "").lower()
+    best = None
+    for pat, gbps in _HBM_PEAK_GBPS.items():
+        if pat in kind and (best is None or len(pat) > len(best[0])):
+            best = (pat, gbps)
+    return best[1] if best else None
+
+
+def pytree_nbytes(tree) -> int:
+    """Total bytes of every array leaf in a pytree (shape metadata only —
+    works on jax.ShapeDtypeStruct trees from ``jax.eval_shape``, so no
+    device memory is touched)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
+def scan_step_traffic_bytes(cfg, params, adj) -> int:
+    """Modeled HBM bytes one sequential scan step must move for ONE
+    dispatch of the given (possibly batched) component shape.
+
+    ``params``/``adj`` are the arrays actually passed to the engine
+    (leading batch axis included) — the state footprint is derived with
+    ``jax.eval_shape`` on the real ``init_state``, so the model can never
+    drift from the carry the kernel actually materializes.
+    """
+    import jax
+    import jax.random as jr
+
+    from ..ops.scan_core import init_state
+
+    batched = getattr(params.kind, "ndim", 1) == 2
+
+    def init(p, a):
+        key = jr.PRNGKey(0)
+        if batched:
+            keys = jax.vmap(jr.PRNGKey)(
+                np.zeros((p.kind.shape[0],), np.int32))
+            return jax.vmap(lambda pp, aa, kk: init_state(cfg, pp, aa, kk))(
+                p, a, keys)
+        return init_state(cfg, p, a, key)
+
+    state = jax.eval_shape(init, params, adj)
+    state_b = pytree_nbytes(state)
+    params_b = pytree_nbytes(params) + pytree_nbytes(adj)
+    n_lanes = params.kind.shape[0] if batched else 1
+    log_b = n_lanes * 8  # one (f32 time, i32 src) slot per lane per step
+    # read state + write state + read params/adj + write log slot
+    return 2 * state_b + params_b + log_b
+
+
+def roofline_fields(n_steps: int, secs: float, bytes_per_step: int,
+                    platform: str, device_kind: str) -> dict:
+    """The utilization block for a bench result line.
+
+    ``n_steps`` = sequential scan steps executed (summed over slabs);
+    ``secs`` = the timed best-of-N wall for those steps; ``bytes_per_step``
+    from :func:`scan_step_traffic_bytes` (per dispatch — slab-level when
+    the batch runs in slabs).
+    """
+    if n_steps <= 0 or not np.isfinite(secs) or secs <= 0:
+        return {}
+    step_ns = secs / n_steps * 1e9
+    gbps = bytes_per_step * n_steps / secs / 1e9
+    peak = hbm_peak_gbps(device_kind) if platform == "tpu" else None
+    return {
+        "steps": int(n_steps),
+        "step_ns": round(step_ns, 1),
+        "bytes_per_step": int(bytes_per_step),
+        "hbm_gbps": round(gbps, 3),
+        "hbm_peak_gbps": peak,
+        "hbm_frac": round(gbps / peak, 4) if peak else None,
+    }

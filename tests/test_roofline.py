@@ -1,0 +1,111 @@
+"""Utilization-model tests (redqueen_tpu/utils/roofline.py).
+
+The roofline block is the bench harness's MFU analogue (SURVEY.md section 5:
+profiling is first-class): per-sequential-step latency and modeled HBM
+traffic against the device's peak bandwidth. These tests pin (a) the peak
+table lookup, (b) the traffic model against a hand count of the SimState /
+SourceParams footprint, and (c) the derived fields' arithmetic — so a bench
+result line's step_ns/hbm_gbps can be trusted to mean what the docstring
+says.
+"""
+
+import numpy as np
+import pytest
+
+from redqueen_tpu.config import GraphBuilder, stack_components
+from redqueen_tpu.utils.roofline import (
+    hbm_peak_gbps,
+    pytree_nbytes,
+    roofline_fields,
+    scan_step_traffic_bytes,
+)
+
+
+def test_hbm_peak_lookup():
+    assert hbm_peak_gbps("TPU v4") == 1228.0
+    # Longest match wins: "v5p" must not fall through to a bare "v5" rule.
+    assert hbm_peak_gbps("TPU v5p") == 2765.0
+    assert hbm_peak_gbps("TPU v5 lite") == 819.0
+    assert hbm_peak_gbps("cpu") is None
+    assert hbm_peak_gbps("") is None
+
+
+def _component(n_followers=4):
+    gb = GraphBuilder(n_sinks=n_followers, end_time=10.0)
+    gb.add_opt(q=1.0)
+    for i in range(n_followers):
+        gb.add_poisson(rate=1.0, sinks=[i])
+    return gb.build(capacity=64)
+
+
+def test_traffic_model_matches_hand_count():
+    import jax
+
+    cfg, p0, a0 = _component()
+    slab = 3
+    params, adj = stack_components([p0] * slab, [a0] * slab)
+    got = scan_step_traffic_bytes(cfg, params, adj)
+
+    # Independent hand count: state via eval_shape on the public init path
+    # (simulate's own _init_fn), params/adj from the concrete arrays.
+    from redqueen_tpu.ops.scan_core import init_state
+
+    keys = jax.vmap(jax.random.PRNGKey)(np.zeros((slab,), np.int32))
+    state = jax.eval_shape(
+        jax.vmap(lambda p, a, k: init_state(cfg, p, a, k)), params, adj, keys
+    )
+    want = (2 * pytree_nbytes(state) + pytree_nbytes(params)
+            + pytree_nbytes(adj) + slab * 8)
+    assert got == want
+    assert got > 0
+
+
+def test_traffic_model_scales_with_batch():
+    cfg, p0, a0 = _component()
+    p1, a1 = stack_components([p0], [a0])
+    p4, a4 = stack_components([p0] * 4, [a0] * 4)
+    b1 = scan_step_traffic_bytes(cfg, p1, a1)
+    b4 = scan_step_traffic_bytes(cfg, p4, a4)
+    # Per-step traffic is linear in the lane count (same component shape).
+    assert b4 == 4 * b1
+
+
+def test_roofline_fields_arithmetic():
+    out = roofline_fields(n_steps=1000, secs=0.5, bytes_per_step=1_000_000,
+                          platform="tpu", device_kind="TPU v4")
+    assert out["steps"] == 1000
+    assert out["step_ns"] == pytest.approx(0.5 / 1000 * 1e9)
+    # 1 MB/step * 1000 steps / 0.5 s = 2 GB/s
+    assert out["hbm_gbps"] == pytest.approx(2.0)
+    assert out["hbm_peak_gbps"] == 1228.0
+    assert out["hbm_frac"] == pytest.approx(2.0 / 1228.0, abs=1e-4)
+    # CPU fallback: no made-up peak denominator.
+    cpu = roofline_fields(1000, 0.5, 1_000_000, "cpu", "cpu")
+    assert cpu["hbm_peak_gbps"] is None and cpu["hbm_frac"] is None
+    # Degenerate inputs produce an empty block, never a division error.
+    assert roofline_fields(0, 0.5, 1, "tpu", "TPU v4") == {}
+    assert roofline_fields(10, float("inf"), 1, "tpu", "TPU v4") == {}
+
+
+def test_bench_quick_result_carries_utilization_block(tmp_path):
+    """End-to-end: a quick scan-engine bench line includes the block (the
+    driver-facing contract the round-4 verdict asked for)."""
+    import json
+    import subprocess
+    import sys
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--quick",
+         "--engine", "scan", "--broadcasters", "8", "--horizon", "5",
+         "--deadline", "240"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["steps"] > 0
+    assert line["step_ns"] > 0
+    assert line["bytes_per_step"] > 0
+    assert line["hbm_gbps"] > 0
+    assert line["hbm_frac"] is None  # cpu run: no fabricated peak

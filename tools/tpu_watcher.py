@@ -52,7 +52,7 @@ def append_log(line: str) -> None:
         f.write(line + "\n")
 
 
-DEFAULT_STAGES = (2, 6, 3, 4, 1, 5)
+DEFAULT_STAGES = (2, 6, 7, 3, 4, 1, 5)
 
 
 def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES,
