@@ -532,19 +532,28 @@ def _run_child(args, engine: str, backend: str, timeout_s: float):
         cmd += ["--config", str(args.config)]
     if args.profile:
         cmd += ["--profile", args.profile]
+    from redqueen_tpu.utils.backend import parse_last_json_line
+
     t0 = time.monotonic()
     try:
         r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
                            text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         log(f"engine {engine} ({backend}) TIMED OUT after {timeout_s:.0f}s")
-        return None
+        # A child that printed its result line BEFORE hanging (e.g. the
+        # deferred --profile trace wedging on the tunnel) must not lose
+        # it: TimeoutExpired carries the stdout captured so far.
+        out_txt = e.stdout if isinstance(e.stdout, str) else (
+            e.stdout.decode(errors="replace") if e.stdout else "")
+        obj = parse_last_json_line(out_txt, require_ok=True)
+        if obj is not None:
+            log(f"engine {engine} ({backend}) result line recovered from "
+                f"pre-timeout stdout")
+        return obj
     took = time.monotonic() - t0
     if r.stderr:
         for line in r.stderr.strip().splitlines()[-6:]:
             log(f"  [{engine}] {line}")
-    from redqueen_tpu.utils.backend import parse_last_json_line
-
     obj = parse_last_json_line(r.stdout, require_ok=True)
     if obj is not None:
         log(f"engine {engine} ({backend}) done in {took:.1f}s wall")

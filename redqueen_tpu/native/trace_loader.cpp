@@ -263,11 +263,25 @@ void* rq_parse_csv(const char* path, int user_col, int time_col,
   size_t pos = 0;
   long lineno = -1;
   while (pos < n) {
-    const char* nl = static_cast<const char*>(
+    // Universal-newline parity with the Python engine (binary read keeps
+    // raw terminators): '\n', '\r', and '\r\n' all end a line — a '\r'
+    // left in a field would silently split e.g. "alice" / "alice\r" into
+    // two users on mixed-endings files, and CR-only (classic-Mac) files
+    // would collapse to one giant line.
+    const char* lf = static_cast<const char*>(
         std::memchr(base + pos, '\n', n - pos));
-    size_t le = nl ? static_cast<size_t>(nl - base) : n;  // rstrip("\n")
+    // '\r' search bounded to the LF-terminated span: an unbounded scan of
+    // the remaining buffer would be O(corpus) per line on LF-only files.
+    const size_t span = lf ? static_cast<size_t>(lf - (base + pos)) : n - pos;
+    const char* cr = static_cast<const char*>(
+        std::memchr(base + pos, '\r', span));
+    const char* nl = cr ? cr : lf;
+    size_t le = nl ? static_cast<size_t>(nl - base) : n;
     std::string_view line(base + pos, le - pos);
     size_t next = le + 1;
+    if (nl && *nl == '\r' && next < n && base[next] == '\n') {
+      ++next;  // CRLF: consume both terminator bytes
+    }
     ++lineno;
     if (lineno < skip_header || is_blank(line)) {
       pos = next;

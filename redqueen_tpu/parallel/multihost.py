@@ -144,12 +144,18 @@ def gather_global(tree):
 
     if jax.process_count() == 1:
         return jax.tree.map(np.asarray, tree)
-    return jax.tree.map(
-        lambda x: np.asarray(
-            multihost_utils.process_allgather(x, tiled=True)
-        ),
-        tree,
-    )
+
+    def _leaf(x):
+        # Only process-sharded jax.Arrays need the all-gather. Replicated
+        # host-NumPy leaves (e.g. StarResult.own_times riding along in the
+        # same tree) are already whole on every process — all-gathering
+        # them would concatenate process_count copies and silently change
+        # their shape (round-4 advisor finding).
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
+    return jax.tree.map(_leaf, tree)
 
 
 def process_summary() -> dict:

@@ -204,9 +204,12 @@ def run_sweep_checkpointed(points: Sequence, n_seeds: int, ckpt_dir: str,
     value — fingerprint + four grids — where a single atomically-renamed
     file IS the whole consistency story, and orbax's step numbering /
     retention would only obscure the per-chunk invalidation."""
-    points = list(points)
-    if not points:
-        raise ValueError("empty sweep: no points given")
+    # Validate the WHOLE grid up front (not per chunk): a cfg change at a
+    # chunk boundary would otherwise run silently where the unchunked
+    # run_sweep/run_sweep_star call raises — breaking the bit-identical
+    # promise above (round-4 advisor finding).
+    points, _ = _validate_points(
+        points, n_seeds, "Wall/CtrlParams" if star else "SourceParams")
     if chunk_points < 1:
         raise ValueError(f"chunk_points must be >= 1, got {chunk_points}")
     os.makedirs(ckpt_dir, exist_ok=True)

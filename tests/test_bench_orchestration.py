@@ -242,6 +242,44 @@ def test_best_line_reprinted_after_every_engine(monkeypatch, capsys,
     assert len([ln for ln in out if ln.startswith("{")]) == 2
 
 
+def test_run_child_recovers_result_from_timeout_stdout(monkeypatch):
+    """A child that printed its result line and THEN hung (the deferred
+    --profile trace wedging on the tunnel) must not lose the measurement:
+    _run_child parses the stdout TimeoutExpired captured (round-5 review
+    finding against the 'can cost only the trace' claim)."""
+    import argparse
+    import subprocess as sp
+
+    line = json.dumps({"ok": True, "events": 10, "secs": 1.0,
+                       "platform": "tpu", "top1": 1.0})
+
+    def fake_run(cmd, timeout, capture_output, text, cwd):
+        raise sp.TimeoutExpired(cmd, timeout,
+                                output="diag noise\n" + line + "\n",
+                                stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    args = argparse.Namespace(followers=10, q=1.0, wall_rate=1.0,
+                              quick=True, broadcasters=None, horizon=None,
+                              capacity=None, config=None, profile=None)
+    got = bench._run_child(args, "scan", "default", 5.0)
+    assert got is not None and got["events"] == 10
+
+    # bytes stdout (text=False edge) and no stdout at all both degrade
+    # to the old None behavior, never raise
+    def fake_run_bytes(cmd, timeout, capture_output, text, cwd):
+        raise sp.TimeoutExpired(cmd, timeout, output=line.encode(), stderr=b"")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run_bytes)
+    assert bench._run_child(args, "scan", "default", 5.0)["events"] == 10
+
+    def fake_run_none(cmd, timeout, capture_output, text, cwd):
+        raise sp.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run_none)
+    assert bench._run_child(args, "scan", "default", 5.0) is None
+
+
 def test_more_reps_fit_rule():
     """The engine-side rep-budget rule: first rep always runs; later reps
     only when ~one more best-observed rep (+15%) fits the deadline."""

@@ -98,6 +98,7 @@ def _reference_summary():
         "star_wall_n": [int(x) for x in np.asarray(star.wall_n)],
         "star_top1": [round(float(x), 6)
                       for x in np.asarray(star.metrics.time_in_top_k)],
+        "star_own_shape": list(np.asarray(star.own_times).shape),
     }
 
 
@@ -160,3 +161,6 @@ def test_two_process_run_matches_single_process(tmp_path):
     assert got["star_own_sum"] == want["star_own_sum"], (got, want)
     assert got["star_wall_n"] == want["star_wall_n"], (got, want)
     assert got["star_top1"] == want["star_top1"], (got, want)
+    # Replicated host-NumPy leaf in the gathered tree keeps its shape —
+    # a process_count-times concatenation would double it (advisor fix)
+    assert got["star_own_shape"] == want["star_own_shape"], (got, want)

@@ -220,6 +220,45 @@ def test_non_seekable_input_is_read(tmp_path):
     np.testing.assert_array_equal(out[1], [3.0])
 
 
+def test_crlf_and_mixed_line_endings_match_python(tmp_path):
+    # The native loader reads binary, so CRLF terminators used to leave a
+    # trailing '\r' in the last field — "alice" and "alice\r" silently
+    # became two users when user_col was last (round-4 advisor finding).
+    # Python's universal newlines never see the '\r'; the engines must
+    # agree on pure-CRLF and on mixed CRLF/LF corpora.
+    raw = b"user,time\r\nalice,2\r\nalice,1\nbob,3\r\n"
+    p = tmp_path / "crlf.csv"
+    p.write_bytes(raw)
+    got = loader.load_csv_native(str(p))
+    want = traces.load_csv(str(p), engine="python")
+    _assert_same(got, want)
+    assert len(got) == 2  # alice (merged), bob — not three users
+    np.testing.assert_array_equal(got[0], [1.0, 2.0])
+    # '\r' when user_col is NOT last: time field would carry it instead;
+    # "2\r" must still parse identically in both engines (Python float()
+    # strips whitespace incl. '\r' — but the line split already removed it).
+    p2 = tmp_path / "crlf2.csv"
+    p2.write_bytes(b"time,user\r\n2,alice\r\n1,alice\r\n")
+    _assert_same(
+        loader.load_csv_native(str(p2), user_col=1, time_col=0),
+        traces.load_csv(str(p2), user_col=1, time_col=0, engine="python"),
+    )
+    # CR-only (classic-Mac) endings: Python's universal newlines split on
+    # lone '\r' too; the native scanner must agree, not collapse the file
+    # into one giant line.
+    p3 = tmp_path / "cr.csv"
+    p3.write_bytes(b"user,time\ru,2\ru,1\rv,3\r")
+    got3 = loader.load_csv_native(str(p3))
+    want3 = traces.load_csv(str(p3), engine="python")
+    _assert_same(got3, want3)
+    np.testing.assert_array_equal(got3[0], [1.0, 2.0])
+    # blank lines expressed as \r\n\r\n must not produce phantom rows
+    p4 = tmp_path / "blank.csv"
+    p4.write_bytes(b"user,time\r\n\r\nu,1\r\n")
+    _assert_same(loader.load_csv_native(str(p4)),
+                 traces.load_csv(str(p4), engine="python"))
+
+
 def test_nan_timestamps_sort_last_like_numpy(tmp_path):
     # "nan" is a parseable timestamp in both engines; np.sort orders NaNs
     # last and the native sort must match (raw std::sort would be UB)

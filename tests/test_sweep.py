@@ -211,6 +211,22 @@ def test_checkpointed_sweep_rejects_empty_points(tmp_path):
         run_sweep_checkpointed([], 2, str(tmp_path / "x"))
 
 
+def test_checkpointed_sweep_rejects_cfg_change_at_chunk_boundary(tmp_path):
+    # A grid whose static config changes exactly at a chunk boundary used
+    # to run silently (each chunk self-consistent) where the unchunked
+    # run_sweep raises — breaking the bit-identical promise (round-4
+    # advisor finding). Validation must now cover the whole grid up front,
+    # before any chunk computes or lands on disk.
+    from redqueen_tpu.sweep import run_sweep_checkpointed
+
+    pts = q_points([1.0], F=4) + q_points([1.0], F=5)
+    d = str(tmp_path / "ck")
+    with pytest.raises(ValueError, match="different static config"):
+        run_sweep_checkpointed(pts, 2, d, chunk_points=1)
+    # nothing half-written: the ckpt dir has no chunk artifacts
+    assert not os.path.exists(d) or not os.listdir(d)
+
+
 def test_checkpointed_sweep_star_engine(tmp_path, monkeypatch):
     """star=True routes chunks through run_sweep_star with the same
     bit-identity and resume-only-missing semantics as the scan engine."""

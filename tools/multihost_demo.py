@@ -111,7 +111,11 @@ def main() -> int:
     own64 = np.asarray(star.own_times, np.float64)
     star_gathered = multihost.gather_global(
         {"wall_n": star.wall_n,
-         "top1": star.metrics.time_in_top_k}
+         "top1": star.metrics.time_in_top_k,
+         # Replicated host-NumPy leaf riding in the same tree: gather must
+         # pass it through unchanged, NOT concatenate one copy per process
+         # (round-4 advisor finding).
+         "own_times": star.own_times}
     )
 
     summary = multihost.process_summary()
@@ -128,6 +132,7 @@ def main() -> int:
         star_own_sum=float(own64[np.isfinite(own64)].sum()),
         star_wall_n=[int(x) for x in star_gathered["wall_n"]],
         star_top1=[round(float(x), 6) for x in star_gathered["top1"]],
+        star_own_shape=list(np.asarray(star_gathered["own_times"]).shape),
     )
     if pid == 0:
         with open(args.out, "w") as f:
