@@ -29,7 +29,35 @@ import hashlib
 import os
 import platform
 
-__all__ = ["enable_persistent_cache", "CACHE_DIR", "host_fingerprint"]
+__all__ = ["enable_persistent_cache", "CACHE_DIR", "host_fingerprint",
+           "benign_aot_warning"]
+
+# XLA:CPU codegen TUNING pseudo-features: chosen by XLA's own heuristics at
+# compile time, never present in /proc/cpuinfo. cpu_aot_loader.cc compares
+# the compile-time LLVM feature list against host features DERIVED FROM
+# cpuinfo, so an AOT executable warns about these on EVERY load — including
+# on the very host that compiled it seconds earlier (verified by
+# tests/test_backend_helpers.py::test_aot_warning_is_benign_same_host).
+# The host fingerprint above deliberately does NOT include them: they are
+# not machine properties, and no cpuinfo-based key could ever make the
+# loader's asymmetric comparison come out clean.
+_TUNING_PSEUDO_FEATURES = ("prefer-no-scatter", "prefer-no-gather")
+
+
+def benign_aot_warning(line: str) -> bool:
+    """True iff ``line`` is a ``cpu_aot_loader`` feature-mismatch warning
+    whose named unsupported feature is one of XLA's tuning pseudo-features
+    — provably same-host noise, not an ISA mismatch. A warning naming a
+    REAL ISA feature (e.g. ``+avx512f``) returns False and must stay
+    visible: that is the latent-SIGILL case the fingerprint exists for."""
+    if "cpu_aot_loader" not in line:
+        return False
+    import re
+
+    named = re.findall(r"feature \+?([\w.-]+) is not\s+supported", line)
+    return bool(named) and all(
+        f in _TUNING_PSEUDO_FEATURES for f in named
+    )
 
 
 def host_fingerprint() -> str:
@@ -55,6 +83,28 @@ CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def enable_persistent_cache() -> str:
     """Point JAX at the per-host on-disk compilation cache (setdefault, so
     an operator's explicit override always wins). Returns the directory
-    used. Child processes inherit the setting through os.environ."""
+    used. Child processes inherit the setting through os.environ.
+
+    TWO mechanisms on purpose (round-5 finding): this JAX version only
+    honors ``JAX_COMPILATION_CACHE_DIR`` when it is present in the process
+    environment AT INTERPRETER START — an ``os.environ`` write before
+    ``import jax`` is silently ignored for the CURRENT process (it still
+    propagates to subprocesses, which is why bench children always cached
+    correctly). So: the env var serves every child process, and when jax
+    is ALREADY imported we also set the config directly for this process.
+    In-process entry points (``__graft_entry__``, ``tools/fire_mode_bench``,
+    ``benchmarks/run``, ``tools/multihost_demo``) must therefore call this
+    AGAIN right after their ``import jax`` — before that second call their
+    own compiles are uncached unless the var came in from the parent."""
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
-    return os.environ["JAX_COMPILATION_CACHE_DIR"]
+    target = os.environ["JAX_COMPILATION_CACHE_DIR"]
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            if jax.config.jax_compilation_cache_dir != target:
+                jax.config.update("jax_compilation_cache_dir", target)
+        except Exception:  # noqa: BLE001 — cache is an optimization,
+            pass           # never a correctness dependency
+    return target

@@ -552,7 +552,13 @@ def _run_child(args, engine: str, backend: str, timeout_s: float):
         return obj
     took = time.monotonic() - t0
     if r.stderr:
-        for line in r.stderr.strip().splitlines()[-6:]:
+        # Drop the known-benign cpu_aot_loader tuning-pseudo-feature
+        # warning (fires on EVERY same-host AOT cache load; see
+        # _jax_cache.benign_aot_warning + its test) so the driver-captured
+        # tail stays clean; any REAL ISA-mismatch warning passes through.
+        lines = [ln for ln in r.stderr.strip().splitlines()
+                 if not _jax_cache.benign_aot_warning(ln)]
+        for line in lines[-6:]:
             log(f"  [{engine}] {line}")
     obj = parse_last_json_line(r.stdout, require_ok=True)
     if obj is not None:

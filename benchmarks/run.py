@@ -179,6 +179,10 @@ def main():
 
     import jax
 
+    # Second call AFTER import jax: the env-var path alone does not cache
+    # for THIS process in this JAX version (see _jax_cache docstring).
+    _jax_cache.enable_persistent_cache()
+
     if args.cpu or args.quick:
         jax.config.update("jax_platforms", "cpu")
     else:

@@ -38,7 +38,15 @@ def main():
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+    import _jax_cache
+
+    _jax_cache.enable_persistent_cache()
+
     import jax
+
+    # Second call AFTER import jax: the env-var path alone does not cache
+    # for THIS process in this JAX version (see _jax_cache docstring).
+    _jax_cache.enable_persistent_cache()
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -53,6 +61,10 @@ def main():
     from bench import TIMED_REPS, _max_chunks, build_component
     from redqueen_tpu.config import stack_components
     from redqueen_tpu.sim import simulate_batch
+    from redqueen_tpu.utils.roofline import (
+        roofline_fields,
+        scan_step_traffic_bytes,
+    )
 
     if args.reps is None:
         args.reps = TIMED_REPS
@@ -73,10 +85,19 @@ def main():
             secs = min(secs, time.perf_counter() - t0)
         ev = int(np.asarray(lg.n_events).sum())
         eps = ev / secs
+        # Utilization block per point: as B grows the modeled traffic
+        # (bytes/step scales linearly in lanes) exposes WHERE throughput
+        # stops scaling — a saturating hbm_gbps at flat bytes/step/lane is
+        # the memory wall, not a dispatch artifact.
+        util = roofline_fields(
+            lg.times.shape[-1], secs, scan_step_traffic_bytes(cfg, params, adj),
+            jax.devices()[0].platform, jax.devices()[0].device_kind)
         rows.append({"B": B, "events": ev, "secs": round(secs, 4),
-                     "events_per_sec": round(eps, 1)})
+                     "events_per_sec": round(eps, 1), **util})
         log(f"B={B:>6}: {ev:>9} events in {secs:.4f}s -> {eps:,.0f} ev/s "
-            f"({eps / max(B, 1):,.0f} per-lane)")
+            f"({eps / max(B, 1):,.0f} per-lane; "
+            f"{util.get('step_ns', 0):,.0f} ns/step, "
+            f"{util.get('hbm_gbps', 0):.1f} GB/s modeled)")
     out = {"platform": jax.devices()[0].platform,
            "shape": "1 Opt x 10 Poisson feeds, T=100, capacity=64",
            "reps": args.reps, "rows": rows}

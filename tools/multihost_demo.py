@@ -51,6 +51,9 @@ def main() -> int:
     import _jax_cache
     _jax_cache.enable_persistent_cache()
     import jax
+    # Second call AFTER import jax: the env-var path alone does not cache
+    # for THIS process in this JAX version (see _jax_cache docstring).
+    _jax_cache.enable_persistent_cache()
     jax.config.update("jax_platforms", "cpu")
 
     from redqueen_tpu.parallel import multihost
