@@ -46,18 +46,37 @@ _TUNING_PSEUDO_FEATURES = ("prefer-no-scatter", "prefer-no-gather")
 
 def benign_aot_warning(line: str) -> bool:
     """True iff ``line`` is a ``cpu_aot_loader`` feature-mismatch warning
-    whose named unsupported feature is one of XLA's tuning pseudo-features
-    — provably same-host noise, not an ISA mismatch. A warning naming a
-    REAL ISA feature (e.g. ``+avx512f``) returns False and must stay
-    visible: that is the latent-SIGILL case the fingerprint exists for."""
+    whose mismatch is ONLY XLA's tuning pseudo-features — provably
+    same-host noise, not an ISA mismatch. A warning involving a REAL ISA
+    feature (e.g. ``+avx512f``) returns False and must stay visible: that
+    is the latent-SIGILL case the fingerprint exists for.
+
+    Two checks, both required when available: (a) the feature(s) the
+    loader NAMES must all be pseudo-features, and (b) when the line
+    carries the bracketed "Compile machine features: [...] vs host
+    machine features: [...]" lists, the full set difference
+    (compile's enabled ``+f`` minus host) must also be a subset of the
+    pseudo-features — the loader demonstrably names only ONE arbitrary
+    member of a multi-feature mismatch, so (a) alone could filter a line
+    that also hides a real ISA gap (shared/NFS cache dirs bypass the
+    per-host fingerprint via the env-var override)."""
     if "cpu_aot_loader" not in line:
         return False
     import re
 
     named = re.findall(r"feature \+?([\w.-]+) is not\s+supported", line)
-    return bool(named) and all(
-        f in _TUNING_PSEUDO_FEATURES for f in named
-    )
+    if not named or not all(f in _TUNING_PSEUDO_FEATURES for f in named):
+        return False
+    m = re.search(
+        r"Compile machine features:\s*\[([^\]]*)\]\s*vs host machine "
+        r"features:\s*\[([^\]]*)\]", line)
+    if m:
+        compiled = {f[1:] for f in m.group(1).split(",")
+                    if f.startswith("+")}
+        host = {f.strip() for f in m.group(2).split(",") if f.strip()}
+        if not (compiled - host) <= set(_TUNING_PSEUDO_FEATURES):
+            return False
+    return True
 
 
 def host_fingerprint() -> str:

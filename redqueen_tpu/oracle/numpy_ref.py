@@ -37,6 +37,7 @@ __all__ = [
     "PiecewiseConst",
     "RealData",
     "Opt",
+    "RMTPP",
     "Manager",
     "SimOpts",
 ]
@@ -341,6 +342,77 @@ class Opt(Broadcaster):
         return self._t_candidate
 
 
+class RMTPP(Broadcaster):
+    """RMTPP neural-intensity broadcaster (BASELINE config 5) — the pure
+    NumPy twin of ``models.rmtpp``: a GRU consumes the source's own
+    inter-event gaps and the conditional intensity until the next own post
+    is lambda(tau) = exp(a + w tau) with a = v.h + b, sampled exactly by
+    inverse CDF (no thinning; same closed form as
+    ``ops.sampling.rmtpp_next_delta``).
+
+    ``weights`` is the flax param tree of ``models.rmtpp.RMTPPCell`` as
+    plain nested dicts of NumPy arrays (convert a trained tree with
+    ``jax.tree.map(np.asarray, w)``); the GRU recurrence mirrors flax's
+    ``nn.GRUCell`` gate layout exactly (r/z gates without hidden bias, the
+    candidate's hidden projection biased INSIDE the reset product), pinned
+    to the jax cell by tests/test_rmtpp.py."""
+
+    def __init__(self, src_id, seed, weights, hidden: int):
+        super().__init__(src_id, seed)
+        self.weights = weights
+        self.hidden = int(hidden)
+        self.h = np.zeros(self.hidden, np.float64)
+        self._t_last = 0.0
+        self._t_next = np.inf
+
+    @staticmethod
+    def _sigmoid(x):
+        return 1.0 / (1.0 + np.exp(-x))
+
+    def _gru(self, h, tau):
+        g = self.weights["gru"]
+        x = np.array([tau, np.log1p(tau)], np.float64)
+        r = self._sigmoid(x @ g["ir"]["kernel"] + g["ir"]["bias"]
+                          + h @ g["hr"]["kernel"])
+        z = self._sigmoid(x @ g["iz"]["kernel"] + g["iz"]["bias"]
+                          + h @ g["hz"]["kernel"])
+        n = np.tanh(x @ g["in"]["kernel"] + g["in"]["bias"]
+                    + r * (h @ g["hn"]["kernel"] + g["hn"]["bias"]))
+        return (1.0 - z) * n + z * h
+
+    def _head(self, h):
+        a = float(h @ np.asarray(self.weights["v"]["kernel"])[:, 0]
+                  + np.asarray(self.weights["v"]["bias"])[0])
+        return a, float(np.asarray(self.weights["w"]))
+
+    def _sample_delta(self):
+        a, w = self._head(self.h)
+        e = self.random_state.exponential()
+        if abs(w) < 1e-6:
+            return e * np.exp(-a)  # w ~ 0: constant intensity exp(a)
+        z = w * e * np.exp(-a)
+        # w < 0: finite total hazard exp(a)/(-w); a draw beyond it means
+        # the process never fires again.
+        return np.log1p(z) / w if z > -1.0 else np.inf
+
+    def init_state(self, start_time, all_sink_ids, follower_sink_ids,
+                   end_time):
+        super().init_state(start_time, all_sink_ids, follower_sink_ids,
+                           end_time)
+        self.h = np.zeros(self.hidden, np.float64)
+        self._t_last = self.start_time
+
+    def get_next_event_time(self, event: Optional[Event]) -> float:
+        if event is None:
+            self._t_next = self.start_time + self._sample_delta()
+        elif event.src_id == self.src_id:
+            tau = event.cur_time - self._t_last
+            self.h = self._gru(self.h, tau)
+            self._t_last = event.cur_time
+            self._t_next = event.cur_time + self._sample_delta()
+        return self._t_next
+
+
 class Manager:
     """Event-loop simulation driver (reference: ``Manager``).
 
@@ -490,6 +562,14 @@ class SimOpts:
         """RealData replay of the controlled broadcaster (reference:
         ``create_manager_with_times`` — real user posting trace)."""
         return self._manager(RealData(self.src_id, times=times))
+
+    def create_manager_with_rmtpp(self, seed: int, weights,
+                                  hidden: int) -> Manager:
+        """RMTPP neural-intensity controlled broadcaster (BASELINE config
+        5); ``weights`` = the flax RMTPPCell tree as nested NumPy dicts."""
+        return self._manager(
+            RMTPP(self.src_id, seed, weights=weights, hidden=hidden)
+        )
 
     def create_manager_with_broadcaster(self, broadcaster: Broadcaster) -> Manager:
         """Open seam: any Broadcaster implementation (the reference's Opt-subclass

@@ -1,0 +1,241 @@
+"""RedQueen (Opt) posting-time extraction for the star engine: the sorted
+suffix-min formulation (step 2 of the ``bigf.py`` design), its two fire
+modes (adaptive while_loop vs pointer doubling), and the suffix-record
+compression of the global sort.
+
+Split out of ``bigf.py`` (round-5 verdict item 7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import random as jr
+
+from . import comm
+from .star_types import StarConfig
+
+__all__ = [
+    "_rec_cap",
+    "_opt_fires",
+    "_fires_by_doubling",
+    "_resolve_fire_mode",
+    "_check_fire_mode",
+    "_FIRE_MODES",
+]
+
+_FIRE_MODES = ("auto", "loop", "doubling")
+
+
+def _resolve_fire_mode(fire_mode: str, feed_sharded: bool) -> str:
+    """Resolve 'auto' to the concrete mode BEFORE any kernel cache is
+    keyed: the choice depends on jax.default_backend(), so caching under
+    the literal 'auto' would reuse a kernel whose loop-vs-doubling
+    decision was made for a different backend after a mid-process platform
+    flip (results stay bit-identical either way; only the measured
+    performance policy would silently be the wrong one)."""
+    if fire_mode != "auto":
+        return fire_mode
+    return ("loop" if feed_sharded or jax.default_backend() == "cpu"
+            else "doubling")
+
+
+def _check_fire_mode(fire_mode: str, feed_sharded: bool):
+    """Early public-API validation: non-Opt control policies never reach
+    _opt_fires, so without this a typo'd mode (or doubling on a sharded
+    feed axis) would be silently ignored on those configs."""
+    if fire_mode not in _FIRE_MODES:
+        raise ValueError(
+            f"unknown fire_mode {fire_mode!r} (choose from {_FIRE_MODES})"
+        )
+    if fire_mode == "doubling" and feed_sharded:
+        raise ValueError(
+            "fire_mode='doubling' needs the full sorted record arrays on "
+            "every device; it does not support a sharded feed axis "
+            "(use 'loop'/'auto')"
+        )
+
+
+def _rec_cap(E: int) -> int:
+    """Static per-feed suffix-record budget for the compressed fire path.
+    Records per feed are the right-to-left running minima of the candidate
+    sequence; their count is ~ln E (~6 at E=256) when the superposition
+    clocks are long relative to inter-event gaps (the low-intensity RedQueen
+    regime: rate_f = sqrt(s/q) small), but approaches E when clocks are
+    short (cand ~ w + tiny noise is nearly increasing). Overflow is checked
+    loudly and the caller retries with compression off — never silent."""
+    return int(max(64, 4 * np.ceil(np.log(max(E, 2)))))
+
+
+def _opt_fires(cfg: StarConfig, feed_times, rate_f, key_tau, feed_offset,
+               compress: bool = True, fire_mode: str = "auto"):
+    """RedQueen posting times via the sorted suffix-min formulation.
+
+    ``feed_times`` [F_local, E] ascending wall events per feed; ``rate_f``
+    [F_local] = sqrt(s_f / q). Returns (own_times [post_cap], truncated,
+    rec_trunc).
+
+    ``fire_mode`` selects how the posting trajectory is extracted from the
+    sorted (wall time, candidate) arrays: ``"loop"`` is the adaptive
+    ``while_loop`` (one searchsorted + suffix lookup per post; under feed
+    sharding also one ``pmin`` per post); ``"doubling"`` is the pointer-
+    doubling formulation (see ``_fires_by_doubling``) — the SAME fires,
+    bit for bit, in O(log post_cap) parallel gather passes with no
+    sequential dependence on the number of posts. ``"auto"`` picks
+    doubling on non-CPU backends when the feed axis is unsharded (the
+    TPU's latency-bound regime) and the loop otherwise (CPU: the loop does
+    ~10x less total work; sharded: the loop's pmin keeps records
+    device-local).
+
+    Suffix-record compression (``compress``): the fire loop only ever
+    queries min{cand_e : w_e > t}. Within a feed, an event e1 with a later
+    event e2 > e1 such that cand_e2 <= cand_e1 can NEVER be that min (any
+    query admitting e1 also admits e2), so only the feed's suffix-record
+    events — cand strictly below every later candidate in the row — matter,
+    and the argmin of any query is itself a record. The global sort then
+    shrinks from [F x E] to [F x rec_cap] with EXACT results — measured 5x
+    on the 100k-feed config, where the 5M-element sort was the whole
+    fire-phase cost. When a feed's record count exceeds the static budget
+    (short-clock regime, see _rec_cap) the rec_trunc flag trips and
+    simulate_star retries with ``compress=False`` (the full-sort path)."""
+    Fl, E = feed_times.shape
+    dtype = feed_times.dtype
+    inf = jnp.asarray(jnp.inf, dtype)
+    # Compaction into [Fl, R] slots only pays when R < E; at small E the
+    # record buffer would be as large as the raw input and the cummin +
+    # min-scatter passes are pure overhead (results are exact either way).
+    compress = compress and E > _rec_cap(E)
+
+    # One Exp clock per wall event — the reference's exact draw count, keyed
+    # by GLOBAL feed index so mesh layout cannot change the streams.
+    def feed_draws(f_global):
+        return jr.exponential(jr.fold_in(key_tau, f_global), (E,), dtype)
+
+    draws = jax.vmap(feed_draws)(feed_offset + jnp.arange(Fl))
+    cand = feed_times + draws / jnp.maximum(rate_f[:, None], 1e-30)
+    cand = jnp.where(rate_f[:, None] > 0, cand, jnp.inf)
+
+    if compress:
+        # --- per-feed suffix-record compaction (exact; see docstring) ---
+        suf_incl = jnp.flip(lax.cummin(jnp.flip(cand, axis=1), axis=1), axis=1)
+        suf_after = jnp.concatenate(
+            [suf_incl[:, 1:], jnp.full((Fl, 1), jnp.inf, dtype)], axis=1
+        )
+        mask = cand < suf_after                  # +inf cands never qualify
+        n_rec = mask.sum(axis=1)
+        R = _rec_cap(E)
+        rec_trunc = comm.pany((n_rec > R).any(), "feed")
+        pos = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0, R - 1)
+        # Min-scatter into the [Fl, R] record slots: records carry their
+        # value, non-records carry +inf (a no-op under .min), and in-budget
+        # record positions are unique per row, so (t, cand) pairs stay
+        # aligned (the overflow case corrupts slot R-1, but rec_trunc then
+        # forces the uncompressed retry before any result is used).
+        val_t = jnp.where(mask, feed_times, inf)
+        val_c = jnp.where(mask, cand, inf)
+        t_src = jax.vmap(
+            lambda p, v: jnp.full((R,), jnp.inf, dtype).at[p].min(v)
+        )(pos, val_t)
+        c_src = jax.vmap(
+            lambda p, v: jnp.full((R,), jnp.inf, dtype).at[p].min(v)
+        )(pos, val_c)
+    else:
+        t_src, c_src = feed_times, cand
+        rec_trunc = jnp.zeros((), bool)
+
+    t_sorted, c_sorted = lax.sort(
+        (t_src.reshape(-1), c_src.reshape(-1)), num_keys=1
+    )
+    # suffix_min[i] = min candidate among (kept) wall events with idx >= i.
+    suffix = jnp.flip(lax.cummin(jnp.flip(c_sorted)))
+    suffix = jnp.concatenate([suffix, jnp.full((1,), jnp.inf, dtype)])
+
+    sharded = comm.axis_present("feed")
+    _check_fire_mode(fire_mode, feed_sharded=sharded)
+    # One policy, one place: entry points resolve 'auto' before keying
+    # their kernel caches; this delegate covers direct _make_kernel users.
+    use_doubling = _resolve_fire_mode(fire_mode, sharded) == "doubling"
+
+    if use_doubling:
+        own, truncated = _fires_by_doubling(cfg, t_sorted, suffix)
+        return own, truncated, rec_trunc
+
+    # Adaptive fire loop: post_cap bounds the buffer, but the while_loop
+    # exits as soon as the trajectory absorbs (a vmapped while runs until
+    # every lane is done — with 4x-headroom caps that is typically a ~4x
+    # shorter loop than a fixed-length scan). Sharded lanes stay in
+    # lockstep: after the pmin the carry is identical on every shard, so
+    # the loop condition is too.
+    Kp = cfg.post_cap
+    t0 = jnp.asarray(cfg.start_time, dtype)
+    buf0 = jnp.full((Kp,), jnp.inf, dtype)
+
+    def cond(c):
+        t_last, n, _ = c
+        return jnp.isfinite(t_last) & (n < Kp)
+
+    def fire(c):
+        t_last, n, buf = c
+        idx = jnp.searchsorted(t_sorted, t_last, side="right")
+        t_next = comm.pmin(suffix[idx], "feed")
+        t_next = jnp.where(t_next <= cfg.end_time, t_next, jnp.inf)
+        buf = buf.at[n].set(t_next)  # +inf write into +inf pad: no-op
+        return t_next, n + jnp.isfinite(t_next).astype(n.dtype), buf
+
+    t_last, _, own = lax.while_loop(
+        cond, fire, (t0, jnp.zeros((), jnp.int32), buf0)
+    )
+    # Overflow: a further post would still fit before the horizon.
+    idx = jnp.searchsorted(t_sorted, t_last, side="right")
+    more = comm.pmin(suffix[idx], "feed") <= cfg.end_time
+    truncated = jnp.isfinite(t_last) & more
+    return own, truncated, rec_trunc
+
+
+def _fires_by_doubling(cfg: StarConfig, t_sorted, suffix):
+    """The posting trajectory as pointer doubling — the while_loop's fires,
+    bit for bit, with no sequential dependence on the post count.
+
+    The fire map is f(t) = suffix[sp(t)] with sp(t) = searchsorted(t_sorted,
+    t, 'right') (the strict ``w > t`` query); every reachable fire value is
+    a ``suffix`` element, so the orbit lives on POSITIONS: p_1 = sp(start),
+    p_{k+1} = nxt[p_k] with nxt[i] = sp(suffix[i]), and own_k =
+    suffix[p_k]. ``nxt`` is strictly forward (every candidate satisfies
+    c >= its own wall time, and 'right' skips equals), so position N — the
+    appended +inf suffix slot, a fixed point of nxt — absorbs every
+    trajectory. Jump tables J_p = nxt^(2^p) then materialize all post_cap
+    positions in ceil(log2(post_cap)) gather passes: the second half of the
+    filled prefix is J_p applied to the first half. Work is
+    O((N + post_cap) log post_cap) fully parallel gathers — vs the loop's
+    O(posts) sequential searchsorted steps, which on a latency-bound
+    backend (the TPU, especially through the tunnel) dominate the star
+    engine's critical path.
+
+    Horizon clipping happens AFTER the orbit: fires increase strictly, so
+    where(raw <= end, raw, inf) is densely packed exactly like the loop's
+    incremental buffer. The truncation flag mirrors the loop's: post_cap
+    in-horizon fires AND one more would still fit."""
+    Kp = cfg.post_cap
+    end = cfg.end_time
+    N = t_sorted.shape[0]
+
+    nxt = jnp.searchsorted(t_sorted, suffix, side="right").astype(jnp.int32)
+    p1 = jnp.searchsorted(
+        t_sorted, jnp.asarray(cfg.start_time, t_sorted.dtype), side="right"
+    ).astype(jnp.int32)
+    pos = jnp.full((Kp,), N, jnp.int32).at[0].set(p1)
+    jump = nxt
+    filled = 1
+    while filled < Kp:  # static unroll: ceil(log2(Kp)) levels
+        take = min(filled, Kp - filled)
+        pos = pos.at[filled:filled + take].set(jump[pos[:take]])
+        filled += take
+        if filled < Kp:
+            jump = jump[jump]
+    raw = suffix[pos]
+    own = jnp.where(raw <= end, raw, jnp.inf)
+    f_next = suffix[nxt[pos[Kp - 1]]]
+    truncated = jnp.isfinite(own[Kp - 1]) & (f_next <= end)
+    return own, truncated

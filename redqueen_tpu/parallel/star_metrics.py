@@ -1,0 +1,169 @@
+"""Per-feed rank metrics for the star engine: the closed-form hot path and
+its sequential merge-scan twin (the property-test oracle) — step 3 of the
+``bigf.py`` design.
+
+Split out of ``bigf.py`` (round-5 verdict item 7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.metrics import FeedMetrics
+from .star_types import StarConfig
+
+__all__ = [
+    "_feed_metrics_star",
+    "_feed_metrics_star_scan",
+    "_METRIC_FEED_BLOCK",
+]
+
+# Feeds per metrics block: bounds the closed form's peak memory at
+# block x E (E = merged wall slots per feed) floats per wall-side
+# intermediate while keeping blocks wide enough to saturate the vector
+# units.
+_METRIC_FEED_BLOCK = 8192
+
+
+def _feed_metrics_star(cfg: StarConfig, feed_times, own_times, K: int):
+    """Per-feed rank integrals in closed form — no sequential pass at all.
+
+    The merge-scan twin (``_feed_metrics_star_scan``, kept as the test
+    oracle) walks E+K events per feed; on TPU that is a length-(E+K)
+    sequential dependency vmapped over feeds. But with one broadcaster the
+    rank process decomposes per event (reference ``utils.py`` integrals,
+    SURVEY.md section 2 items 11-14):
+
+    - each wall event w raises the rank by 1 until the next own post (or the
+      horizon), so  int r dt   = sum_e  (b_e - w_e)^+  and, numbering walls
+      1..m within their inter-own-post window,
+      int r^2 dt = sum_e (2 i_e - 1)(b_e - w_e)^+   (telescoping i^2),
+      where b_e = min(first own post > w_e, T);
+    - the rank is 0 from each own post (and from the start) until the first
+      wall event >= it, clipped at the next own post and T.
+
+    Everything is searchsorted + gathers over already-sorted arrays —
+    embarrassingly parallel over events AND feeds, which is exactly what the
+    VPU wants. Generalizing to K > 1: rank >= K holds exactly from each
+    window's K-th wall event to the window end, so
+
+        time_below_K = (end - start) - sum_{e: i_e == K} (b_e - max(w_e, s))^+
+
+    — the top-K integral needs ONLY the wall-side arrays (i_e, b_e, dt)
+    already built for the rank integrals. An earlier formulation walked the
+    own-post windows with [post_cap+1] searchsorted/gather intermediates per
+    feed; it was 72% of star-engine runtime on the 100k-feed config and is
+    gone (the merge-scan twin still pins both numbers).
+
+    Tie rule (matches the oracle's argmin-lowest-index pop): an own post at
+    exactly a wall-event time applies FIRST, so the wall event counts into
+    the window STARTED by that own post.
+
+    Memory: feeds are processed in ``lax.map`` blocks of
+    ``_METRIC_FEED_BLOCK`` to bound the [feed_block, E] intermediates at
+    100k-feed scale."""
+    Fl, E = feed_times.shape
+    dtype = feed_times.dtype
+    start = jnp.asarray(cfg.start_time, dtype)
+    end = jnp.asarray(cfg.end_time, dtype)
+    inf = jnp.asarray(jnp.inf, dtype)
+    own_ext = jnp.concatenate([own_times, inf[None]])          # [Kp+1]
+    # Window-start array for wall COUNTING: it must include pre-start walls
+    # (the carried-rank convention: events before the window still build
+    # rank history), so window 0 counts from -inf, not from start_time.
+    own_cnt = jnp.concatenate([-inf[None], own_times])         # [Kp+1]
+
+    def one_feed(w_row):
+        # --- wall-event side: all three integrals -----------------------
+        nxt_idx = jnp.searchsorted(own_times, w_row, side="right")
+        b = jnp.minimum(own_ext[nxt_idx], end)                 # window end
+        a = own_cnt[nxt_idx]                                   # window start
+        walls_before = jnp.searchsorted(w_row, a, side="left")
+        i_e = jnp.arange(E) - walls_before + 1                 # 1-based in-window
+        # Left-clipping at start_time keeps the telescoped sum exact: wall i
+        # contributes (i^2 - (i-1)^2) * (b - max(w_i, start))^+ .
+        dt = jnp.maximum(b - jnp.maximum(w_row, start), 0.0)
+        ir = dt.sum()
+        ir2 = ((2.0 * i_e.astype(dtype) - 1.0) * dt).sum()
+        # Padded wall slots (+inf) get dt = 0, so they drop out of every
+        # sum including the top-K complement below.
+        topk = (end - start) - jnp.where(i_e == K, dt, 0.0).sum()
+        return topk, ir, ir2
+
+    if Fl <= _METRIC_FEED_BLOCK:
+        top, ir, ir2 = jax.vmap(one_feed)(feed_times)
+    else:
+        nb = -(-Fl // _METRIC_FEED_BLOCK)
+        padded = jnp.concatenate([
+            feed_times,
+            jnp.full((nb * _METRIC_FEED_BLOCK - Fl, E), jnp.inf, dtype),
+        ]) if nb * _METRIC_FEED_BLOCK != Fl else feed_times
+        blocks = padded.reshape(nb, _METRIC_FEED_BLOCK, E)
+        top, ir, ir2 = lax.map(
+            lambda b: jax.vmap(one_feed)(b), blocks
+        )
+        top = top.reshape(-1)[:Fl]
+        ir = ir.reshape(-1)[:Fl]
+        ir2 = ir2.reshape(-1)[:Fl]
+    return FeedMetrics(
+        time_in_top_k=top, int_rank=ir, int_rank2=ir2,
+        follows=jnp.ones((Fl,), bool), start_time=start, end_time=end,
+    )
+
+
+def _feed_metrics_star_scan(cfg: StarConfig, feed_times, own_times, K: int):
+    """Sequential merge-scan twin of :func:`_feed_metrics_star` (the
+    reference-shaped two-pointer walk). Kept as the property-test oracle for
+    the closed form; not used in the hot path.
+
+    Tie rule: an own post at exactly a wall-event time applies FIRST (the
+    oracle's Manager pops the lowest source index — the controlled
+    broadcaster is row 0)."""
+    Fl, E = feed_times.shape
+    Kp = own_times.shape[0]
+    dtype = feed_times.dtype
+    start = jnp.asarray(cfg.start_time, dtype)
+    end = jnp.asarray(cfg.end_time, dtype)
+    own_ext = jnp.concatenate([own_times, jnp.full((1,), jnp.inf, dtype)])
+
+    def one_feed(times_row):
+        row_ext = jnp.concatenate([times_row, jnp.full((1,), jnp.inf, dtype)])
+
+        def step(carry, _):
+            i, j, r, t_prev, top, ir, ir2 = carry
+            t_w, t_o = row_ext[i], own_ext[j]
+            own_first = t_o <= t_w
+            t = jnp.minimum(t_w, t_o)
+            valid = jnp.isfinite(t)
+            t_clip = jnp.clip(jnp.where(valid, t, t_prev), start, end)
+            dt = jnp.maximum(t_clip - t_prev, 0)
+            rf = r.astype(dtype)
+            top2 = top + dt * (r < K)
+            ir_2 = ir + dt * rf
+            ir2_2 = ir2 + dt * rf * rf
+            r_new = jnp.where(own_first, 0, r + 1)
+            return (
+                jnp.where(valid & ~own_first, i + 1, i),
+                jnp.where(valid & own_first, j + 1, j),
+                jnp.where(valid, r_new, r),
+                jnp.maximum(t_prev, t_clip),
+                top2, ir_2, ir2_2,
+            ), None
+
+        zero = jnp.asarray(0.0, dtype)
+        init = (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                jnp.zeros((), jnp.int32), start, zero, zero, zero)
+        (i, j, r, t_prev, top, ir, ir2), _ = lax.scan(
+            step, init, None, length=E + Kp
+        )
+        dt = jnp.maximum(end - t_prev, 0)
+        rf = r.astype(dtype)
+        return top + dt * (r < K), ir + dt * rf, ir2 + dt * rf * rf
+
+    top, ir, ir2 = jax.vmap(one_feed)(feed_times)
+    return FeedMetrics(
+        time_in_top_k=top, int_rank=ir, int_rank2=ir2,
+        follows=jnp.ones((Fl,), bool), start_time=start, end_time=end,
+    )

@@ -40,6 +40,15 @@ def test_benign_aot_warning_classifier():
     assert not _jax_cache.benign_aot_warning(
         "E000 cpu_aot_loader.cc:210] Loading XLA:CPU AOT result."
     )
+    # The loader names only ONE member of a multi-feature mismatch: a line
+    # that NAMES a pseudo-feature but whose bracketed lists reveal a real
+    # ISA gap (+avx512f compiled, absent on host) must stay visible
+    # (shared-cache-dir scenario; round-5 review finding).
+    hidden_isa_gap = _REAL_WARNING.replace(
+        "host machine features: [64bit,avx512f]",
+        "host machine features: [64bit]",
+    )
+    assert not _jax_cache.benign_aot_warning(hidden_isa_gap)
 
 
 def test_enable_persistent_cache_configures_imported_jax(tmp_path, monkeypatch):
