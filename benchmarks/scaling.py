@@ -98,6 +98,17 @@ def main():
             f"({eps / max(B, 1):,.0f} per-lane; "
             f"{util.get('step_ns', 0):,.0f} ns/step, "
             f"{util.get('hbm_gbps', 0):.1f} GB/s modeled)")
+        if args.out:
+            # Incremental write per point: a deadline kill mid-sweep (the
+            # TPU capture's stage 8 runs LAST in an alive window) must not
+            # lose the points already measured.
+            with open(args.out, "w") as f:
+                json.dump({"platform": jax.devices()[0].platform,
+                           "shape": "1 Opt x 10 Poisson feeds, T=100, "
+                                    "capacity=64",
+                           "reps": args.reps, "partial": True,
+                           "rows": rows}, f, indent=1)
+                f.write("\n")
     out = {"platform": jax.devices()[0].platform,
            "shape": "1 Opt x 10 Poisson feeds, T=100, capacity=64",
            "reps": args.reps, "rows": rows}

@@ -13,6 +13,7 @@ goes so a mid-sequence wedge keeps everything captured so far:
   7. profiled quick-shape scan        -> BENCH_tpu_profile_<tag>.json
      (+ a jax.profiler trace in benchmarks/profiles/<tag>/)
   4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu_<tag>.json
+  8. batch-scaling curve on TPU       -> benchmarks/scaling_tpu_<tag>.json
   5. fire-mode crossover on TPU       -> FIRE_MODE_tpu_<tag>.json
 
 (That is also the default no-``--stage`` execution order: the cheap
@@ -43,7 +44,7 @@ from proc_util import run_logged  # noqa: E402
 
 # The one authoritative stage-number set; tools/tpu_watcher.py imports it
 # for its own --stages validation so the two lists cannot drift.
-STAGE_CHOICES = (1, 2, 3, 4, 5, 6, 7)
+STAGE_CHOICES = (1, 2, 3, 4, 5, 6, 7, 8)
 
 
 def run_stage(name, cmd, out_json, deadline_s, log_path):
@@ -74,7 +75,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", type=int, action="append", default=None,
                     choices=list(STAGE_CHOICES),
-                    help="run only the given stage(s) (1-7; repeatable, "
+                    help="run only the given stage(s) (1-8; repeatable, "
                          "in the listed order)")
     ap.add_argument("--deadline", type=float, default=1500.0)
     ap.add_argument("--tag", default="r04",
@@ -137,6 +138,18 @@ def main() -> int:
          None,  # star_vs_scan writes its own artifact (incrementally)
          os.path.join(REPO, "benchmarks", f"tpu_star_vs_scan_{tag}.log"),
          sweep_budget),
+        # Batch-scaling curve on the chip (how much batch the TPU needs —
+        # SURVEY section 6's "on TPU, how much batch the chip needs to
+        # reach peak"): B=10000 reuses the cached full-shape executable;
+        # B=1000 pays one fresh compile. Ordered LAST by the watcher —
+        # runs only when a window outlives the headline stages.
+        (8, "scaling", [py, os.path.join(REPO, "benchmarks", "scaling.py"),
+                        "--batches", "1000", "10000", "--out",
+                        os.path.join(REPO, "benchmarks",
+                                     f"scaling_tpu_{tag}.json")],
+         None,  # scaling.py writes its own artifact
+         os.path.join(REPO, "benchmarks", f"tpu_scaling_{tag}.log"),
+         args.deadline),
         # Fire-extraction-mode crossover on the chip: DESIGN.md's
         # "doubling on accelerators" policy is CPU-measured + argued, not
         # TPU-measured. The tool writes its artifact incrementally; the

@@ -52,7 +52,7 @@ def append_log(line: str) -> None:
         f.write(line + "\n")
 
 
-DEFAULT_STAGES = (2, 6, 7, 3, 4, 1, 5)
+DEFAULT_STAGES = (2, 6, 7, 3, 4, 1, 5, 8)
 
 
 def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES,
@@ -102,10 +102,10 @@ def main() -> int:
     ap.add_argument("--max-probes", type=int, default=160)
     ap.add_argument("--probe-deadline", type=float, default=75.0)
     # Must cover the staged capture's worst case: with --deadline 600,
-    # DEFAULT_STAGES is five 600s stages + the star-vs-scan sweep's
-    # 6*(300+240)+120 = 3360s -> 6360s; headroom on top so the outer kill
+    # DEFAULT_STAGES is seven 600s stages + the star-vs-scan sweep's
+    # 6*(300+240)+120 = 3360s -> 7560s; headroom on top so the outer kill
     # can only mean a real hang.
-    ap.add_argument("--capture-deadline", type=float, default=7500.0,
+    ap.add_argument("--capture-deadline", type=float, default=9000.0,
                     help="total seconds allowed for the staged capture")
     # choices (imported from tpu_evidence, the owner of the stage table,
     # so the two lists cannot drift) validates each element at LAUNCH: a
