@@ -23,6 +23,14 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _meta(jax, args):
+    """One metadata dict shared by the per-point partial writes and the
+    final artifact, so the two can never drift."""
+    return {"platform": jax.devices()[0].platform,
+            "shape": "1 Opt x 10 Poisson feeds, T=100, capacity=64",
+            "reps": args.reps}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
@@ -103,15 +111,10 @@ def main():
             # TPU capture's stage 8 runs LAST in an alive window) must not
             # lose the points already measured.
             with open(args.out, "w") as f:
-                json.dump({"platform": jax.devices()[0].platform,
-                           "shape": "1 Opt x 10 Poisson feeds, T=100, "
-                                    "capacity=64",
-                           "reps": args.reps, "partial": True,
+                json.dump({**_meta(jax, args), "partial": True,
                            "rows": rows}, f, indent=1)
                 f.write("\n")
-    out = {"platform": jax.devices()[0].platform,
-           "shape": "1 Opt x 10 Poisson feeds, T=100, capacity=64",
-           "reps": args.reps, "rows": rows}
+    out = {**_meta(jax, args), "rows": rows}
     print(json.dumps(out))
     if args.out:
         with open(args.out, "w") as f:

@@ -210,7 +210,13 @@ def make_run_chunk(cfg: SimConfig):
             )
 
             t_next = state.t_next.at[s_star].set(upd.t_next)
-            ctr = state.ctr.at[s_star].add(1)
+            # ctr is the per-source (key, ctr) STREAM position — read only
+            # by fire branches with fire_uses_key (Hawkes thinning, RMTPP).
+            # When no compiled branch reads it (the headline Poisson+Opt
+            # mix draws everything from the panel), the scatter + absorb
+            # select below are dead carry traffic every step; skip them
+            # (bit-preserving: nothing ever consumes the skipped counts).
+            ctr = state.ctr.at[s_star].add(1) if needs_fire_key else None
 
             # -- react hooks: non-fired sources re-decide (RedQueen trick) --
             for hook in react_hooks:
@@ -218,7 +224,8 @@ def make_run_chunk(cfg: SimConfig):
                     cfg, params, state.replace(t_next=t_next), adj, feeds,
                     s_star, t_ev, valid, us[1:],
                 )
-                ctr = ctr + bumped.astype(ctr.dtype)
+                if needs_fire_key:
+                    ctr = ctr + bumped.astype(ctr.dtype)
 
             # Past-horizon steps absorb: emit a sentinel, keep state frozen.
             # Only the fields this policy mix can change are gated/written.
@@ -228,9 +235,10 @@ def make_run_chunk(cfg: SimConfig):
             fields = dict(
                 t=sel(t_ev, state.t),
                 t_next=sel(t_next, state.t_next),
-                ctr=sel(ctr, state.ctr),
                 n_events=state.n_events + valid.astype(state.n_events.dtype),
             )
+            if needs_fire_key:
+                fields["ctr"] = sel(ctr, state.ctr)
             if has_hawkes:
                 fields["exc"] = sel(
                     state.exc.at[s_star].set(upd.exc), state.exc

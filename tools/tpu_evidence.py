@@ -9,9 +9,9 @@ goes so a mid-sequence wedge keeps everything captured so far:
   2. FULL headline bench on TPU       -> BENCH_tpu_full_<tag>.json
   6. QUICK-shape Pallas on the chip   -> BENCH_tpu_pallas_quick_<tag>.json
      (cheap Mosaic compile: banks "Pallas ran on real Mosaic" fast)
-  3. full-shape Pallas engine         -> BENCH_tpu_pallas_<tag>.json
   7. profiled quick-shape scan        -> BENCH_tpu_profile_<tag>.json
      (+ a jax.profiler trace in benchmarks/profiles/<tag>/)
+  3. full-shape Pallas engine         -> BENCH_tpu_pallas_<tag>.json
   4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu_<tag>.json
   8. batch-scaling curve on TPU       -> benchmarks/scaling_tpu_<tag>.json
   5. fire-mode crossover on TPU       -> FIRE_MODE_tpu_<tag>.json
