@@ -508,3 +508,41 @@ class TestTieBreaking:
         valid = srcs >= 0
         np.testing.assert_array_equal(srcs[valid], [0, 1, 0, 1])
         np.testing.assert_allclose(times[valid], [1.0, 1.0, 2.0, 2.0])
+
+
+class TestDeadCarryGating:
+    """Per-source (key, ctr) stream bookkeeping is skipped entirely when no
+    compiled branch reads it (round-5 perf change): the chunk must pass ctr
+    through untouched for panel-only policy mixes, and keep counting for
+    key-using mixes (Hawkes) — bit-preservation both ways."""
+
+    def test_ctr_untouched_for_panel_only_mix(self):
+        import jax
+
+        from redqueen_tpu.config import GraphBuilder
+        from redqueen_tpu.ops.scan_core import init_state, make_run_chunk
+
+        gb = GraphBuilder(n_sinks=3, end_time=20.0)
+        gb.add_opt(q=1.0)
+        for i in range(3):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        cfg, params, adj = gb.build(capacity=64)
+        st = init_state(cfg, params, adj, jax.random.PRNGKey(0))
+        out, (times, _) = jax.jit(make_run_chunk(cfg))(params, adj, st)
+        assert int(out.n_events) > 0  # the chunk really simulated
+        np.testing.assert_array_equal(np.asarray(out.ctr), np.asarray(st.ctr))
+
+    def test_ctr_counts_for_key_using_mix(self):
+        import jax
+
+        from redqueen_tpu.config import GraphBuilder
+        from redqueen_tpu.ops.scan_core import init_state, make_run_chunk
+
+        gb = GraphBuilder(n_sinks=1, end_time=20.0)
+        gb.add_opt(q=1.0)
+        gb.add_hawkes(l0=1.0, alpha=0.5, beta=2.0, sinks=[0])
+        cfg, params, adj = gb.build(capacity=64)
+        st = init_state(cfg, params, adj, jax.random.PRNGKey(0))
+        out, _ = jax.jit(make_run_chunk(cfg))(params, adj, st)
+        assert int(out.n_events) > 0
+        assert int(np.asarray(out.ctr).sum()) > int(np.asarray(st.ctr).sum())
