@@ -48,7 +48,6 @@ import argparse
 import atexit
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -73,9 +72,11 @@ def _emit_result_line(obj: dict) -> None:
     skips atexit)."""
     _FINAL["line"] = obj
     try:
-        with open(RESULT_FILE, "w") as f:
-            json.dump(obj, f)
-            f.write("\n")
+        from redqueen_tpu.runtime import atomic_write_json
+
+        # Atomic (temp + rename): a kill mid-emit leaves the previous
+        # complete line, never a torn file.
+        atomic_write_json(RESULT_FILE, obj)
     except OSError as e:
         log(f"warning: could not write {RESULT_FILE}: {e}")
     print(json.dumps(obj), flush=True)
@@ -108,12 +109,13 @@ def log(*a):
 def _default_backend_alive(log) -> bool:
     """True iff the default JAX backend (the tunneled TPU here) initializes
     within the shared liveness policy's deadlines — the policy itself
-    (probe-in-subprocess, retry, backoff) lives in
-    redqueen_tpu/utils/backend.default_backend_alive so bench and the
-    harness entry points can never disagree about liveness."""
-    from redqueen_tpu.utils.backend import default_backend_alive
+    (probe-in-subprocess, retry, backoff) lives behind the resilience
+    runtime (redqueen_tpu.runtime.backend_alive, delegating to
+    utils/backend) so bench and the harness entry points can never
+    disagree about liveness."""
+    from redqueen_tpu.runtime import backend_alive
 
-    alive, _, _ = default_backend_alive(log=log)
+    alive, _, _ = backend_alive(log=log)
     return alive
 
 
@@ -519,7 +521,17 @@ def _remaining(args) -> float:
 
 
 def _run_child(args, engine: str, backend: str, timeout_s: float):
-    """Run one --as-engine child; return its parsed JSON dict or None."""
+    """Run one --as-engine child under the resilience runtime's supervised
+    dispatch (redqueen_tpu.runtime.Supervisor, argv mode); return its
+    parsed JSON dict or None.
+
+    One attempt, no runtime-level retry/degradation on purpose: THIS
+    parent's sweep loop is the retry/fallback policy at engine
+    granularity (fastest-known-first, CPU-fallback reserve, evidence-run
+    purity), and two stacked retry layers would double every deadline.
+    What the runtime provides here is the supervised kill + the
+    keep-partial-stdout rule: a child that printed its result line before
+    wedging must not lose it."""
     cmd = [sys.executable, os.path.abspath(__file__),
            "--as-engine", engine, "--backend", backend,
            "--followers", str(args.followers),
@@ -539,40 +551,41 @@ def _run_child(args, engine: str, backend: str, timeout_s: float):
         cmd += ["--config", str(args.config)]
     if args.profile:
         cmd += ["--profile", args.profile]
+    from redqueen_tpu.runtime import RetryPolicy, Supervisor
     from redqueen_tpu.utils.backend import parse_last_json_line
 
-    t0 = time.monotonic()
-    try:
-        r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
-                           text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired as e:
+    sup = Supervisor(name=f"bench-{engine}-{backend}",
+                     retry=RetryPolicy(max_attempts=1),
+                     deadline_s=timeout_s, allow_degrade=False,
+                     report_dir=getattr(args, "runtime_reports", None),
+                     cwd=os.path.dirname(os.path.abspath(__file__)),
+                     log=log)
+    att = sup.run(cmd).attempts[-1]
+    if att.outcome == "timeout":
         log(f"engine {engine} ({backend}) TIMED OUT after {timeout_s:.0f}s")
         # A child that printed its result line BEFORE hanging (e.g. the
         # deferred --profile trace wedging on the tunnel) must not lose
-        # it: TimeoutExpired carries the stdout captured so far.
-        out_txt = e.stdout if isinstance(e.stdout, str) else (
-            e.stdout.decode(errors="replace") if e.stdout else "")
-        obj = parse_last_json_line(out_txt, require_ok=True)
+        # it: the supervisor keeps the stdout captured up to the kill.
+        obj = parse_last_json_line(att.stdout, require_ok=True)
         if obj is not None:
             log(f"engine {engine} ({backend}) result line recovered from "
                 f"pre-timeout stdout")
         return obj
-    took = time.monotonic() - t0
-    if r.stderr:
+    if att.stderr:
         # Drop the known-benign cpu_aot_loader tuning-pseudo-feature
         # warning (fires on EVERY same-host AOT cache load; see
         # _jax_cache.benign_aot_warning + its test) so the driver-captured
         # tail stays clean; any REAL ISA-mismatch warning passes through.
-        lines = [ln for ln in r.stderr.strip().splitlines()
+        lines = [ln for ln in att.stderr.strip().splitlines()
                  if not _jax_cache.benign_aot_warning(ln)]
         for line in lines[-6:]:
             log(f"  [{engine}] {line}")
-    obj = parse_last_json_line(r.stdout, require_ok=True)
+    obj = parse_last_json_line(att.stdout, require_ok=True)
     if obj is not None:
-        log(f"engine {engine} ({backend}) done in {took:.1f}s wall")
+        log(f"engine {engine} ({backend}) done in {att.wall_s:.1f}s wall")
         return obj
-    log(f"engine {engine} ({backend}) FAILED (rc={r.returncode}) "
-        f"after {took:.1f}s")
+    log(f"engine {engine} ({backend}) FAILED (rc={att.returncode}) "
+        f"after {att.wall_s:.1f}s")
     return None
 
 
@@ -862,6 +875,10 @@ def main():
                          "under jax.profiler.trace(DIR) (scan/pallas "
                          "engines only) — the on-chip profile capture; "
                          "failure to trace is non-fatal to the result")
+    ap.add_argument("--runtime-reports", default=None, metavar="DIR",
+                    help="write one redqueen_tpu.runtime RunReport JSON "
+                         "per supervised engine child into DIR (attempts, "
+                         "deadlines, disposition) — off by default")
     ap.add_argument("--no-oracle", action="store_true",
                     help="skip the NumPy-oracle denominator (engine-vs-"
                          "engine comparisons; O(sources)-per-event makes it "

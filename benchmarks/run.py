@@ -278,28 +278,49 @@ def main():
     # for THIS process in this JAX version (see _jax_cache docstring).
     _jax_cache.enable_persistent_cache()
 
+    from redqueen_tpu import runtime
+
     if args.cpu or args.quick:
         jax.config.update("jax_platforms", "cpu")
     else:
-        from redqueen_tpu.utils.backend import ensure_live_backend
-
-        ensure_live_backend(log=log)
+        # The resilience runtime's backend guard: honors a
+        # supervisor-imposed CPU degradation (RQ_BACKEND=cpu) and
+        # otherwise runs the shared deadline-bounded liveness probe.
+        runtime.ensure_backend(log=log)
     log(f"devices: {jax.devices()}")
     platform = jax.devices()[0].platform
 
     results = []
-    for which in args.configs:
-        pdir = f"{args.profile}/config{which}" if args.profile else None
-        out = bench_config(which, quick=args.quick,
-                           profile_dir=pdir, n_seeds=args.seeds)
-        # A CPU fallback (dead tunnel) must never pass as a TPU artifact.
-        out["platform"] = platform
-        results.append(out)
-        print(json.dumps(results[-1]))
-    if args.out:
-        with open(args.out, "w") as f:
-            json.dump(results, f, indent=2)
+    preempted = None
+    with runtime.preemption_guard(log=log):
+        for which in args.configs:
+            try:
+                runtime.check_preempt(f"config {which}")
+            except runtime.PreemptedError as e:
+                preempted = e
+                break
+            pdir = f"{args.profile}/config{which}" if args.profile else None
+            out = bench_config(which, quick=args.quick,
+                               profile_dir=pdir, n_seeds=args.seeds)
+            # A CPU fallback (dead tunnel) must never pass as a TPU
+            # artifact.
+            out["platform"] = platform
+            results.append(out)
+            print(json.dumps(results[-1]))
+            runtime.heartbeat()  # prove progress to a supervising process
+            if args.out:
+                # Incremental + atomic: a kill mid-sweep keeps every
+                # completed config, and no reader ever sees a torn file.
+                runtime.atomic_write_json(
+                    args.out, {"partial": True, "results": results},
+                    indent=2)
+    if args.out and preempted is None:
+        runtime.atomic_write_json(args.out, results, indent=2)
         log(f"wrote {args.out}")
+    if preempted is not None:
+        log(f"preempted: {preempted}; completed configs are in the "
+            f"artifact — exiting")
+        raise SystemExit(128 + (preempted.signum or 15))
 
 
 if __name__ == "__main__":

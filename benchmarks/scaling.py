@@ -56,12 +56,14 @@ def main():
     # for THIS process in this JAX version (see _jax_cache docstring).
     _jax_cache.enable_persistent_cache()
 
+    from redqueen_tpu import runtime
+
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     else:
-        from redqueen_tpu.utils.backend import ensure_live_backend
-
-        ensure_live_backend(log=log)
+        # Runtime backend guard: honors RQ_BACKEND=cpu degradation, else
+        # runs the shared deadline-bounded liveness probe.
+        runtime.ensure_backend(log=log)
     import numpy as np
 
     # Shared shape, chunk-allowance formula, and timing protocol with the
@@ -109,17 +111,16 @@ def main():
         if args.out:
             # Incremental write per point: a deadline kill mid-sweep (the
             # TPU capture's stage 8 runs LAST in an alive window) must not
-            # lose the points already measured.
-            with open(args.out, "w") as f:
-                json.dump({**_meta(jax, args), "partial": True,
-                           "rows": rows}, f, indent=1)
-                f.write("\n")
+            # lose the points already measured.  Atomic (temp + rename):
+            # the kill can also never leave a torn file.
+            runtime.atomic_write_json(
+                args.out, {**_meta(jax, args), "partial": True,
+                           "rows": rows}, indent=1)
+        runtime.heartbeat()
     out = {**_meta(jax, args), "rows": rows}
     print(json.dumps(out))
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(out, f, indent=1)
-            f.write("\n")
+        runtime.atomic_write_json(args.out, out, indent=1)
         log(f"wrote {args.out}")
 
 

@@ -41,7 +41,14 @@ from .sweep import SweepResult, run_sweep, run_sweep_star
 # flat `redqueen_tpu.run_sweep`); oracle and data stay import-on-use.
 from . import utils  # noqa: F401
 
+# The resilience runtime (supervised dispatch, retry/backoff, TPU->CPU
+# degradation, preemption safety, fault injection) is stdlib-only at
+# import time — eager re-export costs nothing and every entry point
+# needs it.
+from . import runtime  # noqa: F401
+
 __all__ = [
+    "runtime",
     "__version__",
     "GraphBuilder",
     "SimConfig",

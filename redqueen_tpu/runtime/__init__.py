@@ -1,0 +1,80 @@
+"""Resilient execution runtime: supervised dispatch, retry/backoff,
+graceful TPU->CPU degradation, preemption safety, and deterministic fault
+injection.
+
+The one API behind which the stack's tunnel-hang defenses live (see
+``supervisor`` for the full story):
+
+- :class:`Supervisor` / :func:`run_resilient` — deadline-bounded
+  subprocess dispatch with heartbeats, classified failures, exponential
+  backoff + jitter retries, and recorded TPU->CPU degradation; one
+  :class:`RunReport` (JSON artifact) per supervised run.
+- :func:`supervised_run` — one-shot argv supervision (rc=124 on timeout,
+  partial stdout preserved, durable capture log).
+- :func:`probe_backend` / :func:`backend_alive` / :func:`ensure_backend`
+  — the shared default-backend liveness policy behind the runtime API.
+- :mod:`~redqueen_tpu.runtime.preempt` — SIGTERM/SIGINT -> flush
+  registered writers, stop at the next durable boundary
+  (``run_sweep_checkpointed`` resumes bit-identically).
+- :mod:`~redqueen_tpu.runtime.faultinject` — deterministic hang / crash /
+  transient / OOM faults so every path above runs in CI on CPU.
+- :mod:`~redqueen_tpu.runtime.artifacts` — atomic (temp + ``os.replace``)
+  JSON/NPZ artifact writes; a killed run never leaves a torn file.
+"""
+
+from __future__ import annotations
+
+from . import artifacts, faultinject, preempt  # noqa: F401
+from .artifacts import atomic_savez, atomic_write_json, atomic_write_text
+from .preempt import (
+    PreemptedError,
+    check_preempt,
+    preempt_requested,
+    preemption_guard,
+    register_flush,
+    unregister_flush,
+)
+from .supervisor import (
+    Attempt,
+    RetryPolicy,
+    RunReport,
+    Supervisor,
+    SupervisorError,
+    backend_alive,
+    ensure_backend,
+    heartbeat,
+    probe_backend,
+    run_resilient,
+    supervised_run,
+)
+
+__all__ = [
+    # supervised dispatch
+    "Supervisor",
+    "SupervisorError",
+    "RetryPolicy",
+    "Attempt",
+    "RunReport",
+    "run_resilient",
+    "supervised_run",
+    "heartbeat",
+    # backend liveness (the utils.backend policy behind one API)
+    "probe_backend",
+    "backend_alive",
+    "ensure_backend",
+    # preemption safety
+    "preemption_guard",
+    "preempt_requested",
+    "check_preempt",
+    "register_flush",
+    "unregister_flush",
+    "PreemptedError",
+    # atomic artifacts
+    "atomic_write_json",
+    "atomic_write_text",
+    "atomic_savez",
+    # submodules
+    "artifacts",
+    "faultinject",
+    "preempt",
+]

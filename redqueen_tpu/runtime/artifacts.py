@@ -1,0 +1,58 @@
+"""Crash-consistent artifact IO.
+
+Every JSON/NPZ artifact a harness writes must be readable after a kill at
+any instant — the incremental-write-per-cell pattern the capture tools use
+is worthless if the kill lands mid-``json.dump`` and truncates the file.
+One policy, shared: write to a same-directory temp file, fsync, then
+``os.replace`` (atomic on POSIX).  A reader therefore sees either the
+previous complete artifact or the new complete artifact, never a torn one.
+
+Stdlib + numpy only; safe to import before jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+__all__ = ["atomic_write_json", "atomic_write_text", "atomic_savez"]
+
+
+def _atomic_commit(path: str, write_body) -> None:
+    """Run ``write_body(file_object)`` against a temp file in ``path``'s
+    directory, fsync, and atomically rename over ``path``."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_body(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    _atomic_commit(path, lambda f: f.write(text.encode()))
+
+
+def atomic_write_json(path: str, obj: Any, indent=None,
+                      trailing_newline: bool = True) -> None:
+    """Serialize ``obj`` and atomically replace ``path`` with it."""
+    text = json.dumps(obj, indent=indent)
+    if trailing_newline:
+        text += "\n"
+    atomic_write_text(path, text)
+
+
+def atomic_savez(path: str, **arrays) -> None:
+    """Atomic ``np.savez``: the temp file is passed as an open handle so
+    numpy cannot append ``.npz`` to the name and dodge the rename."""
+    import numpy as np
+
+    _atomic_commit(path, lambda f: np.savez(f, **arrays))

@@ -1,0 +1,174 @@
+"""Deterministic fault injection for the resilience runtime.
+
+Every failure mode the supervisor must survive — a child that hangs past
+its deadline (the wedged-axon-tunnel shape), crashes with a nonzero exit,
+fails transiently then succeeds, or dies with an OOM-looking
+``RuntimeError`` — is reproducible here ON CPU, so the retry / backoff /
+degradation / resume paths run in CI instead of waiting for a wedged TPU.
+
+Two ways in:
+
+- **Env protocol** (for argv children): set ``RQ_FAULT`` to a spec and the
+  supervised child applies it at its first :func:`maybe_inject` call (the
+  supervisor's callable wrapper calls it automatically).  Specs::
+
+      hang[:seconds]        sleep (default 3600s) — deadline-kill path
+      crash[:rc]            hard exit rc (default 17) — crash path
+      transient[:n]         raise TransientError on the first n calls
+                            (default 1), succeed after — needs
+                            RQ_FAULT_STATE pointing at a writable counter
+                            file so the count survives process restarts
+      oom                   raise RuntimeError("RESOURCE_EXHAUSTED ...")
+
+  ``RQ_FAULT_POINT`` (optional) restricts injection to the matching
+  ``maybe_inject(point)`` call site.
+
+- **Callable targets** (for in-process / spawn tests): module-level
+  functions (:func:`hang_forever`, :func:`crash_with`, :func:`flaky`,
+  :func:`raise_oom`, :func:`succeed`) picklable into a spawned child.
+
+Deterministic on purpose: nothing here uses randomness or wall-clock
+state beyond the explicit counter file.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import NamedTuple, Optional
+
+__all__ = [
+    "TransientError",
+    "FaultSpec",
+    "parse_fault",
+    "maybe_inject",
+    "inject",
+    "hang_forever",
+    "crash_with",
+    "flaky",
+    "raise_oom",
+    "succeed",
+    "ENV_FAULT",
+    "ENV_FAULT_STATE",
+    "ENV_FAULT_POINT",
+]
+
+ENV_FAULT = "RQ_FAULT"
+ENV_FAULT_STATE = "RQ_FAULT_STATE"
+ENV_FAULT_POINT = "RQ_FAULT_POINT"
+
+# Marker string the supervisor greps child stderr for, so a transient
+# failure in an argv child (where no exception object crosses the process
+# boundary) is still classified retry-with-backoff rather than crash.
+TRANSIENT_MARKER = "TransientError"
+
+# The OOM substrings the supervisor's classifier recognizes; the injected
+# RuntimeError uses the first (XLA's own allocator message prefix).
+OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "OutOfMemory")
+
+
+class TransientError(RuntimeError):
+    """A failure the caller should retry with backoff (injected stand-in
+    for flaky-tunnel / contended-host shapes)."""
+
+
+class FaultSpec(NamedTuple):
+    kind: str           # hang | crash | transient | oom
+    arg: Optional[str]  # kind-specific argument, unparsed
+
+
+def parse_fault(spec: str) -> FaultSpec:
+    kind, _, arg = spec.strip().partition(":")
+    kind = kind.strip().lower()
+    if kind not in ("hang", "crash", "transient", "oom"):
+        raise ValueError(f"unknown fault spec {spec!r} "
+                         f"(want hang|crash|transient|oom[:arg])")
+    return FaultSpec(kind, arg.strip() or None)
+
+
+def _bump_counter(path: str) -> int:
+    """Read-increment-write the cross-process attempt counter; returns the
+    count BEFORE this call (0 on first).  Plain text file: the supervisor
+    retries attempts sequentially, never concurrently, so no locking."""
+    try:
+        with open(path) as f:
+            n = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        n = 0
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(str(n + 1))
+    os.replace(tmp, path)
+    return n
+
+
+def inject(spec: FaultSpec) -> None:
+    """Apply one parsed fault in the calling process."""
+    if spec.kind == "hang":
+        time.sleep(float(spec.arg or 3600.0))
+    elif spec.kind == "crash":
+        # os._exit: no atexit, no finally — models a segfaulting child as
+        # closely as a Python process can.
+        os._exit(int(spec.arg or 17))
+    elif spec.kind == "transient":
+        n_failures = int(spec.arg or 1)
+        state = os.environ.get(ENV_FAULT_STATE)
+        if not state:
+            raise ValueError(
+                f"{ENV_FAULT}=transient needs {ENV_FAULT_STATE} set to a "
+                f"counter-file path (the failure count must survive the "
+                f"supervisor's process restarts)")
+        seen = _bump_counter(state)
+        if seen < n_failures:
+            raise TransientError(
+                f"injected transient failure {seen + 1}/{n_failures}")
+    elif spec.kind == "oom":
+        raise RuntimeError(
+            f"{OOM_MARKERS[0]}: injected out-of-memory (fault harness)")
+
+
+def maybe_inject(point: str = "start") -> None:
+    """Apply the env-configured fault, if any, at this injection point.
+
+    No-op unless ``RQ_FAULT`` is set; when ``RQ_FAULT_POINT`` is also set,
+    only the matching call site injects.  Supervised callable children get
+    a ``maybe_inject("start")`` automatically from the child wrapper;
+    entry points may add their own named points.
+    """
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return
+    want = os.environ.get(ENV_FAULT_POINT)
+    if want and want != point:
+        return
+    inject(parse_fault(spec))
+
+
+# --- picklable callable faults (spawned-child targets for tests) ---------
+
+def succeed(value=0):
+    """Control case: a supervised callable that just returns."""
+    return value
+
+
+def hang_forever(seconds: float = 3600.0) -> None:
+    time.sleep(seconds)
+
+
+def crash_with(rc: int = 17) -> None:
+    os._exit(rc)
+
+
+def flaky(state_file: str, n_failures: int = 1, value=42):
+    """Fail with :class:`TransientError` on the first ``n_failures`` calls
+    (counted across processes via ``state_file``), then return ``value``."""
+    seen = _bump_counter(state_file)
+    if seen < n_failures:
+        raise TransientError(
+            f"injected transient failure {seen + 1}/{n_failures}")
+    return value
+
+
+def raise_oom() -> None:
+    raise RuntimeError(f"{OOM_MARKERS[0]}: injected out-of-memory "
+                       f"(fault harness)")

@@ -31,6 +31,9 @@ from jax.sharding import Mesh
 from .config import stack_components
 from .parallel.bigf import simulate_star_batch, stack_star
 from .parallel.shard import simulate_sharded
+from .runtime import artifacts as _artifacts
+from .runtime import preempt as _preempt
+from .runtime.supervisor import heartbeat as _heartbeat
 from .sim import simulate_batch
 from .utils.metrics import feed_metrics_batch, num_posts
 
@@ -235,17 +238,17 @@ def run_sweep_checkpointed(points: Sequence, n_seeds: int, ckpt_dir: str,
                 chunk = None
         if chunk is None:
             chunk = runner(pts, n_seeds, seed0=seed0_chunk, **kwargs)
-            tmp = f"{path}.{os.getpid()}.tmp"
-            try:
-                with open(tmp, "wb") as f:  # file handle: savez must not
-                    np.savez(f, fingerprint=fp,  # append .npz to tmp name
-                             **{f2: getattr(chunk, f2)
-                                for f2 in SweepResult._fields})
-                os.replace(tmp, path)
-            finally:
-                if os.path.exists(tmp):
-                    os.remove(tmp)
+            _artifacts.atomic_savez(
+                path, fingerprint=fp,
+                **{f2: getattr(chunk, f2) for f2 in SweepResult._fields})
         grids.append(chunk)
+        # Chunk boundary = the durable safe point: everything appended so
+        # far is an atomically-renamed artifact on disk.  Prove progress
+        # to a supervising process, then honor a pending SIGTERM/SIGINT
+        # (runtime.preempt) — a preempted sweep rerun with the same
+        # arguments resumes from exactly these chunks, bit-identically.
+        _heartbeat()
+        _preempt.check_preempt(f"run_sweep_checkpointed chunk {ci}")
     return SweepResult(*(
         np.concatenate([getattr(g, f) for g in grids], axis=0)
         for f in SweepResult._fields

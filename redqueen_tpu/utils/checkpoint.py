@@ -7,6 +7,12 @@ Three checkpointable artifacts, all plain pytrees:
 - RMTPP weights (+ optax state) from ``models.rmtpp.fit``;
 - a ``SimState`` carry (resume a long-horizon simulation with ``sim.resume``);
 - sweep results (metric pytrees accumulated across seed/q grids).
+
+Read paths (``restore``, ``latest_step``) NEVER create directories: a
+typo'd path must raise/return-None, not leave an empty checkpoint tree
+that a later writer mistakes for a real one.  Writes register with
+``runtime.preempt`` so a SIGTERM mid-save waits out the in-flight orbax
+write before the process exits.
 """
 
 from __future__ import annotations
@@ -17,29 +23,52 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from ..runtime import preempt as _preempt
+
 __all__ = ["save", "restore", "latest_step"]
 
+# Managers with a potentially in-flight async save; the preemption flusher
+# waits these out so a SIGTERM never truncates an orbax step directory.
+_IN_FLIGHT: set = set()
 
-def _manager(path: str) -> ocp.CheckpointManager:
+
+@_preempt.register_flush
+def _flush_in_flight_saves() -> None:
+    for mgr in list(_IN_FLIGHT):
+        try:
+            mgr.wait_until_finished()
+        except Exception:  # noqa: BLE001 — flush must not block exit
+            pass
+
+
+def _manager(path: str, create: bool) -> ocp.CheckpointManager:
+    """``create=True`` only on the write path; read paths must never
+    materialize an empty checkpoint directory (the failure mode: a
+    missing-path ``restore`` leaving behind a dir that a later
+    ``latest_step`` call reads as an empty-but-real checkpoint)."""
     return ocp.CheckpointManager(
         os.path.abspath(path),
-        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=create),
     )
 
 
 def save(path: str, step: int, tree: Any) -> None:
     """Save a pytree (weights/opt state/SimState/metrics) under ``path`` at
     ``step``. Keeps the last 3 steps."""
-    mgr = _manager(path)
-    mgr.save(step, args=ocp.args.StandardSave(tree))
-    mgr.wait_until_finished()
-    mgr.close()
+    mgr = _manager(path, create=True)
+    _IN_FLIGHT.add(mgr)
+    try:
+        mgr.save(step, args=ocp.args.StandardSave(tree))
+        mgr.wait_until_finished()
+    finally:
+        _IN_FLIGHT.discard(mgr)
+        mgr.close()
 
 
 def latest_step(path: str) -> Optional[int]:
     if not os.path.isdir(path):
         return None
-    mgr = _manager(path)
+    mgr = _manager(path, create=False)
     step = mgr.latest_step()
     mgr.close()
     return step
@@ -48,16 +77,20 @@ def latest_step(path: str) -> Optional[int]:
 def restore(path: str, step: Optional[int] = None, like: Any = None):
     """Restore the pytree saved at ``step`` (default: latest). ``like``
     optionally provides the target structure/dtypes (required to restore
-    custom pytree nodes such as SimState)."""
-    mgr = _manager(path)
-    step = mgr.latest_step() if step is None else step
-    if step is None:
-        mgr.close()
+    custom pytree nodes such as SimState).  Raises ``FileNotFoundError``
+    on a missing path WITHOUT creating anything."""
+    if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint under {path}")
-    if like is None:
-        out = mgr.restore(step)
-    else:
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
-        out = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-    mgr.close()
+    mgr = _manager(path, create=False)
+    try:
+        step = mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        if like is None:
+            out = mgr.restore(step)
+        else:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+            out = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    finally:
+        mgr.close()
     return out

@@ -19,6 +19,8 @@ MODULES = [
     "redqueen_tpu.utils.metrics", "redqueen_tpu.utils.metrics_pandas",
     "redqueen_tpu.utils.checkpoint", "redqueen_tpu.utils.backend",
     "redqueen_tpu.native.loader",
+    "redqueen_tpu.runtime", "redqueen_tpu.runtime.faultinject",
+    "redqueen_tpu.runtime.preempt", "redqueen_tpu.runtime.artifacts",
 ]
 
 

@@ -245,38 +245,35 @@ def test_best_line_reprinted_after_every_engine(monkeypatch, capsys,
 def test_run_child_recovers_result_from_timeout_stdout(monkeypatch):
     """A child that printed its result line and THEN hung (the deferred
     --profile trace wedging on the tunnel) must not lose the measurement:
-    _run_child parses the stdout TimeoutExpired captured (round-5 review
-    finding against the 'can cost only the trace' claim)."""
+    _run_child parses the stdout the supervised kill captured (round-5
+    review finding against the 'can cost only the trace' claim).  The
+    seam is the resilience runtime's one low-level argv runner
+    (supervisor._popen_capture), which _run_child now dispatches
+    through."""
     import argparse
-    import subprocess as sp
+
+    import redqueen_tpu.runtime.supervisor as rsup
 
     line = json.dumps({"ok": True, "events": 10, "secs": 1.0,
                        "platform": "tpu", "top1": 1.0})
 
-    def fake_run(cmd, timeout, capture_output, text, cwd):
-        raise sp.TimeoutExpired(cmd, timeout,
-                                output="diag noise\n" + line + "\n",
-                                stderr="")
+    def fake_popen(cmd, deadline_s, env, cwd, hb_path, poll_s, hb_to):
+        return (124, "diag noise\n" + line + "\n", "", deadline_s,
+                f"wall deadline {deadline_s:.1f}s exceeded")
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(rsup, "_popen_capture", fake_popen)
     args = argparse.Namespace(followers=10, q=1.0, wall_rate=1.0,
                               quick=True, broadcasters=None, horizon=None,
                               capacity=None, config=None, profile=None)
     got = bench._run_child(args, "scan", "default", 5.0)
     assert got is not None and got["events"] == 10
 
-    # bytes stdout (text=False edge) and no stdout at all both degrade
-    # to the old None behavior, never raise
-    def fake_run_bytes(cmd, timeout, capture_output, text, cwd):
-        raise sp.TimeoutExpired(cmd, timeout, output=line.encode(), stderr=b"")
+    # no stdout at all degrades to the old None behavior, never raises
+    def fake_popen_none(cmd, deadline_s, env, cwd, hb_path, poll_s, hb_to):
+        return (124, "", "", deadline_s,
+                f"wall deadline {deadline_s:.1f}s exceeded")
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run_bytes)
-    assert bench._run_child(args, "scan", "default", 5.0)["events"] == 10
-
-    def fake_run_none(cmd, timeout, capture_output, text, cwd):
-        raise sp.TimeoutExpired(cmd, timeout)
-
-    monkeypatch.setattr(bench.subprocess, "run", fake_run_none)
+    monkeypatch.setattr(rsup, "_popen_capture", fake_popen_none)
     assert bench._run_child(args, "scan", "default", 5.0) is None
 
 
@@ -298,8 +295,12 @@ def test_run_child_filters_benign_aot_warning(monkeypatch, capsys):
         stdout = line + "\n"
         stderr = benign + "\n" + real + "\n"
 
-    monkeypatch.setattr(bench.subprocess, "run",
-                        lambda *a, **k: R())
+    import redqueen_tpu.runtime.supervisor as rsup
+
+    monkeypatch.setattr(
+        rsup, "_popen_capture",
+        lambda cmd, deadline_s, env, cwd, hb_path, poll_s, hb_to:
+        (R.returncode, R.stdout, R.stderr, 1.0, ""))
     args = argparse.Namespace(followers=10, q=1.0, wall_rate=1.0,
                               quick=True, broadcasters=None, horizon=None,
                               capacity=None, config=None, profile=None)

@@ -86,43 +86,59 @@ class TestMetricParity:
 # integration would not be exercised by well-behaved sim output.
 
 import jax.numpy as jnp
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
 
-_T = 20.0
-_S, _F = 3, 4  # sources x feeds; src 0 tracked, all sources hit all feeds
-_KNOTS = [0.0, 1.25, 2.5, 5.0, 10.0, 19.0, 20.0]
-_time_st = st.one_of(st.sampled_from(_KNOTS), st.floats(0.001, 19.999))
-_ev_st = st.lists(st.tuples(_time_st, st.integers(0, _S - 1)), max_size=24)
+# Guarded, not a module-level importorskip: the parity tests ABOVE must
+# keep collecting/running on containers without hypothesis — only the
+# fuzz twin skips (visibly, so its disappearance never reads as green).
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
 
+    _HAVE_HYPOTHESIS = True
+except ImportError:  # tier-1 must collect clean without hypothesis
+    _HAVE_HYPOTHESIS = False
 
-@settings(max_examples=60, deadline=None)
-@given(events=_ev_st, K=st.integers(1, 3))
-def test_fuzz_device_metrics_match_pandas(events, K):
-    E = 24
-    adj = np.ones((_S, _F), bool)
-    times = np.full(E, np.inf, np.float32)
-    srcs = np.full(E, -1, np.int32)
-    ev = sorted(events)  # ascending, duplicates kept
-    for i, (t, s) in enumerate(ev):
-        times[i] = t
-        srcs[i] = s
-    m = feed_metrics(times, srcs, jnp.asarray(adj), 0, _T, K=K)
-    df = events_to_dataframe(times, srcs, adj)
-    per_top = mp.time_in_top_k(df, K, _T, 0, per_sink=True,
-                               sink_ids=range(_F))
-    per_r = mp.int_rank_dt(df, _T, 0, per_sink=True, sink_ids=range(_F))
-    per_r2 = mp.int_rank2_dt(df, _T, 0, per_sink=True, sink_ids=range(_F))
-    np.testing.assert_allclose(
-        np.asarray(m.time_in_top_k),
-        [per_top[f] for f in range(_F)], rtol=1e-5, atol=1e-5,
-    )
-    np.testing.assert_allclose(
-        np.asarray(m.int_rank),
-        [per_r[f] for f in range(_F)], rtol=1e-5, atol=1e-5,
-    )
-    np.testing.assert_allclose(
-        np.asarray(m.int_rank2),
-        [per_r2[f] for f in range(_F)], rtol=1e-5, atol=1e-4,
-    )
-    assert int(num_posts(srcs, 0)) == mp.num_posts_of_src(df, 0)
+if _HAVE_HYPOTHESIS:
+    _T = 20.0
+    _S, _F = 3, 4  # sources x feeds; src 0 tracked, all sources hit all feeds
+    _KNOTS = [0.0, 1.25, 2.5, 5.0, 10.0, 19.0, 20.0]
+    _time_st = st.one_of(st.sampled_from(_KNOTS), st.floats(0.001, 19.999))
+    _ev_st = st.lists(st.tuples(_time_st, st.integers(0, _S - 1)),
+                      max_size=24)
+
+    @settings(max_examples=60, deadline=None)
+    @given(events=_ev_st, K=st.integers(1, 3))
+    def test_fuzz_device_metrics_match_pandas(events, K):
+        E = 24
+        adj = np.ones((_S, _F), bool)
+        times = np.full(E, np.inf, np.float32)
+        srcs = np.full(E, -1, np.int32)
+        ev = sorted(events)  # ascending, duplicates kept
+        for i, (t, s) in enumerate(ev):
+            times[i] = t
+            srcs[i] = s
+        m = feed_metrics(times, srcs, jnp.asarray(adj), 0, _T, K=K)
+        df = events_to_dataframe(times, srcs, adj)
+        per_top = mp.time_in_top_k(df, K, _T, 0, per_sink=True,
+                                   sink_ids=range(_F))
+        per_r = mp.int_rank_dt(df, _T, 0, per_sink=True, sink_ids=range(_F))
+        per_r2 = mp.int_rank2_dt(df, _T, 0, per_sink=True,
+                                 sink_ids=range(_F))
+        np.testing.assert_allclose(
+            np.asarray(m.time_in_top_k),
+            [per_top[f] for f in range(_F)], rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m.int_rank),
+            [per_r[f] for f in range(_F)], rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m.int_rank2),
+            [per_r2[f] for f in range(_F)], rtol=1e-5, atol=1e-4,
+        )
+        assert int(num_posts(srcs, 0)) == mp.num_posts_of_src(df, 0)
+else:
+    @pytest.mark.skip(reason="hypothesis not installed — fuzz twin skipped")
+    def test_fuzz_device_metrics_match_pandas():
+        """Placeholder so the fuzz twin's absence shows as a SKIP."""

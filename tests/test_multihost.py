@@ -55,6 +55,67 @@ def test_gather_global_single_process_is_asarray():
     assert isinstance(out["x"], np.ndarray)
 
 
+class TestGatherGlobalReplicatedLeaves:
+    """The per-leaf rule inside gather_global on a MULTI-process run (the
+    round-4 advisor finding encoded in the ``_leaf`` comment): only
+    process-sharded jax.Arrays get the all-gather; replicated host-NumPy
+    leaves (and fully-addressable jax.Arrays) riding in the same tree are
+    already whole on every process — all-gathering them would concatenate
+    process_count copies and silently change their shape.  Single-process
+    we fake the topology: process_count -> 2 and a spec'd mock standing in
+    for the one non-fully-addressable leaf."""
+
+    def _fake_multiproc(self, monkeypatch, gathered):
+        import jax
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+        def fake_allgather(x, tiled=False):
+            gathered.append((x, tiled))
+            # the real call returns one array spanning every process
+            return np.concatenate([np.zeros(3)] * 2)
+
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            fake_allgather)
+
+    def test_replicated_numpy_leaf_passes_through_unchanged(
+            self, monkeypatch):
+        import jax.numpy as jnp
+
+        gathered = []
+        self._fake_multiproc(monkeypatch, gathered)
+        own_times = np.array([1.0, 2.0, 3.0])          # host-NumPy leaf
+        addressable = jnp.arange(5)                    # fully-addressable
+        out = multihost.gather_global({"own_times": own_times,
+                                       "dev": addressable})
+        assert gathered == [], (
+            "replicated/addressable leaves must not be all-gathered")
+        np.testing.assert_array_equal(out["own_times"], own_times)
+        assert out["own_times"].shape == (3,), \
+            "shape must not grow by process_count"
+        np.testing.assert_array_equal(out["dev"], np.arange(5))
+        assert isinstance(out["dev"], np.ndarray)
+
+    def test_process_sharded_leaf_is_allgathered_tiled(self, monkeypatch):
+        import unittest.mock as mock
+
+        import jax
+
+        gathered = []
+        self._fake_multiproc(monkeypatch, gathered)
+        sharded = mock.MagicMock(spec=jax.Array)
+        sharded.is_fully_addressable = False
+        out = multihost.gather_global({"sharded": sharded,
+                                       "rep": np.ones(2)})
+        assert len(gathered) == 1 and gathered[0][0] is sharded
+        assert gathered[0][1] is True, "gather must be tiled (concatenate," \
+                                       " not stack)"
+        assert out["sharded"].shape == (6,), \
+            "sharded leaf becomes the global array"
+        np.testing.assert_array_equal(out["rep"], np.ones(2))
+
+
 def _reference_summary():
     """The same computation multihost_demo.py runs, on THIS process's
     8-device mesh with the identical {"dcn": 2, "data": 4} shape."""

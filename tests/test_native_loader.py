@@ -8,8 +8,15 @@ import os
 import numpy as np
 import pytest
 
-from redqueen_tpu.data import traces
-from redqueen_tpu.native import loader
+# Skip (not error) AT COLLECTION when the import chain fails — e.g. a
+# container without the ctypes/toolchain pieces the native module's
+# import path needs.  Tier-1 must collect clean everywhere.
+try:
+    from redqueen_tpu.data import traces
+    from redqueen_tpu.native import loader
+except Exception as e:  # noqa: BLE001 — any import failure means skip
+    pytest.skip(f"native-loader import chain failed: {e!r}",
+                allow_module_level=True)
 
 pytestmark = pytest.mark.skipif(
     not loader.available(), reason="no C++ toolchain on this machine"
@@ -269,35 +276,46 @@ def test_nan_timestamps_sort_last_like_numpy(tmp_path):
     assert np.isnan(got[-2:]).all() and got[0] == 1.0
 
 
-# unconditional, like tests/test_properties.py: hypothesis is a hard test
-# dependency of this repo — a silent disappearance of the parity fuzz
-# would read as green
-from hypothesis import given, settings
-from hypothesis import strategies as st
+# Guarded, not unconditional: the exact-parity tests above must keep
+# collecting/running on containers without hypothesis; the parity fuzz
+# skips VISIBLY (a placeholder SKIP, never a silent disappearance that
+# would read as green).
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
 
-_user = st.text(
-    alphabet=st.characters(min_codepoint=33, max_codepoint=126,
-                           exclude_characters=","),
-    min_size=1, max_size=6,
-)
-_time = st.one_of(
-    st.floats(allow_nan=True, allow_infinity=True).map(repr),
-    st.integers(-10**9, 10**9).map(str),
-    st.just("nan"), st.just("inf"), st.just("-inf"),
-)
+    _HAVE_HYPOTHESIS = True
+except ImportError:  # tier-1 must collect clean without hypothesis
+    _HAVE_HYPOTHESIS = False
 
+if _HAVE_HYPOTHESIS:
+    _user = st.text(
+        alphabet=st.characters(min_codepoint=33, max_codepoint=126,
+                               exclude_characters=","),
+        min_size=1, max_size=6,
+    )
+    _time = st.one_of(
+        st.floats(allow_nan=True, allow_infinity=True).map(repr),
+        st.integers(-10**9, 10**9).map(str),
+        st.just("nan"), st.just("inf"), st.just("-inf"),
+    )
 
-@given(rows=st.lists(st.tuples(_user, _time), max_size=60))
-@settings(max_examples=60, deadline=None)
-def test_fuzz_native_matches_python(tmp_path_factory, rows):
-    # Adversarial corpora: arbitrary printable user keys, the full
-    # float repr envelope incl. nan/inf/subnormals — the two engines
-    # must agree exactly (user order, per-user order, bit values).
-    d = tmp_path_factory.mktemp("fuzz")
-    p = str(d / "f.csv")
-    with open(p, "w") as f:
-        f.write("user,time\n")
-        for u, t in rows:
-            f.write(f"{u},{t}\n")
-    _assert_same(loader.load_csv_native(p),
-                 traces.load_csv(p, engine="python"))
+    @given(rows=st.lists(st.tuples(_user, _time), max_size=60))
+    @settings(max_examples=60, deadline=None)
+    def test_fuzz_native_matches_python(tmp_path_factory, rows):
+        # Adversarial corpora: arbitrary printable user keys, the full
+        # float repr envelope incl. nan/inf/subnormals — the two engines
+        # must agree exactly (user order, per-user order, bit values).
+        d = tmp_path_factory.mktemp("fuzz")
+        p = str(d / "f.csv")
+        with open(p, "w") as f:
+            f.write("user,time\n")
+            for u, t in rows:
+                f.write(f"{u},{t}\n")
+        _assert_same(loader.load_csv_native(p),
+                     traces.load_csv(p, engine="python"))
+else:
+    @pytest.mark.skip(reason="hypothesis not installed — parity fuzz "
+                             "skipped")
+    def test_fuzz_native_matches_python():
+        """Placeholder so the parity fuzz's absence shows as a SKIP."""

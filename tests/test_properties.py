@@ -16,7 +16,13 @@ seeds, which cost nothing to swap. Invariants checked:
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# Every test in this module is a hypothesis property; without the
+# dependency the whole module skips AT COLLECTION (a skip, not an error —
+# tier-1 must collect clean on minimal containers).
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from redqueen_tpu.config import GraphBuilder
 from redqueen_tpu.parallel.bigf import StarBuilder, simulate_star

@@ -1,0 +1,491 @@
+"""Resilience runtime (redqueen_tpu.runtime): supervised dispatch,
+retry/backoff, TPU->CPU degradation, structured failure reports,
+preemption safety — every failure path exercised deterministically on CPU
+via the fault-injection harness (runtime.faultinject), no wedged TPU
+required.
+
+Child-process hygiene: most supervised children here are stdlib-only
+``python -c`` argv targets (fast — no jax import); a couple of
+callable-mode tests pay one spawn each to cover the picklable-target
+path end to end.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from redqueen_tpu import runtime
+from redqueen_tpu.runtime import (
+    PreemptedError,
+    RetryPolicy,
+    SupervisorError,
+    faultinject,
+    preempt,
+    run_resilient,
+    supervised_run,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Stdlib-only child bodies (no jax import: each runs in well under a
+# second, so the whole module stays cheap).
+HANG = "import time; time.sleep(60)"
+OK_LINE = 'print(\'{"ok": true, "platform": "cpu"}\')'
+
+
+def _argv(body):
+    return [sys.executable, "-c", body]
+
+
+def _fast_retry(n, seed=0):
+    return RetryPolicy(max_attempts=n, base_delay_s=0.02, multiplier=2.0,
+                       jitter=0.5, seed=seed)
+
+
+# -------------------------------------------------------------------------
+# RetryPolicy: exponential backoff + deterministic jitter
+# -------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_deterministic_schedule_with_seed(self):
+        p = RetryPolicy(base_delay_s=1.0, multiplier=2.0, max_delay_s=60.0,
+                        jitter=0.5, seed=123)
+        a = [p.delay(i, p.rng()) for i in (1, 2, 3)]
+        b = [p.delay(i, p.rng()) for i in (1, 2, 3)]
+        assert a == b, "same seed must give the same backoff schedule"
+
+    def test_exponential_growth_jitter_bounds_and_cap(self):
+        p = RetryPolicy(base_delay_s=1.0, multiplier=2.0, max_delay_s=5.0,
+                        jitter=0.5, seed=7)
+        rng = p.rng()
+        for n, base in [(1, 1.0), (2, 2.0), (3, 4.0), (4, 5.0), (5, 5.0)]:
+            d = p.delay(n, rng)
+            assert base <= d <= base * 1.5, (n, d)
+
+    def test_no_jitter_is_exact(self):
+        p = RetryPolicy(base_delay_s=0.5, multiplier=3.0, jitter=0.0)
+        rng = p.rng()
+        assert [p.delay(n, rng) for n in (1, 2)] == [0.5, 1.5]
+
+    def test_rejects_zero_attempts(self):
+        with pytest.raises(ValueError, match="max_attempts"):
+            RetryPolicy(max_attempts=0)
+
+
+# -------------------------------------------------------------------------
+# Fault classification + retry/degradation (argv children, stdlib-only)
+# -------------------------------------------------------------------------
+
+def test_injected_hang_triggers_deadline_kill_and_retry():
+    """Acceptance: an injected hang is killed at the deadline, retried
+    with backoff, and the default->cpu degradation is recorded."""
+    rep = run_resilient(_argv(HANG), name="hang", deadline_s=0.75,
+                        retry=_fast_retry(2), poll_s=0.05)
+    assert not rep.ok and rep.failure_kind == "timeout"
+    assert [a.outcome for a in rep.attempts] == ["timeout", "timeout"]
+    assert all(a.returncode == 124 for a in rep.attempts)
+    # one backoff slept between the two attempts, from the seeded policy
+    assert len(rep.backoff_schedule) == 1 and rep.backoff_schedule[0] > 0
+    # hang on the default backend implicates the accelerator: degrade
+    assert rep.degraded and rep.degradations == [
+        {"after_attempt": 1, "from": "default", "to": "cpu",
+         "reason": "timeout"}]
+    assert rep.attempts[1].backend == "cpu"
+
+
+def test_injected_transient_succeeds_on_retry_with_backoff(tmp_path):
+    """Acceptance: a transiently-failing child succeeds on retry; the
+    TransientError marker on stderr classifies it retryable (not crash),
+    and no degradation happens (the backend is not implicated)."""
+    state = str(tmp_path / "count")
+    body = textwrap.dedent(f"""
+        import os, sys
+        p = {state!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        if n < 1:
+            print("TransientError: injected flake", file=sys.stderr)
+            sys.exit(1)
+        {OK_LINE}
+        """)
+    rep = run_resilient(_argv(body), name="transient", deadline_s=30.0,
+                        retry=_fast_retry(3))
+    assert rep.ok and rep.disposition == "ok"
+    assert [a.outcome for a in rep.attempts] == ["transient", "ok"]
+    assert len(rep.backoff_schedule) == 1
+    assert not rep.degraded
+    assert rep.result == {"ok": True, "platform": "cpu"}
+    assert rep.backend_used == "cpu"  # child-reported platform wins
+
+
+def test_injected_crash_after_degradation_yields_failure_report(tmp_path):
+    """Acceptance: hang -> degrade to CPU -> crash -> attempts exhausted;
+    one structured JSON failure report lands with the whole history.
+
+    The wedging attempt dies fast via HEARTBEAT staleness (it heartbeats
+    once, then stalls) while the wall deadline stays generous — a tight
+    wall deadline would race interpreter startup of the healthy attempt
+    on a loaded box (observed flake)."""
+    state = str(tmp_path / "count")
+    body = textwrap.dedent(f"""
+        import os, time
+        p = {state!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        if n < 1:
+            open(os.environ["RQ_HEARTBEAT_FILE"], "w").write("x")
+            time.sleep(60)   # first attempt: wedge (stale heartbeat)
+        os._exit(3)          # after degradation: crash
+        """)
+    rep = run_resilient(_argv(body), name="crash-after-degrade",
+                        deadline_s=60.0, heartbeat_timeout_s=0.5,
+                        retry=_fast_retry(2), poll_s=0.05,
+                        report_dir=str(tmp_path))
+    assert not rep.ok and rep.disposition == "failed"
+    assert [a.outcome for a in rep.attempts] == ["timeout", "crash"]
+    assert rep.degraded and rep.degradations[0]["reason"] == "timeout"
+    assert rep.failure_kind == "crash"
+    assert rep.backend_used == "cpu"
+    # the structured report artifact
+    assert rep.report_path and os.path.exists(rep.report_path)
+    with open(rep.report_path) as f:
+        doc = json.load(f)
+    assert doc["ok"] is False and doc["disposition"] == "failed"
+    assert doc["n_attempts"] == 2
+    assert [a["outcome"] for a in doc["attempts"]] == ["timeout", "crash"]
+    assert doc["attempts"][0]["deadline_s"] == 60.0
+    assert "heartbeat stale" in doc["attempts"][0]["detail"]
+    assert doc["backoff_schedule_s"] == rep.backoff_schedule
+    assert doc["degradations"] == rep.degradations
+    assert doc["retry_policy"]["max_attempts"] == 2
+
+
+def test_injected_oom_classified_and_degrades():
+    body = ("import sys; "
+            "print('RESOURCE_EXHAUSTED: injected', file=sys.stderr); "
+            "sys.exit(1)")
+    rep = run_resilient(_argv(body), name="oom", deadline_s=30.0,
+                        retry=_fast_retry(2))
+    assert [a.outcome for a in rep.attempts] == ["oom", "oom"]
+    assert rep.degraded and rep.degradations[0]["reason"] == "oom"
+
+
+def test_heartbeat_staleness_kills_wedged_child_before_deadline():
+    """A child that heartbeats once then wedges is killed by the
+    staleness bound long before the wall deadline."""
+    body = ("import os, time; "
+            "open(os.environ['RQ_HEARTBEAT_FILE'], 'w').write('x'); "
+            "time.sleep(60)")
+    rep = run_resilient(_argv(body), name="stale-heartbeat",
+                        deadline_s=30.0, heartbeat_timeout_s=0.5,
+                        poll_s=0.05, retry=RetryPolicy(max_attempts=1))
+    att = rep.attempts[0]
+    assert att.outcome == "timeout" and "heartbeat stale" in att.detail
+    assert att.wall_s < 10.0, "must not wait out the 30s wall deadline"
+
+
+def test_crash_not_retried_when_excluded():
+    rep = run_resilient(_argv("import os; os._exit(9)"), name="no-retry",
+                        deadline_s=30.0, retry=_fast_retry(3),
+                        retry_on=("timeout", "transient", "oom"))
+    assert len(rep.attempts) == 1 and rep.failure_kind == "crash"
+
+
+def test_raise_on_failure_carries_report():
+    with pytest.raises(SupervisorError) as ei:
+        run_resilient(_argv("import os; os._exit(2)"), name="boom",
+                      deadline_s=30.0, retry=RetryPolicy(max_attempts=1),
+                      raise_on_failure=True)
+    assert ei.value.report.failure_kind == "crash"
+
+
+def test_degraded_attempt_env_forces_cpu(tmp_path):
+    """After degradation the child env carries RQ_BACKEND=cpu AND
+    JAX_PLATFORMS=cpu — what ensure_backend() honors without a probe."""
+    out = str(tmp_path / "env.json")
+    state = str(tmp_path / "count")
+    body = textwrap.dedent(f"""
+        import json, os, time
+        p = {state!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        if n < 1:
+            open(os.environ["RQ_HEARTBEAT_FILE"], "w").write("x")
+            time.sleep(60)   # wedge; killed fast via stale heartbeat
+        json.dump({{"rq": os.environ.get("RQ_BACKEND"),
+                    "jp": os.environ.get("JAX_PLATFORMS"),
+                    "sup": os.environ.get("RQ_SUPERVISED")}},
+                  open({out!r}, "w"))
+        """)
+    rep = run_resilient(_argv(body), name="degrade-env", deadline_s=60.0,
+                        heartbeat_timeout_s=0.5, retry=_fast_retry(2),
+                        poll_s=0.05)
+    assert rep.ok and rep.degraded
+    with open(out) as f:
+        env = json.load(f)
+    assert env == {"rq": "cpu", "jp": "cpu", "sup": "1"}
+
+
+def test_supervised_run_timeout_preserves_partial_stdout(tmp_path):
+    """The proc_util.run_logged contract, served by the runtime: rc=124,
+    the pre-kill stdout is preserved, and the durable log is written."""
+    lp = str(tmp_path / "capture.log")
+    # 5s deadline: comfortably past interpreter startup (so the EARLY
+    # print always lands) while still far short of the 60s sleep.
+    rc, out, err, wall = supervised_run(
+        _argv("import time; print('EARLY RESULT', flush=True); "
+              "time.sleep(60)"),
+        5.0, log_path=lp, name="partial")
+    assert rc == 124 and "EARLY RESULT" in out
+    text = open(lp).read()
+    assert "rc=124" in text and "EARLY RESULT" in text
+
+
+def test_probe_first_degrades_without_burning_an_attempt(monkeypatch):
+    """probe_first=True + dead backend: degradation happens BEFORE
+    attempt 1 (recorded as after_attempt 0) and the child runs on CPU."""
+    import redqueen_tpu.utils.backend as ub
+
+    monkeypatch.setattr(ub, "default_backend_alive",
+                        lambda log=None, deadlines=None: (False, 0, ""))
+    rep = run_resilient(_argv(OK_LINE), name="probe-degrade",
+                        deadline_s=30.0, retry=RetryPolicy(max_attempts=1),
+                        probe_first=True)
+    assert rep.ok and rep.degraded
+    assert rep.degradations[0]["after_attempt"] == 0
+    assert rep.attempts[0].backend == "cpu"
+
+
+# -------------------------------------------------------------------------
+# Callable targets through the spawn path (the picklable-fault harness)
+# -------------------------------------------------------------------------
+
+def test_callable_flaky_transient_then_success(tmp_path):
+    rep = run_resilient(faultinject.flaky,
+                        args=(str(tmp_path / "c"), 1, 42),
+                        name="flaky-callable", deadline_s=120.0,
+                        retry=_fast_retry(3))
+    assert rep.ok and rep.result == 42
+    assert [a.outcome for a in rep.attempts] == ["transient", "ok"]
+    assert len(rep.backoff_schedule) == 1
+
+
+def test_callable_oom_classified(tmp_path):
+    rep = run_resilient(faultinject.raise_oom, name="oom-callable",
+                        deadline_s=120.0, retry=RetryPolicy(max_attempts=1),
+                        report_dir=str(tmp_path))
+    assert not rep.ok and rep.failure_kind == "oom"
+    with open(rep.report_path) as f:
+        assert json.load(f)["failure_kind"] == "oom"
+
+
+# -------------------------------------------------------------------------
+# faultinject protocol itself
+# -------------------------------------------------------------------------
+
+class TestFaultSpecs:
+    def test_parse(self):
+        assert faultinject.parse_fault("hang:30") == ("hang", "30")
+        assert faultinject.parse_fault("crash") == ("crash", None)
+        assert faultinject.parse_fault("transient:2") == ("transient", "2")
+        with pytest.raises(ValueError, match="unknown fault"):
+            faultinject.parse_fault("nope")
+
+    def test_maybe_inject_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv(faultinject.ENV_FAULT, raising=False)
+        faultinject.maybe_inject()  # must not raise
+
+    def test_maybe_inject_respects_point_filter(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_FAULT, "oom")
+        monkeypatch.setenv(faultinject.ENV_FAULT_POINT, "late")
+        faultinject.maybe_inject("start")  # filtered: no-op
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            faultinject.maybe_inject("late")
+
+    def test_transient_requires_state_file(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_FAULT, "transient:1")
+        monkeypatch.delenv(faultinject.ENV_FAULT_STATE, raising=False)
+        with pytest.raises(ValueError, match="RQ_FAULT_STATE"):
+            faultinject.maybe_inject()
+
+    def test_transient_counts_across_calls(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_FAULT, "transient:2")
+        monkeypatch.setenv(faultinject.ENV_FAULT_STATE,
+                           str(tmp_path / "n"))
+        for _ in range(2):
+            with pytest.raises(faultinject.TransientError):
+                faultinject.maybe_inject()
+        faultinject.maybe_inject()  # third call: healed
+
+
+# -------------------------------------------------------------------------
+# Preemption safety
+# -------------------------------------------------------------------------
+
+@pytest.fixture()
+def _clean_preempt():
+    preempt.reset()
+    yield
+    preempt.reset()
+
+
+def test_preemption_guard_flag_flush_and_checkpoint(_clean_preempt):
+    flushed = []
+
+    def flusher():
+        flushed.append(True)
+
+    preempt.register_flush(flusher)
+    try:
+        with runtime.preemption_guard(log=None):
+            assert not preempt.preempt_requested()
+            preempt.check_preempt("before")  # no-op
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert preempt.preempt_requested()
+            assert flushed == [True], "flushers run on the first signal"
+            with pytest.raises(PreemptedError) as ei:
+                preempt.check_preempt("chunk 3")
+            assert "chunk 3" in str(ei.value)
+            assert ei.value.signum == signal.SIGTERM
+    finally:
+        preempt.unregister_flush(flusher)
+
+
+def test_new_guard_section_resets_signal_count(_clean_preempt):
+    """A preempted earlier section must not make the next section's FIRST
+    signal take the second-signal kill path (the count is per-section):
+    entering a guard resets it, so flushers always run on a fresh
+    section's first signal."""
+    with runtime.preemption_guard(log=None):
+        os.kill(os.getpid(), signal.SIGTERM)
+    assert preempt._STATE["count"] == 1
+    with runtime.preemption_guard(log=None):
+        assert preempt._STATE["count"] == 0
+
+
+def test_preemption_guard_restores_handlers(_clean_preempt):
+    before = signal.getsignal(signal.SIGTERM)
+    with runtime.preemption_guard(log=None):
+        assert signal.getsignal(signal.SIGTERM) is not before
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_failing_flusher_does_not_block_others(_clean_preempt):
+    order = []
+    bad = lambda: (_ for _ in ()).throw(RuntimeError("flush boom"))  # noqa: E731
+    good = lambda: order.append("good")  # noqa: E731
+    preempt.register_flush(bad)
+    preempt.register_flush(good)
+    try:
+        preempt.flush_all(log=None)
+        assert order == ["good"]
+    finally:
+        preempt.unregister_flush(bad)
+        preempt.unregister_flush(good)
+
+
+# -------------------------------------------------------------------------
+# Atomic artifacts
+# -------------------------------------------------------------------------
+
+def test_atomic_write_json_and_savez_roundtrip(tmp_path):
+    p = str(tmp_path / "a.json")
+    runtime.atomic_write_json(p, {"x": 1}, indent=1)
+    assert json.load(open(p)) == {"x": 1}
+    # overwrite keeps the old-or-new invariant trivially; check new wins
+    runtime.atomic_write_json(p, {"x": 2})
+    assert json.load(open(p)) == {"x": 2}
+    z = str(tmp_path / "b.npz")
+    runtime.atomic_savez(z, arr=np.arange(4))
+    with np.load(z) as f:
+        np.testing.assert_array_equal(f["arr"], np.arange(4))
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+# -------------------------------------------------------------------------
+# SIGTERM mid-sweep: resumable checkpoint, bit-identical completion
+# (the acceptance scenario, end to end in a real child process)
+# -------------------------------------------------------------------------
+
+def sweep_points():
+    from redqueen_tpu.config import GraphBuilder
+
+    pts = []
+    for q in (0.5, 1.0, 2.0, 4.0):
+        gb = GraphBuilder(n_sinks=2, end_time=30.0)
+        gb.add_opt(q=q)
+        gb.add_poisson(rate=1.0, sinks=[0])
+        gb.add_poisson(rate=1.0, sinks=[1])
+        pts.append(gb.build(capacity=256))
+    return pts
+
+_CHILD = """
+import os, signal, sys
+
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from redqueen_tpu import runtime
+import redqueen_tpu.sweep as sweep_mod
+
+{points_src}
+
+# Deliver a REAL SIGTERM at the first durable chunk boundary: the sweep
+# heartbeats right after each chunk's atomic rename lands, so patching
+# the heartbeat is the precise 'mid-sweep, nothing in flight' instant.
+_orig_hb = sweep_mod._heartbeat
+_n = {{"chunks": 0}}
+
+def _hb():
+    _n["chunks"] += 1
+    if _n["chunks"] == 2:
+        os.kill(os.getpid(), signal.SIGTERM)
+    _orig_hb()
+
+sweep_mod._heartbeat = _hb
+
+with runtime.preemption_guard():
+    try:
+        sweep_mod.run_sweep_checkpointed(
+            sweep_points(), n_seeds=2, ckpt_dir={ckpt!r}, chunk_points=1)
+        print("COMPLETED")
+        sys.exit(0)
+    except runtime.PreemptedError:
+        print("PREEMPTED")
+        sys.exit(143)
+"""
+
+def test_sigterm_mid_sweep_resumes_bit_identically(tmp_path):
+    import inspect
+
+    ckpt = str(tmp_path / "ckpt")
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD.format(
+        repo=REPO, points_src=inspect.getsource(sweep_points), ckpt=ckpt))
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, timeout=600)
+    assert r.returncode == 143 and "PREEMPTED" in r.stdout, (
+        r.returncode, r.stdout, r.stderr)
+    done = sorted(f for f in os.listdir(ckpt) if f.endswith(".npz"))
+    assert 1 <= len(done) < 4, (
+        f"preemption must land between chunk boundaries, got {done}")
+    mtimes = {f: os.path.getmtime(os.path.join(ckpt, f)) for f in done}
+
+    # Resume in-process: only the missing chunks recompute...
+    from redqueen_tpu.sweep import run_sweep, run_sweep_checkpointed
+
+    resumed = run_sweep_checkpointed(sweep_points(), n_seeds=2,
+                                     ckpt_dir=ckpt, chunk_points=1)
+    for f, t in mtimes.items():
+        assert os.path.getmtime(os.path.join(ckpt, f)) == t, (
+            f"chunk {f} was recomputed on resume despite matching inputs")
+    # ...and the completed grid is bit-identical to an uninterrupted run.
+    ref = run_sweep(sweep_points(), n_seeds=2)
+    for a, b in zip(resumed, ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

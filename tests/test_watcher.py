@@ -105,18 +105,20 @@ def test_stale_sentinel_removed_fresh_one_kept(watcher, monkeypatch,
 def test_capture_evidence_always_removes_sentinel(watcher, monkeypatch,
                                                   tmp_path):
     """The real capture_evidence: sentinel exists during the run, is
-    removed afterwards even when the subprocess times out (run_logged
-    reports a timeout as rc=124)."""
-    import proc_util  # tools/ is on sys.path once the watcher module loads
+    removed afterwards even when the subprocess times out (the runtime's
+    supervised_run reports a deadline kill as rc=124).  The seam is the
+    resilience runtime's one low-level argv runner."""
+    import redqueen_tpu.runtime.supervisor as rsup
 
     sent = tmp_path / "sentinel"
     seen = {}
 
-    def fake_run(cmd, timeout, capture_output, text, cwd):
+    def fake_popen(cmd, deadline_s, env, cwd, hb_path, poll_s, hb_to):
         seen["sentinel_during"] = sent.exists()
-        raise proc_util.subprocess.TimeoutExpired(cmd, timeout)
+        return (124, "", "", deadline_s,
+                f"wall deadline {deadline_s:.1f}s exceeded")
 
-    monkeypatch.setattr(proc_util.subprocess, "run", fake_run)
+    monkeypatch.setattr(rsup, "_popen_capture", fake_popen)
     rc = watcher.capture_evidence(1.0)
     assert rc == 124
     assert seen["sentinel_during"] is True
@@ -137,21 +139,15 @@ def test_stages_flag_reaches_capture(watcher, monkeypatch):
 def test_capture_evidence_builds_stage_args(watcher, monkeypatch, tmp_path):
     """The stage order handed to capture_evidence is exactly the order of
     --stage flags on the tpu_evidence.py command line."""
-    import proc_util
+    import redqueen_tpu.runtime.supervisor as rsup
 
     seen = {}
 
-    def fake_run(cmd, timeout, capture_output, text, cwd):
+    def fake_popen(cmd, deadline_s, env, cwd, hb_path, poll_s, hb_to):
         seen["cmd"] = list(cmd)
+        return 0, "", "", 0.1, ""
 
-        class R:
-            returncode = 0
-            stdout = ""
-            stderr = ""
-
-        return R()
-
-    monkeypatch.setattr(proc_util.subprocess, "run", fake_run)
+    monkeypatch.setattr(rsup, "_popen_capture", fake_popen)
     rc = watcher.capture_evidence(1.0, stages=[3, 1])
     assert rc == 0
     idx = [i for i, a in enumerate(seen["cmd"]) if a == "--stage"]
@@ -163,21 +159,15 @@ def test_tag_flag_flows_to_evidence_cmd_and_log(watcher, monkeypatch):
     """--tag must reach the tpu_evidence command line AND retarget the
     capture log, so a watcher that outlives a round boundary captures
     under the new round's names instead of overwriting banked evidence."""
-    import proc_util
+    import redqueen_tpu.runtime.supervisor as rsup
 
     seen = {}
 
-    def fake_run(cmd, timeout, capture_output, text, cwd):
+    def fake_popen(cmd, deadline_s, env, cwd, hb_path, poll_s, hb_to):
         seen["cmd"] = list(cmd)
+        return 0, "", "", 0.1, ""
 
-        class R:
-            returncode = 0
-            stdout = ""
-            stderr = ""
-
-        return R()
-
-    monkeypatch.setattr(proc_util.subprocess, "run", fake_run)
+    monkeypatch.setattr(rsup, "_popen_capture", fake_popen)
     real_path = os.path.join(REPO, "benchmarks", "tpu_capture_r05.log")
     real_before = os.path.exists(real_path)
     rc = watcher.capture_evidence(1.0, stages=[2], tag="r05")

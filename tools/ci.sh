@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# One-command CI gate: the resilience static pass, then the tier-1 suite
+# (the exact ROADMAP verify command).  Usage: bash tools/ci.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== resilience static pass =="
+python tools/check_resilience.py
+
+echo "== tier-1 suite =="
+rm -f /tmp/_t1.log
+# || rc=$? keeps `set -e` from aborting before the pass-count summary:
+# with pipefail the captured status is pytest's (tee always succeeds).
+rc=0
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || rc=$?
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc

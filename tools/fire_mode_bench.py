@@ -79,12 +79,14 @@ def main() -> int:
     # for THIS process in this JAX version (see _jax_cache docstring).
     _jax_cache.enable_persistent_cache()
 
+    from redqueen_tpu import runtime
+
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     else:
-        from redqueen_tpu.utils.backend import ensure_live_backend
-
-        ensure_live_backend()
+        # Runtime backend guard: honors RQ_BACKEND=cpu degradation, else
+        # runs the shared deadline-bounded liveness probe.
+        runtime.ensure_backend()
     platform = jax.devices()[0].platform
     out = args.out or os.path.join(REPO, f"FIRE_MODE_{platform}.json")
     results = {"platform": platform, "timed": "best of "
@@ -97,9 +99,9 @@ def main() -> int:
             print(f"  {r['label']:20s} {mode:9s}: {r['secs']:8.3f}s "
                   f"({r['events_per_sec']:,.0f} ev/s)",
                   file=sys.stderr, flush=True)
-            with open(out, "w") as f:  # incremental: survive a wedge
-                json.dump(results, f, indent=1)
-                f.write("\n")
+            # Incremental AND atomic: survive a wedge, never tear the file.
+            runtime.atomic_write_json(out, results, indent=1)
+            runtime.heartbeat()
     print(json.dumps({"ok": True, "platform": platform, "out": out}))
     return 0
 

@@ -138,8 +138,12 @@ def main() -> int:
         star_own_shape=list(np.asarray(star_gathered["own_times"]).shape),
     )
     if pid == 0:
-        with open(args.out, "w") as f:
-            json.dump(summary, f)
+        from redqueen_tpu.runtime import atomic_write_json
+
+        # Atomic: the spawning test reads this the moment process 0
+        # exits; a torn file would fail the bit-identical comparison for
+        # the wrong reason.
+        atomic_write_json(args.out, summary, trailing_newline=False)
     print(f"[proc {pid}/{nproc}] OK: {summary}")
     return 0
 

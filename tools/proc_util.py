@@ -1,39 +1,28 @@
-"""Shared subprocess runner for the TPU evidence tools.
-
-One place for the two quirks every capture-path subprocess needs handled
-(tools/tpu_watcher.py and tools/tpu_evidence.py previously carried copies):
-``TimeoutExpired`` may hand back bytes OR str for the streams, and the
-already-printed stdout must be KEPT on a timeout kill — bench.py's whole
-protocol is that a printed result line survives the killer.
+"""Back-compat shim: the capture tools' subprocess runner now lives in the
+resilience runtime (``redqueen_tpu.runtime.supervised_run`` — supervised
+dispatch, rc=124 on a deadline kill, partial stdout preserved, durable
+command log).  This module remains so older scripts importing
+``proc_util.run_logged`` keep working; new code should call the runtime
+directly.
 """
 
 from __future__ import annotations
 
-import subprocess
-import time
+import os
+import sys
 from typing import Sequence, Tuple
 
-
-def _text(x) -> str:
-    return x.decode(errors="replace") if isinstance(x, bytes) else (x or "")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # redqueen_tpu when loaded by path
+    sys.path.insert(0, _REPO)
 
 
 def run_logged(cmd: Sequence[str], timeout_s: float, log_path: str,
                cwd: str) -> Tuple[int, str, str, float]:
-    """Run ``cmd`` with a deadline; write the standard capture log
-    (command, rc, wall seconds, stdout, stderr) to ``log_path``; return
+    """Run ``cmd`` with a deadline; write the standard capture log; return
     ``(rc, stdout, stderr, wall_s)`` with rc=124 on timeout (partial
-    output preserved). Wall time is measured and logged HERE so the
-    durable log always shows whether a kill came at the deadline."""
-    t0 = time.monotonic()
-    try:
-        r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
-                           text=True, cwd=cwd)
-        rc, out, err = r.returncode, r.stdout or "", r.stderr or ""
-    except subprocess.TimeoutExpired as e:
-        rc, out, err = 124, _text(e.stdout), _text(e.stderr)
-    wall = time.monotonic() - t0
-    with open(log_path, "w") as f:
-        f.write(f"$ {' '.join(cmd)}\nrc={rc} wall={wall:.1f}s\n"
-                f"--- stdout ---\n{out}\n--- stderr ---\n{err}\n")
-    return rc, out, err, wall
+    output preserved).  Delegates to the runtime's supervised runner."""
+    from redqueen_tpu.runtime import supervised_run
+
+    return supervised_run(cmd, timeout_s, log_path=log_path, cwd=cwd,
+                          name="run_logged")

@@ -23,7 +23,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -52,6 +51,11 @@ def main() -> int:
     args = ap.parse_args()
 
     sys.path.insert(0, REPO)
+    from redqueen_tpu.runtime import (
+        atomic_write_json,
+        heartbeat,
+        supervised_run,
+    )
     from redqueen_tpu.utils.backend import parse_last_json_line
 
     backend_flag = "--tpu" if args.tpu else "--cpu"
@@ -75,10 +79,11 @@ def main() -> int:
                     os.path.exists(out_path):
                 os.replace(out_path, want)
             out_path = want
-        with open(out_path, "w") as f:
-            json.dump({"date_utc": time.strftime("%Y-%m-%d", time.gmtime()),
-                       "platform": platform, "cells": rows}, f, indent=1)
-            f.write("\n")
+        atomic_write_json(
+            out_path,
+            {"date_utc": time.strftime("%Y-%m-%d", time.gmtime()),
+             "platform": platform, "cells": rows}, indent=1)
+        heartbeat()
 
     for F, B, q in shapes:
         cell = {"followers": F, "broadcasters": B, "q": q, "horizon": T}
@@ -92,14 +97,13 @@ def main() -> int:
             if args.quick:
                 cmd.append("--quick")
                 # --quick forces CPU unless --tpu; keep the flag's meaning
-            t0 = time.monotonic()
-            try:
-                r = subprocess.run(cmd, timeout=args.engine_deadline + 180.0,
-                                   capture_output=True, text=True, cwd=REPO)
-                parsed = parse_last_json_line(r.stdout)
-            except subprocess.TimeoutExpired:
-                parsed = None
-            wall = time.monotonic() - t0
+            # Supervised dispatch: deadline kill preserves any result
+            # line the child printed before wedging (one policy, the
+            # runtime's) — parse it either way.
+            rc, out, err, wall = supervised_run(
+                cmd, args.engine_deadline + 180.0, cwd=REPO,
+                name=f"star-vs-scan-F{F}-{engine}")
+            parsed = parse_last_json_line(out)
             if parsed is None:
                 cell[engine] = {"ok": False, "wall_s": round(wall, 1)}
                 print(f"F={F:>7} {engine:5}: FAILED/timeout ({wall:.0f}s)",

@@ -37,10 +37,10 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _TOOLS = os.path.join(REPO, "tools")
-if _TOOLS not in sys.path:  # proc_util when loaded by path
+if _TOOLS not in sys.path:  # sibling tools when loaded by path
     sys.path.insert(0, _TOOLS)
-
-from proc_util import run_logged  # noqa: E402
+if REPO not in sys.path:  # redqueen_tpu.runtime when loaded by path
+    sys.path.insert(0, REPO)
 
 # The one authoritative stage-number set; tools/tpu_watcher.py imports it
 # for its own --stages validation so the two lists cannot drift.
@@ -50,21 +50,28 @@ STAGE_CHOICES = (1, 2, 3, 4, 5, 6, 7, 8)
 def run_stage(name, cmd, out_json, deadline_s, log_path):
     print(f"== stage {name}: {' '.join(cmd)} (deadline {deadline_s:.0f}s)",
           flush=True)
-    # run_logged keeps whatever stdout the child printed BEFORE a timeout
-    # kill: bench.py's whole protocol is that an already-printed result
-    # line survives.
-    rc, out, err, wall = run_logged(cmd, deadline_s, log_path, cwd=REPO)
+    # Deferred import (pattern of the other runtime imports below): the
+    # package import pays jax/orbax startup, which must not be spent
+    # before a capture window's first stage even dispatches.
+    from redqueen_tpu.runtime import supervised_run
 
-    if REPO not in sys.path:
-        sys.path.insert(0, REPO)
+    # The supervised runner keeps whatever stdout the child printed BEFORE
+    # a deadline kill: bench.py's whole protocol is that an
+    # already-printed result line survives.
+    rc, out, err, wall = supervised_run(cmd, deadline_s, log_path=log_path,
+                                        cwd=REPO, name=f"stage-{name}")
+
+    from redqueen_tpu.runtime import atomic_write_json
     from redqueen_tpu.utils.backend import parse_last_json_line
 
     parsed = parse_last_json_line(out)
     if out_json and parsed is not None:
-        with open(out_json, "w") as f:
-            json.dump({"rc": rc, "wall_s": round(wall, 1), "result": parsed,
-                       "command": " ".join(cmd)}, f, indent=1)
-            f.write("\n")
+        # Atomic: a wedge/kill during a later stage can never tear an
+        # already-banked stage artifact.
+        atomic_write_json(out_json,
+                          {"rc": rc, "wall_s": round(wall, 1),
+                           "result": parsed, "command": " ".join(cmd)},
+                          indent=1)
     status = "OK" if (rc == 0 and parsed is not None) else f"FAILED rc={rc}"
     print(f"== stage {name}: {status} in {wall:.0f}s -> "
           f"{parsed if parsed else log_path}", flush=True)

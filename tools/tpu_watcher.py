@@ -36,8 +36,10 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _TOOLS = os.path.join(REPO, "tools")
-if _TOOLS not in sys.path:  # proc_util when loaded by path
+if _TOOLS not in sys.path:  # tpu_evidence when loaded by path
     sys.path.insert(0, _TOOLS)
+if REPO not in sys.path:  # redqueen_tpu.runtime when loaded by path
+    sys.path.insert(0, REPO)
 LOG_MD = os.path.join(REPO, "TPU_PROBE_LOG.md")
 SENTINEL = os.path.join(REPO, ".tpu_capture_in_progress")
 CAPTURE_LOG = os.path.join(REPO, "benchmarks", "tpu_capture_r04.log")
@@ -67,7 +69,7 @@ def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES,
     artifacts belong to — the watcher outlives round boundaries, so it
     must be able to capture under the new round's names instead of
     overwriting banked evidence."""
-    from proc_util import run_logged
+    from redqueen_tpu.runtime import supervised_run
 
     cmd = [sys.executable, os.path.join(REPO, "tools", "tpu_evidence.py")]
     for s in stages:
@@ -83,8 +85,12 @@ def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES,
     with open(SENTINEL, "w") as f:
         f.write(utcnow() + "\n")
     try:
-        rc, _, _, _ = run_logged(cmd, total_deadline_s, capture_log,
-                                 cwd=REPO)
+        # Supervised dispatch (rc=124 on a deadline kill, partial stdout
+        # preserved, durable command log) — the runtime layer's argv
+        # contract, one implementation for every capture-path subprocess.
+        rc, _, _, _ = supervised_run(cmd, total_deadline_s,
+                                     log_path=capture_log, cwd=REPO,
+                                     name="tpu-evidence-capture")
     finally:
         try:
             os.remove(SENTINEL)
@@ -123,9 +129,9 @@ def main() -> int:
                          "watcher outlives a round boundary")
     args = ap.parse_args()
 
-    if REPO not in sys.path:
-        sys.path.insert(0, REPO)
-    from redqueen_tpu.utils.backend import probe_default_backend
+    # The probe behind the runtime API (delegates to utils.backend at call
+    # time — one liveness policy, one place).
+    from redqueen_tpu.runtime import probe_backend
 
     # A SIGKILLed previous capture can leave the sentinel behind (finally
     # never ran); anything older than one capture deadline is stale.
@@ -139,7 +145,7 @@ def main() -> int:
         pass
 
     for attempt in range(1, args.max_probes + 1):
-        alive, n, plat = probe_default_backend(args.probe_deadline)
+        alive, n, plat = probe_backend(args.probe_deadline)
         if alive and plat == "tpu":
             append_log(f"| {utcnow()} | ALIVE — {n} x {plat} "
                        f"(probe {attempt}); launching staged capture |")
