@@ -31,13 +31,19 @@ from redqueen_tpu.native import loader  # noqa: E402
 
 
 def make_corpus(path: str, rows: int, users: int, seed: int = 0) -> None:
+    import itertools
+
+    from redqueen_tpu.runtime import atomic_write_lines
+
     rng = np.random.RandomState(seed)
     uid = rng.randint(0, users, rows)
     t = rng.uniform(0, 1e6, rows)
-    with open(path, "w") as f:
-        f.write("user,time\n")
-        for i in range(rows):
-            f.write(f"u{uid[i]},{t[i]:.6f}\n")
+    # streamed atomic commit (runtime.artifacts): rows go straight to the
+    # temp file (a 1M-row corpus never sits in RAM) and a killed
+    # generator cannot leave a torn corpus for the next run to ingest
+    atomic_write_lines(path, itertools.chain(
+        ["user,time\n"],
+        (f"u{uid[i]},{t[i]:.6f}\n" for i in range(rows))))
 
 
 def timed(fn, reps: int):
@@ -89,8 +95,9 @@ def main() -> int:
         "native_speedup": round(t_py / t_nat, 2),
         "outputs_identical": True,
     }
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=1)
+    from redqueen_tpu.runtime import atomic_write_json
+
+    atomic_write_json(args.out, result, indent=1)
     print(json.dumps(result))
     return 0
 

@@ -224,11 +224,15 @@ def main():
 
     if args.csv:
         import csv
+        import io
 
-        with open(args.csv, "w", newline="") as f:
-            w = csv.writer(f)
-            w.writerow(["policy", "top1_fraction", "avg_rank", "posts"])
-            w.writerows(rows)
+        from redqueen_tpu.runtime import atomic_write_text
+
+        buf = io.StringIO(newline="")
+        w = csv.writer(buf)
+        w.writerow(["policy", "top1_fraction", "avg_rank", "posts"])
+        w.writerows(rows)
+        atomic_write_text(args.csv, buf.getvalue())
         print(f"wrote {args.csv}")
 
     if args.fig:

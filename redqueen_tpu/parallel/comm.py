@@ -35,6 +35,7 @@ __all__ = [
     "pmax",
     "pany",
     "shard_leading",
+    "shard_map",
     "replicate",
     "axis_total",
 ]
@@ -55,11 +56,43 @@ def make_mesh(axes: dict, devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(mesh_devices, names)
 
 
+def shard_map(f, mesh, in_specs, out_specs, **kw):
+    """``jax.shard_map`` across JAX pins: top-level where it exists,
+    ``jax.experimental.shard_map`` otherwise (this pin), translating the
+    replication-check kwarg across its rename (new ``check_vma`` <-> old
+    ``check_rep``) so kernel code writes ONE spelling."""
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+    else:
+        if "check_rep" in kw:
+            kw["check_vma"] = kw.pop("check_rep")
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def _axis_size(axis_name: str) -> int:
+    """Size of ``axis_name`` where bound; raises ``NameError`` when the
+    axis is unbound here.  ``lax.axis_size`` only exists in newer JAX
+    (this pin raises ``AttributeError`` on the lookup), so fall back to
+    ``psum(1, axis)`` — constant-folded to the axis size at trace time and
+    raising the SAME unbound-axis ``NameError``, which keeps the
+    no-op-outside-collectives contract identical across pins."""
+    try:
+        fn = lax.axis_size
+    except AttributeError:
+        return lax.psum(1, axis_name)
+    return fn(axis_name)
+
+
 def _in_collective(axis_name: str) -> bool:
     """True iff ``axis_name`` is a bound collective axis here (inside
     shard_map/vmap with that axis); collectives outside are no-ops."""
     try:
-        lax.axis_size(axis_name)
+        _axis_size(axis_name)
         return True
     except NameError:
         return False
@@ -70,7 +103,10 @@ def axis_present(axis_name: str) -> bool:
 
 
 def axis_size_or_1(axis_name: str) -> int:
-    return lax.axis_size(axis_name) if _in_collective(axis_name) else 1
+    try:
+        return _axis_size(axis_name)
+    except NameError:
+        return 1
 
 
 def psum(x, axis_name: str = "data"):

@@ -140,7 +140,7 @@ def _get_fn(cfg: StarConfig, metric_K: int, mesh: Optional[Mesh], axis: str,
         )
         out_specs = (P(), P(), P(axis, None), feedP, metrics_spec, P(), P(),
                      P())
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(comm.shard_map(
             kernel, mesh=mesh, in_specs=(wall_spec, ctrl_spec, P()),
             out_specs=out_specs, check_vma=False,
         ))
@@ -420,8 +420,8 @@ def simulate_star_batch(cfg: StarConfig, wall: WallParams, ctrl: CtrlParams,
             vk = jax.vmap(_make_kernel(cfg, metric_K, compress, fire_mode))
             if mesh is not None and feed_axis is not None:
                 in_specs, out_specs = _batch_specs(wall, ctrl, axis, feed_axis)
-                vk = jax.shard_map(vk, mesh=mesh, in_specs=in_specs,
-                                   out_specs=out_specs, check_vma=False)
+                vk = comm.shard_map(vk, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs, check_vma=False)
             fn = jax.jit(vk)
             _BATCH_FN_CACHE[cache_key] = fn
         return fn

@@ -17,15 +17,28 @@ The one API behind which the stack's tunnel-hang defenses live (see
   registered writers, stop at the next durable boundary
   (``run_sweep_checkpointed`` resumes bit-identically).
 - :mod:`~redqueen_tpu.runtime.faultinject` — deterministic hang / crash /
-  transient / OOM faults so every path above runs in CI on CPU.
+  transient / OOM / corrupt faults so every path above runs in CI on CPU.
 - :mod:`~redqueen_tpu.runtime.artifacts` — atomic (temp + ``os.replace``)
   JSON/NPZ artifact writes; a killed run never leaves a torn file.
+- :mod:`~redqueen_tpu.runtime.integrity` — checksummed envelopes +
+  verify-on-read + quarantine: a killed or bit-rotted artifact is never
+  silently TRUSTED either (the other half of the artifacts guarantee).
+- :mod:`~redqueen_tpu.runtime.watchdog` — lease-locked self-healing
+  supervision (crash-loop backoff, probe-budget renewal, heartbeat
+  artifact) for the unattended capture chain.
 """
 
 from __future__ import annotations
 
-from . import artifacts, faultinject, preempt  # noqa: F401
-from .artifacts import atomic_savez, atomic_write_json, atomic_write_text
+from . import artifacts, faultinject, integrity, preempt, watchdog  # noqa: F401
+from .artifacts import (
+    atomic_savez,
+    atomic_write_json,
+    atomic_write_lines,
+    atomic_write_text,
+)
+from .integrity import CorruptArtifactError
+from .watchdog import Lease, LeaseHeldError, Watchdog
 from .preempt import (
     PreemptedError,
     check_preempt,
@@ -72,9 +85,18 @@ __all__ = [
     # atomic artifacts
     "atomic_write_json",
     "atomic_write_text",
+    "atomic_write_lines",
     "atomic_savez",
+    # integrity / quarantine
+    "CorruptArtifactError",
+    # self-healing supervision
+    "Watchdog",
+    "Lease",
+    "LeaseHeldError",
     # submodules
     "artifacts",
     "faultinject",
+    "integrity",
     "preempt",
+    "watchdog",
 ]

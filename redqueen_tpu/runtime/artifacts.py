@@ -17,7 +17,8 @@ import os
 import tempfile
 from typing import Any
 
-__all__ = ["atomic_write_json", "atomic_write_text", "atomic_savez"]
+__all__ = ["atomic_write_json", "atomic_write_text", "atomic_write_lines",
+           "atomic_savez"]
 
 
 def _atomic_commit(path: str, write_body) -> None:
@@ -39,6 +40,17 @@ def _atomic_commit(path: str, write_body) -> None:
 
 def atomic_write_text(path: str, text: str) -> None:
     _atomic_commit(path, lambda f: f.write(text.encode()))
+
+
+def atomic_write_lines(path: str, lines) -> None:
+    """Stream an iterable of text lines into the atomic commit — for
+    corpora too large to hold as one string (the temp file absorbs the
+    stream; the rename is still all-or-nothing)."""
+    def body(f):
+        for line in lines:
+            f.write(line.encode())
+
+    _atomic_commit(path, body)
 
 
 def atomic_write_json(path: str, obj: Any, indent=None,

@@ -19,6 +19,12 @@ Two ways in:
                             RQ_FAULT_STATE pointing at a writable counter
                             file so the count survives process restarts
       oom                   raise RuntimeError("RESOURCE_EXHAUSTED ...")
+      corrupt:mode@path     deterministically corrupt the artifact at
+                            ``path`` in place (mode: truncate | bitflip |
+                            badsum) and continue — the integrity layer's
+                            detection/quarantine/fallback paths
+                            (:mod:`runtime.integrity`) then run against a
+                            reproducible bad file
 
   ``RQ_FAULT_POINT`` (optional) restricts injection to the matching
   ``maybe_inject(point)`` call site.
@@ -48,6 +54,8 @@ __all__ = [
     "flaky",
     "raise_oom",
     "succeed",
+    "corrupt_file",
+    "CORRUPT_MODES",
     "ENV_FAULT",
     "ENV_FAULT_STATE",
     "ENV_FAULT_POINT",
@@ -80,9 +88,10 @@ class FaultSpec(NamedTuple):
 def parse_fault(spec: str) -> FaultSpec:
     kind, _, arg = spec.strip().partition(":")
     kind = kind.strip().lower()
-    if kind not in ("hang", "crash", "transient", "oom"):
+    if kind not in ("hang", "crash", "transient", "oom", "corrupt"):
         raise ValueError(f"unknown fault spec {spec!r} "
-                         f"(want hang|crash|transient|oom[:arg])")
+                         f"(want hang|crash|transient|oom[:arg] or "
+                         f"corrupt:mode@path)")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -125,6 +134,13 @@ def inject(spec: FaultSpec) -> None:
     elif spec.kind == "oom":
         raise RuntimeError(
             f"{OOM_MARKERS[0]}: injected out-of-memory (fault harness)")
+    elif spec.kind == "corrupt":
+        if not spec.arg or "@" not in spec.arg:
+            raise ValueError(
+                f"{ENV_FAULT}=corrupt needs 'mode@path' "
+                f"(mode: {'|'.join(CORRUPT_MODES)})")
+        mode, _, path = spec.arg.partition("@")
+        corrupt_file(path, mode.strip())
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -172,3 +188,84 @@ def flaky(state_file: str, n_failures: int = 1, value=42):
 def raise_oom() -> None:
     raise RuntimeError(f"{OOM_MARKERS[0]}: injected out-of-memory "
                        f"(fault harness)")
+
+
+# --- deterministic artifact corruption (the integrity layer's test rig) ---
+
+CORRUPT_MODES = ("truncate", "bitflip", "badsum")
+
+
+def _flip_bit(path: str) -> dict:
+    """XOR bit 0 of the middle byte — one deterministic position, so a
+    detection failure reproduces byte-for-byte."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if not data:
+        raise ValueError(f"cannot bit-flip empty file {path}")
+    pos = len(data) // 2
+    data[pos] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(data)
+    return {"offset": pos, "size": len(data)}
+
+
+def _rewrite_badsum(path: str) -> dict:
+    """Keep the artifact STRUCTURALLY valid but give it a checksum that
+    cannot match — exercising the digest-comparison path specifically
+    (truncate/bitflip mostly die earlier, at parse/unzip)."""
+    import json as _json
+
+    forged = "0" * 64
+    if path.endswith(".npz"):
+        import numpy as np
+
+        from . import integrity as _integ
+        from .artifacts import atomic_savez
+
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        raw = arrays.pop(_integ.ENVELOPE_KEY, None)
+        env = _json.loads(str(raw)) if raw is not None else {
+            _integ.ENVELOPE_KEY: _integ.ENVELOPE_VERSION}
+        env["sha256"] = forged
+        atomic_savez(path, **arrays,
+                     **{_integ.ENVELOPE_KEY: np.asarray(_json.dumps(env))})
+    else:
+        from .artifacts import atomic_write_json
+
+        with open(path) as f:
+            obj = _json.load(f)
+        if not isinstance(obj, dict):
+            raise ValueError(f"badsum needs an enveloped artifact, "
+                             f"{path} holds {type(obj).__name__}")
+        obj["sha256"] = forged
+        atomic_write_json(path, obj, indent=1)
+    return {"forged_sha256": forged}
+
+
+def corrupt_file(path: str, mode: str = "truncate") -> dict:
+    """Deterministically corrupt the artifact at ``path`` in place.
+
+    - ``truncate`` — cut the file to half its length (a torn write from a
+      non-atomic writer / interrupted copy);
+    - ``bitflip``  — XOR one bit at the middle byte (silent media/transfer
+      corruption; zip CRCs and the envelope sha both exist to catch it);
+    - ``badsum``   — keep the payload readable but forge the stored
+      envelope checksum (stale/forged metadata).
+
+    Returns a dict describing what was done, for test assertions.  No
+    randomness, no wall-clock dependence: the same call on the same bytes
+    yields the same corruption."""
+    if mode not in CORRUPT_MODES:
+        raise ValueError(f"unknown corrupt mode {mode!r} "
+                         f"(want {'|'.join(CORRUPT_MODES)})")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"cannot corrupt missing file {path}")
+    if mode == "truncate":
+        size = os.path.getsize(path)
+        keep = size // 2
+        os.truncate(path, keep)
+        return {"mode": mode, "path": path, "was": size, "now": keep}
+    if mode == "bitflip":
+        return {"mode": mode, "path": path, **_flip_bit(path)}
+    return {"mode": mode, "path": path, **_rewrite_badsum(path)}

@@ -31,7 +31,7 @@ from jax.sharding import Mesh
 from .config import stack_components
 from .parallel.bigf import simulate_star_batch, stack_star
 from .parallel.shard import simulate_sharded
-from .runtime import artifacts as _artifacts
+from .runtime import integrity as _integrity
 from .runtime import preempt as _preempt
 from .runtime.supervisor import heartbeat as _heartbeat
 from .sim import simulate_batch
@@ -164,6 +164,11 @@ def run_sweep_star(points: Sequence, n_seeds: int, metric_K: int = 1,
     return _reduce_to_grid(res.metrics, res.n_posts, P, n_seeds)
 
 
+# Envelope schema tag for chunk artifacts; bump on layout changes so a
+# resume after an upgrade recomputes instead of misreading.
+_CHUNK_SCHEMA = "rq.sweep.chunk/1"
+
+
 def _chunk_fingerprint(chunk_idx: int, pts, n_seeds: int, seed0_chunk: int,
                        star: bool, kwargs: dict) -> str:
     """Content hash of everything that determines a chunk's result: the
@@ -188,10 +193,14 @@ def run_sweep_checkpointed(points: Sequence, n_seeds: int, ckpt_dir: str,
     """Restartable sweep (SURVEY.md §5 checkpoint/resume at the SWEEP
     level): the point grid runs in chunks of ``chunk_points`` points, each
     chunk's [p, n_seeds] result grids landing in ``ckpt_dir`` as one
-    atomically-renamed ``.npz`` keyed by a fingerprint of the chunk's full
-    inputs. A killed sweep rerun with the same arguments recomputes ONLY
-    the missing chunks; a chunk whose inputs changed recomputes and
-    overwrites (never mixes stale numbers).
+    atomically-renamed, checksum-enveloped ``.npz`` (``runtime.integrity``)
+    keyed by a fingerprint of the chunk's full inputs. A killed sweep
+    rerun with the same arguments recomputes ONLY the missing chunks; a
+    chunk whose inputs changed recomputes and overwrites (never mixes
+    stale numbers); a chunk that fails verification on read — truncated,
+    bit-flipped, forged checksum — is quarantined
+    (``*.corrupt-<ts>`` + report) and re-runs, so the resumed grid stays
+    bit-identical to an uninterrupted run.
 
     Results are bit-identical to the corresponding single-dispatch
     ``run_sweep``/``run_sweep_star`` call: each chunk starting at point p0
@@ -226,20 +235,36 @@ def run_sweep_checkpointed(points: Sequence, n_seeds: int, ckpt_dir: str,
         chunk = None
         if os.path.exists(path):
             try:
-                with np.load(path, allow_pickle=False) as z:
+                z = _integrity.load_npz(path, schema=_CHUNK_SCHEMA)
+            except _integrity.CorruptArtifactError:
+                # Torn/bit-flipped/forged-checksum chunk (or a
+                # pre-envelope legacy file): load_npz has QUARANTINED it
+                # (renamed ``*.corrupt-<ts>`` + structured report) so no
+                # later resume trusts it either; this chunk simply
+                # re-runs below — the fingerprinted seed layout makes the
+                # recomputation bit-identical to what the lost file held.
+                pass
+            except Exception:
+                # unreadable for non-corruption reasons (permissions,
+                # races on a shared dir): recompute without judging
+                pass
+            else:
+                try:
                     if str(z["fingerprint"]) == fp:
                         chunk = SweepResult(
-                            *(z[f] for f in SweepResult._fields)
-                        )
-            except Exception:
-                # truncated/foreign file (e.g. an interrupted copy of the
-                # checkpoint dir): treat like a fingerprint mismatch and
-                # recompute — surviving exactly this is the point
-                chunk = None
+                            *(z[f] for f in SweepResult._fields))
+                except KeyError:
+                    # archive verified but an expected field is missing
+                    # (SweepResult layout drifted without a schema
+                    # bump): stale layout, not corruption — recompute
+                    # and overwrite, like a fingerprint mismatch
+                    chunk = None
+                # fingerprint mismatch = STALE inputs, not corruption:
+                # recompute and overwrite, exactly as before
         if chunk is None:
             chunk = runner(pts, n_seeds, seed0=seed0_chunk, **kwargs)
-            _artifacts.atomic_savez(
-                path, fingerprint=fp,
+            _integrity.savez(
+                path, schema=_CHUNK_SCHEMA, fingerprint=fp,
                 **{f2: getattr(chunk, f2) for f2 in SweepResult._fields})
         grids.append(chunk)
         # Chunk boundary = the durable safe point: everything appended so

@@ -13,6 +13,15 @@ typo'd path must raise/return-None, not leave an empty checkpoint tree
 that a later writer mistakes for a real one.  Writes register with
 ``runtime.preempt`` so a SIGTERM mid-save waits out the in-flight orbax
 write before the process exits.
+
+Corrupt-tolerant recovery: orbax's own write path is atomic-ish (tmp dir
+then rename), but nothing protects a LANDED step from truncation/bit rot,
+and a multi-hour sweep must resume from the newest step that actually
+restores — not die on the newest directory present.
+:func:`latest_valid_step` scans backward from the newest step, proving
+each candidate by restoring it; a step that fails is QUARANTINED
+(renamed ``<step>.corrupt-<ts>`` + structured report, via
+``runtime.integrity``) so no later reader trusts it either.
 """
 
 from __future__ import annotations
@@ -23,9 +32,10 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from ..runtime import integrity as _integrity
 from ..runtime import preempt as _preempt
 
-__all__ = ["save", "restore", "latest_step"]
+__all__ = ["save", "restore", "latest_step", "latest_valid_step"]
 
 # Managers with a potentially in-flight async save; the preemption flusher
 # waits these out so a SIGTERM never truncates an orbax step directory.
@@ -74,6 +84,68 @@ def latest_step(path: str) -> Optional[int]:
     return step
 
 
+def _step_dirs(path: str):
+    """Step numbers present on disk, newest first — by direct listing,
+    not via a manager: a corrupt step must be enumerable even when orbax
+    metadata reads would die on it.  Only pure-integer names count
+    (orbax tmp dirs and quarantined ``N.corrupt-*`` entries are not
+    steps)."""
+    steps = []
+    for name in os.listdir(path):
+        if name.isdigit() and os.path.isdir(os.path.join(path, name)):
+            steps.append(int(name))
+    return sorted(steps, reverse=True)
+
+
+def latest_valid_step(path: str, like: Any = None,
+                      quarantine: bool = True) -> Optional[int]:
+    """The newest step that actually RESTORES, scanning backward past
+    torn/corrupt ones.  Each failing candidate is quarantined (renamed
+    ``<path>/<step>.corrupt-<ts>`` with a structured report beside it —
+    set ``quarantine=False`` to only skip) so the bad bytes leave the
+    read path without being destroyed.  Returns None when no step
+    verifies (or the path is missing): the caller starts from scratch —
+    never from a checkpoint that cannot be proven whole.
+
+    Only DESERIALIZATION failures condemn a step: when ``like`` is given
+    and the targeted restore fails, a raw (target-less) restore
+    disambiguates — if the bytes deserialize, the mismatch is the
+    caller's ``like`` tree (drifted config), the step counts as valid
+    and is never quarantined.
+
+    Cost note: the proof IS a full restore, so ``restore(path, step)``
+    afterwards reads the winning step a second time — paid once per
+    process start, the price of never resuming from unproven bytes."""
+    if not os.path.isdir(path):
+        return None
+    for step in _step_dirs(path):
+        try:
+            # A full restore IS the verification: metadata, manifest and
+            # every array chunk must deserialize.  Fresh manager per
+            # candidate — a cached step listing would go stale the moment
+            # a newer sibling is quarantined.
+            restore(path, step=step, like=like)
+            return step
+        except Exception as e:  # noqa: BLE001 — classified below
+            if like is not None:
+                # Disambiguate before condemning the bytes: a RAW
+                # restore (no target tree) proves on-disk integrity.
+                # If it succeeds, the failure above was the CALLER's
+                # ``like`` (drifted model config, wrong dtypes) — the
+                # step is whole and must not be quarantined.
+                try:
+                    restore(path, step=step)
+                    return step
+                except Exception as e2:  # noqa: BLE001
+                    e = e2
+            step_dir = os.path.join(path, str(step))
+            if quarantine and os.path.isdir(step_dir):
+                _integrity.quarantine(
+                    step_dir, "checkpoint step failed to restore",
+                    f"step {step}: {type(e).__name__}: {e}")
+    return None
+
+
 def restore(path: str, step: Optional[int] = None, like: Any = None):
     """Restore the pytree saved at ``step`` (default: latest). ``like``
     optionally provides the target structure/dtypes (required to restore
@@ -87,7 +159,10 @@ def restore(path: str, step: Optional[int] = None, like: Any = None):
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {path}")
         if like is None:
-            out = mgr.restore(step)
+            # Explicit StandardRestore: a bare mgr.restore(step) only
+            # works in the process that SAVED (orbax registers the item
+            # handler at save time) — a resuming run is a fresh process.
+            out = mgr.restore(step, args=ocp.args.StandardRestore())
         else:
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
             out = mgr.restore(step, args=ocp.args.StandardRestore(abstract))

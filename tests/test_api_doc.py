@@ -21,6 +21,7 @@ MODULES = [
     "redqueen_tpu.native.loader",
     "redqueen_tpu.runtime", "redqueen_tpu.runtime.faultinject",
     "redqueen_tpu.runtime.preempt", "redqueen_tpu.runtime.artifacts",
+    "redqueen_tpu.runtime.integrity", "redqueen_tpu.runtime.watchdog",
 ]
 
 

@@ -795,8 +795,8 @@ class TestFireDoubling:
         with pytest.raises(ValueError, match="sharded feed axis"):
             ft = jnp_arr(np.sort(np.random.default_rng(0)
                                  .exponential(1.0, (F, 64)), axis=1))
-            jax.shard_map(shard_fires, mesh=mesh, in_specs=P("feed"),
-                          out_specs=P(), check_vma=False)(ft)
+            comm.shard_map(shard_fires, mesh=mesh, in_specs=P("feed"),
+                           out_specs=P(), check_vma=False)(ft)
 
     def test_fire_mode_plumbed_to_batch_api(self):
         """simulate_star_batch(fire_mode=...) must reach the kernel: both

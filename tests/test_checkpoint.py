@@ -1,5 +1,7 @@
 """Checkpoint/resume round-trips (SURVEY.md section 5)."""
 
+import os
+
 import numpy as np
 import pytest
 from jax import random as jr
@@ -46,3 +48,107 @@ def test_simstate_roundtrip_and_resume(tmp_path):
 def test_restore_missing_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         ckpt.restore(str(tmp_path / "nope"))
+
+
+def _save_steps(tmp_path, steps=(0, 1, 2)):
+    path = str(tmp_path / "ck")
+    for s in steps:
+        ckpt.save(path, s, {"a": [float(s), 2.0]})
+    return path
+
+
+def _corrupt_step(path, step, mode="truncate"):
+    """Tear a landed orbax step: corrupt one of its array-data files."""
+    import glob
+
+    from redqueen_tpu.runtime import faultinject
+
+    victims = sorted(glob.glob(
+        os.path.join(path, str(step), "default", "d", "*")))
+    assert victims, "expected orbax array files under the step dir"
+    faultinject.corrupt_file(victims[0], mode)
+
+
+def test_latest_valid_step_skips_torn_newest(tmp_path):
+    """A torn newest checkpoint must not end a multi-hour resume: the
+    scan falls back to the newest step that actually restores, and the
+    bad step is quarantined with a report."""
+    path = _save_steps(tmp_path)
+    _corrupt_step(path, 2, "truncate")
+    assert ckpt.latest_step(path) == 2  # the blind reader still sees it
+    assert ckpt.latest_valid_step(path) == 1
+    names = sorted(os.listdir(path))
+    assert any(n.startswith("2.corrupt-") and not n.endswith(".report.json")
+               for n in names)
+    assert any(n.startswith("2.corrupt-") and n.endswith(".report.json")
+               for n in names)
+    # the fallback step restores and the manager keeps working past the
+    # quarantined sibling
+    assert ckpt.restore(path, 1) == {"a": [1.0, 2.0]}
+    assert ckpt.latest_step(path) == 1
+
+
+def test_latest_valid_step_scans_past_multiple_corrupt(tmp_path):
+    path = _save_steps(tmp_path)
+    _corrupt_step(path, 2, "bitflip")
+    import shutil
+
+    shutil.rmtree(os.path.join(path, "1", "default"))  # torn mid-write
+    assert ckpt.latest_valid_step(path) == 0
+
+
+def test_latest_valid_step_all_invalid_returns_none(tmp_path):
+    import shutil
+
+    path = _save_steps(tmp_path, steps=(0,))
+    shutil.rmtree(os.path.join(path, "0", "default"))
+    assert ckpt.latest_valid_step(path) is None
+    assert ckpt.latest_valid_step(str(tmp_path / "missing")) is None
+    # every candidate was quarantined on the way down
+    assert any(".corrupt-" in n for n in os.listdir(path))
+
+
+def test_latest_valid_step_like_mismatch_does_not_quarantine(tmp_path):
+    """A drifted ``like`` tree (caller-side error) must not condemn
+    healthy checkpoints: the raw-restore disambiguation proves the bytes
+    are whole, the newest step is returned, nothing is renamed."""
+    path = _save_steps(tmp_path)
+    wrong_like = {"completely": [0.0], "different": [0.0, 0.0, 0.0]}
+    assert ckpt.latest_valid_step(path, like=wrong_like) == 2
+    assert sorted(os.listdir(path)) == ["0", "1", "2"], \
+        "healthy steps were quarantined on a caller-side like mismatch"
+
+
+def test_latest_valid_step_no_quarantine_opt_out(tmp_path):
+    path = _save_steps(tmp_path, steps=(0, 1))
+    _corrupt_step(path, 1, "truncate")
+    assert ckpt.latest_valid_step(path, quarantine=False) == 0
+    assert sorted(os.listdir(path)) == ["0", "1"], \
+        "opt-out must only skip, never move"
+
+
+def test_restore_works_cross_process_shape(tmp_path):
+    """restore(like=None) must use explicit StandardRestore args — a bare
+    mgr.restore only works in the process that saved (orbax registers
+    handlers at save time), and a resuming run is by definition a fresh
+    process."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "ck")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt.save(path, 3, {"a": [9.0, 8.0]})
+    prog = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from redqueen_tpu.utils import checkpoint as ckpt\n"
+        "out = ckpt.restore(%r)\n"
+        "assert out == {'a': [9.0, 8.0]}, out\n"
+        "assert ckpt.latest_valid_step(%r) == 3\n"
+        "print('CROSS-PROC-OK')\n"
+    ) % (repo, path, path)
+    r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                       text=True, timeout=240,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "CROSS-PROC-OK" in r.stdout

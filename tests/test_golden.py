@@ -14,6 +14,16 @@ event index, slot) — ops/scan_core._panel_pairs): a deliberate
 PRNG-discipline change, statistically validated by the closed-form and
 oracle-parity suites. Star-engine constants were unaffected.
 
+All constants regenerated 2026-08-03 on the jax 0.4.37 / jaxlib-CPU pin
+this repo now runs under: the previous constants came from a different
+JAX pin whose random-bit pipeline (threefry lowering / uniform-draw
+plumbing) produces different exact streams at the same seeds, so every
+exact-constant test failed on arrival while the law-level suites
+(closed-form Poisson counts, oracle parity, scan-vs-star parity, the
+invariants below) all passed — the streams are DIFFERENT, not WRONG.
+Cross-pin exact constants are a per-environment artifact exactly like
+the per-platform story below.
+
 Platform story (round-2 verdict item 6): the exact-constant tests below are
 CPU-only BY DESIGN and skip themselves elsewhere — on TPU, fastmath
 reassociation and fusion order can shift floats enough to pick different
@@ -67,14 +77,14 @@ def _star():
 def test_golden_scan_single():
     cfg, p0, a0, me = _component()
     log = simulate(cfg, p0, a0, seed=42)
-    assert int(log.n_events) == 109
+    assert int(log.n_events) == 105
     np.testing.assert_allclose(
         np.asarray(log.times)[:5],
-        [0.259291, 0.378744, 0.447331, 0.503016, 0.588099], atol=1e-4)
-    assert np.asarray(log.srcs)[:5].tolist() == [1, 2, 3, 0, 4]
+        [0.301312, 0.449768, 0.57404, 0.703473, 1.110127], atol=1e-4)
+    assert np.asarray(log.srcs)[:5].tolist() == [3, 4, 0, 4, 1]
     m = feed_metrics(log.times, log.srcs, a0, me, T)
     np.testing.assert_allclose(
-        float(m.mean_time_in_top_k()), 14.652967, atol=1e-4)
+        float(m.mean_time_in_top_k()), 14.555069, atol=1e-4)
 
 
 @cpu_exact
@@ -82,22 +92,22 @@ def test_golden_scan_batch():
     cfg, p0, a0, me = _component()
     params, adj = stack_components([p0] * 3, [a0] * 3)
     logb = simulate_batch(cfg, params, adj, np.array([7, 8, 9]))
-    assert np.asarray(logb.n_events).tolist() == [114, 95, 93]
+    assert np.asarray(logb.n_events).tolist() == [116, 102, 96]
     np.testing.assert_allclose(
         np.asarray(logb.times)[:, 0],
-        [0.228758, 0.207175, 0.07253], atol=1e-4)
+        [0.005257, 1.174572, 0.037488], atol=1e-4)
 
 
 @cpu_exact
 def test_golden_star_single():
     scfg, wall, ctrl = _star()
     res = simulate_star(scfg, wall, ctrl, seed=42)
-    assert res.n_posts == 26
+    assert res.n_posts == 31
     np.testing.assert_allclose(
-        res.own_times[:3], [1.268021, 2.689512, 3.328598], atol=1e-4)
+        res.own_times[:3], [0.199096, 0.444866, 1.50055], atol=1e-4)
     np.testing.assert_allclose(
         float(np.asarray(res.metrics.mean_time_in_top_k()).mean()),
-        14.374208, atol=1e-4)
+        14.72943, atol=1e-4)
 
 
 @cpu_exact
@@ -105,9 +115,9 @@ def test_golden_star_batch():
     scfg, wall, ctrl = _star()
     wb, cb = broadcast_star(wall, ctrl, 3)
     rb = simulate_star_batch(scfg, wb, cb, np.array([7, 8, 9]))
-    assert rb.n_posts.tolist() == [23, 24, 32]
+    assert rb.n_posts.tolist() == [29, 33, 23]
     np.testing.assert_allclose(
-        rb.own_times[:, 0], [0.726041, 0.337657, 0.670188], atol=1e-4)
+        rb.own_times[:, 0], [0.549246, 1.809014, 1.767526], atol=1e-4)
 
 
 class TestGoldenAnyPlatform:

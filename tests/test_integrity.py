@@ -1,0 +1,195 @@
+"""runtime.integrity: checksummed envelopes, verify-on-read, quarantine —
+every detection path driven by runtime.faultinject's deterministic
+``corrupt`` fault kind (truncation / bit-flip / forged checksum), all on
+CPU, no hardware.  The contract under test: a bad artifact is never
+silently trusted AND never a silent crash — it is quarantined
+(``*.corrupt-<ts>`` + structured report) and a typed error tells the
+caller to fall back."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from redqueen_tpu.runtime import faultinject, integrity
+from redqueen_tpu.runtime.integrity import CorruptArtifactError
+
+
+def _quarantine_artifacts(d):
+    names = sorted(os.listdir(d))
+    return ([n for n in names if ".corrupt-" in n and
+             not n.endswith(".report.json")],
+            [n for n in names if n.endswith(".report.json")])
+
+
+# --------------------------------------------------------------------------
+# JSON envelopes
+# --------------------------------------------------------------------------
+
+def test_json_roundtrip_and_schema(tmp_path):
+    p = str(tmp_path / "a.json")
+    payload = {"x": 1, "grid": [1.5, 2.5], "nested": {"ok": True}}
+    integrity.write_json(p, payload, schema="t/1")
+    assert integrity.read_json(p) == payload
+    assert integrity.read_json(p, schema="t/1") == payload
+    # the on-disk form is a valid envelope a human can inspect
+    with open(p) as f:
+        env = json.load(f)
+    assert env[integrity.ENVELOPE_KEY] == integrity.ENVELOPE_VERSION
+    assert env["schema"] == "t/1" and len(env["sha256"]) == 64
+    assert env["writer"]["pid"] == os.getpid()
+
+
+def test_json_schema_mismatch_quarantines(tmp_path):
+    p = str(tmp_path / "a.json")
+    integrity.write_json(p, {"x": 1}, schema="t/1")
+    with pytest.raises(CorruptArtifactError, match="schema mismatch"):
+        integrity.read_json(p, schema="t/2")
+    assert not os.path.exists(p)
+
+
+def test_json_missing_raises_filenotfound(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        integrity.read_json(str(tmp_path / "nope.json"))
+
+
+@pytest.mark.parametrize("mode,reason", [
+    ("truncate", "unreadable/unparseable JSON"),
+    ("badsum", "checksum mismatch"),
+])
+def test_json_corruption_detected_and_quarantined(tmp_path, mode, reason):
+    p = str(tmp_path / "a.json")
+    integrity.write_json(p, {"x": 1, "big": list(range(64))})
+    faultinject.corrupt_file(p, mode)
+    with pytest.raises(CorruptArtifactError, match=reason) as ei:
+        integrity.read_json(p)
+    err = ei.value
+    # the bad file left the read path but was not destroyed
+    assert not os.path.exists(p)
+    assert os.path.exists(err.quarantined_to)
+    # the report is itself a verifiable enveloped artifact
+    rep = integrity.read_json(err.report_path,
+                              schema="rq.quarantine-report/1")
+    assert rep["reason"] == reason
+    assert rep["quarantined_to"] == os.path.abspath(err.quarantined_to)
+
+
+def test_json_bitflip_detected(tmp_path):
+    # the flipped bit lands somewhere in the payload bytes: either the
+    # file stops parsing or the digest mismatches — both are detection
+    p = str(tmp_path / "a.json")
+    integrity.write_json(p, {"k": "v" * 200})
+    faultinject.corrupt_file(p, "bitflip")
+    with pytest.raises(CorruptArtifactError):
+        integrity.read_json(p)
+    assert not os.path.exists(p)
+
+
+def test_json_legacy_file_strict_vs_allow(tmp_path):
+    p = str(tmp_path / "legacy.json")
+    with open(p, "w") as f:
+        json.dump({"old": True}, f)
+    # opt-in legacy read returns it untouched
+    assert integrity.read_json(p, allow_unverified=True) == {"old": True}
+    assert os.path.exists(p)
+    # strict read treats an unverifiable file as corrupt
+    with pytest.raises(CorruptArtifactError, match="no integrity envelope"):
+        integrity.read_json(p)
+    assert not os.path.exists(p)
+
+
+def test_no_quarantine_opt_out_leaves_file(tmp_path):
+    p = str(tmp_path / "a.json")
+    integrity.write_json(p, {"x": 1})
+    faultinject.corrupt_file(p, "badsum")
+    with pytest.raises(CorruptArtifactError) as ei:
+        integrity.read_json(p, do_quarantine=False)
+    assert ei.value.quarantined_to is None
+    assert os.path.exists(p), "opt-out must not move the file"
+
+
+# --------------------------------------------------------------------------
+# NPZ envelopes
+# --------------------------------------------------------------------------
+
+def test_npz_roundtrip(tmp_path):
+    p = str(tmp_path / "g.npz")
+    integrity.savez(p, schema="grid/1", a=np.arange(12.0).reshape(3, 4),
+                    tag=np.asarray("abc"))
+    z = integrity.load_npz(p, schema="grid/1")
+    assert sorted(z) == ["a", "tag"]  # envelope entry never leaks out
+    np.testing.assert_array_equal(z["a"], np.arange(12.0).reshape(3, 4))
+    assert str(z["tag"]) == "abc"
+
+
+def test_npz_reserved_name_rejected(tmp_path):
+    with pytest.raises(ValueError, match="reserved"):
+        integrity.savez(str(tmp_path / "g.npz"),
+                        **{integrity.ENVELOPE_KEY: np.arange(3)})
+
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip", "badsum"])
+def test_npz_corruption_detected_and_quarantined(tmp_path, mode):
+    p = str(tmp_path / "g.npz")
+    integrity.savez(p, a=np.arange(1000.0))
+    faultinject.corrupt_file(p, mode)
+    with pytest.raises(CorruptArtifactError) as ei:
+        integrity.load_npz(p)
+    assert not os.path.exists(p)
+    assert os.path.exists(ei.value.quarantined_to)
+    qs, reports = _quarantine_artifacts(str(tmp_path))
+    assert len(qs) == 1 and len(reports) == 1
+
+
+def test_npz_without_envelope_is_corrupt(tmp_path):
+    p = str(tmp_path / "plain.npz")
+    np.savez(p, a=np.arange(3))
+    with pytest.raises(CorruptArtifactError, match="no integrity envelope"):
+        integrity.load_npz(p)
+
+
+# --------------------------------------------------------------------------
+# quarantine mechanics + the corrupt fault kind itself
+# --------------------------------------------------------------------------
+
+def test_quarantine_name_collisions_disambiguate(tmp_path):
+    clock = lambda: 1_700_000_000.0  # frozen: forces same-timestamp names
+    names = set()
+    for _ in range(3):
+        p = str(tmp_path / "a.json")
+        integrity.write_json(p, {"x": 1})
+        q, r = integrity.quarantine(p, "test", clock=clock)
+        assert os.path.exists(q) and os.path.exists(r)
+        names.add(q)
+    assert len(names) == 3, "collisions must get distinct suffixes"
+
+
+def test_corrupt_file_modes_are_deterministic(tmp_path):
+    a, b = str(tmp_path / "a.bin"), str(tmp_path / "b.bin")
+    for p in (a, b):
+        with open(p, "wb") as f:
+            f.write(bytes(range(256)))
+        faultinject.corrupt_file(p, "bitflip")
+    assert open(a, "rb").read() == open(b, "rb").read()
+    info = faultinject.corrupt_file(a, "truncate")
+    assert info["now"] == info["was"] // 2
+
+
+def test_corrupt_fault_env_protocol(tmp_path, monkeypatch):
+    p = str(tmp_path / "a.json")
+    integrity.write_json(p, {"x": 1})
+    monkeypatch.setenv(faultinject.ENV_FAULT, f"corrupt:badsum@{p}")
+    faultinject.maybe_inject("start")
+    with pytest.raises(CorruptArtifactError, match="checksum mismatch"):
+        integrity.read_json(p)
+
+
+def test_corrupt_fault_spec_validation():
+    assert faultinject.parse_fault("corrupt:bitflip@/tmp/x").kind == "corrupt"
+    with pytest.raises(ValueError, match="mode@path"):
+        faultinject.inject(faultinject.parse_fault("corrupt"))
+    with pytest.raises(ValueError, match="unknown corrupt mode"):
+        faultinject.corrupt_file(__file__, "nope")
+    with pytest.raises(FileNotFoundError):
+        faultinject.corrupt_file("/nonexistent/file", "truncate")

@@ -195,6 +195,17 @@ def test_two_process_run_matches_single_process(tmp_path):
         for p in procs:
             stdout, _ = p.communicate(timeout=420)
             outs.append(stdout)
+            if (p.returncode != 0 and
+                    "Multiprocess computations aren't implemented on the "
+                    "CPU backend" in stdout):
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                pytest.skip(
+                    "this jaxlib's CPU client cannot run cross-process "
+                    "computations (gloo collectives unimplemented) — the "
+                    "two-process path needs a capable jaxlib or real "
+                    "multi-host hardware")
             assert p.returncode == 0, (
                 f"worker rc={p.returncode}\n--- output ---\n{stdout[-4000:]}"
             )

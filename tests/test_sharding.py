@@ -83,7 +83,7 @@ def test_collectives_inside_shard_map():
         return comm.psum(xs.sum(), "data") * jnp.ones_like(xs)
 
     with mesh:
-        out = jax.shard_map(
+        out = comm.shard_map(
             f, mesh=mesh, in_specs=P("data"), out_specs=P("data")
         )(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))

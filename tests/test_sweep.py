@@ -204,6 +204,52 @@ def test_checkpointed_sweep_survives_corrupt_chunk(tmp_path):
     np.testing.assert_array_equal(got.time_in_top_k, want.time_in_top_k)
 
 
+@pytest.mark.parametrize("mode", ["truncate", "bitflip", "badsum"])
+def test_checkpointed_sweep_quarantines_corrupt_chunk_and_reruns(
+        tmp_path, monkeypatch, mode):
+    """The full acceptance loop per corruption kind: a chunk artifact
+    corrupted after landing (torn write / bit flip / forged checksum) is
+    DETECTED on resume, quarantined with a structured report, ONLY that
+    chunk re-runs, and the resumed grid is bit-identical to the
+    uninterrupted sweep."""
+    import redqueen_tpu.sweep as sweep_mod
+    from redqueen_tpu.runtime import faultinject, integrity
+    from redqueen_tpu.sweep import run_sweep, run_sweep_checkpointed
+
+    pts = q_points([0.5, 1.0, 2.0])
+    want = run_sweep(pts, n_seeds=2)
+    d = str(tmp_path / "ck")
+    run_sweep_checkpointed(pts, 2, d, chunk_points=1)
+
+    victim = os.path.join(d, "chunk_00001.npz")
+    faultinject.corrupt_file(victim, mode)
+
+    calls = []
+    real_run = sweep_mod.run_sweep
+
+    def counting_run(p, n, **kw):
+        calls.append(len(p))
+        return real_run(p, n, **kw)
+
+    monkeypatch.setattr(sweep_mod, "run_sweep", counting_run)
+    got = run_sweep_checkpointed(pts, 2, d, chunk_points=1)
+    for f in want._fields:
+        np.testing.assert_array_equal(getattr(got, f), getattr(want, f))
+    assert calls == [1], "exactly the corrupt chunk re-runs"
+    # the bad bytes were quarantined, not overwritten or deleted
+    names = sorted(os.listdir(d))
+    q = [n for n in names if n.startswith("chunk_00001.npz.corrupt-")
+         and not n.endswith(".report.json")]
+    reports = [n for n in names if n.startswith("chunk_00001")
+               and n.endswith(".report.json")]
+    assert len(q) == 1 and len(reports) == 1
+    rep = integrity.read_json(os.path.join(d, reports[0]),
+                              schema="rq.quarantine-report/1")
+    assert rep["quarantined_to"].endswith(q[0])
+    # the rewritten chunk verifies again
+    integrity.load_npz(victim, schema="rq.sweep.chunk/1")
+
+
 def test_checkpointed_sweep_rejects_empty_points(tmp_path):
     from redqueen_tpu.sweep import run_sweep_checkpointed
 

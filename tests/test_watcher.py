@@ -15,6 +15,9 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+from redqueen_tpu.runtime.watchdog import EXIT_BUDGET_EXHAUSTED
+
+
 @pytest.fixture()
 def watcher(tmp_path, monkeypatch):
     spec = importlib.util.spec_from_file_location(
@@ -25,12 +28,15 @@ def watcher(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "LOG_MD", str(tmp_path / "probe_log.md"))
     monkeypatch.setattr(mod, "SENTINEL", str(tmp_path / "sentinel"))
     monkeypatch.setattr(mod, "CAPTURE_LOG", str(tmp_path / "capture.log"))
+    monkeypatch.setattr(mod, "LEASE", str(tmp_path / "watcher.lease"))
+    monkeypatch.setattr(mod, "HEARTBEAT", str(tmp_path / "heartbeat.json"))
     monkeypatch.setattr(mod.time, "sleep", lambda s: None)
     return mod
 
 
 def _run(watcher, monkeypatch, probes, capture_rcs, argv_extra=()):
-    """Drive main() with scripted probe results and capture rcs."""
+    """Drive one probe-budget round (main --child) with scripted probe
+    results and capture rcs — the probe-loop invariants are child-side."""
     probes = iter(probes)
     rcs = iter(capture_rcs)
     calls = {"probes": 0, "captures": 0}
@@ -50,7 +56,7 @@ def _run(watcher, monkeypatch, probes, capture_rcs, argv_extra=()):
     monkeypatch.setattr(backend, "probe_default_backend", fake_probe)
     monkeypatch.setattr(watcher, "capture_evidence", fake_capture)
     monkeypatch.setattr(sys, "argv",
-                        ["tpu_watcher.py", "--max-probes", "4",
+                        ["tpu_watcher.py", "--child", "--max-probes", "4",
                          "--interval", "0.001"] + list(argv_extra))
     rc = watcher.main()
     return rc, calls
@@ -69,6 +75,22 @@ def test_failed_capture_resumes_probing(watcher, monkeypatch):
     assert calls["probes"] == 3
 
 
+def test_failed_capture_waits_out_the_interval(watcher, monkeypatch):
+    """A FAST-failing capture must not burn the probe budget in a tight
+    loop: the capture-failure path sleeps the inter-probe interval like
+    every other failed attempt (1-core box; renewals would amplify the
+    hammering)."""
+    sleeps = []
+    monkeypatch.setattr(watcher.time, "sleep", lambda s: sleeps.append(s))
+    rc, calls = _run(
+        watcher, monkeypatch,
+        probes=[(True, 1, "tpu")] * 4, capture_rcs=[1, 1, 1, 1])
+    assert rc == EXIT_BUDGET_EXHAUSTED and calls["captures"] == 4
+    # 4 attempts -> 3 inter-attempt waits (none after the last)
+    assert len(sleeps) == 3
+    assert all(s == pytest.approx(0.001 * 60.0) for s in sleeps)
+
+
 def test_successful_capture_exits_zero(watcher, monkeypatch):
     rc, calls = _run(watcher, monkeypatch,
                      probes=[(False, 0, ""), (True, 1, "tpu")],
@@ -76,10 +98,14 @@ def test_successful_capture_exits_zero(watcher, monkeypatch):
     assert rc == 0 and calls["captures"] == 1
 
 
-def test_all_probes_down_exits_one(watcher, monkeypatch):
+def test_all_probes_down_reports_budget_exhausted(watcher, monkeypatch):
+    """An expired probe budget is the WATCHDOG's renewal verdict (exit
+    71), never a silent 1 — renewal instead of death is the whole point
+    of the supervised chain."""
     rc, calls = _run(watcher, monkeypatch,
                      probes=[(False, 0, "")] * 4, capture_rcs=[])
-    assert rc == 1 and calls["captures"] == 0 and calls["probes"] == 4
+    assert rc == EXIT_BUDGET_EXHAUSTED
+    assert calls["captures"] == 0 and calls["probes"] == 4
 
 
 def test_stale_sentinel_removed_fresh_one_kept(watcher, monkeypatch,
@@ -93,7 +119,7 @@ def test_stale_sentinel_removed_fresh_one_kept(watcher, monkeypatch,
     os.utime(sent, (old, old))
     rc, _ = _run(watcher, monkeypatch, probes=[(False, 0, "")] * 4,
                  capture_rcs=[], argv_extra=["--capture-deadline", "5400"])
-    assert rc == 1
+    assert rc == EXIT_BUDGET_EXHAUSTED
     assert not sent.exists(), "stale sentinel must be cleaned up"
 
     sent.write_text("fresh\n")
@@ -188,3 +214,74 @@ def test_tag_flag_flows_to_evidence_cmd_and_log(watcher, monkeypatch):
     rc, calls = _run(watcher, monkeypatch, probes=[(True, 1, "tpu")],
                      capture_rcs=[0], argv_extra=["--tag", "r05"])
     assert rc == 0 and calls["tag"] == "r05"
+
+
+# --- the supervised (watchdog) side of main() ----------------------------
+
+def _supervise(watcher, monkeypatch, child_rcs, argv_extra=()):
+    """Drive main() WITHOUT --child: the watchdog path, with the child
+    subprocess replaced by scripted exit codes."""
+    rcs = iter(child_rcs)
+    calls = {"spawns": 0, "cmds": []}
+
+    def fake_call(cmd, cwd=None):
+        calls["spawns"] += 1
+        calls["cmds"].append(list(cmd))
+        return next(rcs)
+
+    monkeypatch.setattr(watcher.subprocess, "call", fake_call)
+    monkeypatch.setattr(sys, "argv",
+                        ["tpu_watcher.py", "--max-probes", "4",
+                         "--interval", "0.001"] + list(argv_extra))
+    rc = watcher.main()
+    return rc, calls
+
+
+def test_supervised_renews_expired_budget(watcher, monkeypatch):
+    """Child reports budget expiry twice; the watchdog grants fresh
+    budgets and the third round's capture succeeds — the chain survives
+    what used to be a silent exit-1 death."""
+    rc, calls = _supervise(
+        watcher, monkeypatch,
+        child_rcs=[EXIT_BUDGET_EXHAUSTED, EXIT_BUDGET_EXHAUSTED, 0])
+    assert rc == 0
+    assert calls["spawns"] == 3
+    assert all("--child" in c for c in calls["cmds"])
+    from redqueen_tpu.runtime import integrity
+
+    hb = integrity.read_json(watcher.HEARTBEAT)
+    assert hb["renewals"] == 2
+    assert hb["state"] == "done"
+
+
+def test_supervised_restarts_crashed_child_then_gives_one(watcher,
+                                                          monkeypatch):
+    """A crashing child restarts under backoff; renewals exhausted ->
+    plain exit 1 (the 'never outlives the round' contract)."""
+    rc, calls = _supervise(
+        watcher, monkeypatch, child_rcs=[3, EXIT_BUDGET_EXHAUSTED],
+        argv_extra=["--max-renewals", "0"])
+    assert rc == 1
+    assert calls["spawns"] == 2
+    from redqueen_tpu.runtime import integrity
+
+    hb = integrity.read_json(watcher.HEARTBEAT)
+    assert hb["restarts"] == 1
+    assert hb["state"] == "budget-exhausted"
+
+
+def test_supervised_refuses_second_instance(watcher, monkeypatch,
+                                            tmp_path):
+    """The lease is the single-instance lock: with a FRESH lease held by
+    a live pid, a second watcher exits 2 without probing (two watchers
+    would distort on-chip timings on this 1-core box)."""
+    import json as _json
+    import time as _time
+
+    (tmp_path / "watcher.lease").write_text(_json.dumps({
+        "pid": os.getpid(), "host": __import__("platform").node(),
+        "acquired_at": _time.time(), "expires_at": _time.time() + 600,
+    }))
+    rc, calls = _supervise(watcher, monkeypatch, child_rcs=[0])
+    assert rc == 2
+    assert calls["spawns"] == 0

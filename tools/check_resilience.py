@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Static resilience pass: no entry point may touch the default backend
-unguarded.
+unguarded, and no entry point may write an artifact raw.
 
 A wedged axon TPU tunnel HANGS ``jax.devices()`` / backend init forever
 rather than raising (the round-1 rc=124 failure), so every entry point
@@ -19,6 +19,17 @@ The check is AST-based (docstrings/comments don't count) and file-level:
   (``jax.config.update("jax_platforms", "cpu")``).
 - the runtime layer itself (``redqueen_tpu/``) is exempt: it IS the
   guard implementation.
+
+Second pass (the integrity PR): every ARTIFACT an entry point writes
+must go through ``redqueen_tpu.runtime`` — the atomic writers
+(``atomic_write_json`` / ``atomic_write_text`` / ``atomic_savez``) or
+the enveloped ones (``integrity.write_json`` / ``integrity.savez``) —
+because a raw ``json.dump(obj, f)`` or ``open(path, "w")`` torn by a
+kill-9 is exactly the corruption the integrity layer exists to keep out
+of the read path.  Any ``json.dump`` call and any ``open`` with a
+constant write mode ("w"/"wb"/"x"...; appends are fine — logs are
+append-only by design) is a violation, per call site, no whitelist:
+migrate the write, don't excuse it.
 
 Exits nonzero listing every violation; run via ``tools/ci.sh``.
 """
@@ -74,15 +85,41 @@ def _is_cpu_pin(call: ast.Call) -> bool:
     return "jax_platforms" in consts and "cpu" in consts
 
 
+def _raw_write(call: ast.Call) -> str:
+    """Nonempty description when ``call`` is a raw artifact write: a
+    ``json.dump`` (the 2-arg into-a-file form — ``dumps`` to stdout is
+    the child JSON-line protocol, not a file) or an ``open`` whose
+    constant mode creates/overwrites ("w"/"wb"/"x"...).  Appends ("a")
+    stay legal: probe logs are append-only by design."""
+    chain = _attr_chain(call.func)
+    if chain == ("json", "dump"):
+        return 'json.dump(...) — use runtime.atomic_write_json / ' \
+               'runtime.integrity.write_json'
+    if chain == ("open",) or chain == ("io", "open"):
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kwarg in call.keywords:
+            if kwarg.arg == "mode":
+                mode = kwarg.value
+        if (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+                and any(c in mode.value for c in "wx")):
+            return (f'open(..., "{mode.value}") — use the runtime '
+                    f'artifact writers (atomic temp + rename)')
+    return ""
+
+
 def analyze(path: str):
-    """Returns (touches, guarded) — backend-touch sites and whether the
-    file references a sanctioned guard or pins CPU."""
+    """Returns (touches, guarded, raw_writes) — backend-touch sites,
+    whether the file references a sanctioned guard or pins CPU, and every
+    raw artifact-write call site."""
     with open(path) as f:
         try:
             tree = ast.parse(f.read(), filename=path)
         except SyntaxError as e:
-            return [(0, f"SYNTAX ERROR: {e}")], False
+            return [(0, f"SYNTAX ERROR: {e}")], False, []
     touches: List[Tuple[int, str]] = []
+    raw_writes: List[Tuple[int, str]] = []
     guarded = False
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):
@@ -91,6 +128,9 @@ def analyze(path: str):
                 touches.append((node.lineno, BACKEND_TOUCHES[chain]))
             if _is_cpu_pin(node):
                 guarded = True
+            what = _raw_write(node)
+            if what:
+                raw_writes.append((node.lineno, what))
         if isinstance(node, ast.Name) and node.id in GUARD_NAMES:
             guarded = True
         if isinstance(node, ast.Attribute) and node.attr in GUARD_NAMES:
@@ -98,7 +138,7 @@ def analyze(path: str):
         if (isinstance(node, ast.alias)
                 and node.name.split(".")[-1] in GUARD_NAMES):
             guarded = True
-    return touches, guarded
+    return touches, guarded, raw_writes
 
 
 def main() -> int:
@@ -110,20 +150,25 @@ def main() -> int:
             if rel == os.path.join("tools", "check_resilience.py"):
                 continue  # mentions of the names above are its own data
             scanned += 1
-            touches, guarded = analyze(path)
+            touches, guarded, raw_writes = analyze(path)
             if touches and not guarded:
                 for line, what in touches:
                     violations.append(f"{rel}:{line}: {what} without a "
                                       f"deadline-bounded backend guard")
+            for line, what in raw_writes:
+                violations.append(f"{rel}:{line}: raw artifact write — "
+                                  f"{what}")
     if violations:
-        print("resilience check FAILED — unguarded default-backend "
-              "touches:\n  " + "\n  ".join(violations))
+        print("resilience check FAILED:\n  " + "\n  ".join(violations))
         print("\nroute backend access through redqueen_tpu.runtime "
               "(ensure_backend/probe_backend/backend_alive) or pin CPU "
-              "via jax.config.update('jax_platforms', 'cpu') first.")
+              "via jax.config.update('jax_platforms', 'cpu') first; "
+              "route artifact writes through runtime.artifacts / "
+              "runtime.integrity (atomic rename + checksummed envelope) "
+              "so a kill-9 can never tear what the next run reads.")
         return 1
     print(f"resilience check OK: {scanned} entry-point files scanned, "
-          f"0 unguarded backend touches")
+          f"0 unguarded backend touches, 0 raw artifact writes")
     return 0
 
 

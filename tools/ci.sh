@@ -1,11 +1,21 @@
 #!/usr/bin/env bash
-# One-command CI gate: the resilience static pass, then the tier-1 suite
-# (the exact ROADMAP verify command).  Usage: bash tools/ci.sh
+# One-command CI gate: the resilience static pass, the integrity/watchdog
+# fault-injection pass (every corruption-detection / quarantine /
+# fallback / self-healing path, deterministically on CPU), then the
+# tier-1 suite (the exact ROADMAP verify command).  Usage: bash tools/ci.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== resilience static pass =="
 python tools/check_resilience.py
+
+echo "== integrity / self-healing fault-injection pass =="
+# Deliberately ALSO collected by tier-1 below (~40s double cost): this
+# pass fast-fails the corruption/self-healing contracts before the long
+# suite, while tier-1 stays byte-exact with the ROADMAP verify command.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
+    tests/test_watchdog.py tests/test_watcher.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log
