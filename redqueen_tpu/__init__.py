@@ -30,8 +30,20 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from .config import GraphBuilder, SimConfig, SourceParams, stack_components
-from .sim import EventLog, resume, simulate, simulate_batch
+from .config import (
+    ConfigValidationError,
+    GraphBuilder,
+    SimConfig,
+    SourceParams,
+    stack_components,
+)
+from .sim import (
+    EventLog,
+    NumericalHealthError,
+    resume,
+    simulate,
+    simulate_batch,
+)
 from .presets import PRESETS, build_preset, run_preset
 from .sweep import SweepResult, run_sweep, run_sweep_star
 
@@ -64,5 +76,7 @@ __all__ = [
     "SweepResult",
     "run_sweep",
     "run_sweep_star",
+    "ConfigValidationError",
+    "NumericalHealthError",
     "utils",
 ]

@@ -18,6 +18,7 @@ three, per SURVEY.md section 5 "Config/flag system":
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import List, Optional, Sequence
 
 import jax
@@ -36,28 +37,74 @@ from .models.base import (
 
 __all__ = [
     "SimConfig", "SourceParams", "GraphBuilder", "stack_components",
-    "check_piecewise",
+    "check_piecewise", "ConfigValidationError",
 ]
 
 
-def check_piecewise(change_times, rates):
+class ConfigValidationError(ValueError):
+    """A component spec failed host-side domain validation (the validated
+    boundary of the in-computation numerics guard, runtime.numerics):
+    NaN/negative rates, out-of-domain Hawkes parameters, non-monotone
+    replay times, a non-positive capacity.  ``component`` names the
+    offending source index inside its builder (None for builder-level
+    arguments), so sweep-generation code can point at the exact spec line
+    that produced the garbage instead of debugging a quarantined lane."""
+
+    def __init__(self, message: str, component: Optional[int] = None):
+        self.component = component
+        where = "" if component is None else f"source {component}: "
+        super().__init__(f"{where}{message}")
+
+
+def _require_finite(name: str, value, component: Optional[int] = None,
+                    minimum: Optional[float] = None,
+                    strict: bool = False) -> float:
+    """One scalar domain check with a typed, component-addressed error."""
+    v = float(value)
+    if not np.isfinite(v):
+        raise ConfigValidationError(
+            f"{name} must be finite, got {v!r}", component)
+    if minimum is not None:
+        ok = v > minimum if strict else v >= minimum
+        if not ok:
+            op = ">" if strict else ">="
+            raise ConfigValidationError(
+                f"{name} must be {op} {minimum:g}, got {v!r}", component)
+    return v
+
+
+def check_piecewise(change_times, rates, component: Optional[int] = None):
     """Validate a piecewise-constant rate spec and return ``(ct, rates)`` as
     float64 arrays (explicit raises, not asserts — asserts vanish under
     ``python -O``). Shared by GraphBuilder / StarBuilder / the oracle
-    factories."""
+    factories.  Knots must be finite and strictly increasing, rates finite
+    and non-negative — the domain the exact hazard inversion
+    (``ops.sampling.piecewise_next_time``) is defined on."""
     ct = np.asarray(change_times, np.float64)
     r = np.asarray(rates, np.float64)
     if ct.shape != r.shape:
-        raise ValueError(
+        raise ConfigValidationError(
             f"change_times and rates must have equal shapes, got "
-            f"{ct.shape} vs {r.shape}"
+            f"{ct.shape} vs {r.shape}", component
         )
     if ct.ndim != 1 or ct.size == 0:
-        raise ValueError(
-            f"change_times must be a non-empty 1-D array, got shape {ct.shape}"
+        raise ConfigValidationError(
+            f"change_times must be a non-empty 1-D array, got shape "
+            f"{ct.shape}", component
         )
+    if not np.isfinite(ct).all():
+        raise ConfigValidationError(
+            f"change_times must be finite, got {ct[~np.isfinite(ct)][0]!r} "
+            f"at index {int(np.flatnonzero(~np.isfinite(ct))[0])}", component)
     if not np.all(np.diff(ct) > 0):
-        raise ValueError("change_times must be strictly increasing")
+        raise ConfigValidationError(
+            "change_times must be strictly increasing", component)
+    bad = ~(np.isfinite(r) & (r >= 0))
+    if bad.any():
+        i = int(np.flatnonzero(bad)[0])
+        raise ConfigValidationError(
+            f"rates must be finite and >= 0, got {r[i]!r} at index {i}",
+            component)
     return ct, r
 
 
@@ -125,6 +172,12 @@ class SimState(struct.PyTreeNode):
     # SURVEY.md section 2 item 9): the scan absorbs once n_events reaches it.
     # None = unbounded (run to the horizon).
     budget: Optional[jnp.ndarray] = None  # i32[]
+    # Per-lane numeric-health bitmask (runtime.numerics BIT_*): 0 =
+    # healthy; a non-zero mask freezes the lane (valid is gated on it in
+    # ops.scan_core.step) so in-computation NaN/Inf can never poison
+    # sibling lanes or the event log. init_state always materializes it;
+    # None only for hand-built legacy states (checks then compile out).
+    health: Optional[jnp.ndarray] = None  # u32[]
 
     # Note: per-(source, sink) feed ranks are deliberately NOT carried. The
     # Opt policy samples via superposition clocks (models/opt.py) and the
@@ -145,8 +198,12 @@ class GraphBuilder:
     def __init__(self, n_sinks: int, end_time: float, start_time: float = 0.0,
                  s_sink: Optional[Sequence[float]] = None):
         self.n_sinks = int(n_sinks)
-        self.end_time = float(end_time)
-        self.start_time = float(start_time)
+        self.end_time = _require_finite("end_time", end_time)
+        self.start_time = _require_finite("start_time", start_time)
+        if not self.end_time > self.start_time:
+            raise ConfigValidationError(
+                f"end_time must be > start_time, got "
+                f"[{self.start_time!r}, {self.end_time!r}]")
         self.s_sink = (
             np.ones(n_sinks) if s_sink is None else np.asarray(s_sink, np.float64)
         )
@@ -155,6 +212,12 @@ class GraphBuilder:
                 f"s_sink must have shape ({self.n_sinks},), got "
                 f"{self.s_sink.shape}"
             )
+        bad = ~(np.isfinite(self.s_sink) & (self.s_sink >= 0))
+        if bad.any():
+            i = int(np.flatnonzero(bad)[0])
+            raise ConfigValidationError(
+                f"s_sink must be finite and >= 0, got {self.s_sink[i]!r} at "
+                f"sink {i}")
         self._rows: List[dict] = []
 
     # ---- source constructors (reference: SimOpts other_sources specs) ----
@@ -169,22 +232,63 @@ class GraphBuilder:
         return idx
 
     def add_poisson(self, rate: float, sinks=None) -> int:
-        return self._add(KIND_POISSON, sinks, rate=float(rate))
+        idx = len(self._rows)
+        rate = _require_finite("Poisson rate", rate, idx, minimum=0.0)
+        return self._add(KIND_POISSON, sinks, rate=rate)
 
     def add_hawkes(self, l0: float, alpha: float, beta: float, sinks=None) -> int:
-        return self._add(KIND_HAWKES, sinks, l0=float(l0), alpha=float(alpha),
-                         beta=float(beta))
+        idx = len(self._rows)
+        l0 = _require_finite("Hawkes l0 (base rate)", l0, idx, minimum=0.0)
+        alpha = _require_finite("Hawkes alpha (jump size)", alpha, idx,
+                                minimum=0.0)
+        beta = _require_finite("Hawkes beta (decay)", beta, idx,
+                               minimum=0.0, strict=True)
+        if alpha >= beta:
+            # Branching ratio alpha/beta >= 1: supercritical — every own
+            # event spawns >= 1 expected child, so the event count grows
+            # without bound.  Legal over a finite horizon (the chunk loop
+            # and proposal cap bound it), but almost always a spec typo in
+            # a sweep — warn with the component index, don't reject.
+            warnings.warn(
+                f"source {idx}: Hawkes branching ratio alpha/beta = "
+                f"{alpha / beta:.3g} >= 1 (supercritical): the process is "
+                f"non-stationary and its event count explodes with the "
+                f"horizon; expect capacity overflows if this is not "
+                f"deliberate", stacklevel=2)
+        return self._add(KIND_HAWKES, sinks, l0=l0, alpha=alpha, beta=beta)
 
     def add_piecewise(self, change_times: Sequence[float],
                       rates: Sequence[float], sinks=None) -> int:
-        return self._add(KIND_PIECEWISE, sinks, pw=check_piecewise(change_times, rates))
+        idx = len(self._rows)
+        return self._add(
+            KIND_PIECEWISE, sinks,
+            pw=check_piecewise(change_times, rates, component=idx))
 
     def add_realdata(self, times: Sequence[float], sinks=None) -> int:
-        return self._add(KIND_REALDATA, sinks, rd=np.sort(np.asarray(times, np.float64)))
+        idx = len(self._rows)
+        rd = np.asarray(times, np.float64)
+        if rd.ndim != 1 or rd.size == 0:
+            raise ConfigValidationError(
+                f"replay times must be a non-empty 1-D array, got shape "
+                f"{rd.shape}", idx)
+        if not np.isfinite(rd).all():
+            i = int(np.flatnonzero(~np.isfinite(rd))[0])
+            raise ConfigValidationError(
+                f"replay times must be finite, got {rd[i]!r} at index {i} "
+                f"(+inf is reserved for the kernel's padding sentinel)", idx)
+        if not np.all(np.diff(rd) >= 0):
+            i = int(np.flatnonzero(np.diff(rd) < 0)[0])
+            raise ConfigValidationError(
+                f"replay times must be non-decreasing, but times[{i + 1}] = "
+                f"{rd[i + 1]!r} < times[{i}] = {rd[i]!r} — sort the trace "
+                f"(was it concatenated from shards?) before adding it", idx)
+        return self._add(KIND_REALDATA, sinks, rd=rd)
 
     def add_opt(self, q: float = 1.0, sinks=None) -> int:
-        if not q > 0:
-            raise ValueError(f"Opt requires q > 0, got q={q}")
+        idx = len(self._rows)
+        if not (np.isfinite(q) and q > 0):
+            raise ConfigValidationError(
+                f"Opt requires finite q > 0, got q={q!r}", idx)
         return self._add(KIND_OPT, sinks, q=float(q))
 
     def add_rmtpp(self, sinks=None) -> int:
@@ -206,6 +310,12 @@ class GraphBuilder:
         S, F = len(self._rows), self.n_sinks
         if S == 0:
             raise ValueError("no sources added")
+        if not int(capacity) >= 1:
+            raise ConfigValidationError(
+                f"capacity must be >= 1 scan step per chunk, got {capacity!r}")
+        if rmtpp_hidden is not None and not int(rmtpp_hidden) >= 1:
+            raise ConfigValidationError(
+                f"rmtpp_hidden must be >= 1, got {rmtpp_hidden!r}")
         Kp = max([len(r["pw"][0]) for r in self._rows if r["pw"] is not None],
                  default=1)
         Kr = max([len(r["rd"]) for r in self._rows if r["rd"] is not None],

@@ -74,6 +74,13 @@ class SourceUpdate(NamedTuple):
     exc_t: jnp.ndarray   # time the excitation was last folded to
     rd_ptr: jnp.ndarray  # RealData replay cursor
     h: jnp.ndarray       # RMTPP recurrent state slice ([H]; zeros elsewhere)
+    # Sampler health: False flags an internal sampler failure (thinning
+    # proposal cap exhausted, non-finite intensity bound) for the kernel's
+    # lane-health mask (runtime.numerics.BIT_SAMPLER_FAILURE).  Policies
+    # whose samplers cannot fail leave the default; the kernel normalizes
+    # the Python-bool default to a traced scalar so every lax.switch
+    # branch stays structurally identical.
+    ok: jnp.ndarray = True
 
 
 class PolicyDef(NamedTuple):

@@ -5,7 +5,9 @@ Intensity lambda(t) = l0 + alpha * sum over own past events of
 exp(-beta (t - t_j)), tracked incrementally as a single (excitation, time)
 pair — the feed history never materializes. Next event via Ogata thinning
 (``ops.sampling.hawkes_next_time``), a ``lax.while_loop`` whose bound
-tightens on every rejection.
+tightens on every rejection — proposal-capped, with sampler failures
+reported through ``SourceUpdate.ok`` into the kernel's lane-health mask
+(runtime.numerics).
 """
 
 from __future__ import annotations
@@ -13,29 +15,32 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..ops.sampling import hawkes_next_time
+from ..runtime.numerics import safe_exp
 from .base import KIND_HAWKES, PolicyDef, SourceUpdate, register_policy
 
 
 def on_init(params, state, s, t0, key):
-    t_next = hawkes_next_time(
+    t_next, ok = hawkes_next_time(
         key, t0, params.l0[s], params.alpha[s], params.beta[s],
-        jnp.zeros_like(params.l0[s]), t0, jnp.inf,
+        jnp.zeros_like(params.l0[s]), t0, jnp.inf, return_ok=True,
     )
     return SourceUpdate(
         t_next=t_next, exc=jnp.zeros_like(state.exc[s]), exc_t=t0,
-        rd_ptr=state.rd_ptr[s], h=state.h[s],
+        rd_ptr=state.rd_ptr[s], h=state.h[s], ok=ok,
     )
 
 
 def on_fire(params, state, s, t, key, u):
     # Fold the decayed excitation to the fire time and add this event's jump.
-    decay = jnp.exp(-params.beta[s] * (t - state.exc_t[s]))
+    decay = safe_exp(-params.beta[s] * (t - state.exc_t[s]))
     exc = state.exc[s] * decay + params.alpha[s]
-    t_next = hawkes_next_time(
-        key, t, params.l0[s], params.alpha[s], params.beta[s], exc, t, jnp.inf
+    t_next, ok = hawkes_next_time(
+        key, t, params.l0[s], params.alpha[s], params.beta[s], exc, t,
+        jnp.inf, return_ok=True,
     )
     return SourceUpdate(
-        t_next=t_next, exc=exc, exc_t=t, rd_ptr=state.rd_ptr[s], h=state.h[s]
+        t_next=t_next, exc=exc, exc_t=t, rd_ptr=state.rd_ptr[s],
+        h=state.h[s], ok=ok,
     )
 
 

@@ -7,6 +7,17 @@ build time, see SURVEY.md section 0): exponential inter-arrival draws
 as a ``lax.while_loop`` (SURVEY.md section 3.3), and exact inverse-CDF
 sampling for piecewise-constant rates. All take explicit PRNG keys and
 dtype-follow their float inputs.
+
+Numerics discipline (the in-computation guard, ``runtime.numerics``): every
+exp/log/division below goes through ``safe_exp``/``safe_log``/``safe_div``
+(enforced statically by ``tools/check_resilience.py``'s third AST pass),
+and the two ``log1p`` sites whose argument domain is NOT structural — a
+model-produced ``z`` that can approach -1, unlike the panel/threefry
+uniforms that are < 1 by construction — route through ``safe_log1p``.  All
+guards are bit-identical to the raw ops on healthy inputs,
+finite-and-detectable on poisoned ones, and the thinning loop is
+proposal-capped, so no degenerate parameter can spin a device or launder a
+NaN into an event log.
 """
 
 from __future__ import annotations
@@ -14,6 +25,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 from jax import random as jr
+
+from ..runtime.numerics import (
+    DEFAULT_MAX_PROPOSALS,
+    safe_div,
+    safe_exp,
+    safe_log1p,
+)
 
 __all__ = [
     "exponential_delta",
@@ -33,7 +51,8 @@ def exponential_delta(key, rate, dtype=None):
     if dtype is None:
         dtype = jnp.result_type(rate, jnp.float32)
     e = jr.exponential(key, dtype=dtype)
-    return jnp.where(rate > 0, e / jnp.asarray(rate, dtype), jnp.inf)
+    rate = jnp.asarray(rate, dtype)
+    return jnp.where(rate > 0, safe_div(e, rate), jnp.inf)
 
 
 def exponential_from_uniform(u, rate, dtype=None):
@@ -44,19 +63,23 @@ def exponential_from_uniform(u, rate, dtype=None):
     inf when rate <= 0."""
     if dtype is None:
         dtype = jnp.result_type(u, jnp.float32)
-    e = -jnp.log1p(-jnp.asarray(u, dtype))
-    return jnp.where(rate > 0, e / jnp.asarray(rate, dtype), jnp.inf)
+    e = -safe_log1p(-jnp.asarray(u, dtype))
+    rate = jnp.asarray(rate, dtype)
+    return jnp.where(rate > 0, safe_div(e, rate), jnp.inf)
 
 
 def hawkes_intensity(t, l0, exc, exc_t, beta):
     """lambda(t) = l0 + exc * exp(-beta (t - exc_t)) for t >= exc_t, where
     ``exc`` is the excitation sum alpha * sum_j exp(-beta (exc_t - t_j))
-    tracked incrementally at time ``exc_t``."""
-    return l0 + exc * jnp.exp(-beta * (t - exc_t))
+    tracked incrementally at time ``exc_t``.  ``safe_exp`` keeps a
+    degenerate (negative-beta / time-reversed) exponent from overflowing
+    to +inf — the intensity stays finite and the health layer can see it."""
+    return l0 + exc * safe_exp(-beta * (t - exc_t))
 
 
 def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max,
-                     bound_scale=1.0):
+                     bound_scale=1.0, max_proposals=DEFAULT_MAX_PROPOSALS,
+                     return_ok=False):
     """Next event time of an exponential-kernel Hawkes process after
     ``t_from``, via Ogata thinning (reference: ``Hawkes.get_next_event_time``;
     SURVEY.md section 3.3).
@@ -76,7 +99,18 @@ def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max,
     (IEEE identity), leaving existing streams bit-identical; tests pin the
     invariance statistically at scale 3.
 
+    ``max_proposals`` is defense-in-depth against degenerate parameters
+    (a NaN/overflowed bound whose accept test can never pass) spinning the
+    device: after that many proposals the loop exits and the function
+    returns +inf.  Valid parameters accept within a handful of proposals,
+    so the huge default is unreachable — and the counter changes no draw,
+    so healthy streams stay bit-identical.
+
     Returns the accepted absolute time, or +inf if none before ``t_max``.
+    With ``return_ok=True`` returns ``(time, ok)`` where ``ok=False``
+    flags a sampler failure — the proposal cap was exhausted or the
+    initial intensity bound was NaN — for the caller to feed the
+    lane-health protocol (``SourceUpdate.ok`` -> ``BIT_SAMPLER_FAILURE``).
     """
     if isinstance(bound_scale, (int, float)) and bound_scale < 1.0:
         # A deflated bound silently biases acceptance early (probability
@@ -85,27 +119,40 @@ def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max,
             f"bound_scale must be >= 1 (got {bound_scale}): a bound below "
             f"the true intensity biases the thinning accept test"
         )
+    if not max_proposals >= 1:  # `not >=` also rejects NaN
+        raise ValueError(f"max_proposals must be >= 1, got {max_proposals}")
     dtype = jnp.result_type(t_from, l0, jnp.float32)
     t_from = jnp.asarray(t_from, dtype)
     scale = jnp.asarray(bound_scale, dtype)
     lbd0 = hawkes_intensity(t_from, l0, exc, exc_t, beta) * scale
 
     def cond(c):
-        _, t, accepted, lbd_bar = c
-        return (~accepted) & (t <= t_max) & (lbd_bar > 0)
+        n, _, t, accepted, lbd_bar = c
+        return ((~accepted) & (t <= t_max) & (lbd_bar > 0)
+                & (n < max_proposals))
 
     def body(c):
-        key, t, _, lbd_bar = c
+        n, key, t, _, lbd_bar = c
         key, k_w, k_u = jr.split(key, 3)
-        t_new = t + jr.exponential(k_w, dtype=dtype) / lbd_bar
+        t_new = t + safe_div(jr.exponential(k_w, dtype=dtype), lbd_bar)
         lbd_new = hawkes_intensity(t_new, l0, exc, exc_t, beta)
         accept = jr.uniform(k_u, dtype=dtype) * lbd_bar <= lbd_new
-        return (key, t_new, accept, lbd_new * scale)
+        return (n + 1, key, t_new, accept, lbd_new * scale)
 
-    _, t_out, accepted, _ = lax.while_loop(
-        cond, body, (key, t_from, jnp.asarray(False), lbd0)
+    n_out, _, t_out, accepted, lbd_out = lax.while_loop(
+        cond, body,
+        (jnp.zeros((), jnp.int32), key, t_from, jnp.asarray(False), lbd0),
     )
-    return jnp.where(accepted & (t_out <= t_max), t_out, jnp.inf)
+    t_ret = jnp.where(accepted & (t_out <= t_max), t_out, jnp.inf)
+    if not return_ok:
+        return t_ret
+    # Cap-exhaustion: the loop stopped while its other conditions still
+    # held.  A NaN bound exits immediately (every comparison is False)
+    # without tripping that test, so flag it explicitly too.
+    cap_hit = ((~accepted) & (t_out <= t_max) & (lbd_out > 0)
+               & (n_out >= max_proposals))
+    ok = ~(cap_hit | jnp.isnan(lbd0))
+    return t_ret, ok
 
 
 def piecewise_next_time(key, t_from, change_times, rates):
@@ -137,7 +184,8 @@ def piecewise_next_time(key, t_from, change_times, rates):
     prev = jnp.where(k_safe > 0, cum[jnp.maximum(k_safe - 1, 0)], 0.0)
     remaining = target - prev
     rate_k = rates[k_safe]
-    t_hit = lo[k_safe] + jnp.where(rate_k > 0, remaining / rate_k, jnp.inf)
+    t_hit = lo[k_safe] + jnp.where(rate_k > 0, safe_div(remaining, rate_k),
+                                   jnp.inf)
     return jnp.where(k < rates.shape[0], t_hit, jnp.inf).astype(dtype)
 
 
@@ -153,8 +201,8 @@ def rmtpp_cum_hazard(a, w, tau):
     with the w -> 0 limit exp(a) * tau handled stably."""
     small = jnp.abs(w) < 1e-6
     w_safe = jnp.where(small, 1.0, w)
-    return jnp.exp(a) * jnp.where(
-        small, tau, jnp.expm1(w * tau) / w_safe
+    return safe_exp(a) * jnp.where(
+        small, tau, safe_div(jnp.expm1(w * tau), w_safe)
     )
 
 
@@ -169,10 +217,10 @@ def rmtpp_next_delta(key, a, w, dtype=None):
     e = jr.exponential(key, dtype=dtype)
     small = jnp.abs(w) < 1e-6
     w_safe = jnp.where(small, 1.0, w)
-    z = w * e * jnp.exp(-a)
+    z = w * e * safe_exp(-a)
     tau = jnp.where(
         small,
-        e * jnp.exp(-a),               # w ~ 0: constant intensity exp(a)
-        jnp.where(z > -1.0, jnp.log1p(z) / w_safe, jnp.inf),
+        e * safe_exp(-a),              # w ~ 0: constant intensity exp(a)
+        jnp.where(z > -1.0, safe_div(safe_log1p(z), w_safe), jnp.inf),
     )
     return tau.astype(dtype)

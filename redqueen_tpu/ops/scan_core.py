@@ -26,8 +26,22 @@ from jax import random as jr
 
 from ..config import SimConfig, SimState, SourceParams
 from ..models.base import get_registry
+from ..runtime import numerics
 
 __all__ = ["init_state", "make_run_chunk"]
+
+
+def _normalize_ok(branch):
+    """Branch wrapper: coerce ``SourceUpdate.ok`` (Python-bool default for
+    policies whose samplers cannot fail, traced bool for e.g. Hawkes
+    thinning) to one traced scalar so every ``lax.switch`` branch returns
+    an identical pytree structure."""
+
+    def wrapped(*args):
+        upd = branch(*args)
+        return upd._replace(ok=jnp.asarray(upd.ok, bool))
+
+    return wrapped
 
 
 def _kinds_for(cfg: SimConfig):
@@ -41,12 +55,12 @@ def _kinds_for(cfg: SimConfig):
 
 def _fire_branches(cfg):
     reg = get_registry()
-    return [reg[k].on_fire for k in _kinds_for(cfg)]
+    return [_normalize_ok(reg[k].on_fire) for k in _kinds_for(cfg)]
 
 
 def _init_branches(cfg):
     reg = get_registry()
-    return [reg[k].on_init for k in _kinds_for(cfg)]
+    return [_normalize_ok(reg[k].on_init) for k in _kinds_for(cfg)]
 
 
 def _react_hooks(cfg):
@@ -91,6 +105,7 @@ def init_state(cfg: SimConfig, params: SourceParams, adj, key,
         keys=keys,
         ctr=jnp.zeros((S,), jnp.uint32),
         n_events=jnp.zeros((), jnp.int32),
+        health=jnp.zeros((), jnp.uint32),
     )
     branches = _init_branches(cfg)
     kind_local = _local_kind(cfg, params.kind)
@@ -100,9 +115,18 @@ def init_state(cfg: SimConfig, params: SourceParams, adj, key,
         return lax.switch(kl, branches, params, state0, s, t0, k)
 
     upd = jax.vmap(one, in_axes=(0, 0, 0))(jnp.arange(S), kind_local, init_keys)
+    # First draws are already health-checked: a NaN first time (poisoned
+    # params that slipped host validation) or a failed sampler marks the
+    # lane sick from step 0, and the NaN is sanitized to +inf so it can
+    # never reach the argmin.  Healthy components take the identity path.
+    bits = jnp.where(jnp.isnan(upd.t_next).any(),
+                     jnp.uint32(numerics.BIT_NONFINITE_TIME), jnp.uint32(0))
+    bits |= jnp.where((~upd.ok).any(),
+                      jnp.uint32(numerics.BIT_SAMPLER_FAILURE), jnp.uint32(0))
     return state0.replace(
-        t_next=upd.t_next, exc=upd.exc, exc_t=upd.exc_t, rd_ptr=upd.rd_ptr,
-        h=upd.h, ctr=jnp.ones((S,), jnp.uint32),
+        t_next=numerics.nan_to_posinf(upd.t_next), exc=upd.exc,
+        exc_t=upd.exc_t, rd_ptr=upd.rd_ptr,
+        h=upd.h, ctr=jnp.ones((S,), jnp.uint32), health=bits,
     )
 
 
@@ -157,7 +181,22 @@ def make_run_chunk(cfg: SimConfig):
         def step(state: SimState, _):
             s_star = jnp.argmin(state.t_next)
             t_ev = state.t_next[s_star]
-            valid = t_ev <= end_time
+            # Lane health (runtime.numerics): a sick lane FREEZES — valid
+            # is gated on health so it emits nothing and its carry stops
+            # moving, exactly like an absorbed lane, and the sickness can
+            # never leak to sibling lanes through the argmin or the
+            # driver's early-exit logic.  jnp.argmin treats NaN as
+            # minimal, so a poisoned t_next selects itself here and the
+            # NaN event time is caught below on the very step it appears.
+            health = (state.health if state.health is not None
+                      else jnp.zeros((), jnp.uint32))
+            healthy = health == 0
+            t_ev_bad = jnp.isnan(t_ev)
+            # A finite event time that moves BACKWARDS is the same class
+            # of corruption as a NaN (a -inf or scrambled carry value);
+            # strict < keeps legitimate simultaneous events valid.
+            regressed = t_ev < state.t
+            valid = (t_ev <= end_time) & healthy & ~regressed
             if state.budget is not None:
                 # run_dynamic semantics: absorb once the event budget is
                 # spent (exactly the oracle's per-event stop, not chunk
@@ -209,7 +248,29 @@ def make_run_chunk(cfg: SimConfig):
                 params, state, s_star, t_ev, key_fire, us[0],
             )
 
-            t_next = state.t_next.at[s_star].set(upd.t_next)
+            # Write-back checks: the kernel never stores a NaN time (a
+            # poisoned resample becomes an absorbing +inf, with the
+            # substitution recorded in the health mask) and every
+            # non-finite state slice is flagged the step it is produced.
+            # All checks are identities on healthy values, so healthy
+            # streams and goldens are bit-identical.
+            u32 = jnp.uint32
+            bits = jnp.where(healthy & (t_ev_bad | regressed),
+                             u32(numerics.BIT_NONFINITE_TIME), u32(0))
+            bits |= jnp.where(valid & jnp.isnan(upd.t_next),
+                              u32(numerics.BIT_NONFINITE_TIME), u32(0))
+            bits |= jnp.where(valid & ~upd.ok,
+                              u32(numerics.BIT_SAMPLER_FAILURE), u32(0))
+            if has_hawkes:
+                bits |= jnp.where(valid & ~jnp.isfinite(upd.exc),
+                                  u32(numerics.BIT_NONFINITE_STATE), u32(0))
+            if has_rmtpp:
+                bits |= jnp.where(
+                    valid & ~jnp.all(jnp.isfinite(upd.h)),
+                    u32(numerics.BIT_NONFINITE_STATE), u32(0))
+            health = health | bits
+            t_next = state.t_next.at[s_star].set(
+                numerics.nan_to_posinf(upd.t_next))
             # ctr is the per-source (key, ctr) STREAM position — read only
             # by fire branches with fire_uses_key (Hawkes thinning, RMTPP).
             # When no compiled branch reads it (the headline Poisson+Opt
@@ -237,6 +298,13 @@ def make_run_chunk(cfg: SimConfig):
                 t_next=sel(t_next, state.t_next),
                 n_events=state.n_events + valid.astype(state.n_events.dtype),
             )
+            if state.health is not None:
+                # Written UNGATED: sickness is detected on the very step
+                # it appears (which is always an invalid step for the
+                # NaN-time case).  For healthy lanes bits == 0, so this
+                # is a value-identical no-op — absorbed chunks stay true
+                # no-ops on the carry.
+                fields["health"] = health
             if needs_fire_key:
                 fields["ctr"] = sel(ctr, state.ctr)
             if has_hawkes:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax import random as jr
 
+from ..runtime.numerics import safe_div, safe_exp
 from .sampling import hawkes_next_time, piecewise_next_time, rmtpp_next_delta
 
 __all__ = [
@@ -63,8 +64,8 @@ def poisson_stream(key, rate, t0, T, cap: int) -> Stream:
     dtype = jnp.result_type(rate, jnp.float32)
     gaps = jr.exponential(key, (cap + 1,), dtype)
     rate = jnp.asarray(rate, dtype)
-    safe = jnp.where(rate > 0, rate, 1.0)
-    times_all = t0 + jnp.where(rate > 0, jnp.cumsum(gaps) / safe, jnp.inf)
+    times_all = t0 + jnp.where(rate > 0, safe_div(jnp.cumsum(gaps), rate),
+                               jnp.inf)
     times, n = _finish(times_all[:cap], t0, T, dtype)
     truncated = (rate > 0) & (times_all[cap] <= T)
     return Stream(times, n, truncated)
@@ -82,7 +83,7 @@ def hawkes_stream(key, l0, alpha, beta, t0, T, cap: int) -> Stream:
         t_new = hawkes_next_time(k, t, l0, alpha, beta, exc, exc_t, T)
         fired = jnp.isfinite(t_new)
         exc = jnp.where(
-            fired, exc * jnp.exp(-beta * (jnp.where(fired, t_new, t) - exc_t))
+            fired, exc * safe_exp(-beta * (jnp.where(fired, t_new, t) - exc_t))
             + alpha, exc
         )
         exc_t = jnp.where(fired, t_new, exc_t)

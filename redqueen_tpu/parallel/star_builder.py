@@ -11,7 +11,7 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import check_piecewise
+from ..config import ConfigValidationError, _require_finite, check_piecewise
 from ..models.base import (
     KIND_HAWKES,
     KIND_OPT,
@@ -33,8 +33,12 @@ class StarBuilder:
     def __init__(self, n_feeds: int, end_time: float, start_time: float = 0.0,
                  s_sink: Optional[Sequence[float]] = None):
         self.n_feeds = int(n_feeds)
-        self.end_time = float(end_time)
-        self.start_time = float(start_time)
+        self.end_time = _require_finite("end_time", end_time)
+        self.start_time = _require_finite("start_time", start_time)
+        if not self.end_time > self.start_time:
+            raise ConfigValidationError(
+                f"end_time must be > start_time, got "
+                f"[{self.start_time!r}, {self.end_time!r}]")
         self.s_sink = (
             np.ones(n_feeds) if s_sink is None
             else np.asarray(s_sink, np.float64)
@@ -44,43 +48,68 @@ class StarBuilder:
                 f"s_sink must have shape ({self.n_feeds},), got "
                 f"{self.s_sink.shape}"
             )
+        bad = ~(np.isfinite(self.s_sink) & (self.s_sink >= 0))
+        if bad.any():
+            i = int(np.flatnonzero(bad)[0])
+            raise ConfigValidationError(
+                f"s_sink must be finite and >= 0, got {self.s_sink[i]!r} at "
+                f"feed {i}")
         self._walls = [[] for _ in range(self.n_feeds)]
         self._ctrl = None
 
     # ---- wall sources (one feed each) ----
+    # Same validated boundary as config.GraphBuilder (runtime.numerics):
+    # garbage is rejected here with the feed index, not detected device-
+    # side as a quarantined lane.
 
     def wall_poisson(self, feed: int, rate: float):
-        self._walls[feed].append(dict(kind=KIND_POISSON, rate=float(rate)))
+        rate = _require_finite("Poisson rate", rate, feed, minimum=0.0)
+        self._walls[feed].append(dict(kind=KIND_POISSON, rate=rate))
         return self
 
     def wall_hawkes(self, feed: int, l0: float, alpha: float, beta: float):
+        l0 = _require_finite("Hawkes l0 (base rate)", l0, feed, minimum=0.0)
+        alpha = _require_finite("Hawkes alpha (jump size)", alpha, feed,
+                                minimum=0.0)
+        beta = _require_finite("Hawkes beta (decay)", beta, feed,
+                               minimum=0.0, strict=True)
         self._walls[feed].append(
-            dict(kind=KIND_HAWKES, l0=float(l0), alpha=float(alpha),
-                 beta=float(beta))
+            dict(kind=KIND_HAWKES, l0=l0, alpha=alpha, beta=beta)
         )
         return self
 
     def wall_piecewise(self, feed: int, change_times, rates):
         self._walls[feed].append(
-            dict(kind=KIND_PIECEWISE, pw=check_piecewise(change_times, rates))
+            dict(kind=KIND_PIECEWISE,
+                 pw=check_piecewise(change_times, rates, component=feed))
         )
         return self
 
     def wall_replay(self, feed: int, times):
-        t = np.sort(np.asarray(times, np.float64))
-        self._walls[feed].append(dict(kind=KIND_REALDATA, rd=t))
+        t = np.asarray(times, np.float64)
+        if t.size and not np.isfinite(t).all():
+            i = int(np.flatnonzero(~np.isfinite(t))[0])
+            raise ConfigValidationError(
+                f"replay times must be finite, got {t[i]!r} at index {i}",
+                feed)
+        # the corpus path feeds bulk per-user slices here — sorting is a
+        # service at this seam (GraphBuilder.add_realdata, the per-source
+        # front end, rejects non-monotone input instead)
+        self._walls[feed].append(dict(kind=KIND_REALDATA, rd=np.sort(t)))
         return self
 
     # ---- controlled broadcaster (reference: the manager factories) ----
 
     def ctrl_opt(self, q: float = 1.0):
-        if not q > 0:
-            raise ValueError(f"Opt requires q > 0, got q={q}")
+        if not (np.isfinite(q) and q > 0):
+            raise ConfigValidationError(
+                f"Opt requires finite q > 0, got q={q!r}")
         self._ctrl = dict(kind=KIND_OPT, q=float(q))
         return self
 
     def ctrl_poisson(self, rate: float):
-        self._ctrl = dict(kind=KIND_POISSON, rate=float(rate))
+        rate = _require_finite("Poisson rate", rate, minimum=0.0)
+        self._ctrl = dict(kind=KIND_POISSON, rate=rate)
         return self
 
     def ctrl_hawkes(self, l0: float, alpha: float, beta: float):
@@ -88,15 +117,12 @@ class StarBuilder:
         vs-Hawkes comparison at big F) — legal because Hawkes depends only on
         its own history. Stationary iff alpha < beta (expected posts
         ~ l0*T/(1 - alpha/beta))."""
-        if not (l0 >= 0 and alpha >= 0 and beta > 0):
-            raise ValueError(
-                f"Hawkes requires l0 >= 0, alpha >= 0, beta > 0; got "
-                f"l0={l0}, alpha={alpha}, beta={beta}"
-            )
-        self._ctrl = dict(
-            kind=KIND_HAWKES, l0=float(l0), alpha=float(alpha),
-            beta=float(beta),
-        )
+        l0 = _require_finite("Hawkes l0 (base rate)", l0, minimum=0.0)
+        alpha = _require_finite("Hawkes alpha (jump size)", alpha,
+                                minimum=0.0)
+        beta = _require_finite("Hawkes beta (decay)", beta, minimum=0.0,
+                               strict=True)
+        self._ctrl = dict(kind=KIND_HAWKES, l0=l0, alpha=alpha, beta=beta)
         return self
 
     def ctrl_piecewise(self, change_times, rates):
@@ -106,9 +132,12 @@ class StarBuilder:
         return self
 
     def ctrl_replay(self, times):
-        self._ctrl = dict(
-            kind=KIND_REALDATA, rd=np.sort(np.asarray(times, np.float64))
-        )
+        t = np.asarray(times, np.float64)
+        if t.size and not np.isfinite(t).all():
+            i = int(np.flatnonzero(~np.isfinite(t))[0])
+            raise ConfigValidationError(
+                f"replay times must be finite, got {t[i]!r} at index {i}")
+        self._ctrl = dict(kind=KIND_REALDATA, rd=np.sort(t))
         return self
 
     def ctrl_rmtpp(self, weights, hidden: int = 16):

@@ -26,6 +26,13 @@ The one API behind which the stack's tunnel-hang defenses live (see
 - :mod:`~redqueen_tpu.runtime.watchdog` — lease-locked self-healing
   supervision (crash-loop backoff, probe-budget renewal, heartbeat
   artifact) for the unattended capture chain.
+- :mod:`~redqueen_tpu.runtime.numerics` — the in-computation guard:
+  ``safe_exp``/``safe_log``/``safe_div`` primitives, the per-lane
+  health-bit protocol (``BIT_*``, :class:`NumericalHealthError`), and
+  deterministic lane poisoning for the ``numeric`` fault kind.  Loaded
+  LAZILY (PEP 562): it imports jax, and everything else in this package
+  must stay importable before jax — the watchdog/capture chain runs in
+  processes that deliberately never touch a backend.
 """
 
 from __future__ import annotations
@@ -61,6 +68,28 @@ from .supervisor import (
     supervised_run,
 )
 
+# Names served lazily from runtime.numerics (PEP 562): the module imports
+# jax, and this package must stay importable before jax for the
+# watchdog/capture processes.  `from redqueen_tpu.runtime import numerics`
+# resolves through the import system (not this hook) and works unchanged.
+_NUMERICS_NAMES = (
+    "NumericalHealthError",
+    "safe_exp",
+    "safe_log",
+    "safe_log1p",
+    "safe_div",
+)
+
+
+def __getattr__(name):
+    if name == "numerics" or name in _NUMERICS_NAMES:
+        from . import numerics
+
+        return numerics if name == "numerics" else getattr(numerics, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     # supervised dispatch
     "Supervisor",
@@ -89,6 +118,12 @@ __all__ = [
     "atomic_savez",
     # integrity / quarantine
     "CorruptArtifactError",
+    # in-computation numerics guard (lazy: see __getattr__)
+    "NumericalHealthError",
+    "safe_exp",
+    "safe_log",
+    "safe_log1p",
+    "safe_div",
     # self-healing supervision
     "Watchdog",
     "Lease",
@@ -97,6 +132,7 @@ __all__ = [
     "artifacts",
     "faultinject",
     "integrity",
+    "numerics",
     "preempt",
     "watchdog",
 ]

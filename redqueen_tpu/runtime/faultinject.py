@@ -25,6 +25,20 @@ Two ways in:
                             detection/quarantine/fallback paths
                             (:mod:`runtime.integrity`) then run against a
                             reproducible bad file
+      numeric:mode@laneN[,chunkM]
+                            plant a NaN (``mode=nan``: source 0's
+                            scheduled time) or +inf (``mode=inf``: source
+                            0's Hawkes excitation) in lane N of a
+                            simulation batch, optionally only when the
+                            sweep-chunk context is M — exercising the
+                            lane-quarantine / re-run machinery
+                            (:mod:`runtime.numerics`,
+                            ``sweep.run_sweep_checkpointed``).  Unlike
+                            the process-level kinds this one is NOT
+                            applied by :func:`maybe_inject` (which
+                            ignores it): the sim driver consumes it via
+                            :func:`active_numeric_lane` at lane
+                            granularity, inside :func:`numeric_scope`
 
   ``RQ_FAULT_POINT`` (optional) restricts injection to the matching
   ``maybe_inject(point)`` call site.
@@ -39,9 +53,10 @@ state beyond the explicit counter file.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 __all__ = [
     "TransientError",
@@ -49,6 +64,12 @@ __all__ = [
     "parse_fault",
     "maybe_inject",
     "inject",
+    "NumericFault",
+    "NUMERIC_MODES",
+    "parse_numeric",
+    "numeric_fault",
+    "numeric_scope",
+    "active_numeric_lane",
     "hang_forever",
     "crash_with",
     "flaky",
@@ -88,10 +109,12 @@ class FaultSpec(NamedTuple):
 def parse_fault(spec: str) -> FaultSpec:
     kind, _, arg = spec.strip().partition(":")
     kind = kind.strip().lower()
-    if kind not in ("hang", "crash", "transient", "oom", "corrupt"):
+    if kind not in ("hang", "crash", "transient", "oom", "corrupt",
+                    "numeric"):
         raise ValueError(f"unknown fault spec {spec!r} "
-                         f"(want hang|crash|transient|oom[:arg] or "
-                         f"corrupt:mode@path)")
+                         f"(want hang|crash|transient|oom[:arg], "
+                         f"corrupt:mode@path, or "
+                         f"numeric:mode@laneN[,chunkM])")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -141,6 +164,11 @@ def inject(spec: FaultSpec) -> None:
                 f"(mode: {'|'.join(CORRUPT_MODES)})")
         mode, _, path = spec.arg.partition("@")
         corrupt_file(path, mode.strip())
+    elif spec.kind == "numeric":
+        # Data-plane fault, not process-plane: validated here (so a typo'd
+        # spec fails fast at the first maybe_inject) but APPLIED by the
+        # sim driver at lane granularity via active_numeric_lane().
+        parse_numeric(spec.arg)
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -158,6 +186,109 @@ def maybe_inject(point: str = "start") -> None:
     if want and want != point:
         return
     inject(parse_fault(spec))
+
+
+# --- numeric (data-plane) faults: NaN/Inf planted in one simulation lane --
+
+NUMERIC_MODES = ("nan", "inf")
+
+
+class NumericFault(NamedTuple):
+    """Parsed ``numeric:mode@laneN[,chunkM]`` spec.  ``lane`` addresses a
+    lane of the *logical* sweep dispatch (see :func:`numeric_scope`);
+    ``chunk`` is None for "any dispatch" or a sweep-chunk index the
+    surrounding scope must match."""
+
+    mode: str            # nan | inf
+    lane: int
+    chunk: Optional[int]
+
+
+def parse_numeric(arg: Optional[str]) -> NumericFault:
+    """Parse the argument of a ``numeric`` fault spec."""
+    if not arg or "@" not in arg:
+        raise ValueError(
+            f"{ENV_FAULT}=numeric needs 'mode@laneN[,chunkM]' "
+            f"(mode: {'|'.join(NUMERIC_MODES)})")
+    mode, _, where = arg.partition("@")
+    mode = mode.strip().lower()
+    if mode not in NUMERIC_MODES:
+        raise ValueError(f"unknown numeric fault mode {mode!r} "
+                         f"(want {'|'.join(NUMERIC_MODES)})")
+    lane_s, _, chunk_s = where.partition(",")
+    lane_s = lane_s.strip().lower()
+    chunk_s = chunk_s.strip().lower()
+    if not lane_s.startswith("lane"):
+        raise ValueError(f"numeric fault needs 'laneN', got {lane_s!r}")
+    try:
+        lane = int(lane_s[4:])
+    except ValueError as e:
+        raise ValueError(f"bad lane in numeric fault: {lane_s!r}") from e
+    chunk: Optional[int] = None
+    if chunk_s:
+        if not chunk_s.startswith("chunk"):
+            raise ValueError(
+                f"numeric fault qualifier must be 'chunkM', got {chunk_s!r}")
+        try:
+            chunk = int(chunk_s[5:])
+        except ValueError as e:
+            raise ValueError(
+                f"bad chunk in numeric fault: {chunk_s!r}") from e
+    return NumericFault(mode, lane, chunk)
+
+
+def numeric_fault() -> Optional[NumericFault]:
+    """The env-configured numeric fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "numeric":
+        return None
+    return parse_numeric(parsed.arg)
+
+
+# (chunk, lane_base) of the dispatch currently running: run_sweep_
+# checkpointed addresses faults by SWEEP-chunk-local lane index, but the
+# dispatch that actually simulates may be the full chunk (lane_base 0) or
+# a single-lane quarantine re-run (lane_base = the lane being re-run) —
+# the scope lets the same spec hit the same logical lane in both, so a
+# still-injected re-run deterministically stays sick.
+_NUMERIC_CTX: Tuple[Optional[int], int] = (None, 0)
+
+
+@contextlib.contextmanager
+def numeric_scope(chunk: Optional[int] = None, lane_base: int = 0):
+    """Declare the sweep-chunk context for numeric-fault addressing while
+    a simulation dispatch runs inside the ``with`` body."""
+    global _NUMERIC_CTX
+    prev = _NUMERIC_CTX
+    _NUMERIC_CTX = (chunk, int(lane_base))
+    try:
+        yield
+    finally:
+        _NUMERIC_CTX = prev
+
+
+def active_numeric_lane(batch_size: int) -> Optional[Tuple[int, str]]:
+    """``(local_lane, mode)`` if the env-configured numeric fault lands in
+    the current dispatch, else None.
+
+    A spec with a ``chunkM`` qualifier fires only inside a matching
+    :func:`numeric_scope`; the spec's lane index is relative to the
+    scope's ``lane_base`` and must fall inside ``[0, batch_size)`` after
+    translation."""
+    nf = numeric_fault()
+    if nf is None:
+        return None
+    chunk, lane_base = _NUMERIC_CTX
+    if nf.chunk is not None and nf.chunk != chunk:
+        return None
+    local = nf.lane - lane_base
+    if 0 <= local < batch_size:
+        return local, nf.mode
+    return None
 
 
 # --- picklable callable faults (spawned-child targets for tests) ---------

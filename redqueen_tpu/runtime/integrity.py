@@ -264,13 +264,22 @@ def savez(path: str, schema: str = "rq.npz/1", **arrays) -> None:
 
 
 def load_npz(path: str, schema: Optional[str] = None,
-             do_quarantine: bool = True) -> Dict[str, Any]:
+             do_quarantine: bool = True,
+             quarantine_schema_mismatch: bool = True) -> Dict[str, Any]:
     """Read + verify an enveloped NPZ; returns ``{name: array}`` for the
     payload arrays only.  Same contract as :func:`read_json`: missing →
     ``FileNotFoundError``; torn zip, missing envelope, flipped payload
     bit, or bad stored checksum → quarantine + CorruptArtifactError.
     (NPZ has no legacy mode: a pre-envelope archive cannot be verified,
-    and every producer in-repo writes envelopes — recompute instead.)"""
+    and every producer in-repo writes envelopes — recompute instead.)
+
+    ``quarantine_schema_mismatch=False`` narrows the quarantine to REAL
+    corruption: a checksum-valid archive whose ``schema`` tag merely
+    differs (a layout written by an older/newer version) still raises
+    ``CorruptArtifactError`` (``reason == "schema mismatch"``,
+    ``quarantined_to is None``) but stays on disk untouched — stale is
+    not corrupt, and a resume that recomputes-and-overwrites must not
+    litter the directory with false corruption reports."""
     import numpy as np
 
     if not os.path.exists(path):
@@ -298,5 +307,5 @@ def load_npz(path: str, schema: Optional[str] = None,
     if schema is not None and env.get("schema") != schema:
         raise _reject(path, "schema mismatch",
                       f"want {schema!r}, found {env.get('schema')!r}",
-                      do_quarantine)
+                      do_quarantine and quarantine_schema_mismatch)
     return arrays

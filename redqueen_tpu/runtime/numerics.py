@@ -1,0 +1,259 @@
+"""In-computation numerics guard: guarded math primitives, the per-lane
+health-bit protocol, and deterministic lane poisoning for fault injection.
+
+PR 1-2 made the *process* layer resilient; this module hardens the
+*computation*.  Three layers, designed together:
+
+1. **Guarded primitives** — :func:`safe_exp` / :func:`safe_log` /
+   :func:`safe_div` (plus :func:`finite_or` / :func:`nan_to_posinf`) used
+   by every sampler in ``ops/`` and every policy in ``models/``.  Each is
+   **bit-identical to the raw op on healthy inputs** (the clamp/guard is an
+   IEEE identity inside the valid domain), so golden streams never move;
+   only the poisoned paths change — from NaN/Inf to a representable,
+   detectable value.  ``tools/check_resilience.py`` (third AST pass)
+   enforces that ``ops/`` uses these instead of raw ``jnp.exp`` /
+   ``jnp.log`` / ``/``-division.
+
+2. **Lane-health protocol** — a ``uint32`` bitmask carried per simulation
+   lane (``SimState.health``, surfaced as ``EventLog.health`` and
+   ``SweepResult.health``).  The event-scan kernel
+   (``ops/scan_core.step``) checks every value it is about to write back:
+   a NaN event time, a NaN resampled ``t_next``, a non-finite Hawkes
+   excitation / RMTPP hidden state, or an exhausted thinning-proposal cap
+   ORs the matching ``BIT_*`` into the lane's mask and **freezes the
+   lane** (``valid`` is gated on ``health == 0``), so a sick lane can
+   never poison siblings through the argmin/early-exit logic and never
+   emits a NaN into the event log.  The sweep layer
+   (``sweep.run_sweep_checkpointed``) records the mask in the enveloped
+   chunk artifact and re-runs exactly the sick lanes under the existing
+   bit-identical resume machinery; the sim driver raises
+   :class:`NumericalHealthError` (with per-lane provenance) when *all*
+   lanes die — silent NaN propagation is never an outcome.
+
+3. **Deterministic poisoning** — :func:`poison_lane` plants a NaN/Inf in
+   one lane's carry, driven by ``runtime.faultinject``'s ``numeric`` fault
+   kind (``RQ_FAULT=numeric:nan@lane3,chunk2``), so every detection /
+   quarantine / re-run path above runs in CI on CPU.
+
+Imports jax at module load (this is kernel-side code); the rest of
+``redqueen_tpu.runtime`` stays importable before jax — the package
+``__init__`` exposes this module lazily.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "safe_exp",
+    "safe_log",
+    "safe_log1p",
+    "safe_div",
+    "finite_or",
+    "nan_to_posinf",
+    "DEFAULT_MAX_PROPOSALS",
+    "HEALTH_OK",
+    "BIT_NONFINITE_TIME",
+    "BIT_NONFINITE_STATE",
+    "BIT_SAMPLER_FAILURE",
+    "BIT_NONFINITE_RESULT",
+    "HEALTH_BITS",
+    "decode_health",
+    "describe_health",
+    "sick_lanes",
+    "NumericalHealthError",
+    "poison_lane",
+    "POISON_MODES",
+]
+
+
+# Defense-in-depth bound on the Ogata-thinning while_loop: valid params
+# terminate almost surely in a handful of proposals (the bound tightens on
+# every rejection), so a cap this size is unreachable except by degenerate
+# inputs — which must return, flagged, instead of spinning the device.
+DEFAULT_MAX_PROPOSALS = 1_000_000
+
+
+# ---------------------------------------------------------------------------
+# Guarded primitives (bit-identical to the raw op on healthy inputs)
+# ---------------------------------------------------------------------------
+
+def _exp_cap(dtype) -> float:
+    """Largest exponent safe_exp passes through: exp(cap) is large but
+    finite in ``dtype`` (f32 overflows at ~88.7, f64 at ~709.8)."""
+    return 80.0 if jnp.finfo(dtype).bits <= 32 else 700.0
+
+
+def safe_exp(x):
+    """``exp(x)`` with the exponent clamped below the dtype's overflow
+    point: healthy inputs are bit-identical (``min(x, cap) == x``), a
+    divergent exponent yields a large **finite** value instead of +inf —
+    representable, orderable, and detectable downstream."""
+    x = jnp.asarray(x)
+    dtype = jnp.result_type(x, jnp.float32)
+    return jnp.exp(jnp.minimum(jnp.asarray(x, dtype), _exp_cap(dtype)))
+
+
+def safe_log(x):
+    """``log(x)`` with the argument clamped to the smallest positive
+    normal: strictly positive inputs are bit-identical, zero/negative/NaN
+    arguments yield a large-magnitude **finite** negative instead of
+    -inf/NaN."""
+    x = jnp.asarray(x)
+    dtype = jnp.result_type(x, jnp.float32)
+    tiny = jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+    x = jnp.asarray(x, dtype)
+    return jnp.log(jnp.maximum(jnp.where(jnp.isnan(x), tiny, x), tiny))
+
+
+def safe_log1p(x):
+    """``log1p(x)`` clamped above -1: every representable argument in
+    (-1, inf) is bit-identical (the floor is the smallest representable
+    value ABOVE -1 — ``-(1 - epsneg)``, not ``-1 + eps``, which would
+    clamp legitimate ``-u`` draws at ``u = 1 - 2^-24``), while x <= -1
+    (where log1p is -inf/NaN) and NaN yield a finite negative."""
+    x = jnp.asarray(x)
+    dtype = jnp.result_type(x, jnp.float32)
+    floor = jnp.asarray(-(1.0 - jnp.finfo(dtype).epsneg), dtype)
+    x = jnp.asarray(x, dtype)
+    return jnp.log1p(jnp.maximum(jnp.where(jnp.isnan(x), floor, x), floor))
+
+
+def safe_div(num, den, when_zero=jnp.inf):
+    """``num / den`` that never divides by zero: where ``den == 0`` the
+    result is ``when_zero`` (default +inf, the "never fires" sentinel)
+    and the division itself runs against a guarded denominator — so not
+    even the *untaken* branch of a ``where`` manufactures a NaN (the
+    0/0 trap the raw idiom leaves open)."""
+    num = jnp.asarray(num)
+    den = jnp.asarray(den)
+    zero = den == 0
+    out = num / jnp.where(zero, jnp.ones_like(den), den)
+    return jnp.where(zero, jnp.asarray(when_zero, out.dtype), out)
+
+
+def finite_or(x, fill):
+    """``x`` where finite, ``fill`` elsewhere (NaN and both infinities)."""
+    x = jnp.asarray(x)
+    return jnp.where(jnp.isfinite(x), x, jnp.asarray(fill, x.dtype))
+
+
+def nan_to_posinf(x):
+    """Replace NaN with +inf — the event-scan write-back sanitizer: +inf
+    is the legal "never fires" value, so a poisoned resample becomes an
+    absorbing source instead of an argmin-poisoning NaN (the health bit
+    records that the substitution happened)."""
+    x = jnp.asarray(x)
+    return jnp.where(jnp.isnan(x), jnp.asarray(jnp.inf, x.dtype), x)
+
+
+# ---------------------------------------------------------------------------
+# Lane-health bitmask
+# ---------------------------------------------------------------------------
+
+HEALTH_OK = 0
+#: A NaN event time was selected, or a policy resample returned NaN.
+BIT_NONFINITE_TIME = 1 << 0
+#: A per-source state slice (Hawkes excitation, RMTPP hidden state) went
+#: non-finite at write-back.
+BIT_NONFINITE_STATE = 1 << 1
+#: A sampler failed internally: the thinning-proposal cap was exhausted
+#: or the intensity bound was non-finite/NaN.
+BIT_SAMPLER_FAILURE = 1 << 2
+#: Host-side backstop: a reduced result grid held a non-finite value even
+#: though the kernel mask was clean (set by the sweep layer, never by the
+#: kernel).
+BIT_NONFINITE_RESULT = 1 << 3
+
+HEALTH_BITS: Dict[int, str] = {
+    BIT_NONFINITE_TIME: "non-finite event time",
+    BIT_NONFINITE_STATE: "non-finite per-source state",
+    BIT_SAMPLER_FAILURE: "sampler failure (thinning cap / bad intensity)",
+    BIT_NONFINITE_RESULT: "non-finite result grid value",
+}
+
+
+def decode_health(bits: int) -> List[str]:
+    """Human-readable reasons for one lane's health word."""
+    bits = int(bits)
+    out = [name for bit, name in sorted(HEALTH_BITS.items()) if bits & bit]
+    unknown = bits & ~sum(HEALTH_BITS)
+    if unknown:
+        out.append(f"unknown bits 0x{unknown:x}")
+    return out
+
+
+def describe_health(health) -> Dict[int, List[str]]:
+    """``{lane_index: reasons}`` for every sick lane of a health array
+    (scalar arrays are treated as one lane 0)."""
+    h = np.atleast_1d(np.asarray(health))
+    return {int(i): decode_health(h[i]) for i in np.flatnonzero(h)}
+
+
+def sick_lanes(health) -> np.ndarray:
+    """Flat indices of the non-zero entries of a health array."""
+    return np.flatnonzero(np.atleast_1d(np.asarray(health)))
+
+
+class NumericalHealthError(RuntimeError):
+    """Every lane of a simulation died numerically.
+
+    Raised by the sim driver instead of returning an all-garbage result;
+    carries the raw per-lane ``health`` bitmask array and the decoded
+    ``reasons`` (``{lane: [reason, ...]}``) so the caller can log exact
+    provenance or route specific lanes to quarantine."""
+
+    def __init__(self, health, context: str = "simulation"):
+        self.health = np.atleast_1d(np.asarray(health))
+        self.reasons = describe_health(self.health)
+        lanes = ", ".join(
+            f"lane {i}: {'; '.join(r)}" for i, r in
+            sorted(self.reasons.items())[:8]
+        )
+        more = "" if len(self.reasons) <= 8 else (
+            f" (+{len(self.reasons) - 8} more)")
+        super().__init__(
+            f"{context}: all {self.health.size} lane(s) numerically dead — "
+            f"{lanes}{more}. Inputs were host-validated, so this is "
+            f"in-computation corruption (or injected via RQ_FAULT=numeric); "
+            f"re-run the lanes or inspect the carry."
+        )
+
+
+# ---------------------------------------------------------------------------
+# Deterministic lane poisoning (the numeric fault kind's payload)
+# ---------------------------------------------------------------------------
+
+POISON_MODES = ("nan", "inf")
+
+
+def poison_lane(state, lane: int, mode: str = "nan"):
+    """Plant a deterministic numeric fault in one lane of a ``SimState``.
+
+    - ``nan``: sets source 0's scheduled ``t_next`` to NaN — the
+      in-computation bit-flip shape; the kernel's argmin selects it, the
+      NaN event time trips ``BIT_NONFINITE_TIME``, and the lane freezes.
+    - ``inf``: sets source 0's Hawkes excitation to +inf — the divergence
+      shape; the next own fire folds it and trips
+      ``BIT_NONFINITE_STATE`` (requires a Hawkes source in the component
+      to be observable; other mixes never read ``exc``).
+
+    Works on single (``t_next[S]``) and batched (``t_next[B, S]``)
+    states; ``lane`` indexes the batch axis (must be 0 when unbatched).
+    Returns the poisoned state — the input is immutable, like every
+    pytree here."""
+    if mode not in POISON_MODES:
+        raise ValueError(
+            f"unknown poison mode {mode!r} (want {'|'.join(POISON_MODES)})")
+    batched = state.t_next.ndim == 2
+    if not batched and lane != 0:
+        raise ValueError(
+            f"unbatched state has exactly one lane, got lane={lane}")
+    if mode == "nan":
+        idx = (lane, 0) if batched else (0,)
+        return state.replace(t_next=state.t_next.at[idx].set(jnp.nan))
+    idx = (lane, 0) if batched else (0,)
+    return state.replace(exc=state.exc.at[idx].set(jnp.inf))

@@ -29,13 +29,17 @@ from jax import random as jr
 
 from .config import SimConfig, SimState, SourceParams
 from .ops.scan_core import init_state, make_run_chunk
+from .runtime import faultinject as _faultinject
+from .runtime import numerics as _numerics
+from .runtime.numerics import NumericalHealthError
 
 # Importing the models package registers the built-in policies (the
 # reference's Broadcaster subclasses; see models/base.py).
 from . import models as _models  # noqa: F401
 from .models import base
 
-__all__ = ["EventLog", "simulate", "simulate_batch", "resume"]
+__all__ = ["EventLog", "simulate", "simulate_batch", "resume",
+           "NumericalHealthError"]
 
 
 class EventLog:
@@ -47,22 +51,32 @@ class EventLog:
     (scalar or [B]). Use ``redqueen_tpu.utils.dataframe`` to export the
     reference-schema DataFrame, or ``redqueen_tpu.utils.metrics`` to compute
     feed metrics on device without leaving HBM.
+
+    ``health`` is the per-lane numeric-health bitmask (scalar or [B]
+    uint32; see ``runtime.numerics``): 0 = healthy, non-zero = the lane
+    went numerically sick mid-run and was FROZEN at that point — its
+    events up to the freeze are valid, nothing after was emitted, and
+    ``times`` is NaN-free by construction.  Decode with
+    ``runtime.numerics.describe_health``.
     """
 
-    def __init__(self, times, srcs, n_events, cfg: SimConfig):
+    def __init__(self, times, srcs, n_events, cfg: SimConfig, health=None):
         self.times = times
         self.srcs = srcs
         self.n_events = n_events
         self.cfg = cfg
+        self.health = health
 
     @property
     def batched(self) -> bool:
         return self.times.ndim == 2
 
     def __repr__(self):
+        sick = (_numerics.sick_lanes(self.health).size
+                if self.health is not None else 0)
         return (
             f"EventLog(batched={self.batched}, n_events={self.n_events!r}, "
-            f"buffer={tuple(self.times.shape)})"
+            f"buffer={tuple(self.times.shape)}, sick_lanes={sick})"
         )
 
 
@@ -89,10 +103,15 @@ def _chunk_fn_cached(cfg: SimConfig, batched: bool, n_kinds: int, k: int = 8):
     end_time = cfg.end_time
 
     def alive_fn(st):
-        # Per-lane liveness; [B] when batched, scalar otherwise.
+        # Per-lane liveness; [B] when batched, scalar otherwise.  A sick
+        # lane (non-zero health mask) is frozen by the kernel and counts
+        # as done: without this gate a lane frozen with a finite t_next
+        # would look alive forever and spin the chunk loop to max_chunks.
         a = st.t_next.min(axis=-1) <= end_time
         if st.budget is not None:
             a &= st.n_events < st.budget
+        if st.health is not None:
+            a &= st.health == 0
         return a
 
     # The while_loop sits OUTSIDE the vmap with one GLOBAL chunk counter
@@ -189,6 +208,60 @@ def _check_kinds(cfg: SimConfig, params: SourceParams):
         )
 
 
+# (field name, allow +inf) — +inf is a legal padding/sentinel value in the
+# piecewise knots and replay timestamps; NaN and -inf never are.
+_FINITE_FIELDS = (
+    ("rate", False), ("l0", False), ("alpha", False), ("beta", False),
+    ("q", False), ("s_sink", False), ("pw_times", True), ("pw_rates", False),
+    ("rd_times", True),
+)
+
+# Host-validation size ceiling: the check copies the array to host, so a
+# big stacked replay/piecewise matrix (B x S x Kr at corpus scale) would
+# pay a transfer + O(n) scan on EVERY dispatch re-validating data the
+# builder already proved finite.  Larger fields skip the host check — the
+# kernel's lane-health mask is the device-side backstop for them.
+_FINITE_CHECK_MAX_ELEMS = 2_000_000
+
+
+def _check_finite_params(cfg: SimConfig, params: SourceParams):
+    """Validated boundary (runtime.numerics): garbage parameters are
+    rejected HOST-side with a named field and flat index, instead of
+    surfacing device-side as a quarantined lane (hand-built SourceParams
+    bypass GraphBuilder's per-component validation, so the driver
+    re-checks the cheap invariant: no NaN anywhere, no inf outside the
+    padding fields).  Fields above ``_FINITE_CHECK_MAX_ELEMS`` are left
+    to the in-kernel health mask (see the constant's comment)."""
+    for field, allow_posinf in _FINITE_FIELDS:
+        arr = getattr(params, field)
+        if int(np.prod(np.shape(arr), dtype=np.int64)) > \
+                _FINITE_CHECK_MAX_ELEMS:
+            continue  # metadata-only size check: no transfer paid
+        arr = _host_view(arr)
+        bad = np.isnan(arr) | np.isneginf(arr)
+        if not allow_posinf:
+            bad |= np.isposinf(arr)
+        if bad.any():
+            flat = int(np.flatnonzero(bad.reshape(-1))[0])
+            raise ValueError(
+                f"SourceParams.{field} holds a non-finite value at flat "
+                f"index {flat} ({arr.reshape(-1)[flat]!r}) — simulation "
+                f"inputs must be finite ({'+inf padding allowed' if allow_posinf else 'no inf/NaN'}); "
+                f"build components through GraphBuilder or fix the array "
+                f"before dispatch"
+            )
+    if params.rmtpp is not None:
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params.rmtpp):
+            arr = _host_view(leaf)
+            if np.isnan(arr).any() or np.isinf(arr).any():
+                raise ValueError(
+                    f"params.rmtpp weight leaf "
+                    f"{jax.tree_util.keystr(path)} holds a non-finite "
+                    f"value — refusing to deploy a diverged checkpoint "
+                    f"as a broadcaster policy"
+                )
+
+
 def _check_weights(cfg: SimConfig, params: SourceParams):
     """RMTPP rows need attached weights (models.rmtpp.attach) whose hidden
     size matches the config's recurrent-state slot; catch both misuses
@@ -214,6 +287,19 @@ def _check_weights(cfg: SimConfig, params: SourceParams):
             f"with rmtpp_hidden={cfg.rmtpp_hidden}; pass "
             f"GraphBuilder.build(rmtpp_hidden={hidden})"
         )
+
+
+def _maybe_poison(state: SimState, batch_size: int) -> SimState:
+    """Apply the env-configured ``numeric`` fault (RQ_FAULT=
+    numeric:mode@laneN[,chunkM]) to the freshly initialized carry, if it
+    addresses a lane of this dispatch — the deterministic stand-in for an
+    in-computation bit flip, so the detection/quarantine/re-run paths run
+    in CI on CPU (runtime.faultinject / runtime.numerics)."""
+    hit = _faultinject.active_numeric_lane(batch_size)
+    if hit is None:
+        return state
+    lane, mode = hit
+    return _numerics.poison_lane(state, lane, mode)
 
 
 @jax.jit
@@ -273,7 +359,18 @@ def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
     axis = 1 if batched else 0
     times = jnp.concatenate(times_chunks, axis=axis)
     srcs = jnp.concatenate(srcs_chunks, axis=axis)
-    return EventLog(times, srcs, state.n_events - n_before, cfg), state
+    if state.health is not None:
+        h = _host_view(state.health)
+        if h.size and np.all(h != 0):
+            # Every lane died numerically: a result would be pure garbage,
+            # so replace silent NaN propagation with typed per-lane
+            # provenance (partial results for SOME sick lanes flow through
+            # EventLog.health instead — the sweep layer quarantines and
+            # re-runs exactly those).
+            raise NumericalHealthError(
+                h, context=f"simulation of {h.size} lane(s)")
+    return EventLog(times, srcs, state.n_events - n_before, cfg,
+                    health=state.health), state
 
 
 def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
@@ -293,8 +390,10 @@ def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
     :func:`resume` with a longer-horizon ``SimConfig`` to continue)."""
     _check_kinds(cfg, params)
     _check_weights(cfg, params)
+    _check_finite_params(cfg, params)
     key = _as_key(seed)
     state = _init_fn(cfg, False)(params, adj, key)
+    state = _maybe_poison(state, 1)
     if max_events is not None:
         state = state.replace(budget=jnp.asarray(max_events, jnp.int32))
     log, state = _drive(
@@ -316,9 +415,11 @@ def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
     ``max_events`` (scalar or [B]) applies the per-lane run_dynamic stop."""
     _check_kinds(cfg, params)
     _check_weights(cfg, params)
+    _check_finite_params(cfg, params)
     seeds = jnp.asarray(seeds)
     keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
     state = _init_fn(cfg, True)(params, adj, keys)
+    state = _maybe_poison(state, int(keys.shape[0]))
     if max_events is not None:
         B = keys.shape[0]
         state = state.replace(

@@ -31,7 +31,9 @@ from jax.sharding import Mesh
 from .config import stack_components
 from .parallel.bigf import simulate_star_batch, stack_star
 from .parallel.shard import simulate_sharded
+from .runtime import faultinject as _faultinject
 from .runtime import integrity as _integrity
+from .runtime import numerics as _numerics
 from .runtime import preempt as _preempt
 from .runtime.supervisor import heartbeat as _heartbeat
 from .sim import simulate_batch
@@ -43,12 +45,21 @@ __all__ = ["SweepResult", "run_sweep", "run_sweep_star",
 
 class SweepResult(NamedTuple):
     """Per-(point, seed) scalars, shape [n_points, n_seeds] (numpy, on
-    host — these are O(grid) summaries, not O(events) logs)."""
+    host — these are O(grid) summaries, not O(events) logs).
+
+    ``health`` is the lane-health grid (uint32 bitmasks, runtime.numerics
+    BIT_*): 0 = trustworthy, non-zero = that (point, seed) lane went
+    numerically sick — its metric values are garbage and
+    ``run_sweep_checkpointed`` quarantines + re-runs exactly those lanes.
+    The scan engine reports the kernel mask; both engines additionally
+    get the host-side non-finite-result backstop (BIT_NONFINITE_RESULT).
+    """
 
     time_in_top_k: np.ndarray   # mean over followed feeds, absolute time
     average_rank: np.ndarray    # time-averaged rank, mean over feeds
     n_posts: np.ndarray         # tracked source's posting budget spent
     int_rank2: np.ndarray       # int r^2 dt, mean over feeds (loss term)
+    health: np.ndarray          # u32 lane-health bitmask grid
 
     @property
     def n_points(self) -> int:
@@ -77,22 +88,37 @@ def _validate_points(points, n_seeds, vary_hint: str):
     return points, cfg0
 
 
-def _reduce_to_grid(m, n_posts, P: int, n_seeds: int) -> SweepResult:
+def _reduce_to_grid(m, n_posts, P: int, n_seeds: int,
+                    kernel_health=None) -> SweepResult:
     """FeedMetrics [B, F] + per-lane post counts -> [P, n_seeds] grids.
     Window normalization comes from the FeedMetrics object itself (it
-    carries the window its integrals used) — never recomputed here."""
+    carries the window its integrals used) — never recomputed here.
+
+    ``kernel_health`` is the per-lane mask from the event-scan kernel
+    ([B] uint32; None for the star engine, which has no in-kernel mask
+    yet).  Either way a host-side backstop ORs BIT_NONFINITE_RESULT into
+    any lane whose reduced grids hold a non-finite value, so a NaN can
+    never ride a SweepResult out unlabeled."""
     follows_n = jnp.maximum(m.follows.sum(-1), 1)
     ir2 = (m.int_rank2 * m.follows).sum(-1) / follows_n
 
     def grid(x):
         return np.asarray(x).reshape(P, n_seeds)
 
-    return SweepResult(
+    values = dict(
         time_in_top_k=grid(m.mean_time_in_top_k()),
         average_rank=grid(m.mean_average_rank()),
         n_posts=grid(n_posts),
         int_rank2=grid(ir2),
     )
+    health = (np.zeros((P, n_seeds), np.uint32) if kernel_health is None
+              else grid(kernel_health).astype(np.uint32))
+    bad = np.zeros((P, n_seeds), bool)
+    for v in values.values():
+        bad |= ~np.isfinite(np.asarray(v, np.float64))
+    health = health | np.where(
+        bad, np.uint32(_numerics.BIT_NONFINITE_RESULT), np.uint32(0))
+    return SweepResult(health=health, **values)
 
 
 def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
@@ -130,7 +156,8 @@ def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
     m = feed_metrics_batch(log.times, log.srcs, adj, src_index,
                            cfg0.end_time, K=metric_K,
                            start_time=cfg0.start_time)
-    return _reduce_to_grid(m, num_posts(log.srcs, src_index), P, n_seeds)
+    return _reduce_to_grid(m, num_posts(log.srcs, src_index), P, n_seeds,
+                           kernel_health=log.health)
 
 
 def run_sweep_star(points: Sequence, n_seeds: int, metric_K: int = 1,
@@ -166,7 +193,49 @@ def run_sweep_star(points: Sequence, n_seeds: int, metric_K: int = 1,
 
 # Envelope schema tag for chunk artifacts; bump on layout changes so a
 # resume after an upgrade recomputes instead of misreading.
-_CHUNK_SCHEMA = "rq.sweep.chunk/1"
+# /2: SweepResult grew the lane-health grid (in-computation numerics guard).
+_CHUNK_SCHEMA = "rq.sweep.chunk/2"
+
+
+def _heal_sick_lanes(chunk: SweepResult, pts, n_seeds: int,
+                     seed0_chunk: int, runner, ci: int, kwargs: dict):
+    """Quarantine recovery at LANE granularity: re-run exactly the sick
+    (point, seed) lanes of one chunk grid and patch the healed values in.
+
+    Each lane re-runs as its own single-lane dispatch with the seed the
+    point-major layout assigned it (``seed0_chunk + p * n_seeds + s``), so
+    a healed lane is bit-identical to what an uninjected/uncorrupted run
+    would have produced — the same replay guarantee the chunk-level resume
+    machinery gives, one level finer.  A lane that is STILL sick after the
+    re-run (deterministically bad inputs, or a fault injection that is
+    still active — the re-run dispatch runs inside a ``numeric_scope``
+    whose ``lane_base`` maps the env spec onto the same logical lane)
+    keeps its recorded health bits.  Returns ``(chunk, n_healed)``."""
+    sick = np.argwhere(np.asarray(chunk.health) != 0)
+    if sick.size == 0:
+        return chunk, 0
+    # A single-lane batch cannot shard (mesh axes never divide 1) — and
+    # does not need to: sharding is placement-only with bit-identical
+    # results, so the re-run executes unsharded and still reproduces the
+    # lane's stream exactly.
+    solo_kwargs = {k: v for k, v in kwargs.items() if k != "mesh"}
+    fields = {f: np.array(getattr(chunk, f)) for f in SweepResult._fields}
+    healed = 0
+    for p, s in sick:
+        p, s = int(p), int(s)
+        lane = p * n_seeds + s
+        try:
+            with _faultinject.numeric_scope(chunk=ci, lane_base=lane):
+                solo = runner([pts[p]], 1, seed0=seed0_chunk + lane,
+                              **solo_kwargs)
+        except _numerics.NumericalHealthError:
+            continue  # the lane's one lane died again: bits stay recorded
+        if int(np.asarray(solo.health)[0, 0]) != 0:
+            continue
+        for f in fields:
+            fields[f][p, s] = np.asarray(getattr(solo, f))[0, 0]
+        healed += 1
+    return SweepResult(**fields), healed
 
 
 def _chunk_fingerprint(chunk_idx: int, pts, n_seeds: int, seed0_chunk: int,
@@ -202,6 +271,17 @@ def run_sweep_checkpointed(points: Sequence, n_seeds: int, ckpt_dir: str,
     (``*.corrupt-<ts>`` + report) and re-runs, so the resumed grid stays
     bit-identical to an uninterrupted run.
 
+    Lane-level numeric quarantine rides the same machinery one level
+    finer (runtime.numerics): a lane that went numerically sick mid-run
+    (in-computation NaN/Inf — detected and frozen by the kernel, so
+    sibling lanes are untouched) is recorded in the chunk artifact's
+    ``health`` grid and re-run as its own single-lane dispatch with its
+    original seed, making the healed grid bit-identical to an
+    uncorrupted run; lanes that stay sick keep their recorded bits for
+    the next resume.  If EVERY lane of a dispatch dies, the sim driver
+    raises :class:`~redqueen_tpu.runtime.numerics.NumericalHealthError`
+    with per-lane provenance instead of returning garbage.
+
     Results are bit-identical to the corresponding single-dispatch
     ``run_sweep``/``run_sweep_star`` call: each chunk starting at point p0
     uses ``seed0 + p0 * n_seeds``, exactly the slice of the point-major
@@ -235,7 +315,8 @@ def run_sweep_checkpointed(points: Sequence, n_seeds: int, ckpt_dir: str,
         chunk = None
         if os.path.exists(path):
             try:
-                z = _integrity.load_npz(path, schema=_CHUNK_SCHEMA)
+                z = _integrity.load_npz(path, schema=_CHUNK_SCHEMA,
+                                        quarantine_schema_mismatch=False)
             except _integrity.CorruptArtifactError:
                 # Torn/bit-flipped/forged-checksum chunk (or a
                 # pre-envelope legacy file): load_npz has QUARANTINED it
@@ -243,6 +324,10 @@ def run_sweep_checkpointed(points: Sequence, n_seeds: int, ckpt_dir: str,
                 # later resume trusts it either; this chunk simply
                 # re-runs below — the fingerprinted seed layout makes the
                 # recomputation bit-identical to what the lost file held.
+                # A checksum-VALID archive with an older schema tag (a
+                # pre-upgrade chunk) raises too but is NOT quarantined
+                # (stale is not corrupt): it recomputes and overwrites
+                # like any stale layout, no false corruption report.
                 pass
             except Exception:
                 # unreadable for non-corruption reasons (permissions,
@@ -261,8 +346,21 @@ def run_sweep_checkpointed(points: Sequence, n_seeds: int, ckpt_dir: str,
                     chunk = None
                 # fingerprint mismatch = STALE inputs, not corruption:
                 # recompute and overwrite, exactly as before
-        if chunk is None:
-            chunk = runner(pts, n_seeds, seed0=seed0_chunk, **kwargs)
+        fresh = chunk is None
+        if fresh:
+            # numeric_scope: the env fault protocol (RQ_FAULT=
+            # numeric:mode@laneN,chunkM) addresses lanes per sweep chunk;
+            # the scope is a no-op when no numeric fault is configured.
+            with _faultinject.numeric_scope(chunk=ci):
+                chunk = runner(pts, n_seeds, seed0=seed0_chunk, **kwargs)
+        # Lane-level quarantine: any sick lane — freshly detected by the
+        # kernel mask, or recorded in a previously landed artifact — re-
+        # runs as its own dispatch, bit-identically.  Healed (or freshly
+        # computed) grids land atomically, sick bits and all, so a resume
+        # knows exactly which lanes to retry.
+        chunk, healed = _heal_sick_lanes(
+            chunk, pts, n_seeds, seed0_chunk, runner, ci, kwargs)
+        if fresh or healed:
             _integrity.savez(
                 path, schema=_CHUNK_SCHEMA, fingerprint=fp,
                 **{f2: getattr(chunk, f2) for f2 in SweepResult._fields})

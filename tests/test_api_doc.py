@@ -22,6 +22,7 @@ MODULES = [
     "redqueen_tpu.runtime", "redqueen_tpu.runtime.faultinject",
     "redqueen_tpu.runtime.preempt", "redqueen_tpu.runtime.artifacts",
     "redqueen_tpu.runtime.integrity", "redqueen_tpu.runtime.watchdog",
+    "redqueen_tpu.runtime.numerics",
 ]
 
 

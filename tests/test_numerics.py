@@ -1,0 +1,620 @@
+"""In-computation numerics guard (redqueen_tpu.runtime.numerics): guarded
+primitives, the lane-health protocol, the ``numeric`` fault kind, and the
+lane-quarantine acceptance scenario — all deterministic, all on CPU.
+
+The acceptance contract (ISSUE 3): injected ``numeric:nan`` in one lane of
+a 64-lane checkpointed sweep -> the sick lane is quarantined and recorded
+in the enveloped chunk artifact, the other 63 lanes are bit-identical to
+an uninjected run, and resume re-runs exactly the sick lane, healing the
+grid bit-identically.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import redqueen_tpu.sweep as sweep_mod
+from redqueen_tpu.config import (
+    ConfigValidationError,
+    GraphBuilder,
+    stack_components,
+)
+from redqueen_tpu.ops.sampling import hawkes_next_time
+from redqueen_tpu.runtime import faultinject, integrity, numerics
+from redqueen_tpu.sim import NumericalHealthError, simulate, simulate_batch
+from redqueen_tpu.sweep import run_sweep, run_sweep_checkpointed
+
+import jax.numpy as jnp
+from jax import random as jr
+
+
+# ---------------------------------------------------------------------------
+# Guarded primitives: bit-identical on healthy inputs, finite on poisoned
+# ---------------------------------------------------------------------------
+
+class TestSafePrimitives:
+    def test_safe_exp_identity_and_clamp(self):
+        xs = jnp.asarray([-100.0, -1.0, 0.0, 1.0, 79.0])
+        np.testing.assert_array_equal(numerics.safe_exp(xs), jnp.exp(xs))
+        big = numerics.safe_exp(jnp.asarray([1e4, jnp.inf]))
+        assert np.isfinite(np.asarray(big)).all()
+        # NaN still propagates (detection is the health layer's job;
+        # safe_exp only removes the overflow-to-inf hazard)
+        assert np.isnan(float(numerics.safe_exp(jnp.nan)))
+
+    def test_safe_log_identity_and_floor(self):
+        xs = jnp.asarray([1e-30, 0.5, 1.0, 1e30])
+        np.testing.assert_array_equal(numerics.safe_log(xs), jnp.log(xs))
+        bad = numerics.safe_log(jnp.asarray([0.0, -3.0, jnp.nan]))
+        assert np.isfinite(np.asarray(bad)).all()
+
+    def test_safe_log1p_identity_and_floor(self):
+        xs = jnp.asarray([-0.5, 0.0, 3.0])
+        np.testing.assert_array_equal(numerics.safe_log1p(xs), jnp.log1p(xs))
+        bad = numerics.safe_log1p(jnp.asarray([-1.0, -2.0, jnp.nan]))
+        assert np.isfinite(np.asarray(bad)).all()
+
+    def test_safe_log1p_identity_at_max_uniform(self):
+        # The largest panel/threefry uniform is u = 1 - 2^-24; -u is the
+        # smallest representable f32 above -1 and must pass UNclamped
+        # (a -1+eps floor would silently shift that draw).
+        u = jnp.float32(1.0 - 2.0 ** -24)
+        np.testing.assert_array_equal(
+            np.asarray(numerics.safe_log1p(-u)), np.asarray(jnp.log1p(-u)))
+
+    def test_safe_div_identity_and_zero_fallback(self):
+        num = jnp.asarray([1.0, -2.0, 3.0])
+        den = jnp.asarray([2.0, 4.0, -8.0])
+        np.testing.assert_array_equal(numerics.safe_div(num, den), num / den)
+        z = numerics.safe_div(jnp.asarray([1.0, 0.0]), jnp.asarray([0.0, 0.0]))
+        np.testing.assert_array_equal(np.asarray(z), [np.inf, np.inf])
+        z0 = numerics.safe_div(jnp.asarray(1.0), jnp.asarray(0.0),
+                               when_zero=0.0)
+        assert float(z0) == 0.0
+        # the guarded denominator means not even the fallback branch
+        # computes 0/0
+        assert not np.isnan(np.asarray(
+            numerics.safe_div(jnp.asarray(0.0), jnp.asarray(0.0)))).any()
+
+    def test_finite_or_and_nan_to_posinf(self):
+        x = jnp.asarray([1.0, jnp.nan, jnp.inf, -jnp.inf])
+        np.testing.assert_array_equal(
+            np.asarray(numerics.finite_or(x, -1.0)), [1.0, -1.0, -1.0, -1.0])
+        np.testing.assert_array_equal(
+            np.asarray(numerics.nan_to_posinf(x)),
+            [1.0, np.inf, np.inf, -np.inf])
+
+    def test_decode_and_describe(self):
+        bits = numerics.BIT_NONFINITE_TIME | numerics.BIT_SAMPLER_FAILURE
+        reasons = numerics.decode_health(bits)
+        assert len(reasons) == 2 and any("time" in r for r in reasons)
+        assert numerics.decode_health(1 << 30)[0].startswith("unknown")
+        d = numerics.describe_health(np.asarray([0, bits, 0], np.uint32))
+        assert list(d) == [1]
+        assert numerics.sick_lanes([0, 3, 0, 1]).tolist() == [1, 3]
+
+    def test_poison_lane_modes_and_errors(self):
+        gb = GraphBuilder(n_sinks=1, end_time=5.0)
+        gb.add_poisson(rate=1.0)
+        cfg, params, adj = gb.build(capacity=16)
+        from redqueen_tpu.ops.scan_core import init_state
+
+        st = init_state(cfg, params, adj, jr.PRNGKey(0))
+        poisoned = numerics.poison_lane(st, 0, "nan")
+        assert np.isnan(np.asarray(poisoned.t_next)[0])
+        poisoned = numerics.poison_lane(st, 0, "inf")
+        assert np.isposinf(np.asarray(poisoned.exc)[0])
+        with pytest.raises(ValueError, match="unknown poison mode"):
+            numerics.poison_lane(st, 0, "zero")
+        with pytest.raises(ValueError, match="one lane"):
+            numerics.poison_lane(st, 3, "nan")
+
+
+# ---------------------------------------------------------------------------
+# Thinning proposal cap (ops.sampling.hawkes_next_time)
+# ---------------------------------------------------------------------------
+
+class TestThinningCap:
+    def test_healthy_params_unaffected_by_cap(self):
+        key = jr.PRNGKey(7)
+        t_ref = hawkes_next_time(key, 0.0, 1.0, 0.5, 2.0, 0.0, 0.0, jnp.inf)
+        t_cap, ok = hawkes_next_time(key, 0.0, 1.0, 0.5, 2.0, 0.0, 0.0,
+                                     jnp.inf, return_ok=True)
+        assert float(t_ref) == float(t_cap)
+        assert bool(ok)
+
+    def test_cap_exhaustion_returns_inf_and_not_ok(self):
+        # bound_scale 1e6 drops the acceptance probability to ~1e-6 per
+        # proposal; a cap of 8 is then all but surely exhausted.
+        t, ok = hawkes_next_time(jr.PRNGKey(0), 0.0, 1.0, 0.0, 1.0, 0.0,
+                                 0.0, jnp.inf, bound_scale=1e6,
+                                 max_proposals=8, return_ok=True)
+        assert np.isposinf(float(t))
+        assert not bool(ok)
+
+    def test_nan_intensity_flagged_not_propagated(self):
+        t, ok = hawkes_next_time(jr.PRNGKey(0), 0.0, jnp.nan, 0.5, 1.0,
+                                 0.0, 0.0, jnp.inf, return_ok=True)
+        assert np.isposinf(float(t))  # +inf, never NaN
+        assert not bool(ok)
+
+    def test_overflow_scale_terminates_finite_loop(self):
+        # bound_scale at the dtype limit overflows the bound to +inf:
+        # every proposal lands at t (e/inf == 0) and can never accept —
+        # without the cap this spins forever; with it the call returns.
+        t, ok = hawkes_next_time(jr.PRNGKey(3), 0.0, 1.0, 0.5, 1.0, 0.0,
+                                 0.0, jnp.inf, bound_scale=3e38,
+                                 max_proposals=64, return_ok=True)
+        assert np.isposinf(float(t))
+        assert not bool(ok)
+
+    def test_bad_cap_rejected(self):
+        with pytest.raises(ValueError, match="max_proposals"):
+            hawkes_next_time(jr.PRNGKey(0), 0.0, 1.0, 0.5, 1.0, 0.0, 0.0,
+                             jnp.inf, max_proposals=0)
+
+
+# ---------------------------------------------------------------------------
+# numeric fault kind (runtime.faultinject)
+# ---------------------------------------------------------------------------
+
+class TestNumericFaultSpec:
+    def test_parse_roundtrip(self):
+        nf = faultinject.parse_numeric("nan@lane3,chunk2")
+        assert nf == faultinject.NumericFault("nan", 3, 2)
+        nf = faultinject.parse_numeric("inf@lane0")
+        assert nf == faultinject.NumericFault("inf", 0, None)
+
+    @pytest.mark.parametrize("bad", [
+        None, "nan", "zap@lane1", "nan@3", "nan@lanex", "nan@lane1,two",
+        "nan@lane1,chunkx",
+    ])
+    def test_bad_specs_rejected(self, bad):
+        with pytest.raises(ValueError):
+            faultinject.parse_numeric(bad)
+
+    def test_parse_fault_accepts_numeric_kind(self):
+        spec = faultinject.parse_fault("numeric:nan@lane1,chunk0")
+        assert spec.kind == "numeric"
+
+    def test_maybe_inject_validates_but_does_not_apply(self, monkeypatch):
+        # the numeric kind is data-plane: maybe_inject must neither crash
+        # nor hang a supervised child that happens to call it
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane0")
+        faultinject.maybe_inject("start")
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:bogus")
+        with pytest.raises(ValueError):
+            faultinject.maybe_inject("start")
+
+    def test_scope_translates_lane_addressing(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane5,chunk1")
+        # no scope: chunk qualifier unsatisfied
+        assert faultinject.active_numeric_lane(64) is None
+        with faultinject.numeric_scope(chunk=1):
+            assert faultinject.active_numeric_lane(64) == (5, "nan")
+            assert faultinject.active_numeric_lane(4) is None  # out of range
+        with faultinject.numeric_scope(chunk=1, lane_base=5):
+            assert faultinject.active_numeric_lane(1) == (0, "nan")
+        with faultinject.numeric_scope(chunk=2):
+            assert faultinject.active_numeric_lane(64) is None
+        # scopes restore on exit
+        assert faultinject.active_numeric_lane(64) is None
+
+    def test_no_fault_no_hit(self):
+        assert faultinject.numeric_fault() is None
+        assert faultinject.active_numeric_lane(8) is None
+
+
+# ---------------------------------------------------------------------------
+# Lane quarantine in the kernel (sim layer)
+# ---------------------------------------------------------------------------
+
+def _component(F=3, T=30.0, capacity=256, hawkes=True):
+    gb = GraphBuilder(n_sinks=F, end_time=T)
+    gb.add_opt(q=1.0)
+    for i in range(F):
+        gb.add_poisson(rate=1.0, sinks=[i])
+    if hawkes:
+        gb.add_hawkes(l0=0.5, alpha=0.3, beta=1.0, sinks=[0])
+    return gb.build(capacity=capacity)
+
+
+class TestLaneQuarantine:
+    def test_healthy_run_reports_all_clear(self):
+        cfg, params, adj = _component()
+        log = simulate(cfg, params, adj, seed=0)
+        assert int(np.asarray(log.health)) == 0
+        assert not np.isnan(np.asarray(log.times)).any()
+
+    def test_injected_nan_freezes_lane_and_spares_siblings(self, monkeypatch):
+        cfg, params, adj = _component()
+        pb, ab = stack_components([params] * 4, [adj] * 4)
+        ref = simulate_batch(cfg, pb, ab, np.arange(4))
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane2")
+        inj = simulate_batch(cfg, pb, ab, np.arange(4))
+        health = np.asarray(inj.health)
+        assert health[2] == numerics.BIT_NONFINITE_TIME
+        assert (health[[0, 1, 3]] == 0).all()
+        # the poisoned lane froze at step 0: nothing emitted, no NaN ever
+        assert int(np.asarray(inj.n_events)[2]) == 0
+        assert not np.isnan(np.asarray(inj.times)).any()
+        # sibling lanes are bit-identical to the uninjected run
+        w = min(np.asarray(ref.times).shape[1], np.asarray(inj.times).shape[1])
+        for lane in (0, 1, 3):
+            np.testing.assert_array_equal(
+                np.asarray(ref.times)[lane, :w],
+                np.asarray(inj.times)[lane, :w])
+            np.testing.assert_array_equal(
+                np.asarray(ref.srcs)[lane, :w],
+                np.asarray(inj.srcs)[lane, :w])
+
+    def test_injected_inf_excitation_detected_on_fire(self, monkeypatch):
+        # inf mode poisons source 0's excitation, so source 0 must be the
+        # Hawkes row for the fault to be observable (exc is unread
+        # otherwise — see poison_lane's docstring).
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:inf@lane1")
+        gb = GraphBuilder(n_sinks=2, end_time=30.0)
+        gb.add_hawkes(l0=0.8, alpha=0.3, beta=1.0, sinks=[0])
+        gb.add_poisson(rate=1.0, sinks=[1])
+        cfg2, p2, a2 = gb.build(capacity=256)
+        pb2, ab2 = stack_components([p2] * 3, [a2] * 3)
+        inj = simulate_batch(cfg2, pb2, ab2, np.arange(3))
+        health = np.asarray(inj.health)
+        assert health[1] & numerics.BIT_NONFINITE_STATE
+        assert (health[[0, 2]] == 0).all()
+        assert not np.isnan(np.asarray(inj.times)).any()
+
+    def test_all_lanes_dead_raises_typed_error(self, monkeypatch):
+        cfg, params, adj = _component()
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane0")
+        with pytest.raises(NumericalHealthError) as ei:
+            simulate(cfg, params, adj, seed=0)
+        assert ei.value.reasons == {0: ["non-finite event time"]}
+        assert ei.value.health.shape == (1,)
+
+    def test_sick_lane_does_not_spin_chunk_loop(self, monkeypatch):
+        # A frozen lane must count as done, not loop to max_chunks.
+        cfg, params, adj = _component(capacity=32)
+        pb, ab = stack_components([params] * 2, [adj] * 2)
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane0")
+        log = simulate_batch(cfg, pb, ab, np.arange(2), max_chunks=50)
+        assert np.asarray(log.health)[0] != 0
+
+    def test_nonfinite_params_rejected_host_side(self):
+        cfg, params, adj = _component()
+        bad = params.replace(rate=params.rate.at[1].set(jnp.nan))
+        with pytest.raises(ValueError, match="SourceParams.rate"):
+            simulate(cfg, bad, adj, seed=0)
+        bad = params.replace(l0=params.l0.at[0].set(jnp.inf))
+        with pytest.raises(ValueError, match="SourceParams.l0"):
+            simulate(cfg, bad, adj, seed=0)
+        # +inf stays legal in the padding fields
+        ok = params.replace(rd_times=jnp.full_like(params.rd_times, jnp.inf))
+        simulate(cfg, ok, adj, seed=0)
+
+
+# ---------------------------------------------------------------------------
+# Sweep-level quarantine: record, re-run exactly the sick lanes, heal
+# ---------------------------------------------------------------------------
+
+def _q_points(q_grid, F=4, T=30.0, capacity=256):
+    pts = []
+    for q in q_grid:
+        gb = GraphBuilder(n_sinks=F, end_time=T)
+        gb.add_opt(q=q)
+        for i in range(F):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        pts.append(gb.build(capacity=capacity))
+    return pts
+
+
+def test_sweep_result_carries_health_grid():
+    res = run_sweep(_q_points([0.5, 2.0]), n_seeds=2)
+    assert res.health.shape == (2, 2)
+    assert res.health.dtype == np.uint32
+    assert not res.health.any()
+
+
+def test_checkpointed_sweep_quarantines_and_heals_sick_lane(
+        tmp_path, monkeypatch):
+    """THE acceptance scenario: numeric:nan in 1 lane of a 64-lane
+    checkpointed sweep (8 points x 8 seeds, chunks of 4 points)."""
+    pts = _q_points(list(np.linspace(0.3, 3.0, 8)))
+    d_ref = str(tmp_path / "ref")
+    d_inj = str(tmp_path / "inj")
+    want = run_sweep_checkpointed(pts, 8, d_ref, chunk_points=4)
+    assert not want.health.any()
+
+    # run 1, fault active: chunk 1's local lane 5 = global grid lane 37.
+    monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane5,chunk1")
+    got1 = run_sweep_checkpointed(pts, 8, d_inj, chunk_points=4)
+    monkeypatch.delenv(faultinject.ENV_FAULT)
+
+    h1 = got1.health.reshape(-1)
+    assert np.flatnonzero(h1).tolist() == [37]
+    mask = np.arange(64) != 37
+    for f in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, f)).reshape(-1)[mask],
+            np.asarray(getattr(got1, f)).reshape(-1)[mask],
+            err_msg=f)
+    # the sick lane is REPORTED in the enveloped chunk artifact
+    z = integrity.load_npz(os.path.join(d_inj, "chunk_00001.npz"),
+                           schema="rq.sweep.chunk/2")
+    assert np.flatnonzero(z["health"].reshape(-1)).tolist() == [5]
+
+    # run 2, fault cleared: EXACTLY the sick lane re-runs (one single-lane
+    # dispatch), and the healed grid is bit-identical to the uninjected run
+    calls = []
+    real = sweep_mod.run_sweep
+
+    def counting(p, n, **kw):
+        calls.append((len(p), n))
+        return real(p, n, **kw)
+
+    monkeypatch.setattr(sweep_mod, "run_sweep", counting)
+    got2 = run_sweep_checkpointed(pts, 8, d_inj, chunk_points=4)
+    assert calls == [(1, 1)]
+    for f in want._fields:
+        np.testing.assert_array_equal(getattr(want, f), getattr(got2, f),
+                                      err_msg=f)
+    # the healed artifact is durable: a third resume recomputes nothing
+    calls.clear()
+    got3 = run_sweep_checkpointed(pts, 8, d_inj, chunk_points=4)
+    assert calls == []
+    np.testing.assert_array_equal(got3.time_in_top_k, want.time_in_top_k)
+
+
+def test_checkpointed_sweep_heals_under_mesh(tmp_path, monkeypatch):
+    """The single-lane quarantine re-run must not inherit the sweep's
+    mesh (a 1-lane batch cannot shard, and does not need to: sharding is
+    placement-only and bit-identical)."""
+    from redqueen_tpu.parallel import comm
+
+    pts = _q_points([0.5, 1.0])
+    d = str(tmp_path / "ck")
+    mesh = comm.make_mesh({"data": 8})  # 2 points x 4 seeds = 8 lanes
+    want = run_sweep_checkpointed(pts, 4, str(tmp_path / "ref"),
+                                  chunk_points=2, mesh=mesh)
+    monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane1,chunk0")
+    run_sweep_checkpointed(pts, 4, d, chunk_points=2, mesh=mesh)
+    monkeypatch.delenv(faultinject.ENV_FAULT)
+    got = run_sweep_checkpointed(pts, 4, d, chunk_points=2, mesh=mesh)
+    assert not got.health.any()
+    for f in want._fields:
+        np.testing.assert_array_equal(getattr(want, f), getattr(got, f))
+
+
+def test_checkpointed_sweep_stale_schema_recomputes_without_quarantine(
+        tmp_path):
+    """A checksum-VALID chunk with an older schema tag (pre-upgrade
+    artifact) is STALE, not corrupt: it recomputes and overwrites with no
+    .corrupt-* rename and no quarantine report."""
+    pts = _q_points([0.5, 1.0])
+    d = str(tmp_path / "ck")
+    want = run_sweep_checkpointed(pts, 2, d, chunk_points=2)
+    path = os.path.join(d, "chunk_00000.npz")
+    # rewrite the artifact under the previous schema tag (valid checksum)
+    z = integrity.load_npz(path, schema="rq.sweep.chunk/2")
+    integrity.savez(path, schema="rq.sweep.chunk/1", **z)
+    got = run_sweep_checkpointed(pts, 2, d, chunk_points=2)
+    for f in want._fields:
+        np.testing.assert_array_equal(getattr(want, f), getattr(got, f))
+    leftovers = [n for n in os.listdir(d) if "corrupt" in n]
+    assert leftovers == [], leftovers
+    # and the overwrite upgraded the artifact to the current schema
+    integrity.load_npz(path, schema="rq.sweep.chunk/2")
+
+
+def test_checkpointed_sweep_keeps_bits_when_fault_persists(
+        tmp_path, monkeypatch):
+    """A lane that is STILL sick on re-run (deterministic corruption /
+    injection still active) keeps its recorded health bits — the sweep
+    completes, nothing silently heals."""
+    pts = _q_points([0.5, 1.0])
+    d = str(tmp_path / "ck")
+    monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane1,chunk0")
+    got = run_sweep_checkpointed(pts, 2, d, chunk_points=2)
+    assert got.health.reshape(-1)[1] != 0
+    # artifact still records the sick lane for the next resume
+    z = integrity.load_npz(os.path.join(d, "chunk_00000.npz"),
+                           schema="rq.sweep.chunk/2")
+    assert z["health"].reshape(-1)[1] != 0
+
+
+# ---------------------------------------------------------------------------
+# Validated boundaries (config.py builders)
+# ---------------------------------------------------------------------------
+
+class TestConfigValidation:
+    def test_poisson_domain(self):
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb.add_poisson(rate=0.0)  # zero stays legal (masked sources)
+        for bad in (np.nan, np.inf, -1.0):
+            with pytest.raises(ConfigValidationError, match="source 1"):
+                gb.add_poisson(rate=bad)
+
+    def test_hawkes_domain_and_stability(self):
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        with pytest.raises(ConfigValidationError, match="l0"):
+            gb.add_hawkes(l0=-0.1, alpha=0.1, beta=1.0)
+        with pytest.raises(ConfigValidationError, match="alpha"):
+            gb.add_hawkes(l0=0.1, alpha=np.nan, beta=1.0)
+        with pytest.raises(ConfigValidationError, match="beta"):
+            gb.add_hawkes(l0=0.1, alpha=0.1, beta=0.0)
+        with pytest.warns(UserWarning, match="supercritical"):
+            gb.add_hawkes(l0=0.1, alpha=2.0, beta=1.0)
+
+    def test_realdata_domain(self):
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb.add_realdata([1.0, 1.0, 2.0])  # ties allowed
+        with pytest.raises(ConfigValidationError, match="finite"):
+            gb.add_realdata([1.0, np.nan])
+        with pytest.raises(ConfigValidationError, match="non-decreasing"):
+            gb.add_realdata([3.0, 1.0])
+        with pytest.raises(ConfigValidationError, match="non-empty"):
+            gb.add_realdata([])
+
+    def test_opt_domain(self):
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        for bad in (0.0, -1.0, np.nan, np.inf):
+            with pytest.raises(ConfigValidationError, match="q"):
+                gb.add_opt(q=bad)
+
+    def test_piecewise_domain(self):
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        with pytest.raises(ConfigValidationError, match="source 0"):
+            gb.add_piecewise([0.0, np.inf], [1.0, 1.0])
+        with pytest.raises(ConfigValidationError, match="rates"):
+            gb.add_piecewise([0.0, 1.0], [1.0, -2.0])
+        with pytest.raises(ConfigValidationError, match="increasing"):
+            gb.add_piecewise([1.0, 0.5], [1.0, 1.0])
+
+    def test_builder_and_build_domain(self):
+        with pytest.raises(ConfigValidationError, match="end_time"):
+            GraphBuilder(n_sinks=1, end_time=np.nan)
+        with pytest.raises(ConfigValidationError, match="start_time"):
+            GraphBuilder(n_sinks=1, end_time=5.0, start_time=6.0)
+        with pytest.raises(ConfigValidationError, match="s_sink"):
+            GraphBuilder(n_sinks=2, end_time=5.0, s_sink=[1.0, -1.0])
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb.add_poisson(rate=1.0)
+        with pytest.raises(ConfigValidationError, match="capacity"):
+            gb.build(capacity=0)
+        with pytest.raises(ConfigValidationError, match="rmtpp_hidden"):
+            gb.build(capacity=64, rmtpp_hidden=0)
+
+    def test_star_builder_domain(self):
+        from redqueen_tpu.parallel.bigf import StarBuilder
+
+        with pytest.raises(ConfigValidationError, match="end_time"):
+            StarBuilder(n_feeds=1, end_time=np.inf)
+        sb = StarBuilder(n_feeds=2, end_time=10.0)
+        with pytest.raises(ConfigValidationError, match="source 1"):
+            sb.wall_poisson(1, -1.0)
+        with pytest.raises(ConfigValidationError, match="beta"):
+            sb.wall_hawkes(0, l0=1.0, alpha=0.1, beta=np.nan)
+        with pytest.raises(ConfigValidationError, match="finite"):
+            sb.wall_replay(0, [1.0, np.inf])
+        with pytest.raises(ConfigValidationError, match="q"):
+            sb.ctrl_opt(q=np.nan)
+        with pytest.raises(ConfigValidationError, match="Poisson rate"):
+            sb.ctrl_poisson(rate=np.nan)
+        with pytest.raises(ConfigValidationError, match="finite"):
+            sb.ctrl_replay([np.nan])
+        sb.wall_replay(0, [])  # empty replay stays legal (corpus path)
+
+    def test_error_carries_component_index(self):
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb.add_poisson(rate=1.0)
+        gb.add_poisson(rate=1.0)
+        try:
+            gb.add_hawkes(l0=np.nan, alpha=0.1, beta=1.0)
+        except ConfigValidationError as e:
+            assert e.component == 2
+        else:
+            pytest.fail("no error raised")
+
+
+# ---------------------------------------------------------------------------
+# Deterministic extreme-but-valid sweeps (the hypothesis suite's anchor
+# cases, runnable without the dependency)
+# ---------------------------------------------------------------------------
+
+class TestExtremeButValid:
+    @pytest.mark.parametrize("rate", [1e-8, 1e-3, 1.0, 1e3, 1e6])
+    def test_extreme_poisson_rates_never_nan(self, rate):
+        gb = GraphBuilder(n_sinks=1, end_time=1.0)
+        gb.add_poisson(rate=rate)
+        cfg, params, adj = gb.build(capacity=64)
+        log = simulate(cfg, params, adj, seed=0, max_events=64)
+        times = np.asarray(log.times)
+        assert not np.isnan(times).any()
+        assert int(np.asarray(log.health)) == 0
+        valid = times[np.asarray(log.srcs) >= 0]
+        assert (valid >= 0).all() and (valid <= 1.0).all()
+
+    @pytest.mark.parametrize("l0,alpha,beta", [
+        (1e-8, 0.0, 1e-6), (1e4, 0.5, 1e-3), (0.5, 0.99, 1.0),
+        (1e-3, 1e3, 1e6), (1e6, 0.0, 1e6),
+    ])
+    def test_extreme_hawkes_params_finite_or_inf(self, l0, alpha, beta):
+        t, ok = hawkes_next_time(jr.PRNGKey(11), 0.0, l0, alpha, beta,
+                                 0.0, 0.0, 100.0, max_proposals=10_000,
+                                 return_ok=True)
+        t = float(t)
+        assert not np.isnan(t)
+        assert t >= 0.0 or np.isposinf(t)
+
+    def test_horizon_near_float32_ulp(self):
+        t0 = np.float32(1000.0)
+        t1 = float(np.nextafter(t0, np.float32(np.inf)))
+        gb = GraphBuilder(n_sinks=1, end_time=t1, start_time=float(t0))
+        gb.add_poisson(rate=1e6)
+        cfg, params, adj = gb.build(capacity=32)
+        log = simulate(cfg, params, adj, seed=0, max_events=32)
+        assert not np.isnan(np.asarray(log.times)).any()
+        assert int(np.asarray(log.health)) == 0
+
+    def test_bound_scale_at_dtype_limit_quarantined_not_spinning(self):
+        # At f32 limits the inflated bound overflows to +inf; the lane
+        # must come back flagged (sampler failure), never hang or NaN.
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        gb.add_hawkes(l0=1.0, alpha=0.5, beta=1.0)
+        cfg, params, adj = gb.build(capacity=64)
+        # direct sampler call at the limit (the builder path cannot set
+        # bound_scale; the kernel default is 1.0)
+        t, ok = hawkes_next_time(jr.PRNGKey(5), 0.0, 1.0, 0.5, 1.0,
+                                 jnp.float32(0.0), 0.0, jnp.inf,
+                                 bound_scale=3.0e38, max_proposals=4096,
+                                 return_ok=True)
+        assert not np.isnan(float(t))
+        assert not bool(ok)
+
+
+# ---------------------------------------------------------------------------
+# Static pass (tools/check_resilience.py third pass)
+# ---------------------------------------------------------------------------
+
+def test_numerics_ast_pass_flags_raw_ops(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    try:
+        import check_resilience as cr
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad_kernel.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(x, y):\n"
+        "    a = jnp.exp(x)\n"
+        "    b = jnp.log(y)\n"
+        "    c = x / y\n"
+        "    d = x / 2**20\n"
+        "    e = x / jnp.maximum(y, 1e-30)\n"
+        "    g = x // y\n"
+        "    return a + b + c + d + e + g\n"
+    )
+    sites = cr.analyze_numerics(str(bad))
+    assert [line for line, _ in sites] == [3, 4, 5]
+    kinds = [what for _, what in sites]
+    assert "safe_exp" in kinds[0] and "safe_log" in kinds[1]
+    assert "safe_div" in kinds[2]
+
+
+def test_repo_ops_tree_is_clean():
+    import glob as _glob
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    try:
+        import check_resilience as cr
+    finally:
+        sys.path.pop(0)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = sorted(_glob.glob(os.path.join(repo, cr.OPS_GLOB)))
+    assert files, "ops tree moved? update check_resilience.OPS_GLOB"
+    dirty = {os.path.basename(p): cr.analyze_numerics(p) for p in files}
+    dirty = {k: v for k, v in dirty.items() if v}
+    assert not dirty, f"raw numerics crept back into ops/: {dirty}"

@@ -1,0 +1,130 @@
+"""Hypothesis properties for the in-computation numerics guard: over
+EXTREME-but-valid traced parameters (tiny/huge rates, bound_scale pushed to
+the f32 limit, horizons near the float32 ulp), every sampler and every
+simulation either yields finite in-window times, a clean +inf ("never
+fires"), or a flagged quarantine — NEVER a NaN in an ``EventLog``.
+
+Same design constraint as tests/test_properties.py: static config fields
+are fixed per test so every hypothesis example reuses one compiled kernel;
+hypothesis varies only traced parameters and seeds.  The deterministic
+anchor cases live in tests/test_numerics.py (TestExtremeButValid) so the
+minimal container still covers them when hypothesis is absent.
+"""
+
+import numpy as np
+import pytest
+
+# Without the dependency the whole module skips AT COLLECTION (a skip, not
+# an error — tier-1 must collect clean on minimal containers).
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import random as jr  # noqa: E402
+
+from redqueen_tpu.config import GraphBuilder  # noqa: E402
+from redqueen_tpu.ops.sampling import (  # noqa: E402
+    hawkes_next_time,
+    piecewise_next_time,
+    rmtpp_next_delta,
+)
+from redqueen_tpu.sim import simulate  # noqa: E402
+
+# Extreme-but-valid domains: spanning ~14 orders of magnitude, everything
+# host-validation would accept.
+tiny_huge_rate = st.floats(1e-8, 1e6, allow_nan=False, allow_infinity=False)
+l0_st = st.floats(0.0, 1e6, allow_nan=False, allow_infinity=False)
+alpha_st = st.floats(0.0, 1e3, allow_nan=False, allow_infinity=False)
+beta_st = st.floats(1e-6, 1e6, allow_nan=False, allow_infinity=False)
+# >= 1 by contract; 3e38 overflows the f32 bound to +inf — the proposal
+# cap must then return a flagged +inf instead of spinning.
+scale_st = st.floats(1.0, 3.0e38, allow_nan=False, allow_infinity=False)
+seed_st = st.integers(0, 2**31 - 1)
+
+
+@settings(max_examples=40, deadline=None)
+@given(l0=l0_st, alpha=alpha_st, beta=beta_st, scale=scale_st, seed=seed_st)
+def test_hawkes_next_time_never_nan(l0, alpha, beta, scale, seed):
+    t, ok = hawkes_next_time(
+        jr.PRNGKey(seed), 0.0, l0, alpha, beta, 0.0, 0.0, 1e6,
+        bound_scale=scale, max_proposals=10_000, return_ok=True,
+    )
+    t = float(t)
+    assert not np.isnan(t)
+    assert t >= 0.0 or np.isposinf(t)
+    # a clean sample must be in-window; a failure must be +inf
+    if not bool(ok):
+        assert np.isposinf(t)
+
+
+@settings(max_examples=40, deadline=None)
+@given(r1=tiny_huge_rate, r2=tiny_huge_rate, t_from=st.floats(
+    0.0, 100.0, allow_nan=False, allow_infinity=False), seed=seed_st)
+def test_piecewise_next_time_never_nan(r1, r2, t_from, seed):
+    t = piecewise_next_time(
+        jr.PRNGKey(seed), jnp.float32(t_from),
+        jnp.asarray([0.0, 50.0], jnp.float32),
+        jnp.asarray([r1, r2], jnp.float32),
+    )
+    t = float(t)
+    assert not np.isnan(t)
+    assert t >= t_from or np.isposinf(t)
+
+
+@settings(max_examples=40, deadline=None)
+@given(a=st.floats(-50.0, 50.0, allow_nan=False, allow_infinity=False),
+       w=st.floats(-10.0, 10.0, allow_nan=False, allow_infinity=False),
+       seed=seed_st)
+def test_rmtpp_next_delta_never_nan(a, w, seed):
+    tau = float(rmtpp_next_delta(jr.PRNGKey(seed), jnp.float32(a),
+                                 jnp.float32(w)))
+    assert not np.isnan(tau)
+    assert tau >= 0.0 or np.isposinf(tau)
+
+
+@settings(max_examples=20, deadline=None)
+@given(rate=tiny_huge_rate, seed=seed_st)
+def test_eventlog_never_nan_extreme_rates(rate, seed):
+    gb = GraphBuilder(n_sinks=1, end_time=1.0)
+    gb.add_poisson(rate=rate)
+    cfg, params, adj = gb.build(capacity=64)
+    log = simulate(cfg, params, adj, seed=seed, max_events=64)
+    times = np.asarray(log.times)
+    assert not np.isnan(times).any()
+    assert int(np.asarray(log.health)) == 0
+    valid = times[np.asarray(log.srcs) >= 0]
+    assert ((valid >= 0) & (valid <= 1.0)).all()
+
+
+@settings(max_examples=15, deadline=None)
+@given(l0=st.floats(0.01, 10.0, allow_nan=False, allow_infinity=False),
+       frac=st.floats(0.0, 0.99, allow_nan=False, allow_infinity=False),
+       beta=st.floats(0.1, 10.0, allow_nan=False, allow_infinity=False),
+       seed=seed_st)
+def test_eventlog_never_nan_hawkes_subcritical(l0, frac, beta, seed):
+    gb = GraphBuilder(n_sinks=1, end_time=20.0)
+    gb.add_hawkes(l0=l0, alpha=frac * beta, beta=beta)
+    cfg, params, adj = gb.build(capacity=256)
+    log = simulate(cfg, params, adj, seed=seed, max_events=256)
+    assert not np.isnan(np.asarray(log.times)).any()
+    assert int(np.asarray(log.health)) == 0
+
+
+@settings(max_examples=15, deadline=None)
+@given(ulps=st.integers(1, 64), rate=tiny_huge_rate, seed=seed_st)
+def test_horizon_near_float32_ulp(ulps, rate, seed):
+    """A window only a few float32 ulps wide must still produce a clean
+    (usually empty) log — never a NaN, never a stuck loop."""
+    t0 = np.float32(1000.0)
+    t1 = t0
+    for _ in range(ulps):
+        t1 = np.nextafter(t1, np.float32(np.inf))
+    gb = GraphBuilder(n_sinks=1, end_time=float(t1), start_time=float(t0))
+    gb.add_poisson(rate=rate)
+    cfg, params, adj = gb.build(capacity=32)
+    log = simulate(cfg, params, adj, seed=seed, max_events=32)
+    times = np.asarray(log.times)
+    assert not np.isnan(times).any()
+    assert int(np.asarray(log.health)) == 0
+    valid = times[np.asarray(log.srcs) >= 0]
+    assert ((valid >= float(t0)) & (valid <= float(t1))).all()

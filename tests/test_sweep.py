@@ -247,7 +247,7 @@ def test_checkpointed_sweep_quarantines_corrupt_chunk_and_reruns(
                               schema="rq.quarantine-report/1")
     assert rep["quarantined_to"].endswith(q[0])
     # the rewritten chunk verifies again
-    integrity.load_npz(victim, schema="rq.sweep.chunk/1")
+    integrity.load_npz(victim, schema="rq.sweep.chunk/2")
 
 
 def test_checkpointed_sweep_rejects_empty_points(tmp_path):

@@ -31,6 +31,21 @@ constant write mode ("w"/"wb"/"x"...; appends are fine — logs are
 append-only by design) is a violation, per call site, no whitelist:
 migrate the write, don't excuse it.
 
+Third pass (the in-computation numerics PR): kernel code under
+``redqueen_tpu/ops/`` must not use raw ``jnp.exp`` / ``jnp.log`` or raw
+``/``-division on data values — the guarded primitives in
+``redqueen_tpu.runtime.numerics`` (``safe_exp`` / ``safe_log`` /
+``safe_div``; bit-identical on healthy inputs) are the sanctioned route,
+because a raw exp/log/division on an unvalidated parameter is exactly
+how a degenerate sweep point manufactures the NaN the lane-health layer
+then has to quarantine.  A division is exempt only when its denominator
+is statically safe: a non-zero numeric constant expression, or a
+``maximum(...)``-clamped value.  ``log1p`` is deliberately NOT in the
+raw set: its remaining ops/ call sites consume panel/threefry uniforms
+that are < 1 by construction (so ``-u > -1`` structurally), while the
+two sampler sites whose argument domain is model-dependent route
+through ``safe_log1p`` voluntarily (see ops/sampling.py).
+
 Exits nonzero listing every violation; run via ``tools/ci.sh``.
 """
 
@@ -109,6 +124,76 @@ def _raw_write(call: ast.Call) -> str:
     return ""
 
 
+# --- third pass: raw numerics in kernel code (redqueen_tpu/ops/) ----------
+
+OPS_GLOB = os.path.join("redqueen_tpu", "ops", "*.py")
+
+# Raw calls that must go through runtime.numerics' guarded twins.
+RAW_NUMERIC_CALLS = {
+    ("jnp", "exp"): "jnp.exp — use runtime.numerics.safe_exp",
+    ("jnp", "log"): "jnp.log — use runtime.numerics.safe_log",
+    ("np", "exp"): "np.exp — use runtime.numerics.safe_exp",
+    ("np", "log"): "np.log — use runtime.numerics.safe_log",
+}
+
+# maximum(x, eps)-style clamps make a denominator statically safe.
+SAFE_DEN_CALLS = {"maximum", "max"}
+
+
+def _static_number(node: ast.AST):
+    """Value of a constants-only numeric expression (e.g. ``2**20``),
+    else None."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, (ast.BinOp, ast.UnaryOp, ast.Constant,
+                                ast.operator, ast.unaryop)):
+            return None
+        if isinstance(sub, ast.Constant) and not isinstance(
+                sub.value, (int, float)):
+            return None
+    try:
+        return eval(  # noqa: S307 — constants-only, verified above
+            compile(ast.Expression(body=node), "<den>", "eval"))
+    except Exception:
+        return None
+
+
+def _division_ok(den: ast.AST) -> bool:
+    """A denominator is statically safe when it cannot be zero/NaN by
+    construction: a non-zero constant expression, or a value clamped
+    through ``maximum(...)``."""
+    n = _static_number(den)
+    if n is not None:
+        return n != 0
+    if isinstance(den, ast.Call):
+        chain = _attr_chain(den.func)
+        return bool(chain) and chain[-1] in SAFE_DEN_CALLS
+    return False
+
+
+def analyze_numerics(path: str):
+    """Raw-numerics call sites in one kernel file: (line, what) per raw
+    ``jnp.exp``/``jnp.log`` call and per ``/``-division whose denominator
+    is not statically safe."""
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [(0, f"SYNTAX ERROR: {e}")]
+    sites: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain in RAW_NUMERIC_CALLS:
+                sites.append((node.lineno, RAW_NUMERIC_CALLS[chain]))
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div)
+                and not _division_ok(node.right)):
+            sites.append((
+                node.lineno,
+                "raw /-division — use runtime.numerics.safe_div (or clamp "
+                "the denominator with maximum(...))"))
+    return sites
+
+
 def analyze(path: str):
     """Returns (touches, guarded, raw_writes) — backend-touch sites,
     whether the file references a sanctioned guard or pins CPU, and every
@@ -158,6 +243,13 @@ def main() -> int:
             for line, what in raw_writes:
                 violations.append(f"{rel}:{line}: raw artifact write — "
                                   f"{what}")
+    n_ops = 0
+    for path in sorted(glob.glob(os.path.join(REPO, OPS_GLOB))):
+        rel = os.path.relpath(path, REPO)
+        n_ops += 1
+        for line, what in analyze_numerics(path):
+            violations.append(f"{rel}:{line}: raw numerics in kernel code "
+                              f"— {what}")
     if violations:
         print("resilience check FAILED:\n  " + "\n  ".join(violations))
         print("\nroute backend access through redqueen_tpu.runtime "
@@ -165,10 +257,15 @@ def main() -> int:
               "via jax.config.update('jax_platforms', 'cpu') first; "
               "route artifact writes through runtime.artifacts / "
               "runtime.integrity (atomic rename + checksummed envelope) "
-              "so a kill-9 can never tear what the next run reads.")
+              "so a kill-9 can never tear what the next run reads; "
+              "route kernel exp/log/division through "
+              "runtime.numerics.safe_exp/safe_log/safe_div so a "
+              "degenerate parameter becomes a quarantined lane, not a "
+              "silent NaN.")
         return 1
     print(f"resilience check OK: {scanned} entry-point files scanned, "
-          f"0 unguarded backend touches, 0 raw artifact writes")
+          f"0 unguarded backend touches, 0 raw artifact writes; "
+          f"{n_ops} kernel files scanned, 0 raw numerics sites")
     return 0
 
 

@@ -9,13 +9,17 @@ cd "$(dirname "$0")/.."
 echo "== resilience static pass =="
 python tools/check_resilience.py
 
-echo "== integrity / self-healing fault-injection pass =="
+echo "== integrity / self-healing / numerics fault-injection pass =="
 # Deliberately ALSO collected by tier-1 below (~40s double cost): this
-# pass fast-fails the corruption/self-healing contracts before the long
-# suite, while tier-1 stays byte-exact with the ROADMAP verify command.
+# pass fast-fails the corruption/self-healing/lane-quarantine contracts
+# before the long suite, while tier-1 stays byte-exact with the ROADMAP
+# verify command.  test_numerics.py carries the numeric:nan lane-
+# quarantine acceptance scenario (inject -> freeze -> record -> re-run
+# exactly the sick lane, bit-identically) on CPU.
 env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
-    tests/test_watchdog.py tests/test_watcher.py -q \
-    -p no:cacheprovider -p no:xdist -p no:randomly
+    tests/test_watchdog.py tests/test_watcher.py tests/test_numerics.py \
+    tests/test_numerics_properties.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log
