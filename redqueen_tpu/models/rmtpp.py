@@ -232,7 +232,12 @@ def fit_traces(key, traces, hidden: int = 16, steps: int = 300,
     if hold.all() or not hold.any():
         raise ValueError(f"degenerate holdout split for {len(traces)} users")
     w0 = init_weights(key, hidden)
-    weights, _, losses = fit(key, taus[~hold], mask[~hold], hidden=hidden,
+    # Distinct key for fit: with weights=w0 the training path never draws
+    # from it (full-batch Adam is deterministic), so this is bit-identical
+    # today — but passing an already-consumed key into an API that CAN
+    # consume it is exactly the correlated-stream hazard RQ501 exists for.
+    weights, _, losses = fit(jax.random.fold_in(key, 1), taus[~hold],
+                             mask[~hold], hidden=hidden,
                              steps=steps, lr=lr, weights=w0)
     info = {
         "heldout_nll": _per_event_nll(weights, taus[hold], mask[hold], hidden),

@@ -102,7 +102,9 @@ def scan_step_traffic_bytes(cfg, params, adj) -> int:
     batched = getattr(params.kind, "ndim", 1) == 2
 
     def init(p, a):
-        key = jr.PRNGKey(0)
+        # Runs only under jax.eval_shape below: the key's VALUE is never
+        # materialized, only its shape/dtype — any constant works.
+        key = jr.PRNGKey(0)  # rqlint: disable=RQ502
         if batched:
             keys = jax.vmap(jr.PRNGKey)(
                 np.zeros((p.kind.shape[0],), np.int32))

@@ -1,239 +1,101 @@
 #!/usr/bin/env python
-"""Static resilience pass: no entry point may touch the default backend
-unguarded, and no entry point may write an artifact raw.
+"""Legacy entry point for the resilience static passes — now a thin shim
+over ``tools/rqlint`` (the pluggable static-analysis framework).
 
-A wedged axon TPU tunnel HANGS ``jax.devices()`` / backend init forever
-rather than raising (the round-1 rc=124 failure), so every entry point
-under ``tools/``, ``benchmarks/``, ``experiments/``, and the repo root
-must reach the backend through the resilience runtime's deadline-bounded
-guards — or pin
-itself to CPU, which cannot hang — BEFORE any in-process backend touch.
+The three passes that used to live here as one monolith are rqlint rules
+with stable IDs, one AST parse per file, per-rule tests, and pragma /
+baseline support:
 
-The check is AST-based (docstrings/comments don't count) and file-level:
+- pass 1 (unguarded backend touches)  -> ``RQ101`` (rules/resilience.py)
+- pass 2 (raw artifact writes)        -> ``RQ201`` (rules/artifacts.py)
+- pass 3 (raw kernel numerics)        -> ``RQ301`` (rules/numerics.py)
 
-- a file VIOLATES when it calls ``jax.devices(...)`` or
-  ``jax.distributed.initialize(...)`` without referencing any sanctioned
-  guard (``ensure_backend`` / ``ensure_live_backend`` /
-  ``backend_alive`` / ``default_backend_alive`` / ``probe_backend`` /
-  ``probe_default_backend``) and without force-pinning the CPU platform
-  (``jax.config.update("jax_platforms", "cpu")``).
-- the runtime layer itself (``redqueen_tpu/``) is exempt: it IS the
-  guard implementation.
+This shim keeps the original contract EXACTLY for external callers and
+CI transitions: same CLI (``python tools/check_resilience.py``), same
+exit codes (0 clean / 1 violations), same violation text (prefix
+``resilience check FAILED:``), and the same module API
+(:func:`analyze`, :func:`analyze_numerics`, ``OPS_GLOB``,
+``SCAN_GLOBS``, ``GUARD_NAMES``) — the implementations now import from
+the rqlint rules, so shim and framework cannot drift.  It deliberately
+does NOT apply pragmas or the baseline: its verdict is the raw-rule
+verdict, bit-compatible with the pre-rqlint monolith.
 
-Second pass (the integrity PR): every ARTIFACT an entry point writes
-must go through ``redqueen_tpu.runtime`` — the atomic writers
-(``atomic_write_json`` / ``atomic_write_text`` / ``atomic_savez``) or
-the enveloped ones (``integrity.write_json`` / ``integrity.savez``) —
-because a raw ``json.dump(obj, f)`` or ``open(path, "w")`` torn by a
-kill-9 is exactly the corruption the integrity layer exists to keep out
-of the read path.  Any ``json.dump`` call and any ``open`` with a
-constant write mode ("w"/"wb"/"x"...; appends are fine — logs are
-append-only by design) is a violation, per call site, no whitelist:
-migrate the write, don't excuse it.
-
-Third pass (the in-computation numerics PR): kernel code under
-``redqueen_tpu/ops/`` must not use raw ``jnp.exp`` / ``jnp.log`` or raw
-``/``-division on data values — the guarded primitives in
-``redqueen_tpu.runtime.numerics`` (``safe_exp`` / ``safe_log`` /
-``safe_div``; bit-identical on healthy inputs) are the sanctioned route,
-because a raw exp/log/division on an unvalidated parameter is exactly
-how a degenerate sweep point manufactures the NaN the lane-health layer
-then has to quarantine.  A division is exempt only when its denominator
-is statically safe: a non-zero numeric constant expression, or a
-``maximum(...)``-clamped value.  ``log1p`` is deliberately NOT in the
-raw set: its remaining ops/ call sites consume panel/threefry uniforms
-that are < 1 by construction (so ``-u > -1`` structurally), while the
-two sampler sites whose argument domain is model-dependent route
-through ``safe_log1p`` voluntarily (see ops/sampling.py).
-
-Exits nonzero listing every violation; run via ``tools/ci.sh``.
+Prefer ``python -m tools.rqlint`` for new wiring: it runs these three
+rules plus the RQ4xx/RQ5xx/RQ6xx hazard classes, and writes the JSON
+findings artifact.
 """
 
 from __future__ import annotations
 
-import ast
 import glob
 import os
 import sys
 from typing import List, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from rqlint.rules.artifacts import raw_write_sites  # noqa: E402
+from rqlint.rules.numerics import numeric_sites  # noqa: E402
+from rqlint.rules.resilience import (  # noqa: E402,F401 (GUARD_NAMES is API)
+    BACKEND_TOUCHES,
+    GUARD_NAMES,
+    backend_analysis,
+)
+
+REPO = os.path.dirname(_TOOLS)
 
 SCAN_GLOBS = ("*.py", os.path.join("tools", "*.py"),
               os.path.join("benchmarks", "*.py"),
               os.path.join("experiments", "*.py"))
 
-GUARD_NAMES = {
-    "ensure_backend", "ensure_live_backend",
-    "backend_alive", "default_backend_alive",
-    "probe_backend", "probe_default_backend",
-}
-
-BACKEND_TOUCHES = {
-    ("jax", "devices"): "jax.devices()",
-    ("jax", "distributed", "initialize"): "jax.distributed.initialize()",
-}
-
-
-def _attr_chain(node: ast.AST) -> Tuple[str, ...]:
-    """``jax.distributed.initialize`` -> ("jax", "distributed",
-    "initialize"); empty tuple when the base is not a plain Name."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return ()
-
-
-def _is_cpu_pin(call: ast.Call) -> bool:
-    """``<anything>.config.update("jax_platforms", "cpu")`` (or the env
-    assignment styles are irrelevant — the config API is the one that
-    sticks against the axon plugin)."""
-    chain = _attr_chain(call.func)
-    if len(chain) < 2 or chain[-1] != "update" or chain[-2] != "config":
-        return False
-    consts = [a.value for a in call.args
-              if isinstance(a, ast.Constant) and isinstance(a.value, str)]
-    return "jax_platforms" in consts and "cpu" in consts
-
-
-def _raw_write(call: ast.Call) -> str:
-    """Nonempty description when ``call`` is a raw artifact write: a
-    ``json.dump`` (the 2-arg into-a-file form — ``dumps`` to stdout is
-    the child JSON-line protocol, not a file) or an ``open`` whose
-    constant mode creates/overwrites ("w"/"wb"/"x"...).  Appends ("a")
-    stay legal: probe logs are append-only by design."""
-    chain = _attr_chain(call.func)
-    if chain == ("json", "dump"):
-        return 'json.dump(...) — use runtime.atomic_write_json / ' \
-               'runtime.integrity.write_json'
-    if chain == ("open",) or chain == ("io", "open"):
-        mode = None
-        if len(call.args) >= 2:
-            mode = call.args[1]
-        for kwarg in call.keywords:
-            if kwarg.arg == "mode":
-                mode = kwarg.value
-        if (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
-                and any(c in mode.value for c in "wx")):
-            return (f'open(..., "{mode.value}") — use the runtime '
-                    f'artifact writers (atomic temp + rename)')
-    return ""
-
-
-# --- third pass: raw numerics in kernel code (redqueen_tpu/ops/) ----------
-
 OPS_GLOB = os.path.join("redqueen_tpu", "ops", "*.py")
 
-# Raw calls that must go through runtime.numerics' guarded twins.
-RAW_NUMERIC_CALLS = {
-    ("jnp", "exp"): "jnp.exp — use runtime.numerics.safe_exp",
-    ("jnp", "log"): "jnp.log — use runtime.numerics.safe_log",
-    ("np", "exp"): "np.exp — use runtime.numerics.safe_exp",
-    ("np", "log"): "np.log — use runtime.numerics.safe_log",
-}
 
-# maximum(x, eps)-style clamps make a denominator statically safe.
-SAFE_DEN_CALLS = {"maximum", "max"}
+def _parse(path: str):
+    """(tree, error) — never raises on bad source."""
+    import ast
 
-
-def _static_number(node: ast.AST):
-    """Value of a constants-only numeric expression (e.g. ``2**20``),
-    else None."""
-    for sub in ast.walk(node):
-        if not isinstance(sub, (ast.BinOp, ast.UnaryOp, ast.Constant,
-                                ast.operator, ast.unaryop)):
-            return None
-        if isinstance(sub, ast.Constant) and not isinstance(
-                sub.value, (int, float)):
-            return None
-    try:
-        return eval(  # noqa: S307 — constants-only, verified above
-            compile(ast.Expression(body=node), "<den>", "eval"))
-    except Exception:
-        return None
+    with open(path) as f:
+        try:
+            return ast.parse(f.read(), filename=path), None
+        except SyntaxError as e:
+            return None, e
 
 
-def _division_ok(den: ast.AST) -> bool:
-    """A denominator is statically safe when it cannot be zero/NaN by
-    construction: a non-zero constant expression, or a value clamped
-    through ``maximum(...)``."""
-    n = _static_number(den)
-    if n is not None:
-        return n != 0
-    if isinstance(den, ast.Call):
-        chain = _attr_chain(den.func)
-        return bool(chain) and chain[-1] in SAFE_DEN_CALLS
-    return False
+def analyze(path: str):
+    """Returns (touches, guarded, raw_writes) — backend-touch sites as
+    (line, what), whether the file references a sanctioned guard or pins
+    CPU, and every raw artifact-write call site.  Same contract as the
+    pre-rqlint monolith; implementation = rqlint rules RQ101 + RQ201."""
+    tree, err = _parse(path)
+    if tree is None:
+        return [(0, f"SYNTAX ERROR: {err}")], False, []
+    touches3, guarded = backend_analysis(tree)
+    touches = [(line, what) for line, _col, what in touches3]
+    raw_writes = [(line, what) for line, _col, what in raw_write_sites(tree)]
+    return touches, guarded, raw_writes
 
 
 def analyze_numerics(path: str):
     """Raw-numerics call sites in one kernel file: (line, what) per raw
     ``jnp.exp``/``jnp.log`` call and per ``/``-division whose denominator
-    is not statically safe."""
-    with open(path) as f:
-        try:
-            tree = ast.parse(f.read(), filename=path)
-        except SyntaxError as e:
-            return [(0, f"SYNTAX ERROR: {e}")]
-    sites: List[Tuple[int, str]] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            if chain in RAW_NUMERIC_CALLS:
-                sites.append((node.lineno, RAW_NUMERIC_CALLS[chain]))
-        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div)
-                and not _division_ok(node.right)):
-            sites.append((
-                node.lineno,
-                "raw /-division — use runtime.numerics.safe_div (or clamp "
-                "the denominator with maximum(...))"))
-    return sites
-
-
-def analyze(path: str):
-    """Returns (touches, guarded, raw_writes) — backend-touch sites,
-    whether the file references a sanctioned guard or pins CPU, and every
-    raw artifact-write call site."""
-    with open(path) as f:
-        try:
-            tree = ast.parse(f.read(), filename=path)
-        except SyntaxError as e:
-            return [(0, f"SYNTAX ERROR: {e}")], False, []
-    touches: List[Tuple[int, str]] = []
-    raw_writes: List[Tuple[int, str]] = []
-    guarded = False
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            if chain in BACKEND_TOUCHES:
-                touches.append((node.lineno, BACKEND_TOUCHES[chain]))
-            if _is_cpu_pin(node):
-                guarded = True
-            what = _raw_write(node)
-            if what:
-                raw_writes.append((node.lineno, what))
-        if isinstance(node, ast.Name) and node.id in GUARD_NAMES:
-            guarded = True
-        if isinstance(node, ast.Attribute) and node.attr in GUARD_NAMES:
-            guarded = True
-        if (isinstance(node, ast.alias)
-                and node.name.split(".")[-1] in GUARD_NAMES):
-            guarded = True
-    return touches, guarded, raw_writes
+    is not statically safe.  Implementation = rqlint rule RQ301."""
+    tree, err = _parse(path)
+    if tree is None:
+        return [(0, f"SYNTAX ERROR: {err}")]
+    return [(line, what) for line, _col, what in numeric_sites(tree)]
 
 
 def main() -> int:
-    violations = []
+    violations: List[str] = []
     scanned = 0
     for pattern in SCAN_GLOBS:
         for path in sorted(glob.glob(os.path.join(REPO, pattern))):
             rel = os.path.relpath(path, REPO)
             if rel == os.path.join("tools", "check_resilience.py"):
-                continue  # mentions of the names above are its own data
+                continue  # mentions of the guard names are its own data
             scanned += 1
             touches, guarded, raw_writes = analyze(path)
             if touches and not guarded:

@@ -1,12 +1,22 @@
 #!/usr/bin/env bash
-# One-command CI gate: the resilience static pass, the integrity/watchdog
-# fault-injection pass (every corruption-detection / quarantine /
-# fallback / self-healing path, deterministically on CPU), then the
-# tier-1 suite (the exact ROADMAP verify command).  Usage: bash tools/ci.sh
+# One-command CI gate: the rqlint static-analysis pass (all rule bands —
+# resilience/artifacts/numerics/trace-safety/PRNG/bench-honesty — with
+# the JSON findings artifact), the integrity/watchdog fault-injection
+# pass (every corruption-detection / quarantine / fallback /
+# self-healing path, deterministically on CPU), then the tier-1 suite
+# (the exact ROADMAP verify command).  Usage: bash tools/ci.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== resilience static pass =="
+echo "== rqlint static pass =="
+# First gate: jax-free, so it fails fast before any backend is touched.
+# RQLINT_FINDINGS.json is the uploaded findings artifact (atomic write;
+# schema rq.rqlint.findings/1 — see docs/API.md).
+python -m tools.rqlint --json RQLINT_FINDINGS.json
+
+echo "== resilience shim (legacy contract) =="
+# The delegating shim must keep the pre-rqlint CLI/exit-code contract
+# for external callers — run it too so a drift fails CI, not a caller.
 python tools/check_resilience.py
 
 echo "== integrity / self-healing / numerics fault-injection pass =="
@@ -18,7 +28,7 @@ echo "== integrity / self-healing / numerics fault-injection pass =="
 # exactly the sick lane, bit-identically) on CPU.
 env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
     tests/test_watchdog.py tests/test_watcher.py tests/test_numerics.py \
-    tests/test_numerics_properties.py \
+    tests/test_numerics_properties.py tests/test_rqlint.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tier-1 suite =="

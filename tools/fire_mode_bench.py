@@ -41,6 +41,7 @@ REPS = 3
 
 
 def bench_cell(label, B, F, T, wall_cap, post_cap, mode):
+    import jax
     import numpy as np
 
     from redqueen_tpu.parallel.bigf import (
@@ -61,6 +62,9 @@ def bench_cell(label, B, F, T, wall_cap, post_cap, mode):
         t0 = time.perf_counter()
         r = simulate_star_batch(cfg, wb, cb, np.arange(B) + B,
                                 fire_mode=mode)
+        # simulate_star_batch blocks internally; restate it in the timed
+        # region so the measurement doesn't lean on a callee detail.
+        jax.block_until_ready(r.wall_n)
         best = min(best, time.perf_counter() - t0)
     events = int(r.wall_n.sum()) + int(r.n_posts.sum())
     return {"label": label, "mode": mode, "secs": round(best, 4),

@@ -1,0 +1,33 @@
+"""rqlint: the repo's pluggable JAX/TPU static-analysis framework.
+
+One AST parse per file, every rule run against the shared tree, precise
+line/col spans, inline ``# rqlint: disable=RQnnn`` pragmas, a checked-in
+baseline so new rules can land warn-first, and human + JSON output.
+
+Rule ID bands (see ``rqlint.rules``):
+
+- ``RQ000``  engine: unparseable file (reported, never a crash)
+- ``RQ1xx``  resilience (unguarded backend touches)
+- ``RQ2xx``  artifacts (raw, tearable artifact writes)
+- ``RQ3xx``  numerics (raw exp/log/division in kernel code)
+- ``RQ4xx``  trace-safety (host control flow on traced values)
+- ``RQ5xx``  PRNG discipline (key reuse, hard-coded seeds)
+- ``RQ6xx``  benchmark honesty (unsynchronized timed regions)
+
+The whole package is stdlib-only at import time: it must stay usable in
+watchdog/driver contexts where jax is absent (the findings artifact is
+written through ``redqueen_tpu.runtime.artifacts.atomic_write_json`` when
+that import works, and through a direct file-load of the same module —
+itself stdlib-only — when the package import would drag jax in).
+
+Entry points: ``python -m tools.rqlint`` (CLI), ``rqlint.engine.run``
+(programmatic), ``tools/check_resilience.py`` (the legacy shim — same
+CLI, exit codes, and violation text as the pre-rqlint monolith).
+"""
+
+from __future__ import annotations
+
+__version__ = "1.0.0"
+
+from .findings import Finding, Severity  # noqa: F401
+from .rules import all_rules, select_rules  # noqa: F401

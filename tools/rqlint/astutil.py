@@ -1,0 +1,108 @@
+"""Shared AST helpers for rqlint rules (stdlib-only).
+
+The attribute-chain / static-denominator logic here is the single source
+of truth the legacy ``tools/check_resilience.py`` shim also reuses — the
+migrated rules must stay verdict-identical with the pre-rqlint monolith.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+
+def attr_chain(node: ast.AST) -> Tuple[str, ...]:
+    """``jax.distributed.initialize`` -> ("jax", "distributed",
+    "initialize"); empty tuple when the base is not a plain Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def chain_tail(node: ast.AST) -> str:
+    """Last component of the attribute chain of a call target (``""`` when
+    the target is not a plain dotted name)."""
+    chain = attr_chain(node)
+    return chain[-1] if chain else ""
+
+
+def static_number(node: ast.AST) -> Optional[float]:
+    """Value of a constants-only numeric expression (e.g. ``2**20``),
+    else None."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, (ast.BinOp, ast.UnaryOp, ast.Constant,
+                                ast.operator, ast.unaryop)):
+            return None
+        if isinstance(sub, ast.Constant) and not isinstance(
+                sub.value, (int, float)):
+            return None
+    try:
+        return eval(  # noqa: S307 — constants-only, verified above
+            compile(ast.Expression(body=node), "<den>", "eval"))
+    except Exception:
+        return None
+
+
+def call_args(call: ast.Call):
+    """Positional args + keyword values of a call, in source order."""
+    return list(call.args) + [k.value for k in call.keywords]
+
+
+def walk_calls(node: ast.AST):
+    """All Call nodes under ``node`` in (lineno, col) order."""
+    calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+    return calls
+
+
+def name_ids(node: ast.AST):
+    """Set of all Name ids appearing under ``node``."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def assign_target_names(node) -> List[str]:
+    """Plain Name targets of an Assign/AnnAssign/AugAssign, flattening
+    tuple/list unpacking; starred/attribute/subscript targets ignored."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    names: List[str] = []
+
+    def flat(t):
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                flat(e)
+        elif isinstance(t, ast.Starred):
+            flat(t.value)
+
+    for t in targets:
+        flat(t)
+    return names
+
+
+def function_defs(tree: ast.AST):
+    """Every FunctionDef/AsyncFunctionDef in the module, nested included."""
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def param_names(fn) -> List[str]:
+    """Positional, keyword-only, vararg and kwarg parameter names of a
+    FunctionDef or Lambda."""
+    a = fn.args
+    names = [p.arg for p in
+             getattr(a, "posonlyargs", []) + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names

@@ -1,0 +1,86 @@
+"""Checked-in baseline: the warn-first landing path for new rules.
+
+A baseline entry is ``(rule, path, code)`` where ``code`` is the stripped
+source line — tolerant of the finding MOVING (line-number drift from
+unrelated edits) but not of the line CHANGING.  Matching consumes entries
+multiset-style, so two identical offending lines need two entries.
+
+``--update-baseline`` rewrites the file from the current findings; the
+diff review of that file IS the approval step for newly-baselined debt.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import List, Tuple
+
+from .findings import Finding, replace
+
+SCHEMA = "rq.rqlint.baseline/1"
+DEFAULT_RELPATH = os.path.join("tools", "rqlint_baseline.json")
+
+
+def _key(rule: str, path: str, code: str) -> Tuple[str, str, str]:
+    return (rule, path.replace(os.sep, "/"), code)
+
+
+def load(path: str) -> Counter:
+    """Baseline multiset keyed by (rule, path, code); empty when the file
+    does not exist.  A malformed baseline raises — silently ignoring it
+    would un-baseline every finding at once."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path}: unknown baseline schema {doc.get('schema')!r} "
+            f"(expected {SCHEMA})")
+    return Counter(_key(e["rule"], e["path"], e.get("code", ""))
+                   for e in doc.get("findings", []))
+
+
+def apply(findings: List[Finding], baseline: Counter) -> List[Finding]:
+    """Mark findings absorbed by the baseline (consuming entries so a
+    baseline row absorbs at most one finding)."""
+    remaining = Counter(baseline)
+    out = []
+    for f in findings:
+        k = _key(f.rule, f.path, f.code)
+        if not f.suppressed and remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            f = replace(f, baselined=True)
+        out.append(f)
+    return out
+
+
+def to_doc(findings: List[Finding], keep: List[dict] = ()) -> dict:
+    """Baseline document for the currently-failing findings (suppressed
+    and already-baselined ones re-enter as plain entries: the new file is
+    the complete debt list, not a delta).  ``keep`` carries prior entries
+    to preserve verbatim — the debt of rules OUTSIDE a ``--select``ed
+    subset, which this run produced no findings for and must not erase."""
+    entries = [
+        {"rule": f.rule, "path": f.path.replace(os.sep, "/"),
+         "line": f.line, "code": f.code}
+        for f in findings
+        if f.severity == "error" and not f.suppressed
+    ] + list(keep)
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    return {"schema": SCHEMA, "findings": entries}
+
+
+def raw_entries(path: str) -> List[dict]:
+    """The baseline file's entry list as-is (empty when absent) — for
+    the ``--update-baseline`` merge path."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path}: unknown baseline schema {doc.get('schema')!r} "
+            f"(expected {SCHEMA})")
+    return list(doc.get("findings", []))

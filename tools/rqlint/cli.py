@@ -1,0 +1,154 @@
+"""CLI: ``python -m tools.rqlint [paths...] [options]``.
+
+Exit codes: 0 clean (every finding pragma-suppressed or baselined),
+1 failing findings, 2 usage/internal error — the same contract
+``tools/check_resilience.py`` has always had, so CI wiring is a drop-in.
+
+The JSON findings artifact (``--json``) is written through
+``redqueen_tpu.runtime.artifacts.atomic_write_json`` — loaded directly
+from its file when importing the package would drag jax in, because
+rqlint must stay usable in watchdog/driver contexts with no jax
+installed (the artifacts module itself is stdlib-only by contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from . import __version__, baseline as baseline_mod, engine
+from .findings import Finding
+from .rules import select_rules
+
+ARTIFACT_SCHEMA = "rq.rqlint.findings/1"
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    """runtime.artifacts.atomic_write_json, acquired without importing
+    jax: the normal package import is preferred (shares any loaded
+    module), with a direct file-load of the same stdlib-only module as
+    the jax-free fallback."""
+    try:
+        from redqueen_tpu.runtime.artifacts import atomic_write_json
+    except Exception:
+        import importlib.util
+        mod_path = os.path.join(engine.repo_root(), "redqueen_tpu",
+                                "runtime", "artifacts.py")
+        spec = importlib.util.spec_from_file_location(
+            "_rqlint_artifacts", mod_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        atomic_write_json = mod.atomic_write_json
+    atomic_write_json(path, obj, indent=2)
+
+
+def artifact_doc(result: dict) -> dict:
+    """The JSON findings artifact: schema-tagged, self-describing (rule
+    metadata included so a reader needs no rqlint checkout)."""
+    findings: List[Finding] = result["findings"]
+    counts = {
+        "failing": sum(1 for f in findings if f.fails),
+        "baselined": sum(1 for f in findings if f.baselined),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "total": len(findings),
+    }
+    return {
+        "schema": ARTIFACT_SCHEMA,
+        "rqlint_version": __version__,
+        "files_scanned": result["files_scanned"],
+        "rules": [r.meta() for r in result["rules"]],
+        "counts": counts,
+        "findings": [f.to_json() for f in findings],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rqlint",
+        description="pluggable JAX/TPU static analysis for this repo")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: the whole tree)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule IDs or prefixes "
+                         "(e.g. RQ101,RQ4)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the findings artifact (atomic)")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"baseline file (default: "
+                         f"{baseline_mod.DEFAULT_RELPATH})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report absorbed debt too)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress per-finding lines, keep the summary")
+    args = ap.parse_args(argv)
+
+    try:
+        rules = select_rules(args.select.split(",")) if args.select \
+            else select_rules()
+    except ValueError as e:
+        print(f"rqlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.name:32s} [{r.severity}]  {r.description}")
+        return 0
+
+    root = args.root or engine.repo_root()
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.DEFAULT_RELPATH)
+
+    try:
+        result = engine.run(root=root, rules=rules,
+                            paths=args.paths or None,
+                            baseline_path=baseline_path,
+                            use_baseline=not (args.no_baseline
+                                              or args.update_baseline))
+    except Exception as e:  # engine bugs must not look like a clean tree
+        print(f"rqlint: internal error: {e!r}", file=sys.stderr)
+        return 2
+
+    findings: List[Finding] = result["findings"]
+
+    if args.update_baseline:
+        # A --select'ed update must not erase the debt of rules that
+        # didn't run: preserve their prior entries verbatim.  RQ000 is
+        # always "active" (the engine emits it regardless of selection).
+        active = {r.id for r in rules} | {engine.RQ000}
+        keep = [e for e in baseline_mod.raw_entries(baseline_path)
+                if e.get("rule") not in active]
+        doc = baseline_mod.to_doc(findings, keep=keep)
+        _atomic_write_json(baseline_path, doc)
+        if args.json:
+            _atomic_write_json(args.json, artifact_doc(result))
+        print(f"rqlint: baseline updated: {len(doc['findings'])} "
+              f"entr{'y' if len(doc['findings']) == 1 else 'ies'} -> "
+              f"{os.path.relpath(baseline_path, root)}"
+              + (f" ({len(keep)} kept from unselected rules)"
+                 if keep else ""))
+        return 0
+
+    if args.json:
+        _atomic_write_json(args.json, artifact_doc(result))
+
+    failing = engine.failing(findings)
+    if not args.quiet:
+        for f in findings:
+            print(f.format())
+    n_base = sum(1 for f in findings if f.baselined)
+    n_supp = sum(1 for f in findings if f.suppressed)
+    print(f"rqlint: {result['files_scanned']} files scanned, "
+          f"{len(rules)} rules active, {len(failing)} failing finding(s)"
+          f" ({n_base} baselined, {n_supp} pragma-suppressed)")
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""The single-parse engine: file discovery, one AST per file, all rules
+against the shared tree, pragma suppression, baseline absorption.
+
+Contrast with the pre-rqlint monolith, which re-read and re-walked every
+file once PER PASS: here a file is read once, parsed once, and every
+applicable rule runs over the same tree.  An unparseable file yields an
+RQ000 finding (never a crash); a crashing RULE yields an RQ000 finding
+naming the rule, so one buggy rule cannot mask the others' verdicts.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import traceback
+from typing import Iterable, List, Optional, Sequence
+
+from . import baseline as baseline_mod
+from . import pragmas
+from .findings import Finding, Severity, finding_at, replace, sort_key
+from .rules import all_rules
+from .rules.base import FileContext, Rule
+
+#: the union of every rule's scope plus everything we at least parse-check
+SCAN_GLOBS = (
+    "*.py",
+    os.path.join("tools", "*.py"),
+    os.path.join("tools", "rqlint", "**", "*.py"),
+    os.path.join("benchmarks", "*.py"),
+    os.path.join("experiments", "*.py"),
+    os.path.join("redqueen_tpu", "**", "*.py"),
+)
+
+RQ000 = "RQ000"
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def iter_files(root: str,
+               explicit: Optional[Sequence[str]] = None) -> List[str]:
+    """Repo-relative paths to scan, sorted and de-duplicated.  With
+    ``explicit`` paths, scan exactly those (files or directories)."""
+    rels: List[str] = []
+    if explicit:
+        for p in explicit:
+            ap = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isdir(ap):
+                rels += [os.path.relpath(q, root) for q in
+                         glob.glob(os.path.join(ap, "**", "*.py"),
+                                   recursive=True)]
+            else:
+                rels.append(os.path.relpath(ap, root))
+    else:
+        for pattern in SCAN_GLOBS:
+            rels += [os.path.relpath(q, root) for q in
+                     glob.glob(os.path.join(root, pattern),
+                               recursive=True)]
+    out = sorted({r.replace(os.sep, "/") for r in rels
+                  if "__pycache__" not in r})
+    return out
+
+
+def check_source(source: str, relpath: str,
+                 rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Lint one in-memory source blob as if it lived at ``relpath`` —
+    the fixture-test entry point.  Applies pragmas, not the baseline."""
+    rules = list(rules) if rules is not None else all_rules()
+    per_line, file_wide = pragmas.extract(source)
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except (SyntaxError, ValueError) as e:
+        ctx = FileContext(relpath, source, None)
+        return [finding_at(RQ000, ctx, None,
+                           f"unparseable file skipped: {e}", line=0)]
+    ctx = FileContext(relpath, source, tree)
+    findings: List[Finding] = []
+    for rule in rules:
+        if not rule.applies_to(ctx.relpath):
+            continue
+        try:
+            found = list(rule.check(ctx))
+        except Exception:
+            tb = traceback.format_exc(limit=2).strip().replace("\n", " | ")
+            findings.append(finding_at(
+                RQ000, ctx, None,
+                f"rule {rule.id} crashed on this file ({tb})", line=0))
+            continue
+        findings.extend(found)
+    out = []
+    for f in findings:
+        if pragmas.suppresses(f.rule, f.line, per_line, file_wide):
+            f = replace(f, suppressed=True)
+        out.append(f)
+    out.sort(key=sort_key)
+    return out
+
+
+def run(root: Optional[str] = None,
+        rules: Optional[Sequence[Rule]] = None,
+        paths: Optional[Sequence[str]] = None,
+        baseline_path: Optional[str] = None,
+        use_baseline: bool = True) -> dict:
+    """Lint the tree.  Returns ``{"findings", "files_scanned", "rules",
+    "root"}`` — findings carry their suppressed/baselined state; the
+    caller decides presentation and exit code."""
+    root = root or repo_root()
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    files = iter_files(root, paths)
+    for rel in files:
+        ap = os.path.join(root, rel)
+        try:
+            with open(ap, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            ctx = FileContext(rel, "", None)
+            findings.append(finding_at(RQ000, ctx, None,
+                                       f"unreadable file skipped: {e}",
+                                       line=0))
+            continue
+        findings.extend(check_source(source, rel, rules))
+    if use_baseline:
+        bp = baseline_path or os.path.join(root,
+                                           baseline_mod.DEFAULT_RELPATH)
+        findings = baseline_mod.apply(findings, baseline_mod.load(bp))
+    findings.sort(key=sort_key)
+    return {
+        "findings": findings,
+        "files_scanned": len(files),
+        "rules": rules,
+        "root": root,
+    }
+
+
+def failing(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if f.fails]

@@ -1,0 +1,74 @@
+"""Finding: one diagnostic at one source span, JSON-serializable.
+
+``code`` is the stripped source line the finding points at — it is the
+line-drift-tolerant identity the baseline matches on (a finding that
+merely moved does not invalidate the baseline; a finding whose line
+CHANGED is a new finding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+class Severity:
+    ERROR = "error"
+    WARN = "warn"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # stable rule ID, e.g. "RQ401"
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based; 0 = file-level
+    col: int           # 0-based column offset
+    message: str
+    severity: str = Severity.ERROR
+    code: str = ""     # stripped source line (baseline identity)
+    baselined: bool = False   # matched the checked-in baseline
+    suppressed: bool = False  # silenced by an inline pragma
+
+    @property
+    def fails(self) -> bool:
+        """True when this finding should fail the run: an error that is
+        neither pragma-suppressed nor absorbed by the baseline."""
+        return (self.severity == Severity.ERROR
+                and not self.baselined and not self.suppressed)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self, show_state: bool = True) -> str:
+        tag = ""
+        if show_state and self.baselined:
+            tag = " [baselined]"
+        elif show_state and self.suppressed:
+            tag = " [suppressed]"
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.severity}: {self.message}{tag}")
+
+
+def replace(f: Finding, **kw) -> Finding:
+    return dataclasses.replace(f, **kw)
+
+
+def sort_key(f: Finding):
+    return (f.path, f.line, f.col, f.rule)
+
+
+def finding_at(rule_id: str, ctx, node, message: str,
+               severity: str = Severity.ERROR,
+               line: Optional[int] = None,
+               col: Optional[int] = None) -> Finding:
+    """Build a Finding from an AST node inside a FileContext (captures the
+    stripped source line as the baseline identity); explicit ``line``/
+    ``col`` override the node's span."""
+    ln = line if line is not None else getattr(node, "lineno", 0)
+    if col is None:
+        col = getattr(node, "col_offset", 0) if line is None else 0
+    code = ""
+    if 1 <= ln <= len(ctx.lines):
+        code = ctx.lines[ln - 1].strip()
+    return Finding(rule=rule_id, path=ctx.relpath, line=ln, col=col,
+                   message=message, severity=severity, code=code)

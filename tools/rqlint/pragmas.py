@@ -1,0 +1,81 @@
+"""Inline suppression pragmas.
+
+Two forms, both parsed from real COMMENT tokens (``tokenize``), so a
+pragma inside a string literal is never honored:
+
+- ``# rqlint: disable=RQ401`` (trailing or own-line) — silences the
+  listed rules for findings ON THAT PHYSICAL LINE.  Comma-separate for
+  several rules; ``all`` silences every rule on the line.
+- ``# rqlint: disable-file=RQ601`` — silences the listed rules for the
+  whole file (put it near the top; position does not matter).
+
+A pragma is a JUSTIFICATION, not an escape hatch: repo policy (see
+DESIGN.md "Static analysis") is that every pragma carries a comment
+explaining why the flagged pattern is safe.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, Set, Tuple
+
+_PRAGMA = re.compile(
+    r"#\s*rqlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+)")
+_ID = re.compile(r"rq\d+\Z", re.IGNORECASE)
+
+ALL = "all"
+
+
+def _parse_ids(raw: str):
+    """Leading run of comma/space-separated rule IDs (case-insensitive;
+    ``all`` accepted).  Stops at the first non-ID token, so a
+    justification appended to the same comment ("# rqlint: disable=RQ601
+    host-only oracle") doesn't corrupt — or silently disarm — the ID
+    list."""
+    ids = set()
+    for tok in re.split(r"[,\s]+", raw.strip()):
+        if not tok:
+            continue
+        if _ID.match(tok):
+            ids.add(tok.upper())
+        elif tok.lower() == ALL:
+            ids.add(ALL)
+        else:
+            break
+    return ids
+
+
+def extract(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(line -> rule-ids disabled on that line, rule-ids disabled
+    file-wide).  Tolerates unparseable source: tokenize errors yield an
+    empty pragma map (the engine then reports RQ000 anyway)."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA.search(tok.string)
+            if not m:
+                continue
+            ids = _parse_ids(m.group(2))
+            if not ids:
+                continue
+            if m.group(1) == "disable-file":
+                file_wide |= ids
+            else:
+                per_line.setdefault(tok.start[0], set()).update(ids)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return per_line, file_wide
+
+
+def suppresses(rule_id: str, line: int, per_line: Dict[int, Set[str]],
+               file_wide: Set[str]) -> bool:
+    if ALL in file_wide or rule_id in file_wide:
+        return True
+    ids = per_line.get(line, ())
+    return ALL in ids or rule_id in ids

@@ -1,0 +1,50 @@
+"""Rule registry.
+
+Stable ID bands: RQ1xx resilience, RQ2xx artifacts, RQ3xx numerics,
+RQ4xx trace-safety, RQ5xx PRNG discipline, RQ6xx benchmark honesty.
+RQ000 (unparseable file) is emitted by the engine itself, not a rule.
+
+``select_rules("RQ4")`` prefix-matches, so a band can be run alone.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .artifacts import RawArtifactWriteRule
+from .base import FileContext, Rule  # noqa: F401 (re-export)
+from .bench import UnsyncedTimingRule
+from .numerics import RawNumericsRule
+from .prng import ConstantSeedRule, KeyReuseRule
+from .resilience import BackendGuardRule
+from .trace_safety import TraceSafetyRule
+
+REGISTRY = (
+    BackendGuardRule,
+    RawArtifactWriteRule,
+    RawNumericsRule,
+    TraceSafetyRule,
+    KeyReuseRule,
+    ConstantSeedRule,
+    UnsyncedTimingRule,
+)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in REGISTRY]
+
+
+def select_rules(ids: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate rules whose ID starts with any of ``ids`` (all rules
+    when ``ids`` is falsy); unknown selectors raise."""
+    rules = all_rules()
+    if not ids:
+        return rules
+    ids = [i.strip().upper() for i in ids if i.strip()]
+    out = [r for r in rules if any(r.id.startswith(p) for p in ids)]
+    matched = {p for p in ids if any(r.id.startswith(p) for r in rules)}
+    unknown = set(ids) - matched
+    if unknown:
+        raise ValueError(f"unknown rule selector(s): {sorted(unknown)}; "
+                         f"known rules: {[r.id for r in rules]}")
+    return out

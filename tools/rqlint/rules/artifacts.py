@@ -1,0 +1,74 @@
+"""RQ201 — raw (tearable) artifact write in an entry point.
+
+Every artifact an entry point writes must go through
+``redqueen_tpu.runtime`` — the atomic writers (``atomic_write_json`` /
+``atomic_write_text`` / ``atomic_savez``) or the enveloped ones
+(``integrity.write_json`` / ``integrity.savez``) — because a raw
+``json.dump(obj, f)`` or ``open(path, "w")`` torn by a kill-9 is exactly
+the corruption the integrity layer exists to keep out of the read path.
+Any ``json.dump`` call and any ``open`` with a constant write mode
+("w"/"wb"/"x"...; appends are fine — logs are append-only by design) is
+a violation, per call site, no whitelist: migrate the write, don't
+excuse it.
+
+Migrated verbatim from the second pass of the pre-rqlint
+``tools/check_resilience.py`` — the shim reuses :func:`raw_write_sites`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from ..astutil import attr_chain
+from ..findings import finding_at
+from .base import ENTRY_POINT_PATHS, Rule
+
+
+def _raw_write(call: ast.Call) -> str:
+    """Nonempty description when ``call`` is a raw artifact write: a
+    ``json.dump`` (the 2-arg into-a-file form — ``dumps`` to stdout is
+    the child JSON-line protocol, not a file) or an ``open`` whose
+    constant mode creates/overwrites ("w"/"wb"/"x"...)."""
+    chain = attr_chain(call.func)
+    if chain == ("json", "dump"):
+        return ('json.dump(...) — use runtime.atomic_write_json / '
+                'runtime.integrity.write_json')
+    if chain == ("open",) or chain == ("io", "open"):
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kwarg in call.keywords:
+            if kwarg.arg == "mode":
+                mode = kwarg.value
+        if (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+                and any(c in mode.value for c in "wx")):
+            return (f'open(..., "{mode.value}") — use the runtime '
+                    f'artifact writers (atomic temp + rename)')
+    return ""
+
+
+def raw_write_sites(tree: ast.AST) -> List[Tuple[int, int, str]]:
+    """(line, col, what) per raw artifact-write call site."""
+    sites: List[Tuple[int, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            what = _raw_write(node)
+            if what:
+                sites.append((node.lineno, node.col_offset, what))
+    return sites
+
+
+class RawArtifactWriteRule(Rule):
+    id = "RQ201"
+    name = "raw-artifact-write"
+    description = ("entry point writes an artifact raw (json.dump / "
+                   "open-for-write) instead of through the atomic "
+                   "runtime writers")
+    paths = ENTRY_POINT_PATHS
+
+    def check(self, ctx):
+        for line, col, what in raw_write_sites(ctx.tree):
+            yield finding_at(self.id, ctx, None,
+                             f"raw artifact write — {what}",
+                             line=line, col=col)

@@ -1,0 +1,103 @@
+"""The Rule protocol and the FileContext rules run against.
+
+A rule is one hazard class with a stable ID.  The engine parses each file
+ONCE; every applicable rule receives the same ``FileContext`` (source,
+lines, shared AST) and yields ``Finding``s with precise spans.  Rules
+never open files and never crash the run: an exception inside a rule is
+converted by the engine into an RQ000-style internal finding against the
+rule itself, so one buggy rule cannot hide the others' verdicts.
+
+To add a rule (the one-file home every future invariant gets):
+
+1. subclass ``Rule`` in a module under ``rqlint/rules/``, pick the next
+   free ID in the matching band (RQ1xx resilience, RQ2xx artifacts,
+   RQ3xx numerics, RQ4xx trace-safety, RQ5xx PRNG, RQ6xx benchmarking),
+2. scope it with ``paths`` (fnmatch globs on the repo-relative path),
+3. implement ``check(ctx)`` yielding findings via
+   ``findings.finding_at``,
+4. register it in ``rqlint.rules.REGISTRY``,
+5. add a firing and a non-firing fixture to ``tests/test_rqlint.py``,
+6. land it warn-first if the tree is dirty: run
+   ``python -m tools.rqlint --update-baseline`` and check the baseline
+   diff in with the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Sequence
+
+from ..findings import Finding, Severity
+
+
+def _glob_to_re(pat: str) -> "re.Pattern":
+    """Path-aware glob: ``*`` never crosses ``/`` (so ``tools/*.py`` is
+    the flat directory, exactly like the shell globs the legacy passes
+    used), ``**/`` matches any number of directories."""
+    out = []
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if pat[i:i + 3] == "**/":
+            out.append("(?:[^/]+/)*")
+            i += 3
+        elif pat[i:i + 2] == "**":
+            out.append(".*")
+            i += 2
+        elif c == "*":
+            out.append("[^/]*")
+            i += 1
+        elif c == "?":
+            out.append("[^/]")
+            i += 1
+        else:
+            out.append(re.escape(c))
+            i += 1
+    return re.compile("".join(out) + r"\Z")
+
+
+class FileContext:
+    """One parsed file, shared by every rule: ``relpath`` (repo-relative,
+    forward slashes), ``source``, ``lines``, and ``tree`` (None only for
+    the engine's internal RQ000 path — rules are never invoked on an
+    unparseable file)."""
+
+    def __init__(self, relpath: str, source: str,
+                 tree: Optional[ast.AST]) -> None:
+        self.relpath = relpath.replace("\\", "/")
+        self.source = source
+        self.lines: List[str] = source.splitlines()
+        self.tree = tree
+
+
+class Rule:
+    """Base class for all rules; subclasses set the class attributes and
+    implement :meth:`check`."""
+
+    id: str = ""
+    name: str = ""
+    severity: str = Severity.ERROR
+    description: str = ""
+    #: fnmatch globs (repo-relative, forward slashes) this rule runs on.
+    paths: Sequence[str] = ("*.py",)
+
+    def applies_to(self, relpath: str) -> bool:
+        relpath = relpath.replace("\\", "/")
+        return any(_glob_to_re(pat).match(relpath) for pat in self.paths)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def meta(self) -> dict:
+        return {"id": self.id, "name": self.name,
+                "severity": self.severity, "paths": list(self.paths),
+                "description": self.description}
+
+
+#: Path scope of the legacy entry-point passes (RQ101/RQ201): repo-root
+#: scripts plus the flat tools/benchmarks/experiments dirs — deliberately
+#: NON-recursive under tools/ (mirrors the pre-rqlint monolith's globs,
+#: which the migrated rules must stay verdict-identical with).
+ENTRY_POINT_PATHS = ("*.py", "tools/*.py", "benchmarks/*.py",
+                     "experiments/*.py")

@@ -1,0 +1,133 @@
+"""RQ601 — unsynchronized timed region in a benchmark harness.
+
+JAX dispatch is asynchronous: ``simulate(...)`` returns the instant the
+work is ENQUEUED, not when it finishes.  A
+``t0 = time.perf_counter(); result = jitted(...); secs = perf_counter()
+- t0`` pair with no ``block_until_ready`` inside the region therefore
+measures dispatch latency, and every BENCH_*.json built from it lies —
+spectacularly so on TPU, where the gap between enqueue and completion is
+the whole kernel.
+
+Detection: within one function scope (or the module top level), an
+assignment ``<name> = time.perf_counter()`` / ``time.monotonic()``
+paired with a later elapsed read ``time.perf_counter() - <name>`` in the
+same scope delimits a timed region (the lines strictly after the start
+and up to the read).  The rule fires when that region contains at least
+one non-trivial call but no reference to ``block_until_ready``.
+
+Host-only timed regions (NumPy oracle loops, CSV ingestion) are real
+and legal — they pin themselves with a line pragma at the ``t0 = ...``
+line, which doubles as documentation that the region was audited.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from ..astutil import attr_chain, chain_tail
+from ..findings import finding_at
+from .base import Rule
+
+CLOCKS = {"perf_counter", "monotonic", "perf_counter_ns", "monotonic_ns",
+          "time"}
+
+#: calls that can't be the device work being timed (bookkeeping noise)
+TRIVIAL_CALLS = {"perf_counter", "monotonic", "perf_counter_ns",
+                 "monotonic_ns", "time", "min", "max", "len", "range",
+                 "print", "log", "append", "round", "float", "int",
+                 "str", "format", "isfinite", "sleep"}
+
+
+def _clock_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = attr_chain(node.func)
+    if not chain or chain[-1] not in CLOCKS:
+        return False
+    # require time.<clock>() or a bare imported perf_counter/monotonic;
+    # a bare time() could be anything, so insist on the dotted form there
+    return len(chain) > 1 or chain[-1] != "time"
+
+
+def _scopes(tree: ast.AST):
+    """(scope node, its direct statements-with-descendants) for the module
+    and every function — each timed pair must live in ONE scope."""
+    scopes = [tree]
+    scopes += [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    return scopes
+
+
+def _scope_nodes(scope: ast.AST, tree: ast.AST):
+    """All nodes belonging to ``scope`` but not to a nested function."""
+    nested = [n for n in ast.walk(scope)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and n is not scope]
+    skip = set()
+    for fn in nested:
+        skip.update(id(x) for x in ast.walk(fn))
+        skip.discard(id(fn))
+    return [n for n in ast.walk(scope) if id(n) not in skip]
+
+
+class UnsyncedTimingRule(Rule):
+    id = "RQ601"
+    name = "unsynchronized-timed-region"
+    description = ("perf timestamp taken around dispatched work with no "
+                   "block_until_ready in the timed region (async "
+                   "dispatch makes the measurement lie)")
+    paths = ("bench.py", "benchmarks/*.py", "tools/*bench*.py")
+
+    def check(self, ctx):
+        for scope in _scopes(ctx.tree):
+            nodes = _scope_nodes(scope, ctx.tree)
+            starts: List[Tuple[str, ast.Assign]] = []
+            reads: List[Tuple[str, ast.AST]] = []
+            for n in nodes:
+                if (isinstance(n, ast.Assign) and _clock_call(n.value)
+                        and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)):
+                    starts.append((n.targets[0].id, n))
+                if (isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub)
+                        and _clock_call(n.left)
+                        and isinstance(n.right, ast.Name)):
+                    reads.append((n.right.id, n))
+            for name, start in starts:
+                read = self._first_read_after(name, start, reads)
+                if read is None:
+                    continue
+                region = [n for n in nodes
+                          if start.lineno < getattr(n, "lineno", 0)
+                          <= read.lineno]
+                if self._region_unsynced(region):
+                    yield finding_at(
+                        self.id, ctx, start,
+                        f"timed region `{name}` (lines "
+                        f"{start.lineno}-{read.lineno}) dispatches work "
+                        f"but never calls block_until_ready — async "
+                        f"dispatch returns before the device finishes, "
+                        f"so the measured time lies")
+
+    @staticmethod
+    def _first_read_after(name: str, start: ast.Assign,
+                          reads) -> Optional[ast.AST]:
+        after = [r for n, r in reads
+                 if n == name and r.lineno > start.lineno]
+        return min(after, key=lambda r: r.lineno) if after else None
+
+    @staticmethod
+    def _region_unsynced(region) -> bool:
+        has_work = False
+        for n in region:
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                tail = n.attr if isinstance(n, ast.Attribute) else n.id
+                if tail == "block_until_ready":
+                    return False
+            if isinstance(n, ast.Call):
+                tail = chain_tail(n.func)
+                if tail and tail not in TRIVIAL_CALLS:
+                    has_work = True
+                elif not tail:  # indirect call (fn(...) via subscript...)
+                    has_work = True
+        return has_work

@@ -1,0 +1,84 @@
+"""RQ301 — raw numerics in kernel code (``redqueen_tpu/ops/``).
+
+Kernel code must not use raw ``jnp.exp`` / ``jnp.log`` or raw
+``/``-division on data values — the guarded primitives in
+``redqueen_tpu.runtime.numerics`` (``safe_exp`` / ``safe_log`` /
+``safe_div``; bit-identical on healthy inputs) are the sanctioned route,
+because a raw exp/log/division on an unvalidated parameter is exactly
+how a degenerate sweep point manufactures the NaN the lane-health layer
+then has to quarantine.  A division is exempt only when its denominator
+is statically safe: a non-zero numeric constant expression, or a
+``maximum(...)``-clamped value.  ``log1p`` is deliberately NOT in the
+raw set: its remaining ops/ call sites consume panel/threefry uniforms
+that are < 1 by construction, while the sampler sites with
+model-dependent domains route through ``safe_log1p`` voluntarily.
+
+Migrated verbatim from the third pass of the pre-rqlint
+``tools/check_resilience.py`` — the shim reuses :func:`numeric_sites`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from ..astutil import attr_chain, static_number
+from ..findings import finding_at
+from .base import Rule
+
+RAW_NUMERIC_CALLS = {
+    ("jnp", "exp"): "jnp.exp — use runtime.numerics.safe_exp",
+    ("jnp", "log"): "jnp.log — use runtime.numerics.safe_log",
+    ("np", "exp"): "np.exp — use runtime.numerics.safe_exp",
+    ("np", "log"): "np.log — use runtime.numerics.safe_log",
+}
+
+# maximum(x, eps)-style clamps make a denominator statically safe.
+SAFE_DEN_CALLS = {"maximum", "max"}
+
+
+def _division_ok(den: ast.AST) -> bool:
+    """A denominator is statically safe when it cannot be zero/NaN by
+    construction: a non-zero constant expression, or a value clamped
+    through ``maximum(...)``."""
+    n = static_number(den)
+    if n is not None:
+        return n != 0
+    if isinstance(den, ast.Call):
+        chain = attr_chain(den.func)
+        return bool(chain) and chain[-1] in SAFE_DEN_CALLS
+    return False
+
+
+def numeric_sites(tree: ast.AST) -> List[Tuple[int, int, str]]:
+    """(line, col, what) per raw ``jnp.exp``/``jnp.log`` call and per
+    ``/``-division whose denominator is not statically safe."""
+    sites: List[Tuple[int, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain in RAW_NUMERIC_CALLS:
+                sites.append((node.lineno, node.col_offset,
+                              RAW_NUMERIC_CALLS[chain]))
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div)
+                and not _division_ok(node.right)):
+            sites.append((
+                node.lineno, node.col_offset,
+                "raw /-division — use runtime.numerics.safe_div (or clamp "
+                "the denominator with maximum(...))"))
+    sites.sort()
+    return sites
+
+
+class RawNumericsRule(Rule):
+    id = "RQ301"
+    name = "raw-kernel-numerics"
+    description = ("kernel code uses raw jnp.exp/jnp.log or unclamped "
+                   "/-division instead of runtime.numerics.safe_*")
+    paths = ("redqueen_tpu/ops/*.py",)
+
+    def check(self, ctx):
+        for line, col, what in numeric_sites(ctx.tree):
+            yield finding_at(self.id, ctx, None,
+                             f"raw numerics in kernel code — {what}",
+                             line=line, col=col)

@@ -1,0 +1,232 @@
+"""RQ5xx — PRNG key discipline in library code.
+
+RQ501: a ``jax.random`` key consumed by two samplers without an
+interleaving ``split``/``fold_in``.  Two consumers of the same key draw
+IDENTICAL randomness — in a point-major sweep that silently correlates
+lanes (or wall sources), which no per-lane statistic will flag; it just
+quietly narrows the Monte-Carlo estimate.  The bug class the Hawkes-at-
+scale literature trips over precisely because it is invisible at small
+F.
+
+RQ502: a hard-coded ``PRNGKey(<constant>)`` in library code.  Library
+code must derive keys from the caller's seed / lane index; a baked-in
+constant gives every lane the same stream.  (Shape-only uses — e.g.
+under ``jax.eval_shape`` — pin themselves with a line pragma.)
+
+RQ501 is path-sensitive within a function: consumptions on the two arms
+of an ``if``/exclusive ``return`` branches don't combine; a consumption
+inside a Python loop counts as repeated unless the key is re-derived in
+the loop body.  Deriving calls (``split``/``fold_in``) are sanctioned
+consumers and reset the count on reassignment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..astutil import (attr_chain, assign_target_names, chain_tail,
+                       param_names, walk_calls)
+from ..findings import finding_at
+from .base import Rule
+
+#: calls producing fresh keys; consuming a key THROUGH these is sanctioned
+DERIVERS = {"split", "fold_in", "PRNGKey", "key", "wrap_key_data",
+            "key_data", "clone"}
+
+#: parameter names assumed to hold PRNG keys
+KEY_PARAM_NAMES = {"key", "rng", "prng", "rngkey"}
+
+
+def _is_key_param(name: str) -> bool:
+    low = name.lower()
+    return (low in KEY_PARAM_NAMES or low.endswith("_key")
+            or low.endswith("_rng"))
+
+
+def _producer_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and chain_tail(node.func) in {"split", "fold_in", "PRNGKey",
+                                          "key", "wrap_key_data"})
+
+
+class _PathState:
+    """Per-path raw-consumption counts for each live key name."""
+
+    def __init__(self) -> None:
+        self.counts: Dict[str, int] = {}
+
+    def copy(self) -> "_PathState":
+        s = _PathState()
+        s.counts = dict(self.counts)
+        return s
+
+    def merge(self, others: List["_PathState"]) -> None:
+        for o in others:
+            for k, v in o.counts.items():
+                self.counts[k] = max(self.counts.get(k, 0), v)
+
+
+def _imports_jax_random(tree: ast.AST) -> bool:
+    """True when the module imports ``jax.random`` (any spelling) or
+    references it as a dotted attribute — the evidence that key-NAMED
+    parameters actually hold PRNG keys.  Without it, ``key`` params are
+    dict keys / cache keys and the reuse heuristic must stand down."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            # plain `import jax` alone is NOT evidence — the Attribute
+            # branch below catches actual jax.random.* usage
+            if any(a.name == "jax.random" for a in node.names):
+                return True
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(a.name == "random"
+                                            for a in node.names):
+                return True
+            if node.module and node.module.startswith("jax.random"):
+                return True
+        if isinstance(node, ast.Attribute):
+            if attr_chain(node)[:2] == ("jax", "random"):
+                return True
+    return False
+
+
+class KeyReuseRule(Rule):
+    id = "RQ501"
+    name = "prng-key-reuse"
+    description = ("the same jax.random key is passed to two consumers "
+                   "without an interleaving split/fold_in (identical "
+                   "draws -> silently correlated lanes)")
+    paths = ("redqueen_tpu/**/*.py",)
+
+    def check(self, ctx):
+        if not _imports_jax_random(ctx.tree):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, node)
+
+    # -- one function ------------------------------------------------------
+
+    def _check_fn(self, ctx, fn):
+        keys: Set[str] = {p for p in param_names(fn) if _is_key_param(p)}
+        self._findings: List = []
+        self._keys = keys
+        self._ctx = ctx
+        self._walk(fn.body, _PathState())
+        yield from self._findings
+
+    def _walk(self, stmts, state: _PathState) -> Optional[_PathState]:
+        """Walk a statement list; returns the fall-through state, or None
+        when every path through ``stmts`` terminates (return/raise)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested fns analyzed as their own scopes
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                self._consume_in(stmt, state)
+                return None
+            if isinstance(stmt, ast.If):
+                self._consume_in(stmt.test, state)
+                b = self._walk(stmt.body, state.copy())
+                o = self._walk(stmt.orelse, state.copy())
+                live = [s for s in (b, o) if s is not None]
+                if not live:
+                    return None
+                # branches are exclusive: the fall-through state is the
+                # per-key max over the arms that actually fall through
+                merged = _PathState()
+                merged.merge(live)
+                state.counts = merged.counts
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                test = stmt.iter if isinstance(stmt, (ast.For,
+                                                      ast.AsyncFor)) \
+                    else stmt.test
+                self._consume_in(test, state)
+                # two passes over the body: a key consumed once per
+                # iteration without re-derivation fires on the second
+                body_state = state.copy()
+                for _ in range(2):
+                    r = self._walk(stmt.body, body_state)
+                    if r is None:
+                        break
+                    body_state = r
+                state.merge([body_state])
+                self._walk(stmt.orelse, state)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._consume_in(item.context_expr, state)
+                r = self._walk(stmt.body, state)
+                if r is None:
+                    return None
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk(stmt.body, state)
+                for h in stmt.handlers:
+                    self._walk(h.body, state.copy())
+                self._walk(stmt.orelse, state)
+                self._walk(stmt.finalbody, state)
+                continue
+            # plain statement: consumptions, then assignment effects
+            self._consume_in(stmt, state)
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = assign_target_names(stmt)
+                value = stmt.value
+                if value is not None and (_producer_call(value) or (
+                        isinstance(value, ast.Tuple)
+                        and any(_producer_call(e) for e in value.elts))):
+                    for t in targets:
+                        self._keys.add(t)
+                        state.counts[t] = 0
+                else:
+                    for t in targets:
+                        # rebound to something else: count resets either
+                        # way (stale counts on a dead name are noise)
+                        state.counts.pop(t, None)
+        return state
+
+    def _consume_in(self, node, state: _PathState) -> None:
+        """Record raw key consumptions in source order within one
+        statement/expression."""
+        for call in walk_calls(node):
+            tail = chain_tail(call.func)
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                if not (isinstance(arg, ast.Name)
+                        and arg.id in self._keys):
+                    continue
+                if tail in DERIVERS:
+                    continue  # deriving/sanctioned consumer
+                n = state.counts.get(arg.id, 0)
+                if n >= 1:
+                    self._findings.append(finding_at(
+                        self.id, self._ctx, call,
+                        f"PRNG key `{arg.id}` consumed a second time "
+                        f"with no interleaving split/fold_in — identical "
+                        f"draws (correlated lanes)"))
+                state.counts[arg.id] = n + 1
+
+
+class ConstantSeedRule(Rule):
+    id = "RQ502"
+    name = "hard-coded-prng-seed"
+    description = ("library code builds a PRNG key from a hard-coded "
+                   "constant seed (every lane/caller gets the same "
+                   "stream)")
+    # the PRNGKey CALL is its own evidence — no import gate needed
+    paths = KeyReuseRule.paths
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] != "PRNGKey":
+                continue
+            if (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, int)):
+                yield finding_at(
+                    self.id, ctx, node,
+                    f"PRNGKey({node.args[0].value}) with a hard-coded "
+                    f"seed in library code — derive from the caller's "
+                    f"seed / lane index")

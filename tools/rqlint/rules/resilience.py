@@ -1,0 +1,92 @@
+"""RQ101 — unguarded default-backend touch in an entry point.
+
+A wedged axon TPU tunnel HANGS ``jax.devices()`` / backend init forever
+rather than raising (the round-1 rc=124 failure), so every entry point
+under ``tools/``, ``benchmarks/``, ``experiments/``, and the repo root
+must reach the backend through the resilience runtime's deadline-bounded
+guards — or pin itself to CPU, which cannot hang — BEFORE any in-process
+backend touch.  The check is file-level: a file violates when it touches
+the backend without referencing any sanctioned guard and without the CPU
+config pin.  ``redqueen_tpu/`` itself is exempt: it IS the guard
+implementation.
+
+Migrated verbatim from the first pass of the pre-rqlint
+``tools/check_resilience.py`` — the shim reuses :func:`backend_analysis`
+so the two can never drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from ..astutil import attr_chain
+from ..findings import finding_at
+from .base import ENTRY_POINT_PATHS, Rule
+
+GUARD_NAMES = {
+    "ensure_backend", "ensure_live_backend",
+    "backend_alive", "default_backend_alive",
+    "probe_backend", "probe_default_backend",
+}
+
+BACKEND_TOUCHES = {
+    ("jax", "devices"): "jax.devices()",
+    ("jax", "distributed", "initialize"): "jax.distributed.initialize()",
+}
+
+
+def _is_cpu_pin(call: ast.Call) -> bool:
+    """``<anything>.config.update("jax_platforms", "cpu")`` (the env
+    assignment styles are irrelevant — the config API is the one that
+    sticks against the axon plugin)."""
+    chain = attr_chain(call.func)
+    if len(chain) < 2 or chain[-1] != "update" or chain[-2] != "config":
+        return False
+    consts = [a.value for a in call.args
+              if isinstance(a, ast.Constant) and isinstance(a.value, str)]
+    return "jax_platforms" in consts and "cpu" in consts
+
+
+def backend_analysis(tree: ast.AST) -> Tuple[List[Tuple[int, int, str]],
+                                             bool]:
+    """(touch sites as (line, col, what), file-is-guarded).  Guarded =
+    references a sanctioned guard name anywhere (call, attribute, or
+    import alias) or pins the CPU platform through the config API."""
+    touches: List[Tuple[int, int, str]] = []
+    guarded = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain in BACKEND_TOUCHES:
+                touches.append((node.lineno, node.col_offset,
+                                BACKEND_TOUCHES[chain]))
+            if _is_cpu_pin(node):
+                guarded = True
+        if isinstance(node, ast.Name) and node.id in GUARD_NAMES:
+            guarded = True
+        if isinstance(node, ast.Attribute) and node.attr in GUARD_NAMES:
+            guarded = True
+        if (isinstance(node, ast.alias)
+                and node.name.split(".")[-1] in GUARD_NAMES):
+            guarded = True
+    return touches, guarded
+
+
+class BackendGuardRule(Rule):
+    id = "RQ101"
+    name = "unguarded-backend-touch"
+    description = ("entry point touches jax.devices()/"
+                   "jax.distributed.initialize() without a "
+                   "deadline-bounded backend guard or CPU pin")
+    paths = ENTRY_POINT_PATHS
+
+    def check(self, ctx):
+        touches, guarded = backend_analysis(ctx.tree)
+        if guarded:
+            return
+        for line, col, what in touches:
+            yield finding_at(
+                self.id, ctx, None,
+                f"{what} without a deadline-bounded backend guard",
+                line=line, col=col)

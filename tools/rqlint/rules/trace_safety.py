@@ -1,0 +1,254 @@
+"""RQ401 — host control flow / forced concretization on traced values.
+
+Inside a ``@jit`` function or a ``lax.scan`` / ``while_loop`` / ``cond``
+/ ``switch`` / ``vmap`` body, the arguments are tracers.  Python
+``if``/``while`` on a tracer, ``bool()``/``float()``/``int()``,
+``.item()``, and ``np.asarray`` each force concretization: on TPU that
+is an implicit device->host sync at best and a
+``ConcretizationTypeError`` at worst — the bug class that only bites
+once a sweep is scaled past what eager CPU smoke tests cover.
+
+Detection is intraprocedural and deliberately conservative:
+
+- *traced contexts*: function defs (or lambdas) passed to a JAX
+  transform in the same module (``lax.scan(step, ...)``,
+  ``jax.vmap(f)``, ...) or decorated with ``jit``/``pmap`` (bare,
+  dotted, or via ``partial(jax.jit, ...)``).
+- *taint*: the context's parameters are traced; anything assigned from
+  an expression involving a traced name becomes traced.  Static-under-
+  trace accessors (``.shape``/``.ndim``/``.dtype``/``len()``/
+  ``isinstance()``) break the taint: branching on a SHAPE is legal and
+  idiomatic.  Closure variables (configs, static tables) are never
+  tainted, so the pervasive ``if cfg.flag:`` pattern stays clean.
+
+False negatives are accepted (cross-module bodies aren't marked);
+a false positive documents itself with a line pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..astutil import attr_chain, chain_tail, param_names
+from ..findings import finding_at
+from .base import Rule
+
+#: call-target tails whose function arguments run traced
+TRANSFORMS = {
+    "scan", "while_loop", "fori_loop", "cond", "switch", "vmap", "pmap",
+    "jit", "pjit", "shard_map", "checkpoint", "remat", "pallas_call",
+    "associative_scan", "map",
+}
+#: only treat bare "map"/"checkpoint" as transforms when dotted through
+#: a jax-ish module (plain builtins map() must not mark its fn traced)
+DOTTED_ONLY = {"map", "checkpoint", "remat"}
+JAXISH_HEADS = {"jax", "lax", "jnp", "pl", "pltpu", "nn", "comm"}
+
+#: attribute accesses that are static under tracing (shape metadata)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+#: calls whose result is static/host-legal even on traced args
+STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
+                "eval_shape", "result_type", "canonicalize_dtype"}
+
+_CONCRETIZERS = {"bool", "float", "int", "complex"}
+
+
+def _jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if chain_tail(target) in {"jit", "pjit", "pmap"}:
+            return True
+        if (isinstance(dec, ast.Call) and chain_tail(dec.func) == "partial"
+                and dec.args
+                and chain_tail(dec.args[0]) in {"jit", "pjit", "pmap"}):
+            return True
+    return False
+
+
+def _traced_contexts(tree: ast.AST):
+    """(FunctionDef|Lambda) nodes whose parameters run traced."""
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    contexts: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(node):
+        if id(node) not in seen:
+            seen.add(id(node))
+            contexts.append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decorated(node):
+                add(node)
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        tail = chain[-1] if chain else ""
+        if tail not in TRANSFORMS:
+            continue
+        if tail in DOTTED_ONLY and (len(chain) < 2
+                                    or chain[0] not in JAXISH_HEADS):
+            continue  # bare map()/checkpoint() are not JAX transforms
+        if tail == "map" and chain[-2] != "lax":
+            continue  # only lax.map traces its fn (jax.tree.map is host)
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                add(arg)
+            elif isinstance(arg, ast.Name):
+                for fn in defs_by_name.get(arg.id, ()):
+                    add(fn)
+    return contexts
+
+
+class _Taint:
+    """Forward taint over one traced context's body."""
+
+    def __init__(self, params: Set[str]) -> None:
+        self.names: Set[str] = set(params)
+
+    def expr(self, node: ast.AST) -> bool:
+        """Is this expression traced-valued?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Constant):
+            return False
+        if (isinstance(node, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops)):
+            # `x is None` on a tracer is a pytree-STRUCTURE check —
+            # static under trace, and the idiomatic optional-leaf gate
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            tail = chain_tail(node.func)
+            if tail in STATIC_CALLS:
+                return False
+            args = list(node.args) + [k.value for k in node.keywords]
+            tainted = any(self.expr(a) for a in args)
+            if isinstance(node.func, ast.Attribute):
+                # method call on a traced value (x.sum(), key.astype(...))
+                tainted = tainted or self.expr(node.func.value)
+            return tainted
+        if isinstance(node, ast.Lambda):
+            return False
+        return any(self.expr(c) for c in ast.iter_child_nodes(node)
+                   if isinstance(c, ast.expr))
+
+
+class TraceSafetyRule(Rule):
+    id = "RQ401"
+    name = "host-control-flow-on-traced"
+    description = ("Python if/while/bool/float/.item()/np.asarray on a "
+                   "traced value inside a jit/scan/vmap body (implicit "
+                   "host sync or ConcretizationTypeError on TPU)")
+    paths = ("redqueen_tpu/ops/*.py", "redqueen_tpu/parallel/*.py")
+
+    def check(self, ctx):
+        for fn in _traced_contexts(ctx.tree):
+            yield from self._check_context(ctx, fn)
+
+    # -- one traced context ------------------------------------------------
+
+    def _check_context(self, ctx, fn):
+        taint = _Taint(set(param_names(fn)))
+        body = fn.body if isinstance(fn.body, list) else []
+        if isinstance(fn, ast.Lambda):
+            yield from self._check_expr(ctx, taint, fn.body)
+            return
+        yield from self._walk(ctx, taint, body)
+
+    def _walk(self, ctx, taint, stmts):
+        for stmt in stmts:
+            # nested defs are separate contexts (marked only if they are
+            # themselves passed to a transform) — don't descend
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                if taint.expr(stmt.test):
+                    kw = "while" if isinstance(stmt, ast.While) else "if"
+                    fix = ("lax.while_loop" if kw == "while"
+                           else "jnp.where / lax.cond / lax.select")
+                    yield finding_at(
+                        self.id, ctx, stmt,
+                        f"Python `{kw}` on a traced value inside a "
+                        f"jit/scan/vmap body — use {fix}")
+                else:
+                    yield from self._check_expr(ctx, taint, stmt.test)
+                yield from self._walk(ctx, taint, stmt.body)
+                yield from self._walk(ctx, taint, stmt.orelse)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if taint.expr(stmt.iter):
+                    yield finding_at(
+                        self.id, ctx, stmt,
+                        "Python `for` over a traced value inside a "
+                        "jit/scan/vmap body — use lax.scan/fori_loop")
+                else:
+                    yield from self._check_expr(ctx, taint, stmt.iter)
+                yield from self._walk(ctx, taint, stmt.body)
+                yield from self._walk(ctx, taint, stmt.orelse)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # header expressions here, bodies via _walk — never both
+                # (the generic subtree scan below would double-report)
+                for item in stmt.items:
+                    yield from self._check_expr(ctx, taint,
+                                                item.context_expr)
+                yield from self._walk(ctx, taint, stmt.body)
+                continue
+            if isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    yield from self._walk(ctx, taint, blk)
+                for h in stmt.handlers:
+                    yield from self._walk(ctx, taint, h.body)
+                continue
+            # generic statement: update taint from assignments, then
+            # scan its expressions for concretizing calls
+            self._assign(taint, stmt)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.expr):
+                    yield from self._check_expr(ctx, taint, node,
+                                                recurse=False)
+
+    def _assign(self, taint, stmt):
+        from ..astutil import assign_target_names
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            if value is not None and taint.expr(value):
+                taint.names.update(assign_target_names(stmt))
+
+    def _check_expr(self, ctx, taint, node, recurse=True):
+        nodes = ast.walk(node) if recurse else [node]
+        for n in nodes:
+            if not isinstance(n, ast.Call):
+                continue
+            tail = chain_tail(n.func)
+            args = list(n.args) + [k.value for k in n.keywords]
+            chain = attr_chain(n.func)
+            if (tail in _CONCRETIZERS and len(chain) == 1
+                    and any(taint.expr(a) for a in args)):
+                yield finding_at(
+                    self.id, ctx, n,
+                    f"`{tail}()` on a traced value forces host "
+                    f"concretization (ConcretizationTypeError under jit)")
+            elif (isinstance(n.func, ast.Attribute) and n.func.attr == "item"
+                    and taint.expr(n.func.value)):
+                yield finding_at(
+                    self.id, ctx, n,
+                    "`.item()` on a traced value forces a device->host "
+                    "sync inside the traced region")
+            elif (chain[:1] in (("np",), ("numpy",), ("onp",))
+                    and tail in {"asarray", "array"}
+                    and any(taint.expr(a) for a in args)):
+                yield finding_at(
+                    self.id, ctx, n,
+                    "np.asarray/np.array on a traced value materializes "
+                    "it on host inside the traced region — use jnp")
