@@ -34,6 +34,8 @@ def run(T: float = 100.0, F: int = 5, q: float = 1.0, wall_rate: float = 1.0,
     comparison: only the controlled broadcaster differs between panels.
     Aggregate ordering over many seeds is pinned by
     experiments/compare_policies.py."""
+    import jax
+
     from redqueen_tpu.baselines import budget_matched_poisson_rate
     from redqueen_tpu.config import GraphBuilder
     from redqueen_tpu.sim import simulate
@@ -47,7 +49,9 @@ def run(T: float = 100.0, F: int = 5, q: float = 1.0, wall_rate: float = 1.0,
             gb.add_poisson(rate=wall_rate, sinks=[i])
         cfg, params, adj = gb.build(capacity=capacity)
         log = simulate(cfg, params, adj, seed=seed)
-        df = events_to_dataframe(log.times, log.srcs, np.asarray(adj))
+        # explicit device->host boundary before the pandas twin
+        times, srcs = jax.device_get((log.times, log.srcs))
+        df = events_to_dataframe(times, srcs, np.asarray(adj))
         return df, ctrl
 
     df_opt, opt_id = component(lambda gb: gb.add_opt(q=q))

@@ -160,4 +160,5 @@ def offline_schedule(wall_rates, change_times, end_time: float,
             f"last change_time ({ct[-1]}) must precede end_time ({end_time})"
         )
     mu = offline_rates(wall_rates, durations, budget)
-    return ct, np.asarray(mu, np.float64)
+    # the fit runs on device (jnp bisection); fetch the [S] rates once
+    return ct, np.asarray(jax.device_get(mu), np.float64)

@@ -198,15 +198,22 @@ def fit(key, taus, mask, hidden: int = 16, steps: int = 300,
     losses = []
     for _ in range(steps):
         weights, opt_state, loss = train_step(weights, opt_state)
-        losses.append(float(loss))
-    return weights, opt_state, np.asarray(losses)
+        # keep the per-step loss ON DEVICE: float(loss) here would force
+        # a host sync every optimizer step (the hidden round-trip RQ701
+        # exists for); one batched device_get below fetches the curve
+        losses.append(loss)
+    return weights, opt_state, np.asarray(jax.device_get(losses),
+                                          np.float64)
 
 
 def _per_event_nll(weights, taus, mask, hidden: int) -> float:
     """Total NLL / total events over a batch — the per-event score two
     weight sets are comparable on (sequence lengths vary per user)."""
     per = jax.vmap(lambda t, m: sequence_nll(weights, t, m, hidden))(taus, mask)
-    return float(per.sum() / max(int(mask.sum()), 1))
+    # one explicit transfer for both reductions (int(mask.sum()) +
+    # float(nll) would each sync separately)
+    total, n_events = jax.device_get((per.sum(), mask.sum()))
+    return float(total) / max(int(n_events), 1)
 
 
 def fit_traces(key, traces, hidden: int = 16, steps: int = 300,

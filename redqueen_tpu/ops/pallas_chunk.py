@@ -308,7 +308,9 @@ def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
         times_chunks.append(times_c[:, :B])
         srcs_chunks.append(srcs_c[:, :B])
         check = (i % sync_every == sync_every - 1) or (i == max_chunks - 1)
-        if check and not bool(
+        # The docstring's cadence-controlled liveness round-trip: ONE
+        # scalar sync every `sync_every` chunks, never per event.
+        if check and not bool(  # rqlint: disable=RQ702 cadence-gated sync
             jnp.any(jnp.min(t_next, axis=0) <= cfg.end_time)
         ):
             break
@@ -320,4 +322,4 @@ def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
         )
     times = jnp.concatenate(times_chunks, axis=0).T   # [B, E]
     srcs = jnp.concatenate(srcs_chunks, axis=0).T
-    return EventLog(times, srcs, np.asarray(nev[:B]), cfg)
+    return EventLog(times, srcs, jax.device_get(nev[:B]), cfg)

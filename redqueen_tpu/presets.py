@@ -167,6 +167,7 @@ def run_preset(bundle, seeds, mesh=None, max_chunks: int = 256,
     per-seed values. Batch bundles treat an int-array ``seeds`` as the
     component batch (must match the stacked batch dim if any); star bundles
     loop seeds host-side (each run is one big component)."""
+    import jax
     import jax.numpy as jnp
 
     kind = bundle[0]
@@ -200,9 +201,11 @@ def run_preset(bundle, seeds, mesh=None, max_chunks: int = 256,
             )
             m = feed_metrics_batch(log.times, log.srcs, adj_b, opt_row,
                                    cfg.end_time, K=metric_K)
-            tops = np.asarray(m.mean_time_in_top_k())
-            posts = np.asarray(num_posts(log.srcs, opt_row))
-            events = int(np.asarray(log.n_events).sum())
+            # explicit device->host boundary: the run is over, fetch the
+            # reduced metrics once instead of syncing np-call by np-call
+            tops = jax.device_get(m.mean_time_in_top_k())
+            posts = jax.device_get(num_posts(log.srcs, opt_row))
+            events = int(jax.device_get(log.n_events).sum())
         else:
             # Seed sweep = a vmap batch axis (SURVEY.md section 3.5), not a
             # host loop: stack the single component once per seed.
@@ -215,9 +218,9 @@ def run_preset(bundle, seeds, mesh=None, max_chunks: int = 256,
                                  max_chunks=max_chunks)
             m = feed_metrics_batch(log.times, log.srcs, adj_b, opt_row,
                                    cfg.end_time, K=metric_K)
-            tops = np.asarray(m.mean_time_in_top_k())
-            posts = np.asarray(num_posts(log.srcs, opt_row))
-            events = int(np.asarray(log.n_events).sum())
+            tops = jax.device_get(m.mean_time_in_top_k())
+            posts = jax.device_get(num_posts(log.srcs, opt_row))
+            events = int(jax.device_get(log.n_events).sum())
     elif kind == "star":
         _, cfg, wall, ctrl = bundle
         seeds_arr = np.asarray(seeds).ravel()

@@ -185,11 +185,15 @@ def _host_view(x) -> np.ndarray:
     locally-addressable shards are enough — every process validates the
     rows it owns, which collectively covers all of them (the SPMD
     contract; exercised by tests/test_multihost.py)."""
+    # rqlint: RQ701 pragmas — _host_view IS the validated-input boundary
+    # (PR 3): a deliberate, size-capped transfer for host-side checks
+    # (_FINITE_CHECK_MAX_ELEMS skips corpus-scale fields).  Sanctioning
+    # the sync here keeps every driver call edge's summary clean.
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
-        return np.concatenate(
+        return np.concatenate(  # rqlint: disable=RQ701 validated boundary
             [np.asarray(s.data).reshape(-1) for s in x.addressable_shards]
         )
-    return np.asarray(x)
+    return np.asarray(x)  # rqlint: disable=RQ701 validated boundary
 
 
 def _check_kinds(cfg: SimConfig, params: SourceParams):
@@ -331,7 +335,10 @@ def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
     k = 1
     while True:
         state, t_sc, s_sc, c, alive = chunk_fn_for(k)(
-            params, adj, state, np.int32(max_chunks - n_chunks)
+            # np.int32 of two HOST ints (no transfer; keeps the chunk
+            # budget weak-type-stable across dispatches)
+            params, adj, state,
+            np.int32(max_chunks - n_chunks),  # rqlint: disable=RQ701 host ints
         )
         k = sync_every
         # The ONE host sync per superchunk: chunks executed + liveness.
@@ -340,8 +347,12 @@ def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
         # multihost runs (where the [B] lanes span processes and could not
         # be fetched whole) — and only two scalars cross to the host.
         c_max_dev, alive_dev = _sync_reduce(c, alive)
-        c_max = int(c_max_dev)
-        alive_any = bool(alive_dev)
+        # rqlint: RQ702 pragmas — this IS the deliberate, cadence-
+        # controlled sync the comment above documents (two replicated
+        # scalars per superchunk, not per event); sanctioning it here
+        # keeps every simulate()/sweep caller's summary clean.
+        c_max = int(c_max_dev)  # rqlint: disable=RQ702 the one sync/superchunk
+        alive_any = bool(alive_dev)  # rqlint: disable=RQ702 same sync point
         # Trim unused chunk slots so the returned buffers are bit-identical
         # to the per-chunk driver's (goldens/parity unchanged).
         times_chunks.append(t_sc[..., : c_max * cap])

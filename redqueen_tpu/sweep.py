@@ -88,6 +88,14 @@ def _validate_points(points, n_seeds, vary_hint: str):
     return points, cfg0
 
 
+def _grid_host(x, P: int, n_seeds: int) -> np.ndarray:
+    """The sweep's documented device->host boundary: one explicit
+    ``jax.device_get`` per reduced [B] metric vector, reshaped to the
+    [P, n_seeds] grid.  Every SweepResult field crosses here and nowhere
+    else — host code downstream works on numpy."""
+    return np.asarray(jax.device_get(x)).reshape(P, n_seeds)
+
+
 def _reduce_to_grid(m, n_posts, P: int, n_seeds: int,
                     kernel_health=None) -> SweepResult:
     """FeedMetrics [B, F] + per-lane post counts -> [P, n_seeds] grids.
@@ -102,17 +110,14 @@ def _reduce_to_grid(m, n_posts, P: int, n_seeds: int,
     follows_n = jnp.maximum(m.follows.sum(-1), 1)
     ir2 = (m.int_rank2 * m.follows).sum(-1) / follows_n
 
-    def grid(x):
-        return np.asarray(x).reshape(P, n_seeds)
-
     values = dict(
-        time_in_top_k=grid(m.mean_time_in_top_k()),
-        average_rank=grid(m.mean_average_rank()),
-        n_posts=grid(n_posts),
-        int_rank2=grid(ir2),
+        time_in_top_k=_grid_host(m.mean_time_in_top_k(), P, n_seeds),
+        average_rank=_grid_host(m.mean_average_rank(), P, n_seeds),
+        n_posts=_grid_host(n_posts, P, n_seeds),
+        int_rank2=_grid_host(ir2, P, n_seeds),
     )
     health = (np.zeros((P, n_seeds), np.uint32) if kernel_health is None
-              else grid(kernel_health).astype(np.uint32))
+              else _grid_host(kernel_health, P, n_seeds).astype(np.uint32))
     bad = np.zeros((P, n_seeds), bool)
     for v in values.values():
         bad |= ~np.isfinite(np.asarray(v, np.float64))

@@ -10,8 +10,21 @@ cd "$(dirname "$0")/.."
 
 echo "== rqlint static pass =="
 # First gate: jax-free, so it fails fast before any backend is touched.
+# Runs in project mode (tier-2 whole-program dataflow: call-graph
+# summaries power the RQ7xx hidden-host-sync and RQ8xx recompilation
+# bands plus the cross-function RQ401/RQ501 upgrades; <10s, stdlib-only).
 # RQLINT_FINDINGS.json is the uploaded findings artifact (atomic write;
 # schema rq.rqlint.findings/1 — see docs/API.md).
+#
+# Pre-commit (fast local gate — findings restricted to files you touched
+# vs HEAD; the project view still covers the whole tree so cross-file
+# summaries stay exact):
+#     python -m tools.rqlint --changed-only
+# or against a branch point:  python -m tools.rqlint --changed-only main
+# In GitHub Actions, add `--format github` so failing findings render as
+# inline PR annotations. `--prune-baseline` drops baseline entries that
+# no longer match (a baseline referencing deleted paths FAILS this gate
+# until pruned).
 python -m tools.rqlint --json RQLINT_FINDINGS.json
 
 echo "== resilience shim (legacy contract) =="

@@ -10,9 +10,21 @@ Rule ID bands (see ``rqlint.rules``):
 - ``RQ1xx``  resilience (unguarded backend touches)
 - ``RQ2xx``  artifacts (raw, tearable artifact writes)
 - ``RQ3xx``  numerics (raw exp/log/division in kernel code)
-- ``RQ4xx``  trace-safety (host control flow on traced values)
-- ``RQ5xx``  PRNG discipline (key reuse, hard-coded seeds)
+- ``RQ4xx``  trace-safety (host control flow on traced values;
+  summary-propagated across call edges in project mode)
+- ``RQ5xx``  PRNG discipline (key reuse incl. cross-function via
+  summaries, hard-coded seeds)
 - ``RQ6xx``  benchmark honesty (unsynchronized timed regions)
+- ``RQ7xx``  hidden host-device sync (tier-2: implicit transfers on
+  summary-proven device values; per-iteration transfers in hot loops)
+- ``RQ8xx``  recompilation hazards (tier-2: varying/unhashable static
+  jit args, shape-string dispatch, strong-typed constants under jit)
+
+Tier-2 (the default "project mode") parses the whole tree once, builds
+the module/import graph, the name-resolved intra-repo call graph, and
+per-function dataflow summaries (bottom-up over SCCs with a fixpoint
+for cycles), and hands every rule a read-only ``ProjectView``.
+``--no-project`` reproduces the tier-1 per-file engine exactly.
 
 The whole package is stdlib-only at import time: it must stay usable in
 watchdog/driver contexts where jax is absent (the findings artifact is
@@ -27,7 +39,7 @@ CLI, exit codes, and violation text as the pre-rqlint monolith).
 
 from __future__ import annotations
 
-__version__ = "1.0.0"
+__version__ = "1.1.0"
 
 from .findings import Finding, Severity  # noqa: F401
 from .rules import all_rules, select_rules  # noqa: F401

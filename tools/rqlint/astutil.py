@@ -89,6 +89,20 @@ def assign_target_names(node) -> List[str]:
     return names
 
 
+def jit_decorated(fn) -> bool:
+    """True when a FunctionDef is jit-compiled via decorator: bare or
+    dotted ``jit``/``pjit``/``pmap``, or ``partial(jax.jit, ...)``."""
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if chain_tail(target) in {"jit", "pjit", "pmap"}:
+            return True
+        if (isinstance(dec, ast.Call) and chain_tail(dec.func) == "partial"
+                and dec.args
+                and chain_tail(dec.args[0]) in {"jit", "pjit", "pmap"}):
+            return True
+    return False
+
+
 def function_defs(tree: ast.AST):
     """Every FunctionDef/AsyncFunctionDef in the module, nested included."""
     return [n for n in ast.walk(tree)

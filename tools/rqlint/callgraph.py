@@ -1,0 +1,132 @@
+"""Name-resolved intra-repo call graph over the ProjectView.
+
+``collect_functions`` catalogues every module-level function and every
+one-level class method (``Class.method``) as a ``FunctionInfo``; nested
+``def``s and lambdas are deliberately NOT catalogued — calls to them stay
+unresolved and the consuming rules fall back to tier-1 conservatism.
+
+``call_edges`` resolves every dotted call in each function body (through
+import aliases, ``from x import y as z``, relative imports, one-hop
+re-exports, and ``self.method``) to an intra-repo callee, producing the
+graph :mod:`summaries` runs its bottom-up SCC fixpoint over.
+
+``sccs`` is an iterative Tarjan: it emits strongly-connected components
+in reverse-topological order (callees before callers), which is exactly
+the summary computation order — mutually-recursive functions land in one
+SCC and get a joint fixpoint instead of an unbounded recursion.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .astutil import attr_chain, param_names
+
+
+class FunctionInfo:
+    """One summarizable function: ``fid`` is ``module::qualname``."""
+
+    __slots__ = ("fid", "modname", "qualname", "node", "params",
+                 "encl_class")
+
+    def __init__(self, fid: str, modname: str, qualname: str,
+                 node: ast.AST, encl_class: Optional[str]) -> None:
+        self.fid = fid
+        self.modname = modname
+        self.qualname = qualname
+        self.node = node
+        self.params: List[str] = param_names(node)
+        self.encl_class = encl_class
+
+
+def collect_functions(view) -> Dict[str, FunctionInfo]:
+    out: Dict[str, FunctionInfo] = {}
+    for modname, mod in view.modules.items():
+        for qual, node in mod.defs.items():
+            encl = qual.split(".")[0] if "." in qual else None
+            fid = f"{modname}::{qual}"
+            out[fid] = FunctionInfo(fid, modname, qual, node, encl)
+    return out
+
+
+def body_nodes(fn: ast.AST) -> List[ast.AST]:
+    """All nodes of a function body excluding nested function/class/lambda
+    subtrees (those are separate — or unsummarized — scopes)."""
+    skip: Set[int] = set()
+    for node in ast.walk(fn):
+        if node is fn:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+    return [n for n in ast.walk(fn) if id(n) not in skip]
+
+
+def call_edges(view) -> Dict[str, Set[str]]:
+    """fid -> set of resolved intra-repo callee fids."""
+    graph: Dict[str, Set[str]] = {fid: set() for fid in view.functions}
+    for fid, info in view.functions.items():
+        for node in body_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            r = view.resolve(info.modname, chain, info.encl_class)
+            if r is not None and r[0] == "func":
+                graph[fid].add(r[1])
+    return graph
+
+
+def sccs(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan SCCs, iteratively (no recursion-limit hazard on deep call
+    chains), emitted callees-first."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in graph:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in graph:
+                    continue  # edge to a node outside the graph
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+    return out

@@ -15,14 +15,47 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
-from typing import List
+from collections import Counter
+from typing import List, Optional
 
 from . import __version__, baseline as baseline_mod, engine
 from .findings import Finding
 from .rules import select_rules
 
 ARTIFACT_SCHEMA = "rq.rqlint.findings/1"
+
+
+def changed_files(root: str, ref: str) -> Optional[List[str]]:
+    """Python files touched vs ``ref`` (committed diff + staged +
+    working tree + untracked) — the ``--changed-only`` pre-commit set.
+    None when git itself fails (not a repo, unknown ref)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    names = set(diff.stdout.splitlines()) | set(
+        untracked.stdout.splitlines())
+    return sorted(n for n in names
+                  if n.endswith(".py")
+                  and os.path.exists(os.path.join(root, n)))
+
+
+def github_annotation(f: Finding) -> str:
+    """One GitHub Actions workflow command per failing finding — CI
+    renders these as inline PR annotations."""
+    msg = f.message.replace("%", "%25").replace("\r", "%0D") \
+                   .replace("\n", "%0A")
+    return (f"::error file={f.path},line={f.line},"
+            f"col={f.col + 1},title=rqlint {f.rule}::{msg}")
 
 
 def _atomic_write_json(path: str, obj) -> None:
@@ -83,6 +116,23 @@ def main(argv=None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current findings "
                          "and exit 0")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries that no longer match "
+                         "any finding (or whose file is gone), rewrite "
+                         "the baseline, and exit 0")
+    ap.add_argument("--no-project", action="store_true",
+                    help="tier-1 per-file mode: skip the whole-program "
+                         "pass and the RQ7xx/RQ8xx project rules")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="REF",
+                    help="report findings only for files changed vs a "
+                         "git ref (default HEAD) — the fast pre-commit "
+                         "gate; the project view still covers the full "
+                         "tree")
+    ap.add_argument("--format", choices=("human", "github"),
+                    default="human",
+                    help="per-finding output: human lines, or GitHub "
+                         "Actions ::error annotations (inline in CI)")
     ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-q", "--quiet", action="store_true",
@@ -95,6 +145,10 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"rqlint: {e}", file=sys.stderr)
         return 2
+    if args.no_project:
+        # tier-1 mode: the project rules can't run; reflect that in the
+        # rule list (and the summary line) instead of silently skipping
+        rules = [r for r in rules if not r.needs_project]
 
     if args.list_rules:
         for r in rules:
@@ -105,12 +159,44 @@ def main(argv=None) -> int:
     baseline_path = args.baseline or os.path.join(
         root, baseline_mod.DEFAULT_RELPATH)
 
+    paths = args.paths or None
+    if (args.prune_baseline or args.update_baseline) and (
+            args.paths or args.changed_only is not None):
+        # a restricted scan would rewrite the baseline from a PARTIAL
+        # finding set, silently erasing the debt of every unscanned file
+        print("rqlint: --prune-baseline/--update-baseline need a "
+              "full-tree scan (no paths / --changed-only)",
+              file=sys.stderr)
+        return 2
+    if args.prune_baseline and args.no_baseline:
+        # with the baseline unapplied nothing is marked absorbed, so
+        # pruning would drop every entry and report success
+        print("rqlint: --prune-baseline and --no-baseline are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
+    if args.changed_only is not None:
+        if args.paths:
+            print("rqlint: --changed-only and explicit paths are "
+                  "mutually exclusive", file=sys.stderr)
+            return 2
+        changed = changed_files(root, args.changed_only)
+        if changed is None:
+            print(f"rqlint: --changed-only: git diff vs "
+                  f"{args.changed_only!r} failed (not a repo, or "
+                  f"unknown ref)", file=sys.stderr)
+            return 2
+        if not changed:
+            print(f"rqlint: no python files changed vs "
+                  f"{args.changed_only} — nothing to lint")
+            return 0
+        paths = changed
     try:
         result = engine.run(root=root, rules=rules,
-                            paths=args.paths or None,
+                            paths=paths,
                             baseline_path=baseline_path,
                             use_baseline=not (args.no_baseline
-                                              or args.update_baseline))
+                                              or args.update_baseline),
+                            project=not args.no_project)
     except Exception as e:  # engine bugs must not look like a clean tree
         print(f"rqlint: internal error: {e!r}", file=sys.stderr)
         return 2
@@ -135,11 +221,63 @@ def main(argv=None) -> int:
                  if keep else ""))
         return 0
 
+    if args.prune_baseline:
+        # an entry survives iff it absorbed a finding in THIS full scan
+        # (multiset-consumed, same identity the baseline matches on);
+        # entries for deleted files can't match and are dropped too.
+        # Entries of rules that did NOT run (--select subset,
+        # --no-project skipping tier-2) are preserved verbatim — same
+        # reason --update-baseline keeps them: a rule that produced no
+        # findings because it never ran proves nothing about its debt.
+        entries = baseline_mod.raw_entries(baseline_path)
+        active = {r.id for r in rules} | {engine.RQ000}
+        absorbed = Counter((f.rule, f.path, f.code)
+                           for f in findings if f.baselined)
+        kept, dropped = [], []
+        for e in entries:
+            k = (e["rule"], e["path"], e.get("code", ""))
+            if e.get("rule") not in active:
+                kept.append(e)  # rule didn't run: debt stays recorded
+            elif absorbed.get(k, 0) > 0:
+                absorbed[k] -= 1
+                kept.append(e)
+            else:
+                dropped.append(e)
+        _atomic_write_json(baseline_path,
+                           {"schema": baseline_mod.SCHEMA,
+                            "findings": kept})
+        if args.json:
+            _atomic_write_json(args.json, artifact_doc(result))
+        print(f"rqlint: baseline pruned: {len(dropped)} stale "
+              f"entr{'y' if len(dropped) == 1 else 'ies'} dropped, "
+              f"{len(kept)} kept -> "
+              f"{os.path.relpath(baseline_path, root)}")
+        return 0
+
+    # A baseline that references deleted files is rotten debt: fail CI
+    # until --prune-baseline is run (a full scan can never absorb them).
+    if not args.no_baseline:
+        stale = sorted({e["path"]
+                        for e in baseline_mod.raw_entries(baseline_path)
+                        if not os.path.exists(
+                            os.path.join(root, e["path"]))})
+        if stale:
+            for p in stale:
+                print(f"rqlint: baseline references deleted path: {p}",
+                      file=sys.stderr)
+            print("rqlint: run `python -m tools.rqlint "
+                  "--prune-baseline` to drop stale entries",
+                  file=sys.stderr)
+            return 1
+
     if args.json:
         _atomic_write_json(args.json, artifact_doc(result))
 
     failing = engine.failing(findings)
-    if not args.quiet:
+    if args.format == "github":
+        for f in failing:
+            print(github_annotation(f))
+    elif not args.quiet:
         for f in findings:
             print(f.format())
     n_base = sum(1 for f in findings if f.baselined)

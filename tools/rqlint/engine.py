@@ -6,6 +6,16 @@ file once PER PASS: here a file is read once, parsed once, and every
 applicable rule runs over the same tree.  An unparseable file yields an
 RQ000 finding (never a crash); a crashing RULE yields an RQ000 finding
 naming the rule, so one buggy rule cannot mask the others' verdicts.
+
+Tier-2 adds a TWO-PASS project mode (the default): pass one parses the
+whole tree and builds the read-only :class:`~tools.rqlint.project.
+ProjectView` (module/import graph, call graph, bottom-up dataflow
+summaries); pass two runs the per-file rules, each receiving the view
+through ``ctx.project``.  Even when findings are restricted to a subset
+of files (explicit paths, ``--changed-only``), the view is still built
+over the FULL tree — cross-file summaries must not degrade just because
+reporting narrowed.  ``--no-project`` skips pass one and the
+``needs_project`` rules, reproducing the tier-1 engine exactly.
 """
 
 from __future__ import annotations
@@ -14,11 +24,12 @@ import ast
 import glob
 import os
 import traceback
-from typing import Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import baseline as baseline_mod
 from . import pragmas
 from .findings import Finding, Severity, finding_at, replace, sort_key
+from .project import ProjectView
 from .rules import all_rules
 from .rules.base import FileContext, Rule
 
@@ -65,21 +76,31 @@ def iter_files(root: str,
 
 
 def check_source(source: str, relpath: str,
-                 rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+                 rules: Optional[Sequence[Rule]] = None,
+                 project: Optional[ProjectView] = None,
+                 tree: Optional[ast.AST] = None,
+                 pragma_maps=None) -> List[Finding]:
     """Lint one in-memory source blob as if it lived at ``relpath`` —
-    the fixture-test entry point.  Applies pragmas, not the baseline."""
+    the fixture-test entry point.  Applies pragmas, not the baseline.
+    ``project`` is the tier-2 view (None = tier-1: ``needs_project``
+    rules are skipped); ``tree`` reuses an already-parsed AST;
+    ``pragma_maps`` reuses an already-tokenized pragma extraction."""
     rules = list(rules) if rules is not None else all_rules()
-    per_line, file_wide = pragmas.extract(source)
-    try:
-        tree = ast.parse(source, filename=relpath)
-    except (SyntaxError, ValueError) as e:
-        ctx = FileContext(relpath, source, None)
-        return [finding_at(RQ000, ctx, None,
-                           f"unparseable file skipped: {e}", line=0)]
-    ctx = FileContext(relpath, source, tree)
+    per_line, file_wide = pragma_maps if pragma_maps is not None \
+        else pragmas.extract(source)
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except (SyntaxError, ValueError) as e:
+            ctx = FileContext(relpath, source, None)
+            return [finding_at(RQ000, ctx, None,
+                               f"unparseable file skipped: {e}", line=0)]
+    ctx = FileContext(relpath, source, tree, project=project)
     findings: List[Finding] = []
     for rule in rules:
         if not rule.applies_to(ctx.relpath):
+            continue
+        if rule.needs_project and project is None:
             continue
         try:
             found = list(rule.check(ctx))
@@ -99,30 +120,82 @@ def check_source(source: str, relpath: str,
     return out
 
 
+def check_sources(files: Dict[str, str],
+                  rules: Optional[Sequence[Rule]] = None,
+                  ) -> Dict[str, List[Finding]]:
+    """Lint a set of in-memory files AS A PROJECT (the tier-2 fixture
+    entry point): {relpath: source} in, {relpath: findings} out, with a
+    ProjectView built over exactly these files."""
+    parsed: Dict[str, ast.AST] = {}
+    for rel, src in files.items():
+        try:
+            parsed[rel] = ast.parse(src, filename=rel)
+        except (SyntaxError, ValueError):
+            continue
+    view = ProjectView.build(parsed, files)
+    return {rel: check_source(src, rel, rules, project=view,
+                              tree=parsed.get(rel))
+            for rel, src in files.items()}
+
+
+def _read_tree(root: str, rels: Sequence[str]
+               ) -> Tuple[Dict[str, str], Dict[str, ast.AST],
+                          List[Finding]]:
+    """One read + one parse per file: (sources, trees, io-findings)."""
+    sources: Dict[str, str] = {}
+    trees: Dict[str, ast.AST] = {}
+    io_findings: List[Finding] = []
+    for rel in rels:
+        ap = os.path.join(root, rel)
+        try:
+            with open(ap, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError as e:
+            ctx = FileContext(rel, "", None)
+            io_findings.append(finding_at(
+                RQ000, ctx, None, f"unreadable file skipped: {e}",
+                line=0))
+            continue
+        try:
+            trees[rel] = ast.parse(sources[rel], filename=rel)
+        except (SyntaxError, ValueError):
+            pass  # check_source re-raises this as the RQ000 finding
+    return sources, trees, io_findings
+
+
 def run(root: Optional[str] = None,
         rules: Optional[Sequence[Rule]] = None,
         paths: Optional[Sequence[str]] = None,
         baseline_path: Optional[str] = None,
-        use_baseline: bool = True) -> dict:
+        use_baseline: bool = True,
+        project: bool = True) -> dict:
     """Lint the tree.  Returns ``{"findings", "files_scanned", "rules",
-    "root"}`` — findings carry their suppressed/baselined state; the
-    caller decides presentation and exit code."""
+    "root", "project"}`` — findings carry their suppressed/baselined
+    state; the caller decides presentation and exit code.
+
+    ``paths`` restricts which files findings are REPORTED for; in
+    project mode the whole tree is still parsed so cross-file summaries
+    stay exact.  ``project=False`` is the tier-1 engine: per-file only,
+    ``needs_project`` rules skipped."""
     root = root or repo_root()
     rules = list(rules) if rules is not None else all_rules()
-    findings: List[Finding] = []
-    files = iter_files(root, paths)
-    for rel in files:
-        ap = os.path.join(root, rel)
-        try:
-            with open(ap, encoding="utf-8") as f:
-                source = f.read()
-        except OSError as e:
-            ctx = FileContext(rel, "", None)
-            findings.append(finding_at(RQ000, ctx, None,
-                                       f"unreadable file skipped: {e}",
-                                       line=0))
-            continue
-        findings.extend(check_source(source, rel, rules))
+    report = iter_files(root, paths)
+    if project:
+        scan = sorted(set(iter_files(root)) | set(report))
+    else:
+        scan = report
+    sources, trees, io_findings = _read_tree(root, scan)
+    view = ProjectView.build(trees, sources) if project else None
+    findings: List[Finding] = [f for f in io_findings
+                               if f.path in set(report)]
+    for rel in report:
+        if rel not in sources:
+            continue  # unreadable: RQ000 already recorded above
+        mod = view.by_relpath.get(rel) if view is not None else None
+        findings.extend(check_source(
+            sources[rel], rel, rules, project=view,
+            tree=trees.get(rel),
+            pragma_maps=mod.pragma_maps() if mod is not None else None))
     if use_baseline:
         bp = baseline_path or os.path.join(root,
                                            baseline_mod.DEFAULT_RELPATH)
@@ -130,9 +203,10 @@ def run(root: Optional[str] = None,
     findings.sort(key=sort_key)
     return {
         "findings": findings,
-        "files_scanned": len(files),
+        "files_scanned": len(report),
         "rules": rules,
         "root": root,
+        "project": view,
     }
 
 

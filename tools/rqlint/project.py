@@ -1,0 +1,302 @@
+"""Tier-2 whole-program layer: the module/import graph and the read-only
+``ProjectView`` every rule receives in project mode.
+
+The view is built once per run from the already-parsed trees (the engine
+parses each file exactly once; tier-2 adds no re-reads): module names
+derived from repo-relative paths, an import table per module (``import
+a.b as c`` / ``from x import y as z`` / relative imports, re-exports
+chased one hop at a time), the top-level function/method catalogue, and
+— via :mod:`callgraph` and :mod:`summaries` — the name-resolved call
+graph and the per-function dataflow summaries computed bottom-up over
+its SCCs.
+
+Resolution is deliberately *intra-repo and conservative*: a dotted call
+either resolves to a function this repo defines (then its summary is
+authoritative) or it does not resolve (then rules fall back to their
+tier-1 conservative behavior).  Nested ``def``s and lambdas are not
+summarized — calls to them simply stay unresolved, which only costs
+precision, never soundness-within-policy.
+
+Stdlib-only, like the rest of rqlint: the whole tier-2 layer must run in
+watchdog/driver contexts with no jax installed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: re-export chase depth bound (a.b -> from c import b -> ...)
+_MAX_CHASE = 6
+
+
+def module_name(relpath: str) -> str:
+    """``redqueen_tpu/ops/scan_core.py`` -> ``redqueen_tpu.ops.scan_core``;
+    a package ``__init__.py`` names the package itself."""
+    rel = relpath.replace("\\", "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    parts = [p for p in rel.split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class ModuleInfo:
+    """One parsed module: its import table (local name -> dotted target),
+    top-level function defs (methods as ``Class.method``), class names,
+    and the file's pragma map (so summaries can honor a sanction at the
+    sync site — see :mod:`summaries`)."""
+
+    __slots__ = ("name", "relpath", "tree", "is_package", "imports",
+                 "defs", "classes", "_pragma_lines", "_pragma_file")
+
+    def __init__(self, name: str, relpath: str, tree: ast.AST,
+                 source: Optional[str] = None) -> None:
+        self.name = name
+        self.relpath = relpath
+        self.tree = tree
+        self.is_package = relpath.endswith("__init__.py")
+        self.imports: Dict[str, str] = {}
+        self.defs: Dict[str, ast.AST] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        if source is not None:
+            from . import pragmas
+            self._pragma_lines, self._pragma_file = pragmas.extract(
+                source)
+        else:
+            self._pragma_lines, self._pragma_file = {}, set()
+        self._collect()
+
+    def pragma_maps(self):
+        """(per-line pragma map, file-wide pragma set) — extracted once
+        at view build; the engine reuses them so a project-mode run
+        tokenizes each file exactly once."""
+        return self._pragma_lines, self._pragma_file
+
+    def pragma_sanctions(self, line: int, ids) -> bool:
+        """True when an inline/file pragma at ``line`` disables any rule
+        in ``ids`` (``ALL`` included) — the audited-boundary sanction
+        the summary layer honors."""
+        ids = set(ids)
+        if self._pragma_file & ids:
+            return True
+        return bool(self._pragma_lines.get(line, set()) & ids)
+
+    # -- construction ------------------------------------------------------
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.imports[alias.asname] = alias.name
+                    else:
+                        head = alias.name.split(".")[0]
+                        self.imports[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = (base + "." + alias.name
+                                           if base else alias.name)
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[stmt.name] = stmt
+            elif isinstance(stmt, ast.ClassDef):
+                self.classes[stmt.name] = stmt
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.defs[f"{stmt.name}.{sub.name}"] = sub
+
+    def _from_base(self, node: ast.ImportFrom) -> Optional[str]:
+        """Absolute dotted base a ``from ... import`` pulls from (handles
+        relative levels against this module's package)."""
+        if node.level == 0:
+            return node.module or ""
+        pkg = self.name.split(".")
+        if not self.is_package:
+            pkg = pkg[:-1]
+        up = node.level - 1
+        if up > len(pkg):
+            return None
+        base_parts = pkg[:len(pkg) - up] if up else pkg
+        if node.module:
+            base_parts = base_parts + node.module.split(".")
+        return ".".join(base_parts)
+
+
+class ProjectView:
+    """Read-only whole-program view handed to rules in project mode:
+    ``modules`` (by dotted name), ``by_relpath``, ``functions`` (fid ->
+    FunctionInfo, from :mod:`callgraph`), and ``summaries`` (fid ->
+    Summary, from :mod:`summaries`)."""
+
+    def __init__(self, modules: Dict[str, ModuleInfo]) -> None:
+        self.modules = modules
+        self.by_relpath: Dict[str, ModuleInfo] = {
+            m.relpath: m for m in modules.values()}
+        # filled by build(); typed loosely to keep this module standalone
+        self.functions: Dict[str, object] = {}
+        self.summaries: Dict[str, object] = {}
+
+    @classmethod
+    def build(cls, parsed: Dict[str, ast.AST],
+              sources: Optional[Dict[str, str]] = None) -> "ProjectView":
+        """Construct the full tier-2 view from {relpath: tree} (plus the
+        matching sources, for the pragma-sanction map).  Modules whose
+        derived names collide (shouldn't happen in-tree) keep the first
+        occurrence."""
+        modules: Dict[str, ModuleInfo] = {}
+        for relpath, tree in sorted(parsed.items()):
+            name = module_name(relpath)
+            if name and name not in modules:
+                modules[name] = ModuleInfo(
+                    name, relpath, tree,
+                    (sources or {}).get(relpath))
+        view = cls(modules)
+        from . import callgraph, summaries  # late: avoid import cycles
+        view.functions = callgraph.collect_functions(view)
+        view.summaries = summaries.compute(view)
+        return view
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(self, modname: str, chain: Sequence[str],
+                encl_class: Optional[str] = None
+                ) -> Optional[Tuple[str, str]]:
+        """Resolve a dotted reference used inside ``modname`` to an
+        intra-repo definition: ``("func", fid)`` or ``("class", cid)``,
+        ids are ``module::qualname``.  None when it doesn't resolve."""
+        mod = self.modules.get(modname)
+        if mod is None or not chain:
+            return None
+        head = chain[0]
+        if head == "self" and encl_class and len(chain) == 2:
+            qual = f"{encl_class}.{chain[1]}"
+            if qual in mod.defs:
+                return ("func", f"{modname}::{qual}")
+            return None
+        if len(chain) == 1:
+            if head in mod.defs:
+                return ("func", f"{modname}::{head}")
+            if head in mod.classes:
+                return ("class", f"{modname}::{head}")
+            tgt = mod.imports.get(head)
+            return self._resolve_dotted(tgt) if tgt else None
+        if head in mod.classes and len(chain) == 2:
+            qual = f"{head}.{chain[1]}"
+            if qual in mod.defs:
+                return ("func", f"{modname}::{qual}")
+        tgt = mod.imports.get(head)
+        if tgt is None:
+            return None
+        return self._resolve_dotted(".".join([tgt] + list(chain[1:])))
+
+    def resolve_func(self, modname: str, chain: Sequence[str],
+                     encl_class: Optional[str] = None) -> Optional[str]:
+        r = self.resolve(modname, chain, encl_class)
+        return r[1] if r and r[0] == "func" else None
+
+    def resolve_call(self, relpath: str, call: ast.Call,
+                     encl_class: Optional[str] = None
+                     ) -> Optional[Tuple[str, str]]:
+        """Resolve a Call node appearing in ``relpath``."""
+        from .astutil import attr_chain
+        mod = self.by_relpath.get(relpath.replace("\\", "/"))
+        if mod is None:
+            return None
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        return self.resolve(mod.name, chain, encl_class)
+
+    def _resolve_dotted(self, full: str,
+                        depth: int = 0) -> Optional[Tuple[str, str]]:
+        """Resolve an absolute dotted path, chasing one re-export hop per
+        recursion (``from .supervisor import ensure_backend`` style)."""
+        if depth > _MAX_CHASE or not full:
+            return None
+        parts = full.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mname = ".".join(parts[:i])
+            mod = self.modules.get(mname)
+            if mod is None:
+                continue
+            qual = ".".join(parts[i:])
+            if qual in mod.defs:
+                return ("func", f"{mname}::{qual}")
+            if qual in mod.classes:
+                return ("class", f"{mname}::{qual}")
+            head = parts[i]
+            tgt = mod.imports.get(head)
+            if tgt is not None:
+                rest = parts[i + 1:]
+                return self._resolve_dotted(".".join([tgt] + rest),
+                                            depth + 1)
+            return None
+        return None
+
+    # -- convenience -------------------------------------------------------
+
+    def summary_for_call(self, relpath: str, call: ast.Call,
+                         encl_class: Optional[str] = None):
+        """(fid, Summary) when the call resolves to a summarized function,
+        else (None, None)."""
+        r = self.resolve_call(relpath, call, encl_class)
+        if r is None or r[0] != "func":
+            return None, None
+        fid = r[1]
+        return fid, self.summaries.get(fid)
+
+    def callee_arg_indices(self, fid: str,
+                           call: ast.Call) -> List[Tuple[int, ast.AST]]:
+        """(callee param index, arg expr) pairs for a resolved call —
+        positional by position, keywords by the callee's param names;
+        *args/**kwargs fan-in is skipped (conservative).  A bound-method
+        call (``obj.m(v)`` resolved to ``Class.m(self, v)``) shifts the
+        positional mapping past ``self``."""
+        info = self.functions.get(fid)
+        params: List[str] = getattr(info, "params", [])
+        offset = 0
+        if getattr(info, "encl_class", None) and isinstance(
+                call.func, ast.Attribute):
+            from .astutil import attr_chain
+            chain = attr_chain(call.func)
+            # unbound spellings — C.m(obj, v) / mod.C.m(obj, v) — keep
+            # positional args aligned with (self, ...); any other
+            # receiver (obj.m(v), self.m(v)) is a bound call
+            if not (len(chain) >= 2 and chain[-2] == info.encl_class):
+                offset = 1
+        out: List[Tuple[int, ast.AST]] = []
+        for j, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            out.append((j + offset, arg))
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            if kw.arg in params:
+                out.append((params.index(kw.arg), kw.value))
+        return out
+
+    def import_graph(self) -> Dict[str, set]:
+        """module -> set of intra-repo modules it imports (the coarse
+        project graph; diagnostic/teaching surface, also used by tests)."""
+        graph: Dict[str, set] = {}
+        for name, mod in self.modules.items():
+            deps = set()
+            for tgt in mod.imports.values():
+                parts = tgt.split(".")
+                for i in range(len(parts), 0, -1):
+                    cand = ".".join(parts[:i])
+                    if cand in self.modules and cand != name:
+                        deps.add(cand)
+                        break
+            graph[name] = deps
+        return graph

@@ -1,8 +1,11 @@
 """Rule registry.
 
 Stable ID bands: RQ1xx resilience, RQ2xx artifacts, RQ3xx numerics,
-RQ4xx trace-safety, RQ5xx PRNG discipline, RQ6xx benchmark honesty.
+RQ4xx trace-safety, RQ5xx PRNG discipline, RQ6xx benchmark honesty,
+RQ7xx hidden host-sync (tier-2), RQ8xx recompilation hazards (tier-2).
 RQ000 (unparseable file) is emitted by the engine itself, not a rule.
+Tier-2 rules carry ``needs_project`` and are skipped under
+``--no-project`` (which therefore reproduces the tier-1 rule set).
 
 ``select_rules("RQ4")`` prefix-matches, so a band can be run alone.
 """
@@ -14,8 +17,10 @@ from typing import List, Optional, Sequence
 from .artifacts import RawArtifactWriteRule
 from .base import FileContext, Rule  # noqa: F401 (re-export)
 from .bench import UnsyncedTimingRule
+from .hostsync import HiddenSyncRule, HotLoopTransferRule
 from .numerics import RawNumericsRule
 from .prng import ConstantSeedRule, KeyReuseRule
+from .recompile import RecompilationHazardRule, WeakTypeWideningRule
 from .resilience import BackendGuardRule
 from .trace_safety import TraceSafetyRule
 
@@ -27,6 +32,10 @@ REGISTRY = (
     KeyReuseRule,
     ConstantSeedRule,
     UnsyncedTimingRule,
+    HiddenSyncRule,
+    HotLoopTransferRule,
+    RecompilationHazardRule,
+    WeakTypeWideningRule,
 )
 
 

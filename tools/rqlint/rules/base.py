@@ -11,7 +11,8 @@ To add a rule (the one-file home every future invariant gets):
 
 1. subclass ``Rule`` in a module under ``rqlint/rules/``, pick the next
    free ID in the matching band (RQ1xx resilience, RQ2xx artifacts,
-   RQ3xx numerics, RQ4xx trace-safety, RQ5xx PRNG, RQ6xx benchmarking),
+   RQ3xx numerics, RQ4xx trace-safety, RQ5xx PRNG, RQ6xx benchmarking,
+   RQ7xx host-sync, RQ8xx recompilation),
 2. scope it with ``paths`` (fnmatch globs on the repo-relative path),
 3. implement ``check(ctx)`` yielding findings via
    ``findings.finding_at``,
@@ -59,16 +60,19 @@ def _glob_to_re(pat: str) -> "re.Pattern":
 
 class FileContext:
     """One parsed file, shared by every rule: ``relpath`` (repo-relative,
-    forward slashes), ``source``, ``lines``, and ``tree`` (None only for
+    forward slashes), ``source``, ``lines``, ``tree`` (None only for
     the engine's internal RQ000 path — rules are never invoked on an
-    unparseable file)."""
+    unparseable file), and ``project`` — the read-only tier-2
+    :class:`~tools.rqlint.project.ProjectView` in project mode, None
+    under ``--no-project``."""
 
     def __init__(self, relpath: str, source: str,
-                 tree: Optional[ast.AST]) -> None:
+                 tree: Optional[ast.AST], project=None) -> None:
         self.relpath = relpath.replace("\\", "/")
         self.source = source
         self.lines: List[str] = source.splitlines()
         self.tree = tree
+        self.project = project
 
 
 class Rule:
@@ -81,6 +85,10 @@ class Rule:
     description: str = ""
     #: fnmatch globs (repo-relative, forward slashes) this rule runs on.
     paths: Sequence[str] = ("*.py",)
+    #: tier-2 rules require the whole-program ProjectView; the engine
+    #: skips them under ``--no-project`` (which therefore reproduces the
+    #: tier-1 rule set exactly).
+    needs_project: bool = False
 
     def applies_to(self, relpath: str) -> bool:
         relpath = relpath.replace("\\", "/")
@@ -92,6 +100,7 @@ class Rule:
     def meta(self) -> dict:
         return {"id": self.id, "name": self.name,
                 "severity": self.severity, "paths": list(self.paths),
+                "needs_project": self.needs_project,
                 "description": self.description}
 
 

@@ -1,0 +1,373 @@
+"""RQ701/RQ702 — hidden device->host synchronization in HOST code.
+
+JAX dispatch is asynchronous and device-resident: a value returned by a
+jitted/dispatched computation stays on device until something forces it
+across the transfer boundary.  ``float()`` / ``int()`` / ``bool()``,
+``.item()`` / ``.tolist()``, ``np.asarray`` and every implicit ``np.*``
+ufunc each force that transfer *silently* — three calls away from the
+dispatch, nothing in the source says "this line blocks on the device".
+At corpus scale (the 8.58M-row config-4 pipeline) those hidden
+round-trips dominate wall clock, which is why they must be caught before
+they reach a bench line (the paper's O(1)-per-event claim dies by a
+thousand ``float()``s, not by the kernel).
+
+- **RQ701** — a hidden sync on a value the tier-2 summaries prove flows
+  from dispatched computation, outside an explicitly-synchronized
+  region.  The sanctioned fixes: ``jax.device_get(...)`` at a
+  documented boundary (explicit, batched), or ``block_until_ready`` on
+  the value first (after which host conversions are no longer *hidden*
+  — the explicitly-timed-region idiom), or a line pragma with prose for
+  genuinely host-only paths.
+- **RQ702** — a device->host transfer (hidden OR explicit
+  ``device_get``) executed per-iteration of a Python loop, or a Python
+  loop/comprehension iterating a device array element-by-element.  The
+  per-event round-trip is the single costliest anti-pattern the paper's
+  throughput claim rules out; batch the transfer outside the loop.
+
+Scope: host code only — traced contexts (jit/scan/vmap bodies) are
+RQ401's domain and are excluded here.  Device provenance is the shared
+``summaries.device_expr`` classifier: ``jnp.``/``lax.``/``jax.`` calls,
+jit-decorated or summary-proven device-returning intra-repo callees
+(cross-function, cross-module), constructors wrapping device values,
+and conservative propagation through unresolved calls fed device
+values.  Function parameters are NOT assumed device — the cross-function
+case is caught at the CALLER (passing a device value into a callee
+position the summary proves is force-synced).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..astutil import assign_target_names, attr_chain, name_ids
+from ..findings import finding_at
+from ..summaries import (CONCRETIZERS, EMPTY, HOST_METHODS, NP_HEADS,
+                         NP_METADATA, device_expr)
+from .base import Rule
+from .trace_safety import _traced_contexts
+
+#: everything rqlint scans — hidden syncs hide anywhere host code runs
+HOST_PATHS = ("*.py", "tools/*.py", "benchmarks/*.py",
+              "experiments/*.py", "redqueen_tpu/**/*.py")
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                   ast.DictComp)
+
+
+#: all Name ids under a node (astutil's helper, shared with recompile)
+_base_names = name_ids
+
+
+class _Loop:
+    """One enclosing host loop: the names (re)bound inside it."""
+
+    def __init__(self, node: ast.AST) -> None:
+        self.node = node
+        self.assigned: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                self.assigned.update(assign_target_names(sub))
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                self.assigned.update(_base_names(sub.target))
+
+
+class _HostScan:
+    """Forward device-provenance scan of ONE host scope."""
+
+    def __init__(self, ctx, view, encl_class: Optional[str]) -> None:
+        self.ctx = ctx
+        self.view = view
+        mod = view.by_relpath.get(ctx.relpath) if view else None
+        self.modname = mod.name if mod else None
+        self.encl_class = encl_class
+        self.device: Set[str] = set()
+        self.synced: Set[str] = set()
+        self.loops: List[_Loop] = []
+        self.findings: List = []
+        self.reported: Set[int] = set()
+
+    # -- resolution / classification ---------------------------------------
+
+    def _resolve(self, chain) -> Optional[Tuple[str, str]]:
+        if self.view is None or self.modname is None:
+            return None
+        return self.view.resolve(self.modname, chain, self.encl_class)
+
+    def _summaries(self) -> Dict:
+        return self.view.summaries if self.view is not None else {}
+
+    def is_device(self, e: ast.AST) -> bool:
+        return device_expr(e, self.device, self._resolve,
+                           self._summaries())
+
+    def _escaped(self, e: ast.AST) -> bool:
+        """True when every device name feeding ``e`` was explicitly
+        synchronized (block_until_ready) — the conversion is no longer
+        hidden."""
+        dev = _base_names(e) & self.device
+        return bool(dev) and dev <= self.synced
+
+    def _hot(self, e: ast.AST) -> bool:
+        """Per-iteration transfer: inside a loop AND the value is fresh
+        each pass (produced by a call in the expression, or derived from
+        a name the loop rebinds)."""
+        if not self.loops:
+            return False
+        if any(isinstance(n, ast.Call) for n in ast.walk(e)):
+            return True
+        names = _base_names(e)
+        return any(names & lp.assigned for lp in self.loops)
+
+    # -- findings ----------------------------------------------------------
+
+    def _report(self, node: ast.AST, desc: str, hot: bool) -> None:
+        if id(node) in self.reported:
+            return
+        self.reported.add(id(node))
+        if hot:
+            self.findings.append(finding_at(
+                HotLoopTransferRule.id, self.ctx, node,
+                f"{desc} inside a Python loop — a per-iteration "
+                f"device->host round-trip; batch the transfer outside "
+                f"the loop"))
+        else:
+            self.findings.append(finding_at(
+                HiddenSyncRule.id, self.ctx, node,
+                f"{desc} — make the boundary explicit with "
+                f"jax.device_get(...) (or block_until_ready first)"))
+
+    def _check_call(self, call: ast.Call) -> None:
+        chain = attr_chain(call.func)
+        tail = chain[-1] if chain else ""
+        args = [a for a in call.args if not isinstance(a, ast.Starred)] \
+            + [k.value for k in call.keywords]
+        if tail in CONCRETIZERS and len(chain) == 1:
+            for a in args:
+                if self.is_device(a) and not self._escaped(a):
+                    self._report(call, f"hidden device->host sync: "
+                                 f"`{tail}()` on a dispatched result",
+                                 self._hot(a))
+        elif (isinstance(call.func, ast.Attribute)
+                and call.func.attr in HOST_METHODS):
+            v = call.func.value
+            if self.is_device(v) and not self._escaped(v):
+                self._report(call, f"hidden device->host sync: "
+                             f"`.{call.func.attr}()` on a dispatched "
+                             f"result", self._hot(v))
+        elif chain and chain[0] in NP_HEADS:
+            if tail in NP_METADATA:
+                return  # metadata read: no transfer (shared escape set)
+            for a in args:
+                if self.is_device(a) and not self._escaped(a):
+                    self._report(call, f"hidden device->host sync: "
+                                 f"np.{tail} on a dispatched result",
+                                 self._hot(a))
+                    break
+        elif chain[:2] == ("jax", "device_get") or tail == "device_get":
+            # the sanctioned boundary — unless executed per-iteration
+            for a in args:
+                if self.is_device(a) and self._hot(a):
+                    self._report(call, "explicit device_get", True)
+                    break
+        elif chain:
+            r = self._resolve(chain)
+            if r is not None and r[0] == "func":
+                summ = self._summaries().get(r[1], EMPTY)
+                if summ.concretizes and self.view is not None:
+                    for idx, arg in self.view.callee_arg_indices(r[1],
+                                                                 call):
+                        if idx in summ.concretizes and \
+                                self.is_device(arg) and \
+                                not self._escaped(arg):
+                            qual = r[1].split("::")[-1]
+                            self._report(
+                                call,
+                                f"hidden device->host sync: `{qual}()` "
+                                f"force-syncs this argument internally "
+                                f"(summary-proven)", self._hot(arg))
+                            break
+
+    def _scan_expr(self, e: Optional[ast.AST]) -> None:
+        if e is None:
+            return
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+            elif isinstance(node, _COMPREHENSIONS):
+                for gen in node.generators:
+                    if self.is_device(gen.iter):
+                        self._report(node, "iterating a device array "
+                                     "element-by-element", True)
+
+    # -- statement walk ----------------------------------------------------
+
+    def walk(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scopes
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(stmt.iter)
+                if self.is_device(stmt.iter):
+                    self._report(stmt, "iterating a device array "
+                                 "element-by-element", True)
+                    self.device.update(_base_names(stmt.target))
+                self.loops.append(_Loop(stmt))
+                self.walk(stmt.body)
+                self.loops.pop()
+                self.walk(stmt.orelse)
+                continue
+            if isinstance(stmt, ast.While):
+                # unlike a For's iter, the test re-executes EVERY
+                # iteration — scan it inside the loop context so a
+                # per-iteration transfer in the condition classifies hot
+                self.loops.append(_Loop(stmt))
+                self._scan_expr(stmt.test)
+                self.walk(stmt.body)
+                self.loops.pop()
+                self.walk(stmt.orelse)
+                continue
+            if isinstance(stmt, ast.If):
+                self._scan_expr(stmt.test)
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr)
+                self.walk(stmt.body)
+                continue
+            if isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self.walk(blk)
+                for h in stmt.handlers:
+                    self.walk(h.body)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                value = getattr(stmt, "value", None)
+                # sync markers first: `y = float(block_until_ready(x))`
+                # is the documented escape idiom inlined in an assignment
+                self._mark_synced(stmt)
+                self._scan_expr(value)
+                self._assign(stmt, value)
+                continue
+            # plain statement (Expr/Return/...): sync markers then sites
+            self._mark_synced(stmt)
+            self._scan_expr(stmt)
+
+    def _assign(self, stmt: ast.stmt, value: Optional[ast.AST]) -> None:
+        if value is None:
+            return
+        # literal-tuple RHS unpacks element-wise
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Tuple)
+                and isinstance(value, ast.Tuple)
+                and len(stmt.targets[0].elts) == len(value.elts)):
+            for t, v in zip(stmt.targets[0].elts, value.elts):
+                if isinstance(t, ast.Name):
+                    self._bind([t.id], v)
+            return
+        targets = assign_target_names(stmt)
+        if targets:
+            self._bind(targets, value, single=len(targets) == 1)
+
+    def _bind(self, targets: List[str], value: ast.AST,
+              single: bool = True) -> None:
+        chain = attr_chain(value.func) if isinstance(value, ast.Call) \
+            else ()
+        tail = chain[-1] if chain else ""
+        if tail == "device_get":
+            return  # result is host: targets stay non-device
+        # device-ness is not propagated through multi-target unpacking
+        # of an opaque call (which element is device is unknowable —
+        # same accepted false negative as the summary layer)
+        if single and self.is_device(value):
+            self.device.update(targets)
+            dev_in = _base_names(value) & self.device
+            if tail == "block_until_ready" or (
+                    dev_in and dev_in <= self.synced):
+                self.synced.update(targets)
+                if tail == "block_until_ready":
+                    for a in value.args:
+                        self.synced.update(_base_names(a) & self.device)
+
+    def _mark_synced(self, stmt: ast.stmt) -> None:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain and chain[-1] == "block_until_ready":
+                if isinstance(node.func, ast.Attribute) and not (
+                        len(chain) and chain[0] in ("jax",)):
+                    # x.block_until_ready()
+                    self.synced.update(
+                        _base_names(node.func.value) & self.device)
+                for a in node.args:
+                    self.synced.update(_base_names(a) & self.device)
+
+
+class HiddenSyncRule(Rule):
+    id = "RQ701"
+    name = "hidden-host-sync"
+    description = ("float()/int()/.item()/.tolist()/np.* on a value that "
+                   "summaries prove flows from dispatched computation — "
+                   "a silent device->host sync; use jax.device_get at an "
+                   "explicit boundary")
+    paths = HOST_PATHS
+    needs_project = True
+
+    def check(self, ctx):
+        yield from _run_host_scan(ctx, self.id)
+
+
+class HotLoopTransferRule(Rule):
+    id = "RQ702"
+    name = "transfer-in-hot-loop"
+    description = ("device->host transfer executed per-iteration of a "
+                   "Python loop (or element-wise iteration of a device "
+                   "array) — the per-event round-trip the O(1) cost "
+                   "model rules out")
+    paths = HOST_PATHS
+    needs_project = True
+
+    def check(self, ctx):
+        yield from _run_host_scan(ctx, self.id)
+
+
+def _run_host_scan(ctx, want_id: str):
+    """Both rules share one scan; each yields only its own band (the
+    engine invokes per-rule, so the scan runs twice per file — cheap,
+    and keeps the one-rule-one-ID reporting contract)."""
+    view = getattr(ctx, "project", None)
+    if view is None:
+        return
+    traced: Set[int] = set()
+    for fn in _traced_contexts(ctx.tree):
+        for sub in ast.walk(fn):
+            traced.add(id(sub))
+    # enclosing-class map for method scopes (self.m resolution)
+    encl: Dict[int, str] = {}
+    for cls in ast.walk(ctx.tree):
+        if isinstance(cls, ast.ClassDef):
+            for sub in cls.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    encl[id(sub)] = cls.name
+    # module scope
+    scan = _HostScan(ctx, view, None)
+    scan.walk(list(ctx.tree.body))
+    findings = list(scan.findings)
+    # every non-traced function scope
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(fn) in traced:
+            continue
+        s = _HostScan(ctx, view, encl.get(id(fn)))
+        s.walk(fn.body)
+        findings.extend(s.findings)
+    for f in findings:
+        if f.rule == want_id:
+            yield f

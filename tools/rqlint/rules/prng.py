@@ -18,6 +18,16 @@ of an ``if``/exclusive ``return`` branches don't combine; a consumption
 inside a Python loop counts as repeated unless the key is re-derived in
 the loop body.  Deriving calls (``split``/``fold_in``) are sanctioned
 consumers and reset the count on reassignment.
+
+Tier-2 (project mode): consumption propagates across call edges via the
+whole-program summaries — a key handed to an intra-repo callee counts
+as consumed only when the callee's summary proves it raw-consumes that
+parameter (a helper that merely ``fold_in``s or reshapes the key is
+sanctioned, killing the tier-1 false positive), and a name bound from a
+key-RETURNING intra-repo factory (``k = make_key(seed)``) becomes a
+tracked key — the cross-function reuse the intraprocedural pass
+provably misses.  With ``--no-project`` the rule is byte-identical to
+its PR 4 behavior.
 """
 
 from __future__ import annotations
@@ -28,20 +38,9 @@ from typing import Dict, List, Optional, Set
 from ..astutil import (attr_chain, assign_target_names, chain_tail,
                        param_names, walk_calls)
 from ..findings import finding_at
+from ..summaries import DERIVERS, KEY_PARAM_NAMES  # noqa: F401 (re-export)
+from ..summaries import is_key_param as _is_key_param
 from .base import Rule
-
-#: calls producing fresh keys; consuming a key THROUGH these is sanctioned
-DERIVERS = {"split", "fold_in", "PRNGKey", "key", "wrap_key_data",
-            "key_data", "clone"}
-
-#: parameter names assumed to hold PRNG keys
-KEY_PARAM_NAMES = {"key", "rng", "prng", "rngkey"}
-
-
-def _is_key_param(name: str) -> bool:
-    low = name.lower()
-    return (low in KEY_PARAM_NAMES or low.endswith("_key")
-            or low.endswith("_rng"))
 
 
 def _producer_call(node: ast.AST) -> bool:
@@ -112,8 +111,28 @@ class KeyReuseRule(Rule):
         self._findings: List = []
         self._keys = keys
         self._ctx = ctx
+        self._view = getattr(ctx, "project", None)
         self._walk(fn.body, _PathState())
         yield from self._findings
+
+    def _resolved_summary(self, call: ast.Call):
+        """(fid, Summary) when project mode resolves this call to a
+        summarized intra-repo function, else (None, None)."""
+        if self._view is None:
+            return None, None
+        return self._view.summary_for_call(self._ctx.relpath, call)
+
+    def _is_producer(self, node: ast.AST) -> bool:
+        """Producer calls mint fresh keys: the jax.random derivers, or —
+        in project mode — an intra-repo factory whose summary proves it
+        returns a key."""
+        if _producer_call(node):
+            return True
+        if isinstance(node, ast.Call):
+            _fid, summ = self._resolved_summary(node)
+            return bool(summ is not None
+                        and getattr(summ, "returns_key", False))
+        return False
 
     def _walk(self, stmts, state: _PathState) -> Optional[_PathState]:
         """Walk a statement list; returns the fall-through state, or None
@@ -173,9 +192,10 @@ class KeyReuseRule(Rule):
             if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
                 targets = assign_target_names(stmt)
                 value = stmt.value
-                if value is not None and (_producer_call(value) or (
+                if value is not None and (self._is_producer(value) or (
                         isinstance(value, ast.Tuple)
-                        and any(_producer_call(e) for e in value.elts))):
+                        and any(self._is_producer(e)
+                                for e in value.elts))):
                     for t in targets:
                         self._keys.add(t)
                         state.counts[t] = 0
@@ -191,20 +211,38 @@ class KeyReuseRule(Rule):
         statement/expression."""
         for call in walk_calls(node):
             tail = chain_tail(call.func)
+            fid, summ = self._resolved_summary(call)
+            if fid is not None:
+                # summary-propagated consumption: only the callee
+                # positions PROVEN to raw-consume a key count; a helper
+                # that merely derives from (or ignores) its key param is
+                # sanctioned across the call edge
+                consuming = getattr(summ, "consumes_key", frozenset()) \
+                    if summ is not None else frozenset()
+                for idx, arg in self._view.callee_arg_indices(fid, call):
+                    if (isinstance(arg, ast.Name)
+                            and arg.id in self._keys
+                            and idx in consuming):
+                        self._count(call, arg.id, state)
+                continue
             for arg in list(call.args) + [k.value for k in call.keywords]:
                 if not (isinstance(arg, ast.Name)
                         and arg.id in self._keys):
                     continue
                 if tail in DERIVERS:
                     continue  # deriving/sanctioned consumer
-                n = state.counts.get(arg.id, 0)
-                if n >= 1:
-                    self._findings.append(finding_at(
-                        self.id, self._ctx, call,
-                        f"PRNG key `{arg.id}` consumed a second time "
-                        f"with no interleaving split/fold_in — identical "
-                        f"draws (correlated lanes)"))
-                state.counts[arg.id] = n + 1
+                self._count(call, arg.id, state)
+
+    def _count(self, call: ast.Call, key: str,
+               state: _PathState) -> None:
+        n = state.counts.get(key, 0)
+        if n >= 1:
+            self._findings.append(finding_at(
+                self.id, self._ctx, call,
+                f"PRNG key `{key}` consumed a second time "
+                f"with no interleaving split/fold_in — identical "
+                f"draws (correlated lanes)"))
+        state.counts[key] = n + 1
 
 
 class ConstantSeedRule(Rule):

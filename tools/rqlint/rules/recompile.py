@@ -1,0 +1,339 @@
+"""RQ801/RQ802 — recompilation hazards under jit.
+
+XLA compiles one executable per (shape, dtype, static-argument-value)
+signature.  A static argument that varies per call — a Python object, a
+loop index, a config dict — recompiles the kernel every time, silently
+turning the O(1)-per-event pipeline into O(compile) per dispatch; on
+TPU a single recompile costs more than the whole batch it guards.
+
+- **RQ801** — recompilation hazards around jit call sites and defs:
+
+  * a jit-decorated def whose ``static_argnums``/``static_argnames``
+    points at a parameter with an unhashable default (``{}``/``[]``/
+    ``dict()``/``list()``) — every call either TypeErrors or forces the
+    caller to thread a fresh object through the cache key;
+  * a resolved call site passing a dict/list/set/comprehension literal
+    at a static position — unhashable, or a fresh object per call
+    (cache miss -> recompile);
+  * a call to a jit function inside a Python loop whose static-position
+    argument is rebound by the loop — one recompile *per iteration*;
+  * f-string / ``str(...)`` dispatch keyed on ``.shape`` — a per-shape
+    cache is recompilation churn wearing a disguise (pad to a fixed
+    shape, or key on static structure explicitly).
+
+- **RQ802** — a non-weak-typed constant (``np.float64(...)``,
+  ``np.array(c)``, ``jnp.array(c)`` with no explicit dtype) combined
+  with a traced value inside a jit/scan/vmap body: unlike a plain
+  Python scalar (weak-typed, follows the operand), a strong-typed
+  constant widens the whole computation's dtype — and a dtype change is
+  a new signature, i.e. a recompile, plus double memory traffic on the
+  widened lanes.
+
+Both rules are tier-2 (``needs_project``): RQ801's call-site checks
+resolve callees through the project call graph, and keeping the whole
+band behind project mode preserves ``--no-project`` as exactly the
+PR 4 rule set.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..astutil import attr_chain, chain_tail, name_ids, param_names
+from ..callgraph import body_nodes
+from ..findings import finding_at
+from .base import Rule
+from .trace_safety import _Taint, _traced_contexts
+
+#: literal expressions that are unhashable (or fresh-per-call) as
+#: static arguments
+_UNHASHABLE = (ast.Dict, ast.List, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+_MUTABLE_CTORS = {"dict", "list", "set"}
+
+#: strong-typed constant constructors (weak-typed Python scalars are the
+#: sanctioned spelling); an explicit dtype kwarg is a deliberate choice
+_CONST_HEADS = {"np", "numpy", "onp", "jnp"}
+_CONST_TAILS = {"array", "asarray", "float32", "float64", "int32",
+                "int64"}
+
+
+def jit_static_info(fn) -> Tuple[Set[int], Set[str]]:
+    """(static argnum positions, static argnames) declared by a jit
+    decorator on ``fn`` — empty sets when none (or not jitted)."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for dec in getattr(fn, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        target = dec.func
+        is_jit = chain_tail(target) in {"jit", "pjit"}
+        if (chain_tail(target) == "partial" and dec.args
+                and chain_tail(dec.args[0]) in {"jit", "pjit"}):
+            is_jit = True
+        if not is_jit:
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnums":
+                nums |= _int_elems(kw.value)
+            elif kw.arg == "static_argnames":
+                names |= _str_elems(kw.value)
+    return nums, names
+
+
+def _int_elems(e: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    elems = e.elts if isinstance(e, (ast.Tuple, ast.List)) else [e]
+    for el in elems:
+        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+            out.add(el.value)
+    return out
+
+
+def _str_elems(e: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    elems = e.elts if isinstance(e, (ast.Tuple, ast.List)) else [e]
+    for el in elems:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.add(el.value)
+    return out
+
+
+def _static_positions(fn) -> Tuple[Set[int], List[str]]:
+    """Static param POSITIONS (argnums + argnames mapped to indices) and
+    the param-name list."""
+    nums, names = jit_static_info(fn)
+    params = param_names(fn)
+    pos = set(nums)
+    for n in names:
+        if n in params:
+            pos.add(params.index(n))
+    return pos, params
+
+
+def _is_unhashable_literal(e: ast.AST) -> bool:
+    if isinstance(e, _UNHASHABLE):
+        return True
+    return (isinstance(e, ast.Call)
+            and chain_tail(e.func) in _MUTABLE_CTORS
+            and len(attr_chain(e.func)) == 1)
+
+
+def _shape_keyed(e: ast.AST) -> bool:
+    """An f-string or str(...) embedding ``.shape`` — the per-shape
+    dispatch-key smell."""
+    for node in ast.walk(e):
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue) and any(
+                        isinstance(s, ast.Attribute) and s.attr == "shape"
+                        for s in ast.walk(v.value)):
+                    return True
+        if (isinstance(node, ast.Call)
+                and chain_tail(node.func) == "str" and node.args
+                and any(isinstance(s, ast.Attribute) and s.attr == "shape"
+                        for s in ast.walk(node.args[0]))):
+            return True
+    return False
+
+
+class RecompilationHazardRule(Rule):
+    id = "RQ801"
+    name = "jit-recompilation-hazard"
+    description = ("static jit args that vary per call (unhashable "
+                   "defaults/literals, loop-varying static args) or "
+                   "shape-string-keyed dispatch — every variation is a "
+                   "silent recompile")
+    paths = ("*.py", "tools/*.py", "benchmarks/*.py", "experiments/*.py",
+             "redqueen_tpu/**/*.py")
+    needs_project = True
+
+    def check(self, ctx):
+        view = getattr(ctx, "project", None)
+        if view is None:
+            return
+        yield from self._check_defs(ctx)
+        yield from self._check_calls(ctx, view)
+        yield from self._check_shape_keys(ctx)
+
+    # -- (a) jit defs with unhashable static defaults ----------------------
+
+    def _check_defs(self, ctx):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            pos, params = _static_positions(fn)
+            if not pos:
+                continue
+            args = fn.args
+            all_args = list(getattr(args, "posonlyargs", [])) + \
+                list(args.args)
+            defaults = args.defaults
+            offset = len(all_args) - len(defaults)
+            for i, default in enumerate(defaults):
+                idx = offset + i
+                if idx in pos and _is_unhashable_literal(default):
+                    yield finding_at(
+                        self.id, ctx, default,
+                        f"static arg `{all_args[idx].arg}` of jitted "
+                        f"`{fn.name}` has an unhashable default — every "
+                        f"call TypeErrors or recompiles")
+
+    # -- (b)/(c) resolved call sites ---------------------------------------
+
+    def _check_calls(self, ctx, view):
+        loops = self._loop_bindings(ctx.tree)
+        for call, enclosing in self._calls_with_loops(ctx.tree, loops):
+            r = view.resolve_call(ctx.relpath, call)
+            if r is None or r[0] != "func":
+                continue
+            info = view.functions.get(r[1])
+            if info is None:
+                continue
+            pos, _params = _static_positions(info.node)
+            if not pos:
+                continue
+            qual = r[1].split("::")[-1]
+            for idx, arg in view.callee_arg_indices(r[1], call):
+                if idx not in pos:
+                    continue
+                if _is_unhashable_literal(arg):
+                    yield finding_at(
+                        self.id, ctx, call,
+                        f"Python-object literal passed at static "
+                        f"position {idx} of jitted `{qual}` — "
+                        f"unhashable or fresh-per-call (recompiles "
+                        f"every time)")
+                elif enclosing:
+                    names = name_ids(arg)
+                    if any(names & bound for bound in enclosing):
+                        yield finding_at(
+                            self.id, ctx, call,
+                            f"static arg {idx} of jitted `{qual}` "
+                            f"varies with the enclosing Python loop — "
+                            f"one recompile per iteration")
+
+    @staticmethod
+    def _loop_bindings(tree) -> Dict[int, Set[str]]:
+        """loop-node id -> names the loop rebinds."""
+        from ..astutil import assign_target_names
+        out: Dict[int, Set[str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                bound: Set[str] = set()
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    bound |= name_ids(node.target)
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Assign, ast.AnnAssign,
+                                        ast.AugAssign)):
+                        bound |= set(assign_target_names(sub))
+                out[id(node)] = bound
+        return out
+
+    @staticmethod
+    def _calls_with_loops(tree, loops) -> Iterable[
+            Tuple[ast.Call, List[Set[str]]]]:
+        """(call, [bindings of each enclosing host loop]) pairs."""
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.ClassDef)):
+                    yield from walk(child, [])  # fresh stack per scope
+                    continue
+                sub = stack
+                if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                    sub = stack + [loops[id(child)]]
+                if isinstance(child, ast.Call):
+                    yield child, stack
+                yield from walk(child, sub)
+        yield from walk(tree, [])
+
+    # -- (d) shape-string dispatch -----------------------------------------
+
+    def _check_shape_keys(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Subscript) and _shape_keyed(
+                    node.slice):
+                yield finding_at(
+                    self.id, ctx, node,
+                    "dispatch keyed on a shape string — a per-shape "
+                    "cache hides recompilation churn; pad to a fixed "
+                    "shape or key on static structure explicitly")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in {"get", "setdefault", "pop"}
+                    and node.args and _shape_keyed(node.args[0])):
+                yield finding_at(
+                    self.id, ctx, node,
+                    "dispatch keyed on a shape string — a per-shape "
+                    "cache hides recompilation churn; pad to a fixed "
+                    "shape or key on static structure explicitly")
+
+
+class WeakTypeWideningRule(Rule):
+    id = "RQ802"
+    name = "strong-typed-constant-under-jit"
+    description = ("np/jnp array constant with no explicit dtype "
+                   "combined with a traced value under jit — widens the "
+                   "computation dtype (new signature -> recompile, plus "
+                   "wider memory traffic); use a plain Python scalar")
+    paths = ("redqueen_tpu/ops/*.py", "redqueen_tpu/parallel/*.py")
+    needs_project = True
+
+    def check(self, ctx):
+        for fn in _traced_contexts(ctx.tree):
+            taint = _Taint(set(param_names(fn)))
+            if isinstance(fn, ast.Lambda):
+                nodes = list(ast.walk(fn.body))
+            else:
+                nodes = body_nodes(fn)
+            # settle assignments (sets only grow; two rounds suffice for
+            # the straight-line bodies tracing allows)
+            from ..astutil import assign_target_names
+            for _ in range(2):
+                for n in nodes:
+                    if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                      ast.AugAssign)):
+                        value = getattr(n, "value", None)
+                        if value is not None and taint.expr(value):
+                            taint.names.update(assign_target_names(n))
+            seen: Set[int] = set()
+            for n in nodes:
+                if not isinstance(n, (ast.BinOp, ast.Compare)):
+                    continue
+                sides = [n.left, n.right] if isinstance(n, ast.BinOp) \
+                    else [n.left] + list(n.comparators)
+                tainted = any(taint.expr(s) for s in sides)
+                if not tainted:
+                    continue
+                for s in sides:
+                    c = self._strong_const(s)
+                    if c is not None and id(c) not in seen:
+                        seen.add(id(c))
+                        yield finding_at(
+                            self.id, ctx, c,
+                            f"strong-typed constant "
+                            f"`{ast.unparse(c) if hasattr(ast, 'unparse') else 'np/jnp constant'}`"
+                            f" combined with a traced value — widens "
+                            f"the dtype under jit; use a weak-typed "
+                            f"Python scalar (or pass an explicit dtype)")
+
+    @staticmethod
+    def _strong_const(e: ast.AST) -> Optional[ast.Call]:
+        """The offending constructor Call when ``e`` is (or directly
+        wraps) a strong-typed constant with no explicit dtype."""
+        for node in ast.walk(e):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if (len(chain) == 2 and chain[0] in _CONST_HEADS
+                    and chain[1] in _CONST_TAILS
+                    and node.args
+                    and all(isinstance(a, ast.Constant)
+                            and isinstance(a.value, (int, float))
+                            for a in node.args)
+                    and not any(k.arg == "dtype" for k in node.keywords)):
+                return node
+        return None

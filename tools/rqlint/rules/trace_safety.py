@@ -23,6 +23,13 @@ Detection is intraprocedural and deliberately conservative:
 
 False negatives are accepted (cross-module bodies aren't marked);
 a false positive documents itself with a line pragma.
+
+Tier-2 (project mode): taint additionally propagates ACROSS call edges
+via the whole-program summaries — a traced value passed to an intra-repo
+helper whose summary proves it force-concretizes that parameter
+(``def to_scalar(v): return float(v)``) fires at the call site, the
+exact cross-function case the intraprocedural pass provably misses.
+With ``--no-project`` the rule is byte-identical to its PR 4 behavior.
 """
 
 from __future__ import annotations
@@ -30,7 +37,7 @@ from __future__ import annotations
 import ast
 from typing import List, Set
 
-from ..astutil import attr_chain, chain_tail, param_names
+from ..astutil import attr_chain, chain_tail, jit_decorated, param_names
 from ..findings import finding_at
 from .base import Rule
 
@@ -54,16 +61,8 @@ STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
 _CONCRETIZERS = {"bool", "float", "int", "complex"}
 
 
-def _jit_decorated(fn) -> bool:
-    for dec in fn.decorator_list:
-        target = dec.func if isinstance(dec, ast.Call) else dec
-        if chain_tail(target) in {"jit", "pjit", "pmap"}:
-            return True
-        if (isinstance(dec, ast.Call) and chain_tail(dec.func) == "partial"
-                and dec.args
-                and chain_tail(dec.args[0]) in {"jit", "pjit", "pmap"}):
-            return True
-    return False
+#: single source of truth with the tier-2 summary layer
+_jit_decorated = jit_decorated
 
 
 def _traced_contexts(tree: ast.AST):
@@ -252,3 +251,28 @@ class TraceSafetyRule(Rule):
                     self.id, ctx, n,
                     "np.asarray/np.array on a traced value materializes "
                     "it on host inside the traced region — use jnp")
+            else:
+                yield from self._check_summary_call(ctx, taint, n, chain)
+
+    def _check_summary_call(self, ctx, taint, call, chain):
+        """Tier-2: a traced value handed to an intra-repo callee whose
+        summary proves it force-concretizes that parameter."""
+        view = getattr(ctx, "project", None)
+        if view is None or not chain:
+            return
+        r = view.resolve_call(ctx.relpath, call)
+        if r is None or r[0] != "func":
+            return
+        summ = view.summaries.get(r[1])
+        if summ is None or not summ.concretizes:
+            return
+        for idx, arg in view.callee_arg_indices(r[1], call):
+            if idx in summ.concretizes and taint.expr(arg):
+                qual = r[1].split("::")[-1]
+                yield finding_at(
+                    self.id, ctx, call,
+                    f"`{qual}()` force-concretizes its argument {idx} "
+                    f"(summary-proven across the call edge) — a traced "
+                    f"value passed here hits ConcretizationTypeError "
+                    f"under jit")
+                return

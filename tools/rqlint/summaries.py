@@ -1,0 +1,437 @@
+"""Per-function dataflow summaries, computed bottom-up over call-graph
+SCCs with a fixpoint for cycles.
+
+A :class:`Summary` is the whole-program interface of one function — the
+facts a CALLER needs without re-walking the callee:
+
+- ``concretizes``: parameter positions the function force-syncs to host
+  (``float()``/``int()``/``bool()``, ``.item()``/``.tolist()``,
+  ``np.asarray``/any ``np.*`` ufunc) — directly or through a callee.
+- ``consumes_key``: key-named parameter positions raw-consumed as PRNG
+  keys (passed to a non-deriving consumer) — directly or transitively.
+- ``returns_key``: the return value is a PRNG key (producer call,
+  key-returning callee, or a key parameter passed back through).
+- ``returns_device``: the return value flows from dispatched
+  computation (``jnp.``/``lax.``/``jax.`` ops except the host-returning
+  tails, a jit-decorated body, or a device-returning callee).
+- ``returns_host``: the return value is a HOST copy (``np.*`` result,
+  builtin concretizer, or a host-returning callee) — callers' taint
+  stops there: the transfer was already accounted inside the callee.
+- ``jitted``: the def itself is jit-compiled.
+
+The lattice is finite (sets of parameter indices + booleans) and the
+transfer function only adds facts, so the per-SCC iteration is monotone
+and terminates; mutually-recursive functions converge in at most
+``2 * |SCC| + 4`` rounds (bounded defensively anyway).
+
+Two deliberate policy choices:
+
+- **Shape metadata is static.** ``x.shape`` / ``x.dtype`` / ``len(x)``
+  / ``np.shape(x)`` never carry device- or param-taint — branching on
+  metadata is free and idiomatic (same escape set RQ401 uses).
+- **A pragma at the sync site sanctions the call edge.** When the
+  concretizing line inside a callee carries ``# rqlint: disable=RQ701``
+  (or RQ702/RQ401/all), the fact is NOT exported into the summary: the
+  justification prose lives once, at the audited boundary, instead of
+  being re-litigated at every caller.  Same for RQ501 and
+  ``consumes_key``.
+
+Soundness policy, same as the rest of rqlint: unresolved calls degrade
+to the tier-1 conservative answer; false negatives are accepted over
+noise (lambdas, nested defs, and container contents are not tracked).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set
+
+from .astutil import attr_chain, chain_tail, jit_decorated
+from .callgraph import body_nodes
+
+#: calls producing fresh PRNG keys; consuming a key THROUGH these is
+#: sanctioned (single source of truth — rules/prng.py imports these)
+KEY_PRODUCERS = {"PRNGKey", "split", "fold_in", "key", "wrap_key_data"}
+DERIVERS = KEY_PRODUCERS | {"key_data", "clone"}
+
+#: parameter names assumed to hold PRNG keys
+KEY_PARAM_NAMES = {"key", "rng", "prng", "rngkey"}
+
+#: builtin concretizers (host sync + ConcretizationTypeError under jit)
+CONCRETIZERS = {"bool", "float", "int", "complex"}
+#: methods that force a device->host transfer
+HOST_METHODS = {"item", "tolist"}
+
+#: dotted-call heads that produce device values
+DEVICE_HEADS = {"jnp", "lax"}
+#: numpy spellings: calls through these run ON HOST (and force a sync
+#: when handed a device value — the RQ701 hazard)
+NP_HEADS = {"np", "numpy", "onp"}
+#: np.* tails that read metadata only — no transfer, no taint
+NP_METADATA = {"shape", "ndim", "size", "result_type", "dtype", "iinfo",
+               "finfo", "isscalar", "promote_types"}
+#: host-returning jax.* tails (everything else under jax. is device)
+JAX_HOST_TAILS = {"device_get", "eval_shape", "devices", "local_devices",
+                  "device_count", "local_device_count",
+                  "default_backend", "process_index", "process_count",
+                  "live_arrays", "clear_caches"}
+#: jax tree ops mirror their inputs: device iff fed device values
+_TREE_TAILS_PREFIX = "tree"
+
+#: attribute reads that are static metadata (never device, never taint)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes",
+                "sharding", "aval", "platform", "device_kind"}
+#: builtins whose results are host/static regardless of args
+HOST_BUILTINS = {"len", "range", "enumerate", "zip", "isinstance",
+                 "getattr", "hasattr", "type", "print", "repr", "str",
+                 "format", "sorted", "id", "vars", "dir"}
+#: calls that break PARAM taint (metadata/static results)
+PMAP_STATIC_CALLS = {"len", "isinstance", "hasattr", "type", "range",
+                     "enumerate", "zip", "id", "print", "repr",
+                     "format"}
+
+
+def is_key_param(name: str) -> bool:
+    low = name.lower()
+    return (low in KEY_PARAM_NAMES or low.endswith("_key")
+            or low.endswith("_rng"))
+
+
+@dataclasses.dataclass(frozen=True)
+class Summary:
+    concretizes: FrozenSet[int] = frozenset()
+    consumes_key: FrozenSet[int] = frozenset()
+    returns_key: bool = False
+    returns_device: bool = False
+    returns_host: bool = False
+    jitted: bool = False
+
+
+EMPTY = Summary()
+
+#: a pragma with any of these IDs at a callee's sync site keeps the
+#: fact OUT of the summary (the audited-boundary sanction); "all" is
+#: the pragmas module's spelling for a blanket disable
+_CONC_PRAGMAS = frozenset({"RQ701", "RQ702", "RQ401", "all"})
+_KEY_PRAGMAS = frozenset({"RQ501", "all"})
+
+
+def _is_tree_op(chain) -> bool:
+    """jax.tree.map / jax.tree_util.tree_* / jax.tree_map — result
+    mirrors the inputs."""
+    if not chain or chain[0] != "jax":
+        return False
+    return (any(part == "tree" or part == "tree_util"
+                for part in chain[:-1])
+            or chain[-1].startswith(_TREE_TAILS_PREFIX + "_")
+            or chain[-1] == _TREE_TAILS_PREFIX)
+
+
+def device_expr(e: ast.AST, device_names, resolve, summaries) -> bool:
+    """Shared classifier: does this expression hold a device value?
+
+    ``device_names`` is the caller's set of known-device local names,
+    ``resolve(chain)`` returns ``("func", fid)`` / ``("class", cid)`` /
+    None for an attribute chain, ``summaries`` maps fid -> Summary.
+    Used by both the summary transfer function and the RQ7xx host-sync
+    rule so the two can never drift."""
+    if isinstance(e, ast.Name):
+        return e.id in device_names
+    if isinstance(e, ast.Constant):
+        return False
+    if isinstance(e, ast.Attribute):
+        if e.attr in STATIC_ATTRS:
+            return False  # metadata: host/static by construction
+        return device_expr(e.value, device_names, resolve, summaries)
+    if isinstance(e, ast.Subscript):
+        return device_expr(e.value, device_names, resolve, summaries)
+    if isinstance(e, ast.Lambda):
+        return False
+    if isinstance(e, ast.Call):
+        chain = attr_chain(e.func)
+        tail = chain[-1] if chain else ""
+        args = [a for a in e.args if not isinstance(a, ast.Starred)] + \
+               [k.value for k in e.keywords]
+
+        def any_arg_device():
+            return any(device_expr(a, device_names, resolve, summaries)
+                       for a in args)
+
+        if chain:
+            head = chain[0]
+            if _is_tree_op(chain):
+                return any_arg_device()  # tree ops mirror their inputs
+            if head in DEVICE_HEADS:
+                return True
+            if head == "jax":
+                return tail not in JAX_HOST_TAILS
+            if head in NP_HEADS:
+                return False  # host result (the sync is flagged elsewhere)
+            if len(chain) == 1 and (tail in CONCRETIZERS
+                                    or tail in HOST_BUILTINS):
+                return False
+            r = resolve(chain)
+            if r is not None:
+                if r[0] == "func":
+                    return bool(getattr(summaries.get(r[1]),
+                                        "returns_device", False))
+                # constructor: wraps whatever it is given
+                return any_arg_device()
+        # method call on a device value, or unresolved call fed one:
+        # conservative propagate (result assumed device)
+        if isinstance(e.func, ast.Attribute) and device_expr(
+                e.func.value, device_names, resolve, summaries):
+            return True
+        return any_arg_device()
+    return any(device_expr(c, device_names, resolve, summaries)
+               for c in ast.iter_child_nodes(e)
+               if isinstance(c, ast.expr))
+
+
+def compute(view) -> Dict[str, Summary]:
+    """All summaries, bottom-up over SCCs (callees before callers), with
+    a per-SCC fixpoint so recursion cycles converge."""
+    from .callgraph import call_edges, sccs
+    graph = call_edges(view)
+    summaries: Dict[str, Summary] = {}
+    for comp in sccs(graph):
+        changed = True
+        rounds = 0
+        bound = 2 * len(comp) + 4
+        while changed and rounds < bound:
+            changed = False
+            rounds += 1
+            for fid in comp:
+                info = view.functions.get(fid)
+                if info is None:
+                    continue
+                s = _transfer(view, info, summaries)
+                if summaries.get(fid) != s:
+                    summaries[fid] = s
+                    changed = True
+    return summaries
+
+
+# ---------------------------------------------------------------------------
+# The transfer function: one pass (run twice for ordering robustness) of
+# forward dataflow over a single function body.
+# ---------------------------------------------------------------------------
+
+class _State:
+    def __init__(self, params: List[str]) -> None:
+        self.param_idx = {p: i for i, p in enumerate(params)}
+        #: name -> set of param indices it derives from
+        self.pmap: Dict[str, Set[int]] = {
+            p: {i} for i, p in enumerate(params)}
+        self.device: Set[str] = set()
+        self.host: Set[str] = set()  # names holding host copies
+        self.keys: Set[str] = set(
+            p for p in params if is_key_param(p))
+        self.key_params: FrozenSet[int] = frozenset(
+            i for i, p in enumerate(params) if is_key_param(p))
+
+
+def _transfer(view, info, summaries: Dict[str, Summary]) -> Summary:
+    st = _State(info.params)
+    mod = view.modules.get(info.modname)
+    concretizes: Set[int] = set()
+    consumes: Set[int] = set()
+    returns_key = False
+    returns_host = False
+    returns_device = jit_decorated(info.node)
+
+    def sanctioned(node: ast.AST, ids: FrozenSet[str]) -> bool:
+        return mod is not None and mod.pragma_sanctions(
+            getattr(node, "lineno", 0), ids)
+
+    def _resolve(chain):
+        return view.resolve(info.modname, chain, info.encl_class)
+
+    def resolve_func(call: ast.Call) -> Optional[str]:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        return view.resolve_func(info.modname, chain, info.encl_class)
+
+    def pmap_of(e: ast.AST) -> Set[int]:
+        if isinstance(e, ast.Name):
+            if e.id in st.host:
+                # a host copy: its transfer was recorded where it was
+                # made (host-wins over the stale param taint — the
+                # analysis is flow-insensitive per name)
+                return set()
+            return set(st.pmap.get(e.id, ()))
+        if isinstance(e, ast.Constant):
+            return set()
+        if isinstance(e, ast.Attribute):
+            if e.attr in STATIC_ATTRS:
+                return set()
+            return pmap_of(e.value)
+        if isinstance(e, (ast.Subscript, ast.Starred)):
+            return pmap_of(e.value)
+        if isinstance(e, ast.Call):
+            chain = attr_chain(e.func)
+            tail = chain[-1] if chain else ""
+            if chain:
+                if len(chain) == 1 and tail in PMAP_STATIC_CALLS:
+                    return set()
+                if (chain[0] in NP_HEADS or tail == "device_get"
+                        or (len(chain) == 1 and tail in CONCRETIZERS)):
+                    # np/device_get/concretizer results are HOST copies:
+                    # the transfer is accounted at that call, taint stops
+                    return set()
+                fid = resolve_func(e)
+                if fid is not None and getattr(
+                        summaries.get(fid), "returns_host", False):
+                    return set()
+        out: Set[int] = set()
+        for c in ast.iter_child_nodes(e):
+            if isinstance(c, ast.expr):
+                out |= pmap_of(c)
+        return out
+
+    def expr_device(e: ast.AST) -> bool:
+        return device_expr(e, st.device, _resolve, summaries)
+
+    def expr_host(e: ast.AST) -> bool:
+        """Is this a host copy (np result / concretizer / host-returning
+        callee / known host name)?"""
+        if isinstance(e, ast.Name):
+            return e.id in st.host
+        if not isinstance(e, ast.Call):
+            return False
+        chain = attr_chain(e.func)
+        tail = chain[-1] if chain else ""
+        if chain and (chain[0] in NP_HEADS or tail == "device_get"
+                      or (len(chain) == 1 and tail in CONCRETIZERS)):
+            return True
+        fid = resolve_func(e)
+        return bool(fid is not None and getattr(
+            summaries.get(fid), "returns_host", False))
+
+    def expr_key(e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in st.keys
+        if isinstance(e, ast.Call):
+            tail = chain_tail(e.func)
+            if tail in KEY_PRODUCERS:
+                return True
+            fid = resolve_func(e)
+            if fid is not None:
+                return bool(getattr(summaries.get(fid),
+                                    "returns_key", False))
+            return False
+        if isinstance(e, ast.Tuple):
+            return any(expr_key(c) for c in e.elts)
+        return False
+
+    def handle_call(call: ast.Call) -> None:
+        chain = attr_chain(call.func)
+        tail = chain[-1] if chain else ""
+        args = [a for a in call.args
+                if not isinstance(a, ast.Starred)] + \
+               [k.value for k in call.keywords]
+        conc_ok = not sanctioned(call, _CONC_PRAGMAS)
+        # direct concretizers on param-derived values
+        if tail in CONCRETIZERS and len(chain) == 1:
+            if conc_ok:
+                for a in args:
+                    concretizes.update(pmap_of(a))
+        elif (isinstance(call.func, ast.Attribute)
+                and call.func.attr in HOST_METHODS):
+            if conc_ok:
+                concretizes.update(pmap_of(call.func.value))
+        elif chain and chain[0] in NP_HEADS:
+            # any np.* call (metadata reads aside) forces its
+            # (would-be-device) args to host
+            if conc_ok and tail not in NP_METADATA:
+                for a in args:
+                    concretizes.update(pmap_of(a))
+        # resolved callees: propagate their summary onto our params
+        fid = resolve_func(call) if chain else None
+        if fid is not None:
+            summ = summaries.get(fid, EMPTY)
+            for idx, arg in view.callee_arg_indices(fid, call):
+                p = pmap_of(arg)
+                if conc_ok and idx in summ.concretizes:
+                    concretizes.update(p)
+                if idx in summ.consumes_key and not sanctioned(
+                        call, _KEY_PRAGMAS):
+                    consumes.update(p & st.key_params)
+        elif chain and tail not in DERIVERS and chain[0] not in NP_HEADS \
+                and not (tail in CONCRETIZERS and len(chain) == 1):
+            # unresolved non-deriving call: tier-1 conservatism — a key
+            # handed to it counts as raw-consumed
+            if not sanctioned(call, _KEY_PRAGMAS):
+                for a in args:
+                    if isinstance(a, ast.Name) and a.id in st.keys:
+                        consumes.update(pmap_of(a) & st.key_params)
+
+    def handle_assign(stmt: ast.stmt) -> None:
+        value = getattr(stmt, "value", None)
+        if value is None:
+            return
+        from .astutil import assign_target_names
+        # literal-tuple RHS unpacks element-wise (a, b = dev_x, cfg)
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Tuple)
+                and isinstance(value, ast.Tuple)
+                and len(stmt.targets[0].elts) == len(value.elts)):
+            for t, v in zip(stmt.targets[0].elts, value.elts):
+                if isinstance(t, ast.Name):
+                    _bind(t.id, v, single=True)
+            return
+        targets = assign_target_names(stmt)
+        if not targets:
+            return
+        single = len(targets) == 1
+        for t in targets:
+            _bind(t, value, single)
+
+    def _bind(name: str, value: ast.AST, single: bool) -> None:
+        host = expr_host(value)
+        p = set() if host else pmap_of(value)
+        # device-ness through MULTI-target unpacking of an opaque call
+        # (cfg, params, adj = build(...)) is NOT propagated: we cannot
+        # tell which element is device, and tainting the host config
+        # would indict every downstream driver call.  Accepted false
+        # negative (rqlint policy: precision over noise).
+        dev = (not host and single and expr_device(value))
+        key = expr_key(value)
+        if host:
+            st.host.add(name)
+        if p:
+            st.pmap.setdefault(name, set()).update(p)
+        if dev:
+            st.device.add(name)
+        if key:
+            st.keys.add(name)
+
+    nodes = body_nodes(info.node)
+    # two assignment-only rounds settle the (monotone) name maps
+    # regardless of walk order; detection runs once, against the settled
+    # maps — recording during an unsettled round would bake in stale
+    # taint (e.g. a name later proven to be a host copy).
+    for _ in range(2):
+        for node in nodes:
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                handle_assign(node)
+    for node in nodes:
+        if isinstance(node, ast.Call):
+            handle_call(node)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            if expr_device(node.value):
+                returns_device = True
+            if expr_key(node.value):
+                returns_key = True
+            if expr_host(node.value):
+                returns_host = True
+
+    return Summary(concretizes=frozenset(concretizes),
+                   consumes_key=frozenset(consumes),
+                   returns_key=returns_key,
+                   returns_device=returns_device,
+                   returns_host=returns_host,
+                   jitted=jit_decorated(info.node))
