@@ -595,10 +595,44 @@ def _run_child(args, engine: str, backend: str, timeout_s: float):
     return None
 
 
+_ENGINE_CHOICES = ("oracle", "scan", "star", "pallas")
+
+
+def _selected_engines(args):
+    """The --engines selection: ``(run_oracle, [engine, ...])``.
+
+    Default (``--engines`` unset): ``oracle,scan`` plus ``pallas`` —
+    the star engine burns ~88s of every bench run for 746K ev/s on CPU
+    (20x slower than scan, BENCH_r05) and never wins, so it is opt-in
+    (``--engines oracle,scan,star``) until ROADMAP item 4 decides its
+    fate.  pallas stays in the DEFAULT sweep (it is skipped off-TPU
+    anyway, and dropping it would silently degrade the best-TPU-number
+    contract) but is excluded by any explicit --engines list that omits
+    it.  The legacy ``--engine NAME`` (non-auto) still overrides the
+    engine list."""
+    engines_str = getattr(args, "engines", None) or "oracle,scan,pallas"
+    sel = [e.strip() for e in engines_str.split(",") if e.strip()]
+    unknown = sorted(set(sel) - set(_ENGINE_CHOICES))
+    if unknown:
+        raise RuntimeError(
+            f"unknown --engines entries {unknown} "
+            f"(choose from {','.join(_ENGINE_CHOICES)})")
+    use_oracle = "oracle" in sel and not args.no_oracle
+    if args.engine != "auto":
+        return use_oracle, [args.engine]
+    engines = [e for e in sel if e != "oracle"]
+    if not engines:
+        raise RuntimeError(
+            "--engines selected no simulation engine (oracle alone is a "
+            "denominator, not a benchmark) — add scan/star/pallas")
+    return use_oracle, engines
+
+
 def parent_main(args) -> None:
     # Children recompute their own capacity/oracle_comps via _shapes; the
     # parent only needs the display shape.
     B, T, _, _ = _shapes(args)
+    use_oracle, engines = _selected_engines(args)
 
     # --- backend decision (no JAX in this process) ---
     if (args.cpu or args.quick) and not args.tpu:
@@ -624,7 +658,7 @@ def parent_main(args) -> None:
         backend = "cpu"
     log(f"backend: {backend}; total deadline {args.deadline:.0f}s "
         f"({_remaining(args):.0f}s remaining)")
-    if args.engine == "pallas" and backend == "cpu":
+    if engines == ["pallas"] and backend == "cpu":
         raise RuntimeError(
             "--engine pallas requires the TPU backend (Mosaic lowering); "
             "interpret mode exists for tests, not timing — run with --tpu "
@@ -686,10 +720,11 @@ def parent_main(args) -> None:
             f"only {rem:.0f}s of the --deadline left after backend probing; "
             f"no time to produce any result"
         )
-    if args.no_oracle:
-        # Engine-vs-engine comparisons (tools/star_vs_scan.py) don't need
-        # the NumPy denominator — which is O(sources) per event and
-        # infeasible at F >= 1k followers; vs_baseline is reported null.
+    if not use_oracle:
+        # Engine-vs-engine comparisons (tools/star_vs_scan.py, or an
+        # --engines list without "oracle") don't need the NumPy
+        # denominator — which is O(sources) per event and infeasible at
+        # F >= 1k followers; vs_baseline is reported null.
         o, o_eps = None, None
     else:
         o = _run_child(args, "oracle", "cpu", min(600.0, rem * 0.5))
@@ -701,13 +736,8 @@ def parent_main(args) -> None:
             f"time-in-top-1 {o['top1']:.2f}")
 
     # --- engines, fastest-known-first, each in a bounded subprocess ---
-    if args.engine == "auto":
-        engines = ["scan", "star"]
-        if backend == "default":  # pallas needs a real TPU (Mosaic)
-            engines.append("pallas")
-    else:
-        engines = [args.engine]
-
+    # (the list comes from --engines / --engine via _selected_engines;
+    # the sweep below still skips pallas off-TPU — Mosaic lowering only)
     best = None
 
     def gate_fields(res):
@@ -868,8 +898,16 @@ def main():
                          "scan: the general event-scan kernel (arbitrary "
                          "graphs/policy mixes); pallas: the VMEM-resident "
                          "fused chunk kernel (TPU only); auto (default): "
-                         "run the engines available on this backend "
-                         "fastest-known-first and report the best")
+                         "run the --engines selection fastest-known-first "
+                         "and report the best")
+    ap.add_argument("--engines", default=None,
+                    help="comma list from {oracle,scan,star,pallas} "
+                         "consulted when --engine is auto (default: "
+                         "oracle,scan + pallas-on-TPU — star costs "
+                         "~88s/run for a result that never wins on CPU "
+                         "[BENCH_r05], so it is opt-in); drop 'oracle' "
+                         "to skip the NumPy denominator like "
+                         "--no-oracle")
     ap.add_argument("--deadline", type=float, default=900.0,
                     help="total wall-clock budget (s); chosen well under "
                          "the driver's capture timeout so bench always "

@@ -17,7 +17,7 @@ numbers only.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,6 +25,8 @@ __all__ = [
     "load_csv",
     "save_npz",
     "load_npz",
+    "LoadStats",
+    "TraceOrderError",
     "normalize_traces",
     "pad_traces",
     "bucket_traces",
@@ -36,9 +38,32 @@ __all__ = [
 Traces = List[np.ndarray]  # one ascending float64 time array per user
 
 
+class TraceOrderError(ValueError):
+    """A trace row's timestamp cannot be ordered (NaN): typed rejection
+    instead of a silently NaN-sorted corpus.  Raised identically by both
+    loader engines — downstream consumers (the serving ingest path's
+    reorder window, the RealData replay kernel) all assume orderable
+    times, so an unorderable row must die at the boundary with a line
+    number, not three layers later as a quarantined lane."""
+
+
+class LoadStats(NamedTuple):
+    """What the parse observed about the corpus's ORDER quality — the
+    measured input contract for the serving reorder window (a corpus
+    with many non-monotonic rows needs a wide window; duplicates feed
+    the duplicate-drop expectation).  Counted identically by both
+    engines (pinned by tests/test_native_loader.py)."""
+
+    n_rows: int                 # events parsed (post header/blank skip)
+    n_users: int                # distinct users
+    duplicate_timestamps: int   # same user, exactly equal timestamps
+    non_monotonic_rows: int     # rows that regressed vs the same user's
+    #                             previous row in FILE order
+
+
 def load_csv(path: str, user_col: int = 0, time_col: int = 1,
              delimiter: str = ",", skip_header: int = 1,
-             engine: str = "auto") -> Traces:
+             engine: str = "auto", return_stats: bool = False):
     """Load (user, timestamp) rows into per-user ascending time arrays.
 
     Users are ordered by first appearance; times sort per user. This is the
@@ -50,7 +75,12 @@ def load_csv(path: str, user_col: int = 0, time_col: int = 1,
     when it builds on this machine and falls back to pure Python
     otherwise; ``"native"`` requires it; ``"python"`` forces the
     interpreter path. Both engines produce identical output (pinned by
-    tests/test_native_loader.py)."""
+    tests/test_native_loader.py).
+
+    ``return_stats=True`` returns ``(traces, LoadStats)`` — the
+    duplicate-timestamp / non-monotonic-row counts are surfaced, never
+    silently absorbed by the per-user sort.  A NaN timestamp raises
+    :class:`TraceOrderError` (it cannot be ordered) in both engines."""
     if engine not in ("auto", "native", "python"):
         raise ValueError(f"unknown engine {engine!r}")
     # Arguments only the Python path supports (multi-char or non-ASCII
@@ -66,9 +96,12 @@ def load_csv(path: str, user_col: int = 0, time_col: int = 1,
             return _native.load_csv_native(
                 path, user_col=user_col, time_col=time_col,
                 delimiter=delimiter, skip_header=skip_header,
+                return_stats=return_stats,
             )
     users: Dict = {}
     order: List = []
+    n_rows = 0
+    non_monotonic = 0
     with open(path) as f:
         for i, line in enumerate(f):
             if i < skip_header or not line.strip():
@@ -76,11 +109,26 @@ def load_csv(path: str, user_col: int = 0, time_col: int = 1,
             parts = line.rstrip("\n").split(delimiter)
             u = parts[user_col]
             t = float(parts[time_col])
+            if t != t:  # NaN: unorderable — same wording as the C parser
+                raise TraceOrderError(
+                    f"{path}: line {i}: unorderable timestamp "
+                    f"'{parts[time_col].strip()}' (NaN rows cannot be "
+                    f"ordered)")
             if u not in users:
                 users[u] = []
                 order.append(u)
+            elif users[u] and t < users[u][-1]:
+                non_monotonic += 1
             users[u].append(t)
-    return [np.sort(np.asarray(users[u], np.float64)) for u in order]
+            n_rows += 1
+    out = [np.sort(np.asarray(users[u], np.float64)) for u in order]
+    if not return_stats:
+        return out
+    duplicates = sum(int(np.sum(a[1:] == a[:-1])) for a in out if len(a))
+    return out, LoadStats(
+        n_rows=n_rows, n_users=len(order),
+        duplicate_timestamps=duplicates,
+        non_monotonic_rows=non_monotonic)
 
 
 def save_csv(path: str, traces: Traces, float_format: str = "%.9g") -> None:

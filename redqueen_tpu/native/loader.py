@@ -111,6 +111,10 @@ def build(force: bool = False) -> ctypes.CDLL:
         lib.rq_n_users.argtypes = [ctypes.c_void_p]
         lib.rq_total_events.restype = ctypes.c_long
         lib.rq_total_events.argtypes = [ctypes.c_void_p]
+        lib.rq_n_nonmonotonic.restype = ctypes.c_long
+        lib.rq_n_nonmonotonic.argtypes = [ctypes.c_void_p]
+        lib.rq_n_duplicates.restype = ctypes.c_long
+        lib.rq_n_duplicates.argtypes = [ctypes.c_void_p]
         lib.rq_fill.restype = None
         lib.rq_fill.argtypes = [
             ctypes.c_void_p,
@@ -133,11 +137,19 @@ def available() -> bool:
 
 
 def load_csv_native(path: str, user_col: int = 0, time_col: int = 1,
-                    delimiter: str = ",", skip_header: int = 1
-                    ) -> List[np.ndarray]:
+                    delimiter: str = ",", skip_header: int = 1,
+                    return_stats: bool = False):
     """Native twin of ``data.traces.load_csv`` — same rows in, same
     per-user ascending arrays out (equality pinned by
-    tests/test_native_loader.py)."""
+    tests/test_native_loader.py).
+
+    ``return_stats=True`` returns ``(traces, LoadStats)`` — row/user
+    counts plus the duplicate-timestamp and non-monotonic-row counts the
+    parse observed (the serving reorder window's measured input
+    contract; see ``data.traces.LoadStats``).  A row whose timestamp
+    cannot be ordered (NaN) raises the typed
+    ``data.traces.TraceOrderError`` in BOTH engines instead of being
+    silently sorted somewhere."""
     if len(delimiter.encode()) != 1:  # one BYTE: the C ABI takes c_char
         raise ValueError("native loader needs a single-byte delimiter")
     if user_col < 0 or time_col < 0:
@@ -153,21 +165,41 @@ def load_csv_native(path: str, user_col: int = 0, time_col: int = 1,
         skip_header, errbuf, len(errbuf),
     )
     if not h:
-        raise ValueError(
-            f"{path}: {errbuf.value.decode(errors='replace') or 'parse failed'}"
-        )
+        import re
+
+        msg = errbuf.value.decode(errors="replace") or "parse failed"
+        # Anchored on the C error's own prefix, not a bare substring —
+        # a field VALUE containing the word (e.g. a bad float
+        # 'unorderable') must stay a generic parse error.
+        if re.match(r"line \d+: unorderable timestamp", msg):
+            from ..data.traces import TraceOrderError
+
+            raise TraceOrderError(f"{path}: {msg}")
+        raise ValueError(f"{path}: {msg}")
     try:
         n_users = lib.rq_n_users(h)
         total = lib.rq_total_events(h)
         times = np.empty(total, np.float64)
         offsets = np.empty(n_users + 1, np.int64)
         lib.rq_fill(h, times, offsets)
+        n_nonmono = lib.rq_n_nonmonotonic(h)
+        n_dups = lib.rq_n_duplicates(h)
     finally:
         lib.rq_free(h)
     if n_users == 0:
-        return []  # np.split on an empty corpus would invent one user
-    # OWNING copies, deliberately: np.split views over one backing buffer
-    # would pin the whole corpus in memory for as long as any single
-    # user's trace is retained, and would differ observably (.base) from
-    # the Python engine's owning arrays. The copies cost ~10% of the parse.
-    return [a.copy() for a in np.split(times, offsets[1:-1])]
+        out: List[np.ndarray] = []  # np.split would invent one user
+    else:
+        # OWNING copies, deliberately: np.split views over one backing
+        # buffer would pin the whole corpus in memory for as long as any
+        # single user's trace is retained, and would differ observably
+        # (.base) from the Python engine's owning arrays. The copies cost
+        # ~10% of the parse.
+        out = [a.copy() for a in np.split(times, offsets[1:-1])]
+    if not return_stats:
+        return out
+    from ..data.traces import LoadStats
+
+    return out, LoadStats(
+        n_rows=int(total), n_users=int(n_users),
+        duplicate_timestamps=int(n_dups),
+        non_monotonic_rows=int(n_nonmono))

@@ -42,6 +42,12 @@ namespace {
 struct ParseResult {
   std::string data;  // the whole file; field views point into it
   std::vector<std::vector<double>> per_user;  // first-appearance order
+  // Load stats (the serving reorder window's measured input contract):
+  // rows whose timestamp regressed vs the SAME user's previous row in
+  // file order, and exact duplicate timestamps within a user (counted
+  // post-sort as adjacent equals).
+  long n_nonmonotonic = 0;
+  long n_duplicates = 0;
 };
 
 // Open-addressing user-key index (FNV-1a, linear probing, stored hashes,
@@ -187,6 +193,8 @@ bool parse_time(std::string_view sv, double* out) {
   while (!sv.empty() && is_space(sv.front())) sv.remove_prefix(1);
   while (!sv.empty() && is_space(sv.back())) sv.remove_suffix(1);
   if (sv.empty()) return false;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  // Fast path: std::from_chars for doubles (libstdc++ >= 11 / libc++).
   if (sv.front() == '+') return parse_time_slow(sv, out);  // rare
   double v;
   auto [p, ec] = std::from_chars(sv.data(), sv.data() + sv.size(), v);
@@ -209,6 +217,13 @@ bool parse_time(std::string_view sv, double* out) {
     return parse_time_slow(sv, out);
   }
   return false;
+#else
+  // Toolchains without floating-point from_chars (libstdc++ 10, the
+  // container's g++) take the strtod_l slow path for EVERY field — the
+  // semantic reference the fast path above mirrors, so the two builds
+  // parse identically; only the throughput differs.
+  return parse_time_slow(sv, out);
+#endif
 }
 
 }  // namespace
@@ -314,24 +329,47 @@ void* rq_parse_csv(const char* path, int user_col, int time_col,
       delete res;
       return nullptr;
     }
+    if (t != t) {
+      // A NaN row cannot be ordered against any other row of its user:
+      // typed rejection (the Python side maps "unorderable" onto
+      // TraceOrderError), matching data/traces.py's Python engine —
+      // including its .strip()ed field in the message (wording parity
+      // is fuzz-pinned).
+      std::string_view tt = tf;
+      while (!tt.empty() && is_space(tt.front())) tt.remove_prefix(1);
+      while (!tt.empty() && is_space(tt.back())) tt.remove_suffix(1);
+      set_err(errbuf, errlen,
+              "line " + std::to_string(lineno) + ": unorderable timestamp '" +
+                  std::string(tt) + "' (NaN rows cannot be ordered)");
+      delete res;
+      return nullptr;
+    }
     bool inserted;
     // key views into res->data: stable for the index's lifetime
     size_t ui = index.find_or_insert(uf, res->per_user.size(), &inserted);
     if (inserted) res->per_user.emplace_back();
-    res->per_user[ui].push_back(t);
+    std::vector<double>& uv = res->per_user[ui];
+    if (!uv.empty() && t < uv.back()) ++res->n_nonmonotonic;
+    uv.push_back(t);
     pos = next;
   }
   for (auto& v : res->per_user) {
-    // np.sort semantics: NaNs order LAST. Raw operator< would be
-    // undefined behavior under std::sort the moment a corpus contains a
-    // parseable "nan" timestamp (not a strict weak order), so move NaNs
-    // to the tail first and sort only the numeric prefix — the common
-    // NaN-free case pays no per-comparison branches.
-    auto mid = std::partition(v.begin(), v.end(),
-                              [](double x) { return x == x; });
-    std::sort(v.begin(), mid);
+    // NaNs are rejected at parse above, so operator< is a strict weak
+    // order here and plain std::sort is defined.
+    std::sort(v.begin(), v.end());
+    for (size_t i = 1; i < v.size(); ++i) {
+      if (v[i] == v[i - 1]) ++res->n_duplicates;
+    }
   }
   return res;
+}
+
+long rq_n_nonmonotonic(void* h) {
+  return static_cast<ParseResult*>(h)->n_nonmonotonic;
+}
+
+long rq_n_duplicates(void* h) {
+  return static_cast<ParseResult*>(h)->n_duplicates;
 }
 
 long rq_n_users(void* h) {

@@ -39,6 +39,20 @@ Two ways in:
                             ignores it): the sim driver consumes it via
                             :func:`active_numeric_lane` at lane
                             granularity, inside :func:`numeric_scope`
+      ingest:mode@batchN    deterministic serving-ingest fault at
+                            micro-batch sequence number N (mode: dup |
+                            reorder | drop | torn_journal |
+                            crash_after_apply) — the online serving
+                            runtime's failure modes
+                            (:mod:`redqueen_tpu.serving`): duplicated /
+                            swapped / withheld delivery of batch N, a
+                            torn journal tail after batch N's append,
+                            or a hard ``os._exit`` (kill -9 shape)
+                            right after batch N is applied+journaled.
+                            Like ``numeric`` this is a data-plane kind:
+                            validated at :func:`maybe_inject` but
+                            APPLIED by the serving stream driver /
+                            runtime via :func:`ingest_fault`
 
   ``RQ_FAULT_POINT`` (optional) restricts injection to the matching
   ``maybe_inject(point)`` call site.
@@ -70,6 +84,10 @@ __all__ = [
     "numeric_fault",
     "numeric_scope",
     "active_numeric_lane",
+    "IngestFault",
+    "INGEST_MODES",
+    "parse_ingest",
+    "ingest_fault",
     "hang_forever",
     "crash_with",
     "flaky",
@@ -110,11 +128,12 @@ def parse_fault(spec: str) -> FaultSpec:
     kind, _, arg = spec.strip().partition(":")
     kind = kind.strip().lower()
     if kind not in ("hang", "crash", "transient", "oom", "corrupt",
-                    "numeric"):
+                    "numeric", "ingest"):
         raise ValueError(f"unknown fault spec {spec!r} "
                          f"(want hang|crash|transient|oom[:arg], "
-                         f"corrupt:mode@path, or "
-                         f"numeric:mode@laneN[,chunkM])")
+                         f"corrupt:mode@path, "
+                         f"numeric:mode@laneN[,chunkM], or "
+                         f"ingest:mode@batchN)")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -169,6 +188,10 @@ def inject(spec: FaultSpec) -> None:
         # spec fails fast at the first maybe_inject) but APPLIED by the
         # sim driver at lane granularity via active_numeric_lane().
         parse_numeric(spec.arg)
+    elif spec.kind == "ingest":
+        # Same data-plane contract as ``numeric``: validated here, applied
+        # by the serving stream driver / runtime via ingest_fault().
+        parse_ingest(spec.arg)
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -289,6 +312,57 @@ def active_numeric_lane(batch_size: int) -> Optional[Tuple[int, str]]:
     if 0 <= local < batch_size:
         return local, nf.mode
     return None
+
+
+# --- ingest (serving data-plane) faults: micro-batch delivery failures ----
+
+INGEST_MODES = ("dup", "reorder", "drop", "torn_journal",
+                "crash_after_apply")
+
+
+class IngestFault(NamedTuple):
+    """Parsed ``ingest:mode@batchN`` spec.  ``batch`` is the SEQUENCE
+    NUMBER of the targeted micro-batch (the serving stream's logical
+    clock, not a wall-time index), so the same spec hits the same batch
+    in an uninterrupted run and in a replay-after-recovery run."""
+
+    mode: str   # dup | reorder | drop | torn_journal | crash_after_apply
+    batch: int
+
+
+def parse_ingest(arg: Optional[str]) -> IngestFault:
+    """Parse the argument of an ``ingest`` fault spec."""
+    if not arg or "@" not in arg:
+        raise ValueError(
+            f"{ENV_FAULT}=ingest needs 'mode@batchN' "
+            f"(mode: {'|'.join(INGEST_MODES)})")
+    mode, _, where = arg.partition("@")
+    mode = mode.strip().lower()
+    if mode not in INGEST_MODES:
+        raise ValueError(f"unknown ingest fault mode {mode!r} "
+                         f"(want {'|'.join(INGEST_MODES)})")
+    where = where.strip().lower()
+    if not where.startswith("batch"):
+        raise ValueError(f"ingest fault needs 'batchN', got {where!r}")
+    try:
+        batch = int(where[5:])
+    except ValueError as e:
+        raise ValueError(f"bad batch in ingest fault: {where!r}") from e
+    if batch < 0:
+        raise ValueError(f"ingest fault batch must be >= 0, got {batch}")
+    return IngestFault(mode, batch)
+
+
+def ingest_fault() -> Optional[IngestFault]:
+    """The env-configured ingest fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "ingest":
+        return None
+    return parse_ingest(parsed.arg)
 
 
 # --- picklable callable faults (spawned-child targets for tests) ---------

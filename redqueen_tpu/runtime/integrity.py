@@ -63,6 +63,8 @@ __all__ = [
     "savez",
     "load_npz",
     "quarantine",
+    "make_envelope",
+    "verify_envelope",
 ]
 
 ENVELOPE_KEY = "__rq_envelope__"
@@ -182,19 +184,54 @@ def _reject(path: str, reason: str, detail: str = "",
 # JSON envelopes
 # --------------------------------------------------------------------------
 
-def write_json(path: str, payload: Any, schema: str = "rq.json/1",
-               indent=1) -> None:
-    """Atomically write ``payload`` wrapped in a checksummed envelope.
-    ``schema`` tags what the payload IS (bump the suffix on layout
-    changes so readers can migrate deliberately)."""
+def make_envelope(payload: Any, schema: str = "rq.json/1") -> Dict[str, Any]:
+    """The checksummed envelope OBJECT for ``payload`` — exactly what
+    :func:`write_json` lands on disk, as a dict.  Public so in-memory /
+    line-oriented consumers (the serving journal appends one envelope per
+    JSONL record) reuse the one digest definition instead of inventing a
+    second checksum format."""
     writer = _writer_meta()
-    atomic_write_json(path, {
+    return {
         ENVELOPE_KEY: ENVELOPE_VERSION,
         "schema": schema,
         "sha256": _json_digest(schema, writer, payload),
         "writer": writer,
         "payload": payload,
-    }, indent=indent)
+    }
+
+
+def verify_envelope(obj: Any, schema: Optional[str] = None,
+                    where: str = "<envelope>") -> Any:
+    """Verify an in-memory envelope object; returns the payload.
+
+    The non-file twin of :func:`read_json`'s checks (no quarantine — the
+    caller owns the bytes): a non-envelope object, malformed keys, a
+    digest mismatch, or a ``schema`` mismatch raise
+    :class:`CorruptArtifactError` with ``quarantined_to=None`` and
+    ``where`` standing in for the path."""
+    if not (isinstance(obj, dict) and ENVELOPE_KEY in obj):
+        raise CorruptArtifactError(where, "no integrity envelope")
+    if not isinstance(obj.get("sha256"), str) or "payload" not in obj:
+        raise CorruptArtifactError(
+            where, f"malformed envelope (keys: {sorted(obj)})")
+    got = _json_digest(obj.get("schema"), obj.get("writer"), obj["payload"])
+    if got != obj["sha256"]:
+        raise CorruptArtifactError(
+            where, f"checksum mismatch (stored {obj['sha256'][:12]}.. != "
+                   f"computed {got[:12]}..)")
+    if schema is not None and obj.get("schema") != schema:
+        raise CorruptArtifactError(
+            where, f"schema mismatch (want {schema!r}, "
+                   f"found {obj.get('schema')!r})")
+    return obj["payload"]
+
+
+def write_json(path: str, payload: Any, schema: str = "rq.json/1",
+               indent=1) -> None:
+    """Atomically write ``payload`` wrapped in a checksummed envelope.
+    ``schema`` tags what the payload IS (bump the suffix on layout
+    changes so readers can migrate deliberately)."""
+    atomic_write_json(path, make_envelope(payload, schema), indent=indent)
 
 
 def read_json(path: str, schema: Optional[str] = None,

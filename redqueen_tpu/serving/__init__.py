@@ -1,0 +1,104 @@
+"""Online serving runtime: RedQueen as a service, not a batch sim.
+
+The paper's algorithm is online — one exponential update per rank
+change (WSDM'17) — and this package is its serving shape (ROADMAP item
+2): persistent per-edge feed state advanced by ingest micro-batches,
+posting decisions returned online, and the PR 1–5 robustness stack
+(integrity envelopes, checkpoint recovery, lane-health quarantine,
+deterministic fault injection) made load-bearing:
+
+- :mod:`~redqueen_tpu.serving.events`   — micro-batch types + typed
+  ingest validation (:class:`IngestError`);
+- :mod:`~redqueen_tpu.serving.ingest`   — duplicate drop + bounded
+  reorder window over sequence numbers (:class:`Sequencer`);
+- :mod:`~redqueen_tpu.serving.state`    — the per-edge carry
+  (:class:`FeedState`), jitted donated apply, per-edge health
+  quarantine, canonical carry digest;
+- :mod:`~redqueen_tpu.serving.journal`  — crash-safe checksummed
+  append-only journal with torn-tail quarantine;
+- :mod:`~redqueen_tpu.serving.service`  — :class:`ServingRuntime`
+  (bounded queue, backpressure, shed accounting, stale-but-served
+  decisions) and :func:`recover` (snapshot + journal replay,
+  bit-identical);
+- :mod:`~redqueen_tpu.serving.metrics`  — steady-state counters +
+  latency percentiles, landed as the enveloped ``rq.serving.metrics/1``
+  artifact;
+- :mod:`~redqueen_tpu.serving.stream`   — the deterministic stream
+  driver / CLI (``python -m redqueen_tpu.serving.stream``), where the
+  ``RQ_FAULT=ingest:*`` delivery faults are applied.
+
+Every failure mode runs deterministically in CI on CPU via
+``runtime.faultinject``'s ``ingest`` fault kinds; see
+``docs/DESIGN.md`` "Online serving & ingest fault tolerance".
+"""
+
+from __future__ import annotations
+
+from . import events, ingest, journal, metrics, service, state  # noqa: F401
+from .events import EventBatch, IngestError, synthetic_stream, validate_batch
+from .ingest import Sequencer
+from .journal import JOURNAL_SCHEMA, Journal, JournalError, tear_tail
+from .metrics import METRICS_SCHEMA, ServingMetrics
+from .service import (
+    Admission,
+    CONFIG_SCHEMA,
+    RecoveryInfo,
+    ServingRuntime,
+    journal_decisions,
+    recover,
+)
+from .state import (
+    Decision,
+    FeedState,
+    init_feed_state,
+    make_apply_fn,
+    poison_edge,
+    state_digest,
+)
+__all__ = [
+    "EventBatch",
+    "IngestError",
+    "validate_batch",
+    "synthetic_stream",
+    "Sequencer",
+    "Journal",
+    "JournalError",
+    "JOURNAL_SCHEMA",
+    "tear_tail",
+    "ServingMetrics",
+    "METRICS_SCHEMA",
+    "ServingRuntime",
+    "Admission",
+    "RecoveryInfo",
+    "recover",
+    "journal_decisions",
+    "CONFIG_SCHEMA",
+    "FeedState",
+    "Decision",
+    "init_feed_state",
+    "make_apply_fn",
+    "state_digest",
+    "poison_edge",
+    "drive",
+    "FINAL_SCHEMA",
+]
+
+# ``stream`` is served lazily (PEP 562): eager import would trip runpy's
+# found-in-sys.modules warning on every ``python -m
+# redqueen_tpu.serving.stream`` invocation (the module doubles as the
+# CLI entry point).
+_STREAM_NAMES = ("stream", "drive", "FINAL_SCHEMA")
+
+
+def __getattr__(name):
+    if name in _STREAM_NAMES:
+        import importlib
+
+        # import_module (not ``from . import``): the fromlist protocol
+        # getattrs the package for the submodule and would re-enter this
+        # hook before the import finishes binding the attribute.
+        _stream = importlib.import_module(".stream", __name__)
+        if name == "stream":
+            return _stream
+        return getattr(_stream, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,152 @@
+"""Ingest event types and host-side validation for the serving runtime.
+
+The serving ingest unit is a **sequence-numbered micro-batch** of wall
+events: ``(seq, times[E], feeds[E])`` — posts by OTHER broadcasters
+landing in follower feeds, each one a rank change for the controlled
+broadcaster's last post (the paper's online signal: one exponential
+update per rank change, WSDM'17).  ``seq`` is the stream's logical
+clock: the source stamps consecutive integers, and the runtime's
+idempotence (duplicate drop) and order tolerance (bounded reorder
+window) are defined over it — NOT over wall-clock arrival.
+
+Validation is the same boundary philosophy as the sim driver's
+``_check_finite_params`` (runtime.numerics "validated inputs"): garbage
+is rejected HOST-side with a typed :class:`IngestError` naming the batch
+and row, never silently skipped and never allowed to poison the carry.
+Stdlib + numpy only; safe to import before jax.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+__all__ = ["EventBatch", "IngestError", "validate_batch",
+           "synthetic_stream"]
+
+
+class IngestError(ValueError):
+    """A micro-batch failed ingest validation.  Typed rejection — the
+    runtime counts it (``rejected``) and the source gets a precise
+    reason; a malformed event is never silently dropped and never
+    applied.  ``seq`` is the offending batch's sequence number (None
+    when the envelope itself is unusable), ``row`` the first offending
+    event index within it (None for batch-level problems)."""
+
+    def __init__(self, message: str, seq: Optional[int] = None,
+                 row: Optional[int] = None):
+        self.seq = seq
+        self.row = row
+        where = "" if seq is None else f"batch {seq}"
+        if row is not None:
+            where += f" row {row}"
+        super().__init__(f"{where}: {message}" if where else message)
+
+
+class EventBatch(NamedTuple):
+    """One ingest micro-batch: ``times`` are event timestamps (float64,
+    non-decreasing within the batch), ``feeds`` the follower feed index
+    each event lands in (int32).  Immutable by convention — the arrays
+    are owned by the producer and never mutated by the runtime."""
+
+    seq: int
+    times: np.ndarray  # f64[E]
+    feeds: np.ndarray  # i32[E]
+
+    @property
+    def n_events(self) -> int:
+        return int(len(self.times))
+
+    @property
+    def t_end(self) -> float:
+        """The batch's trailing timestamp (the serving clock after
+        applying it); batches may be empty (a pure heartbeat carries the
+        clock forward is NOT supported — empty means no clock motion)."""
+        return float(self.times[-1]) if len(self.times) else float("nan")
+
+
+def validate_batch(batch: EventBatch, n_feeds: int,
+                   max_events: Optional[int] = None) -> EventBatch:
+    """Host-side domain check; returns the batch (arrays coerced to the
+    canonical dtypes) or raises :class:`IngestError` naming the first
+    offending row.
+
+    Checks: non-negative integer ``seq``; 1-D equal-length arrays;
+    ``times`` finite (NaN/±inf cannot be ordered against the carry) and
+    non-decreasing within the batch; ``feeds`` in ``[0, n_feeds)``;
+    optionally at most ``max_events`` rows (the runtime's fixed dispatch
+    pad — an oversized batch must be split by the source, not silently
+    truncated here)."""
+    if not isinstance(batch.seq, (int, np.integer)) or int(batch.seq) < 0:
+        raise IngestError(f"seq must be a non-negative int, got "
+                          f"{batch.seq!r}", seq=None)
+    seq = int(batch.seq)
+    try:
+        times = np.asarray(batch.times, np.float64)
+    except (TypeError, ValueError) as e:
+        # numpy's coercion error must not escape bare: the runtime's
+        # submit() boundary catches ONLY IngestError.
+        raise IngestError(f"times are not numeric: {e}", seq=seq) from e
+    try:
+        feeds = np.asarray(batch.feeds)
+    except (TypeError, ValueError) as e:
+        raise IngestError(f"feeds are not an array: {e}", seq=seq) from e
+    if times.ndim != 1 or feeds.ndim != 1:
+        raise IngestError(
+            f"times/feeds must be 1-D, got shapes {times.shape} / "
+            f"{feeds.shape}", seq=seq)
+    if len(times) != len(feeds):
+        raise IngestError(
+            f"times and feeds must have equal lengths, got "
+            f"{len(times)} vs {len(feeds)}", seq=seq)
+    if max_events is not None and len(times) > max_events:
+        raise IngestError(
+            f"batch holds {len(times)} events, over the runtime's "
+            f"max_batch_events={max_events} — split it at the source",
+            seq=seq)
+    bad = ~np.isfinite(times)
+    if bad.any():
+        i = int(np.flatnonzero(bad)[0])
+        raise IngestError(
+            f"non-finite event time {times[i]!r} — unorderable against "
+            f"the feed carry", seq=seq, row=i)
+    if len(times) > 1:
+        dec = np.diff(times) < 0
+        if dec.any():
+            i = int(np.flatnonzero(dec)[0]) + 1
+            raise IngestError(
+                f"times regress within the batch (times[{i}] = "
+                f"{times[i]!r} < times[{i - 1}] = {times[i - 1]!r}) — "
+                f"sort events before batching", seq=seq, row=i)
+    if not np.issubdtype(feeds.dtype, np.integer):
+        raise IngestError(
+            f"feeds must be integers, got dtype {feeds.dtype}", seq=seq)
+    oob = (feeds < 0) | (feeds >= n_feeds)
+    if oob.any():
+        i = int(np.flatnonzero(oob)[0])
+        raise IngestError(
+            f"feed index {int(feeds[i])} out of range [0, {n_feeds})",
+            seq=seq, row=i)
+    return EventBatch(seq, times, feeds.astype(np.int32, copy=False))
+
+
+def synthetic_stream(seed: int, n_batches: int, n_feeds: int,
+                     events_per_batch: int = 8, dt: float = 1.0,
+                     start_seq: int = 0):
+    """Deterministic synthetic ingest stream for tests and the serving
+    micro-bench: ``n_batches`` batches of Poisson-ish wall traffic, seqs
+    ``start_seq..``, each spanning ``dt`` of serving time.  Pure
+    ``np.random.RandomState(seed)`` — the same call always yields the
+    byte-identical stream, so a crashed driver regenerates exactly the
+    batches its journal already holds (the retransmit model)."""
+    rng = np.random.RandomState(seed)
+    out = []
+    t0 = 0.0
+    for i in range(n_batches):
+        n = int(rng.poisson(events_per_batch))
+        times = np.sort(rng.uniform(t0, t0 + dt, n))
+        feeds = rng.randint(0, n_feeds, n).astype(np.int32)
+        out.append(EventBatch(start_seq + i, times, feeds))
+        t0 += dt
+    return out

@@ -1,0 +1,116 @@
+"""Idempotent, order-tolerant micro-batch sequencing.
+
+The stream contract: the source stamps consecutive sequence numbers and
+MAY deliver duplicates (retransmits after a lost ack), out-of-order
+batches (parallel transport), or gaps (lost batches awaiting
+retransmit).  The :class:`Sequencer` turns that into the strictly
+in-order, exactly-once apply stream the journal/recovery protocol
+requires:
+
+- ``seq <= last applied``  → **duplicate**: dropped and counted; the
+  apply stream never sees a batch twice (idempotence — a recovering
+  source can blindly retransmit its whole window).
+- ``seq == next expected`` → ready now, plus every consecutive follower
+  buffered in the window (their out-of-order arrival is counted as
+  ``reordered`` when they drain).
+- within the window        → buffered (bounded: at most ``window``
+  batches of lookahead, so memory is bounded no matter how long a gap
+  stays open).
+- beyond the window        → typed :class:`IngestError` rejection — the
+  source must back off and retransmit the gap first; silently widening
+  the window would unbound memory, silently dropping would corrupt the
+  stream.
+
+``missing_seqs()`` is the backpressure/retransmit signal: the exact gap
+list a source needs to close before the window can drain.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .events import EventBatch, IngestError
+
+__all__ = ["Sequencer"]
+
+
+class Sequencer:
+    """Reorder/dedup stage between ``submit`` and apply.  Not
+    thread-safe by design — the serving runtime owns one and serializes
+    access (the apply path is single-writer by construction: one journal,
+    one carry)."""
+
+    def __init__(self, start_seq: int = 0, window: int = 8):
+        if window < 1:
+            raise ValueError(f"reorder window must be >= 1, got {window}")
+        self.next_seq = int(start_seq)
+        self.window = int(window)
+        self._held: Dict[int, EventBatch] = {}
+        self.duplicates = 0
+        self.reordered = 0
+        self.window_rejects = 0
+
+    @property
+    def held(self) -> int:
+        return len(self._held)
+
+    def classify(self, seq: int) -> str:
+        """Read-only probe: ``applied`` (seq is behind the apply stream
+        — a retransmit the source may treat as an ack), ``held``
+        (buffered in the window, NOT yet applied — the arrival is
+        redundant but the batch is not durable, so the admission must
+        not read as an ack), or ``new``.  The runtime consults this
+        BEFORE its queue-capacity shed check so neither redundant class
+        is ever miscounted as shed."""
+        seq = int(seq)
+        if seq < self.next_seq:
+            return "applied"
+        return "held" if seq in self._held else "new"
+
+    def missing_seqs(self) -> List[int]:
+        """The gap list blocking the window from draining — the
+        retransmit request the backpressure signal carries."""
+        if not self._held:
+            return []
+        return [s for s in range(self.next_seq, max(self._held))
+                if s not in self._held]
+
+    def offer(self, batch: EventBatch) -> Tuple[str, List[EventBatch]]:
+        """Feed one validated batch; returns ``(status, ready)`` where
+        ``status`` is ``accepted`` / ``duplicate`` and ``ready`` the
+        in-order run now unblocked (empty for a buffered out-of-order
+        batch — status is still ``accepted``: it WILL apply once the gap
+        closes).  Raises :class:`IngestError` when the batch lands
+        beyond the bounded window."""
+        seq = int(batch.seq)
+        if seq < self.next_seq:
+            self.duplicates += 1
+            return "duplicate", []
+        if seq in self._held:
+            # Redundant arrival of a batch already buffered: counted as
+            # a duplicate delivery, but reported ``accepted`` — it has
+            # NOT applied yet, so the source must not take this as an
+            # ack (a crash before the gap closes would lose it).
+            self.duplicates += 1
+            return "accepted", []
+        if seq >= self.next_seq + self.window:
+            self.window_rejects += 1
+            raise IngestError(
+                f"seq {seq} is beyond the reorder window "
+                f"[{self.next_seq}, {self.next_seq + self.window}) — "
+                f"retransmit the missing batches "
+                f"{self.missing_seqs() or [self.next_seq]} first",
+                seq=seq)
+        if seq != self.next_seq:
+            # Held for later: counted as a reorder when it drains (it
+            # arrived before its predecessors).
+            self._held[seq] = batch
+            return "accepted", []
+        ready = [batch]
+        self.next_seq += 1
+        while self.next_seq in self._held:
+            nxt = self._held.pop(self.next_seq)
+            self.reordered += 1
+            ready.append(nxt)
+            self.next_seq += 1
+        return "accepted", ready

@@ -1,0 +1,263 @@
+"""Crash-safe state journaling: checksummed append-only record log.
+
+The journal is the serving runtime's write-ahead source of truth: every
+applied micro-batch lands as ONE JSONL record — the batch's events, the
+decision taken, and the post-apply carry digest — wrapped in the same
+checksummed envelope format as every other artifact in the repo
+(``runtime.integrity.make_envelope``; schema ``rq.serving.journal/1``).
+Appends are flushed + fsynced before the apply is acknowledged, so a
+SIGKILL at ANY instruction boundary leaves one of exactly two shapes:
+
+- every acknowledged batch is a complete, verifiable record;
+- plus at most one **torn tail** — a partial last line from an append the
+  kill interrupted (that batch was never acknowledged).
+
+Recovery (:func:`replay`) verifies records front-to-back.  A torn or
+corrupt TAIL is quarantined — the bad bytes move to a
+``<journal>.torn-<utc-ts>`` sidecar with a structured report beside it
+(``runtime.integrity.quarantine`` semantics, scoped to the tail), the
+journal truncates back to its last good record, and replay returns the
+verified prefix: torn bytes are never trusted and never silently
+deleted.  A bad record in the MIDDLE of the file is a different animal —
+an fsynced record can only fail verification through real corruption
+(bit rot, truncation by a non-atomic copier), and nothing after it can
+be trusted to follow the right state — so that raises a typed
+:class:`JournalError` instead of guessing.
+
+Stdlib + numpy only; safe to import before jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..runtime import integrity as _integrity
+
+__all__ = ["Journal", "JournalError", "replay", "tear_tail",
+           "rotate", "prune_segments", "segment_paths",
+           "JOURNAL_SCHEMA"]
+
+JOURNAL_SCHEMA = "rq.serving.journal/1"
+
+
+class JournalError(RuntimeError):
+    """A journal record BEFORE the tail failed verification: the file is
+    corrupt in a way crash-tearing cannot produce (fsynced prefix), so
+    replay refuses to trust anything past it.  Carries the path and the
+    0-based record index that failed."""
+
+    def __init__(self, path: str, record: int, reason: str):
+        self.path = path
+        self.record = record
+        super().__init__(
+            f"journal {path} record {record}: {reason} — a non-tail "
+            f"record can only fail through real corruption; refusing to "
+            f"replay past it (recover from the snapshot + a fresh "
+            f"journal, or restore the file from backup)")
+
+
+class Journal:
+    """Append-only writer.  One instance owns the file handle; appends
+    are atomic at the OS-write level (single ``write`` of one line) and
+    durable (flush + fsync) before :meth:`append` returns — the "applied"
+    acknowledgement the serving runtime gives its source is backed by
+    this fsync."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+
+    def append(self, payload: Dict[str, Any]) -> None:
+        env = _integrity.make_envelope(payload, schema=JOURNAL_SCHEMA)
+        line = json.dumps(env, separators=(",", ":")) + "\n"
+        self._f.write(line)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _quarantine_tail(path: str, offset: int, reason: str,
+                     detail: str) -> Tuple[str, str]:
+    """Move the bytes from ``offset`` to EOF into a ``.torn-<ts>``
+    sidecar (never deleted — the bytes are evidence), write the
+    structured report beside it, and truncate the journal back to the
+    last verified record.  Returns ``(sidecar_path, report_path)``."""
+    import datetime as _dt
+    import time as _time
+
+    ts = _dt.datetime.fromtimestamp(
+        _time.time(), _dt.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    sidecar = f"{path}.torn-{ts}"
+    n = 0
+    while os.path.exists(sidecar):
+        n += 1
+        sidecar = f"{path}.torn-{ts}-{n}"
+    with open(path, "rb") as f:
+        f.seek(offset)
+        torn = f.read()
+    with open(sidecar, "wb") as f:
+        f.write(torn)
+        f.flush()
+        os.fsync(f.fileno())
+    os.truncate(path, offset)
+    report = f"{sidecar}.report.json"
+    _integrity.write_json(report, {
+        "journal": os.path.abspath(path),
+        "quarantined_to": os.path.abspath(sidecar),
+        "tail_offset": offset,
+        "tail_bytes": len(torn),
+        "reason": reason,
+        "detail": detail,
+    }, schema="rq.quarantine-report/1")
+    return sidecar, report
+
+
+def _replay_file(path: str, quarantine_torn_tail: bool,
+                 tail_allowed: bool, record_base: int
+                 ) -> Tuple[List[Dict[str, Any]], Optional[Dict[str, Any]]]:
+    """Verify one journal file.  ``tail_allowed`` is True only for the
+    LIVE (unsuffixed) file: a rotated segment was complete and fsynced
+    at rotation, so ANY failure there is real corruption, never a torn
+    append.  ``record_base`` offsets the record index in errors."""
+    payloads: List[Dict[str, Any]] = []
+    bad: Optional[Tuple[int, str, str]] = None  # (offset, reason, detail)
+    offset = 0
+    with open(path, "rb") as f:
+        lines = f.read().split(b"\n")
+    # A well-formed journal ends with a newline, so the final split
+    # element is empty; a NON-empty final element is unterminated bytes
+    # — the only shape a crash-torn append can leave, and the ONLY
+    # record the torn-tail quarantine may claim.  A newline-terminated
+    # last record was written whole and fsynced — its batch was
+    # ACKNOWLEDGED (the source stopped retransmitting), so a
+    # verification failure there is real corruption of acked data and
+    # must raise like any mid-file failure, never be silently dropped.
+    for i, raw in enumerate(lines):
+        at_tail = tail_allowed and i == len(lines) - 1
+        if not raw:
+            offset += len(raw) + 1
+            continue
+        try:
+            obj = json.loads(raw.decode("utf-8"))
+            payload = _integrity.verify_envelope(
+                obj, schema=JOURNAL_SCHEMA,
+                where=f"{path} record {record_base + len(payloads)}")
+        except (ValueError, _integrity.CorruptArtifactError) as e:
+            if not at_tail:
+                raise JournalError(path, record_base + len(payloads),
+                                   str(e)) from e
+            bad = (offset, "torn tail record", str(e))
+            break
+        payloads.append(payload)
+        offset += len(raw) + 1
+    torn_info: Optional[Dict[str, Any]] = None
+    if bad is not None:
+        off, reason, detail = bad
+        torn_info = {"reason": reason, "detail": detail,
+                     "records_kept": record_base + len(payloads),
+                     "sidecar": None, "report": None}
+        if quarantine_torn_tail:
+            sidecar, report = _quarantine_tail(path, off, reason, detail)
+            torn_info["sidecar"] = sidecar
+            torn_info["report"] = report
+    return payloads, torn_info
+
+
+def segment_paths(path: str) -> List[str]:
+    """Rotated segments of ``path`` (``<path>.<seq>``), oldest first."""
+    import glob as _glob
+
+    out = []
+    for p in _glob.glob(path + ".*"):
+        suffix = p[len(path) + 1:]
+        if suffix.isdigit():
+            out.append((int(suffix), p))
+    return [p for _, p in sorted(out)]
+
+
+def replay(path: str, quarantine_torn_tail: bool = True
+           ) -> Tuple[List[Dict[str, Any]], Optional[Dict[str, Any]]]:
+    """Read + verify every retained record — rotated segments (oldest
+    first), then the live file; returns ``(payloads, torn_info)``.
+
+    ``torn_info`` is None for a clean journal, else a dict describing
+    the quarantined tail (``{reason, sidecar, report, records_kept}``);
+    only the LIVE file can have a torn tail (segments were complete at
+    rotation — any failure there raises :class:`JournalError`).  A
+    missing journal returns ``([], None)`` — absence is a fresh stream,
+    not corruption.  Pass ``quarantine_torn_tail=False`` to only skip
+    the tail (read-only inspection)."""
+    payloads: List[Dict[str, Any]] = []
+    for seg in segment_paths(path):
+        recs, _ = _replay_file(seg, quarantine_torn_tail=False,
+                               tail_allowed=False,
+                               record_base=len(payloads))
+        payloads.extend(recs)
+    torn_info: Optional[Dict[str, Any]] = None
+    if os.path.exists(path):
+        recs, torn_info = _replay_file(
+            path, quarantine_torn_tail=quarantine_torn_tail,
+            tail_allowed=True, record_base=len(payloads))
+        payloads.extend(recs)
+    return payloads, torn_info
+
+
+def rotate(path: str, seq: int) -> Optional[str]:
+    """Close out the live journal as segment ``<path>.<seq>`` (records
+    ≤ seq, complete by construction: rotation runs right after the
+    snapshot at ``seq`` landed, and appends are serialized with it).
+    Bounds the live file; :func:`prune_segments` bounds the segments.
+    No-op (returns None) when the live file is missing or empty."""
+    if not os.path.exists(path) or os.path.getsize(path) == 0:
+        return None
+    seg = f"{path}.{int(seq):012d}"
+    os.replace(path, seg)
+    return seg
+
+
+def prune_segments(path: str, oldest_retained_seq: int) -> List[str]:
+    """Delete segments fully covered by EVERY retained snapshot: a
+    segment ``<path>.<k>`` holds records with seq ≤ k, so once the
+    oldest retained snapshot is ≥ k no recovery path can need it.
+    Returns the removed paths.  This is what keeps total journal size
+    bounded (~retained-snapshot window), at the documented cost that
+    ``journal_decisions`` returns the retained history, not all time."""
+    removed = []
+    for seg in segment_paths(path):
+        k = int(seg[len(path) + 1:])
+        if k <= int(oldest_retained_seq):
+            os.remove(seg)
+            removed.append(seg)
+    return removed
+
+
+def tear_tail(path: str, keep_bytes: Optional[int] = None) -> dict:
+    """Deterministically tear the journal's LAST record mid-line — the
+    crash-mid-append shape the ``ingest:torn_journal`` fault kind drives:
+    the final line is truncated to half its length (or ``keep_bytes``),
+    exactly as if the process died between the ``write`` and the
+    ``fsync`` landing the full line.  Returns what was done, for test
+    assertions.  No randomness: same bytes in, same tear out."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.strip():
+        raise ValueError(f"cannot tear empty journal {path}")
+    body = data[:-1] if data.endswith(b"\n") else data
+    start = body.rfind(b"\n") + 1  # 0 when the file holds one record
+    last = body[start:]
+    keep = len(last) // 2 if keep_bytes is None else int(keep_bytes)
+    os.truncate(path, start + keep)
+    return {"path": path, "record_offset": start,
+            "record_was": len(last), "record_now": keep}

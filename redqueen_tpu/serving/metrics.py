@@ -1,0 +1,134 @@
+"""Steady-state serving metrics: counters, latency percentiles, and the
+enveloped ``rq.serving.metrics/1`` artifact.
+
+Accounting is CLOSED by construction and asserted in CI: every submitted
+batch ends in exactly one of {applied, shed, rejected, duplicate, still
+pending}, so after a drain
+
+    ingested == applied + shed + rejected + duplicates
+
+— load shedding records exactly what was shed (count, events, and the
+shed sequence numbers), never a silent gap.  Decision latency is
+wall-clock submit→decision per applied batch (``time.monotonic``),
+reported as p50/p99; events/s sustained divides applied events by the
+busy window.  The artifact is written through ``runtime.integrity`` so
+it carries the standard checksummed envelope.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..runtime import integrity as _integrity
+
+__all__ = ["ServingMetrics", "METRICS_SCHEMA", "MAX_SHED_SEQS",
+           "LATENCY_WINDOW"]
+
+METRICS_SCHEMA = "rq.serving.metrics/1"
+
+# Hard caps keeping a long-lived runtime's metrics state bounded (the
+# overload contract promises bounded MEMORY, which must include the
+# accounting itself): the first MAX_SHED_SEQS shed seqs are recorded
+# verbatim (the artifact flags truncation; the total count is always
+# exact), and latency percentiles are computed over a sliding window of
+# the most recent LATENCY_WINDOW applies.
+MAX_SHED_SEQS = 1024
+LATENCY_WINDOW = 8192
+
+
+class ServingMetrics:
+    """Mutable counter block owned by the serving runtime; one instance
+    per runtime lifetime (recovery starts a fresh one — the artifact
+    describes THIS process's steady state, not history)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self.t_start = clock()
+        # batch counters (the reconciliation identity's terms)
+        self.ingested = 0       # submit() calls that carried a batch
+        self.applied = 0        # batches applied to the carry
+        self.shed = 0           # dropped by overload policy (queue full)
+        self.rejected = 0       # typed IngestError rejections
+        self.duplicates = 0     # duplicate-seq drops
+        self.reordered = 0      # batches that arrived out of order
+        self.window_rejects = 0  # rejected for landing beyond the window
+        # event / decision counters
+        self.events_applied = 0
+        self.posts = 0
+        self.shed_events = 0
+        self.shed_seqs: List[int] = []  # first MAX_SHED_SEQS only
+        self.decisions_served = 0   # decide() calls answered (incl. stale)
+        self.stale_decisions = 0    # decide() served with backlog pending
+        self._latencies: collections.deque = collections.deque(
+            maxlen=LATENCY_WINDOW)
+
+    def observe_apply(self, n_events: int, posted: bool,
+                      latency_s: Optional[float]) -> None:
+        self.applied += 1
+        self.events_applied += int(n_events)
+        self.posts += int(bool(posted))
+        if latency_s is not None:
+            self._latencies.append(float(latency_s))
+
+    def observe_shed(self, seq: int, n_events: int) -> None:
+        self.shed += 1
+        self.shed_events += int(n_events)
+        if len(self.shed_seqs) < MAX_SHED_SEQS:
+            self.shed_seqs.append(int(seq))
+
+    def latency_percentiles(self) -> Dict[str, Optional[float]]:
+        if not self._latencies:
+            return {"p50_ms": None, "p99_ms": None, "max_ms": None}
+        lat = np.asarray(self._latencies)
+        return {
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "max_ms": round(float(lat.max()) * 1e3, 3),
+        }
+
+    def reconciles(self, pending: int = 0) -> bool:
+        """The closed-accounting identity (pending = batches accepted
+        but not yet applied: queued or held in the reorder window)."""
+        return self.ingested == (self.applied + self.shed + self.rejected
+                                 + self.duplicates + pending)
+
+    def report(self, pending: int = 0,
+               extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        busy_s = max(self._clock() - self.t_start, 1e-9)
+        out: Dict[str, Any] = {
+            "ingested": self.ingested,
+            "applied": self.applied,
+            "shed": self.shed,
+            "rejected": self.rejected,
+            "duplicates": self.duplicates,
+            "reordered": self.reordered,
+            "window_rejects": self.window_rejects,
+            "pending": int(pending),
+            "reconciles": self.reconciles(pending),
+            "events_applied": self.events_applied,
+            "posts": self.posts,
+            "shed_events": self.shed_events,
+            "shed_seqs": list(self.shed_seqs),
+            "shed_seqs_truncated": self.shed > len(self.shed_seqs),
+            "decisions_served": self.decisions_served,
+            "stale_decisions": self.stale_decisions,
+            "busy_s": round(busy_s, 6),
+            "events_per_sec": round(self.events_applied / busy_s, 1),
+            "batches_per_sec": round(self.applied / busy_s, 1),
+            "decision_latency": self.latency_percentiles(),
+        }
+        if extra:
+            out.update(extra)
+        return out
+
+    def write(self, path: str, pending: int = 0,
+              extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Land the report as the enveloped ``rq.serving.metrics/1``
+        artifact (atomic + checksummed); returns the payload."""
+        payload = self.report(pending=pending, extra=extra)
+        _integrity.write_json(path, payload, schema=METRICS_SCHEMA)
+        return payload

@@ -1,0 +1,198 @@
+"""Persistent per-edge feed state and the jitted micro-batch apply step.
+
+The serving carry is the online analogue of the sim's ``SimState``: one
+rank scalar per broadcaster×follower edge (how far the controlled
+broadcaster's last post has been pushed down that follower's feed),
+advanced by ingest micro-batches instead of a ``lax.scan`` over sampled
+events.  The paper's online algorithm needs exactly this: the RedQueen
+intensity is ``u(t) = Σ_f sqrt(s_f/q) · r_f(t)`` and each wall event in
+feed ``f`` is one rank change (one exponential update, WSDM'17) —
+:func:`make_apply_fn` discretizes that at micro-batch granularity with
+a counter-addressed threefry draw per batch (``ops.threefry``, the same
+stream discipline as the event-scan kernel), so the decision sequence is
+a pure function of ``(initial state, batch stream)`` — the property the
+journal-replay recovery protocol (``serving.journal``) depends on for
+bit-identical resume.
+
+Robustness pieces shared with the sim stack:
+
+- **Per-edge health quarantine** (PR 3 protocol, ``runtime.numerics``):
+  the apply step checks every rank it writes back; a non-finite value
+  sets ``BIT_NONFINITE_STATE`` for exactly that edge and freezes it
+  (excluded from the intensity, no further updates) while healthy edges
+  keep serving — a poisoned edge never stalls the feed graph.
+- **Donated-buffer in-place update**: the carry is donated to the jitted
+  apply on backends that support donation, so steady-state serving never
+  copies the [F] state (F = millions of edges at the north-star scale).
+- **Deterministic digest** (:func:`state_digest`): the canonical-bytes
+  sha256 of the carry, the bit-identity witness the crash-recovery
+  acceptance test compares.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops.threefry import threefry2x32, uniform_from_bits
+from ..runtime import numerics
+
+__all__ = ["FeedState", "Decision", "init_feed_state", "make_apply_fn",
+           "state_digest", "poison_edge"]
+
+
+class FeedState(struct.PyTreeNode):
+    """The serving carry: everything the apply step needs between
+    micro-batches, and everything recovery needs to resume."""
+
+    t: jnp.ndarray         # f32[]  serving clock (last applied batch end)
+    rank: jnp.ndarray      # f32[F] rank of our last post per feed
+    key: jnp.ndarray       # u32[2] decision-draw key (counter-addressed)
+    seq: jnp.ndarray       # i32[]  last applied batch sequence number
+    n_batches: jnp.ndarray  # i32[] micro-batches applied
+    n_events: jnp.ndarray  # i32[]  wall events applied
+    n_posts: jnp.ndarray   # i32[]  posting decisions taken
+    health: jnp.ndarray    # u32[F] per-edge health bits (0 = healthy)
+
+
+class Decision(NamedTuple):
+    """One posting decision, host-side (the apply step's output after the
+    explicit device_get boundary in ``serving.service``)."""
+
+    seq: int
+    post: bool
+    post_time: float   # the serving clock when the decision was taken
+    intensity: float   # u(t) = sum_f sqrt(s_f/q) * r_f at decision time
+    stale_batches: int = 0  # submitted-but-unapplied backlog at decision
+
+
+def init_feed_state(n_feeds: int, seed: int, start_seq: int = 0,
+                    dtype=jnp.float32) -> FeedState:
+    """Fresh carry for ``n_feeds`` edges; ``start_seq`` is the first
+    sequence number the stream will carry (``seq`` starts one below it).
+    """
+    from jax import random as jr
+
+    key = seed if not isinstance(seed, (int, np.integer)) else \
+        jr.PRNGKey(int(seed))
+    return FeedState(
+        t=jnp.zeros((), dtype),
+        rank=jnp.zeros((n_feeds,), dtype),
+        key=jnp.asarray(key, jnp.uint32),
+        seq=jnp.asarray(int(start_seq) - 1, jnp.int32),
+        n_batches=jnp.zeros((), jnp.int32),
+        n_events=jnp.zeros((), jnp.int32),
+        n_posts=jnp.zeros((), jnp.int32),
+        health=jnp.zeros((n_feeds,), jnp.uint32),
+    )
+
+
+def _apply(state: FeedState, times, feeds, n_valid, seq, s_sink, q):
+    """One micro-batch: rank increments for every valid event, write-back
+    health check, then the batch's posting decision.  Pure; jitted (and
+    carry-donated) by :func:`make_apply_fn`."""
+    F = state.rank.shape[0]
+    E = times.shape[0]
+    valid = jnp.arange(E, dtype=jnp.int32) < n_valid
+    u32 = jnp.uint32
+
+    # -- rank changes: one increment per wall event in the edge's feed --
+    # (scatter-add over the batch; feeds are pre-validated in [0, F)).
+    inc = jnp.zeros((F,), state.rank.dtype).at[feeds].add(
+        valid.astype(state.rank.dtype))
+    healthy = state.health == 0
+    rank = jnp.where(healthy, state.rank + inc, state.rank)
+
+    # Write-back check (the scan kernel's idiom): a non-finite rank is
+    # flagged the step it appears and the edge FREEZES — identity on
+    # healthy values, so healthy streams are bit-identical.
+    bad = ~jnp.isfinite(rank)
+    health = state.health | jnp.where(
+        bad, u32(numerics.BIT_NONFINITE_STATE), u32(0))
+    healthy = health == 0
+
+    # -- serving clock: the batch's trailing timestamp --
+    t_batch = jnp.max(jnp.where(valid, times, -jnp.inf))
+    t_new = jnp.maximum(state.t, jnp.where(n_valid > 0, t_batch, state.t)
+                        .astype(state.t.dtype))
+    dt = t_new - state.t
+
+    # -- posting decision: survival draw against u(t) over the batch --
+    # u(t) = sum over HEALTHY edges of sqrt(s_f/q) * r_f; sick edges
+    # contribute zero (quarantined, not stalling).  The draw is one
+    # threefry block keyed on (serving key, batch seq) — the same
+    # counter-addressed discipline as the scan kernel's panel, so replay
+    # of the same batch stream reproduces the same decisions bitwise.
+    w = jnp.sqrt(numerics.safe_div(s_sink, q, when_zero=0.0))
+    lam = jnp.sum(jnp.where(healthy, w * rank, 0.0))
+    w0, _ = threefry2x32(state.key[0], state.key[1],
+                         jnp.asarray(seq, u32),
+                         jnp.asarray(0x80000000, u32))
+    u = uniform_from_bits(w0).astype(state.rank.dtype)
+    p_post = -jnp.expm1(-lam * dt)
+    posted = (u < p_post) & (n_valid > 0)
+    # Our post jumps to the top of every healthy feed: rank resets to 0.
+    rank = jnp.where(posted & healthy, jnp.zeros_like(rank), rank)
+
+    new = state.replace(
+        t=t_new,
+        rank=rank,
+        seq=jnp.asarray(seq, jnp.int32),
+        n_batches=state.n_batches + 1,
+        n_events=state.n_events + n_valid.astype(jnp.int32),
+        n_posts=state.n_posts + posted.astype(jnp.int32),
+        health=health,
+    )
+    return new, (posted, t_new, lam)
+
+
+@functools.lru_cache(maxsize=None)
+def _apply_fn_cached(donate: bool):
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(_apply, donate_argnums=donate_argnums)
+
+
+def make_apply_fn():
+    """The jitted apply step, carry-donated where the backend supports it
+    (CPU ignores donation and would warn on every call)."""
+    return _apply_fn_cached(jax.default_backend() != "cpu")
+
+
+def state_digest(state: FeedState) -> str:
+    """Canonical-bytes sha256 of the carry — name + dtype + shape + raw
+    bytes per field, sorted by name (the ``runtime.integrity`` NPZ-digest
+    idiom) — so two carries are bit-identical iff their digests match.
+    One explicit, documented device→host transfer (the whole point of a
+    digest is host-side comparison)."""
+    leaves = {
+        "t": state.t, "rank": state.rank, "key": state.key,
+        "seq": state.seq, "n_batches": state.n_batches,
+        "n_events": state.n_events, "n_posts": state.n_posts,
+        "health": state.health,
+    }
+    h = hashlib.sha256()
+    for name in sorted(leaves):
+        a = np.ascontiguousarray(jax.device_get(leaves[name]))
+        h.update(name.encode())
+        h.update(str((a.dtype.str, a.shape)).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def poison_edge(state: FeedState, feed: int,
+                mode: str = "nan") -> FeedState:
+    """Plant a deterministic non-finite value in one edge's rank carry —
+    the serving twin of ``runtime.numerics.poison_lane`` (driven by
+    ``RQ_FAULT=numeric:mode@laneN`` through the serving runtime), so the
+    per-edge quarantine path runs in CI on CPU."""
+    if mode not in numerics.POISON_MODES:
+        raise ValueError(f"unknown poison mode {mode!r} "
+                         f"(want {'|'.join(numerics.POISON_MODES)})")
+    val = jnp.nan if mode == "nan" else jnp.inf
+    return state.replace(rank=state.rank.at[feed].set(val))

@@ -1,0 +1,147 @@
+"""Deterministic ingest-stream driver: the serving runtime's test rig
+and CLI entry point (``python -m redqueen_tpu.serving.stream``).
+
+Plays a :func:`serving.events.synthetic_stream` (pure function of its
+seed — a restarted driver regenerates byte-identical batches, which IS
+the retransmit model) into a :class:`ServingRuntime`, applying the
+env-configured ``ingest`` fault (``RQ_FAULT=ingest:mode@batchN``,
+``runtime.faultinject``) at the delivery layer where each failure mode
+physically lives:
+
+- ``dup``          — batch N delivered twice (lost ack → retransmit);
+- ``reorder``      — batches N and N+1 delivered swapped;
+- ``drop``         — batch N withheld, redelivered after the first pass
+                     (gap → retransmit-on-missing-signal);
+- ``torn_journal`` / ``crash_after_apply`` — applied by the RUNTIME
+                     itself (``serving.service._apply_one``): a tear of
+                     batch N's journal record mid-append + hard exit,
+                     or ``os._exit`` right after batch N is applied +
+                     journaled (the kill -9 acceptance scenario).
+
+On a clean finish the driver lands ``<dir>/final.json`` (enveloped,
+schema ``rq.serving.final/1``): carry digest, journal decision history,
+and the metrics report — everything the crash-recovery acceptance test
+compares bitwise between an uninterrupted run and a killed+recovered
+one.  Exit codes: 0 clean; 17 crash_after_apply (runtime); 19
+torn_journal (driver).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from ..runtime import faultinject as _faultinject
+from ..runtime import integrity as _integrity
+from .events import EventBatch, synthetic_stream
+from .service import ServingRuntime, journal_decisions, recover
+
+__all__ = ["drive", "main", "FINAL_SCHEMA"]
+
+FINAL_SCHEMA = "rq.serving.final/1"
+
+
+def _delivery_order(batches: List[EventBatch],
+                    fault) -> List[EventBatch]:
+    """The shaped first-pass delivery the configured fault implies."""
+    order = list(batches)
+    if fault is None:
+        return order
+    idx = {int(b.seq): i for i, b in enumerate(order)}
+    n = fault.batch
+    if fault.mode == "dup" and n in idx:
+        order.insert(idx[n] + 1, order[idx[n]])
+    elif fault.mode == "reorder" and n in idx and idx[n] + 1 < len(order):
+        i = idx[n]
+        order[i], order[i + 1] = order[i + 1], order[i]
+    elif fault.mode == "drop" and n in idx:
+        dropped = order.pop(idx[n])
+        order.append(dropped)  # redelivered after the gap is signalled
+    return order
+
+
+def drive(rt: ServingRuntime, batches: List[EventBatch],
+          fault=None, max_retransmit_rounds: int = 4) -> None:
+    """Deliver ``batches`` (fault-shaped), drain, and retransmit until
+    the runtime has applied everything it was offered or the retransmit
+    budget is exhausted (then the gap is the caller's to assert on)."""
+    for b in _delivery_order(batches, fault):
+        rt.submit(b)
+        rt.poll()
+    # Retransmit rounds: a real source resends un-acked batches; here
+    # "un-acked" is anything past the runtime's applied seq (covers the
+    # drop fault's gap and any shed batches once admission reopens).
+    for _ in range(max_retransmit_rounds):
+        rt.poll()
+        missing = [b for b in batches if int(b.seq) > rt.applied_seq]
+        if not missing:
+            break
+        for b in missing:
+            rt.submit(b)
+            rt.poll()
+    rt.poll()
+
+
+def _final_payload(rt: ServingRuntime) -> dict:
+    return {
+        "state_digest": rt.state_digest(),
+        "applied_seq": rt.applied_seq,
+        "decisions": [
+            {"seq": d.seq, "post": d.post,
+             "post_time": d.post_time, "intensity": d.intensity}
+            for d in journal_decisions(rt.dir)
+        ],
+        "metrics": rt.metrics.report(pending=rt.pending),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m redqueen_tpu.serving.stream",
+        description="drive a deterministic ingest stream through the "
+                    "serving runtime (fault-injectable via RQ_FAULT)")
+    ap.add_argument("--dir", required=True,
+                    help="serving directory (journal + snapshots + "
+                         "config + final.json)")
+    ap.add_argument("--feeds", type=int, default=8)
+    ap.add_argument("--batches", type=int, default=12)
+    ap.add_argument("--events-per-batch", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--q", type=float, default=1.0)
+    ap.add_argument("--snapshot-every", type=int, default=4)
+    ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--resume", action="store_true",
+                    help="recover from --dir (snapshot + journal "
+                         "replay) instead of starting fresh, then "
+                         "deliver the full regenerated stream "
+                         "(duplicate drop absorbs what already applied)")
+    args = ap.parse_args(argv)
+
+    fault = _faultinject.ingest_fault()
+    batches = synthetic_stream(args.seed, args.batches, args.feeds,
+                               events_per_batch=args.events_per_batch)
+    if args.resume:
+        rt, info = recover(args.dir)
+        print(f"recovered: snapshot_seq={info.snapshot_seq} "
+              f"replayed={info.replayed} skipped={info.skipped} "
+              f"torn={'yes' if info.torn else 'no'} "
+              f"seq={info.recovered_seq}", file=sys.stderr)
+    else:
+        rt = ServingRuntime(
+            n_feeds=args.feeds, q=args.q, seed=args.seed, dir=args.dir,
+            snapshot_every=args.snapshot_every,
+            reorder_window=args.window,
+            queue_capacity=args.queue_capacity)
+    with rt:
+        drive(rt, batches, fault=fault)
+        rt.write_metrics()
+        _integrity.write_json(os.path.join(args.dir, "final.json"),
+                              _final_payload(rt), schema=FINAL_SCHEMA)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

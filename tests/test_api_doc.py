@@ -19,6 +19,10 @@ MODULES = [
     "redqueen_tpu.utils.metrics", "redqueen_tpu.utils.metrics_pandas",
     "redqueen_tpu.utils.checkpoint", "redqueen_tpu.utils.backend",
     "redqueen_tpu.native.loader",
+    "redqueen_tpu.serving", "redqueen_tpu.serving.events",
+    "redqueen_tpu.serving.ingest", "redqueen_tpu.serving.journal",
+    "redqueen_tpu.serving.metrics", "redqueen_tpu.serving.service",
+    "redqueen_tpu.serving.state", "redqueen_tpu.serving.stream",
     "redqueen_tpu.runtime", "redqueen_tpu.runtime.faultinject",
     "redqueen_tpu.runtime.preempt", "redqueen_tpu.runtime.artifacts",
     "redqueen_tpu.runtime.integrity", "redqueen_tpu.runtime.watchdog",

@@ -33,8 +33,8 @@ def _args(**kw):
     ns = types.SimpleNamespace(
         quick=False, cpu=False, tpu=False, broadcasters=64, followers=10,
         horizon=20.0, capacity=None, q=1.0, wall_rate=1.0, config=None,
-        engine="auto", deadline=900.0, engine_deadline=420.0,
-        no_oracle=False,
+        engine="auto", engines=None, deadline=900.0,
+        engine_deadline=420.0, no_oracle=False,
     )
     for k, v in kw.items():
         setattr(ns, k, v)
@@ -235,11 +235,73 @@ def test_best_line_reprinted_after_every_engine(monkeypatch, capsys,
     runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000),
                      ("star", "cpu"): star})
     _patch(monkeypatch, runner, alive=False)
-    bench.parent_main(_args())
+    # star is opt-in since the --engines default narrowed to oracle,scan
+    bench.parent_main(_args(engines="oracle,scan,star"))
     out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     assert json.loads(out[-1])["value"] == pytest.approx(3_000_000)
     # best emitted once for scan, re-printed once after the star outcome
     assert len([ln for ln in out if ln.startswith("{")]) == 2
+
+
+def test_engines_default_excludes_star(monkeypatch, capsys):
+    """--engines defaults to oracle,scan(+pallas-on-TPU): the star
+    engine (20x slower than scan on CPU, BENCH_r05, never wins) must
+    not burn its ~88s unless opted in."""
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000),
+                     ("star", "cpu"): _engine_res("cpu", 9_000_000)})
+    _patch(monkeypatch, runner, alive=False)
+    bench.parent_main(_args())
+    assert all(e != "star" for e, _, _ in runner.calls)
+    line = _last_json(capsys)
+    assert line["value"] == pytest.approx(3_000_000)
+    assert line["engine"] == "scan"
+
+
+def test_engines_default_keeps_pallas_on_tpu(monkeypatch, capsys):
+    """The narrowed default must NOT drop pallas from the default TPU
+    sweep — the VMEM kernel stays in the best-TPU-number contest."""
+    runner = Runner({
+        ("scan", "default"): _engine_res("tpu", 50_000),
+        ("pallas", "default"): _engine_res("tpu", 90_000),
+    })
+    _patch(monkeypatch, runner, alive=True)
+    bench.parent_main(_args(tpu=True))
+    assert any(e == "pallas" for e, _, _ in runner.calls)
+    line = _last_json(capsys)
+    assert line["engine"] == "pallas"
+    assert line["value"] == pytest.approx(90_000)
+
+
+def test_engines_without_oracle_skips_denominator(monkeypatch, capsys):
+    """Dropping 'oracle' from --engines behaves like --no-oracle: no
+    oracle child, null vs_baseline/gate on the line."""
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000)})
+    _patch(monkeypatch, runner, alive=False)
+    bench.parent_main(_args(engines="scan"))
+    assert all(e != "oracle" for e, _, _ in runner.calls)
+    line = _last_json(capsys)
+    assert line["vs_baseline"] is None and line["gate_ok"] is None
+
+
+def test_engines_validation(monkeypatch):
+    runner = Runner({})
+    _patch(monkeypatch, runner, alive=False)
+    with pytest.raises(RuntimeError, match="unknown --engines"):
+        bench.parent_main(_args(engines="scan,warp"))
+    with pytest.raises(RuntimeError, match="no simulation engine"):
+        bench.parent_main(_args(engines="oracle"))
+    assert runner.calls == []
+
+
+def test_legacy_engine_flag_overrides_engines(monkeypatch, capsys):
+    """--engine star (non-auto) still forces exactly that engine, with
+    the oracle denominator governed by the --engines list."""
+    runner = Runner({("star", "cpu"): _engine_res("cpu", 800_000)})
+    _patch(monkeypatch, runner, alive=False)
+    bench.parent_main(_args(engine="star"))
+    assert [e for e, _, _ in runner.calls] == ["oracle", "star"]
+    line = _last_json(capsys)
+    assert line["engine"] == "star"
 
 
 def test_run_child_recovers_result_from_timeout_stdout(monkeypatch):
@@ -364,7 +426,8 @@ bench._default_backend_alive = lambda log: False
 args = types.SimpleNamespace(
     quick=False, cpu=True, tpu=False, broadcasters=64, followers=10,
     horizon=20.0, capacity=None, q=1.0, wall_rate=1.0, config=None,
-    engine="auto", deadline=900.0, engine_deadline=420.0, no_oracle=False)
+    engine="auto", engines="oracle,scan,star", deadline=900.0,
+    engine_deadline=420.0, no_oracle=False)
 bench.parent_main(args)
 print("late diagnostic after the sweep returned", file=sys.stderr)
 """)

@@ -266,14 +266,41 @@ def test_crlf_and_mixed_line_endings_match_python(tmp_path):
                  traces.load_csv(str(p4), engine="python"))
 
 
-def test_nan_timestamps_sort_last_like_numpy(tmp_path):
-    # "nan" is a parseable timestamp in both engines; np.sort orders NaNs
-    # last and the native sort must match (raw std::sort would be UB)
-    p = _write(tmp_path, "h\nu,nan\nu,2\nu,nan\nu,1\nu,inf\n")
-    got = loader.load_csv_native(p)[0]
-    want = traces.load_csv(p, engine="python")[0]
-    np.testing.assert_array_equal(got, want)  # NaN-positional equality
-    assert np.isnan(got[-2:]).all() and got[0] == 1.0
+def test_nan_timestamps_raise_typed_order_error(tmp_path):
+    # "nan" parses as a float but cannot be ORDERED against the user's
+    # other rows: both engines reject it with the typed TraceOrderError
+    # (naming the line) instead of silently sorting it somewhere — the
+    # serving ingest path and the RealData replay kernel both assume
+    # orderable times, so the garbage dies at the loader boundary.
+    p = _write(tmp_path, "h\nu,1\nu,2\nu,nan\nu,3\n")
+    with pytest.raises(traces.TraceOrderError, match="line 3"):
+        loader.load_csv_native(p)
+    with pytest.raises(traces.TraceOrderError, match="line 3"):
+        traces.load_csv(p, engine="python")
+    # inf IS orderable and stays legal
+    p2 = _write(tmp_path, "h\nu,1\nu,inf\n", name="inf.csv")
+    _assert_same(loader.load_csv_native(p2),
+                 traces.load_csv(p2, engine="python"))
+
+
+def test_load_stats_parity_and_counts(tmp_path):
+    # The serving reorder window's measured input contract: duplicate
+    # timestamps and non-monotonic rows are COUNTED by both engines
+    # (identically), never silently absorbed by the per-user sort.
+    p = _write(tmp_path, "user,time\na,2\na,1\na,2\nb,3\nb,3\nb,4\nc,5\n")
+    want = traces.LoadStats(n_rows=7, n_users=3, duplicate_timestamps=2,
+                            non_monotonic_rows=1)
+    for engine in ("python", "native"):
+        tr, stats = traces.load_csv(p, engine=engine, return_stats=True)
+        assert stats == want, engine
+        assert len(tr) == 3
+    # a monotone, duplicate-free corpus reports clean stats
+    p2 = _write(tmp_path, "user,time\na,1\na,2\nb,3\n", name="clean.csv")
+    for engine in ("python", "native"):
+        _, stats = traces.load_csv(p2, engine=engine, return_stats=True)
+        assert stats.duplicate_timestamps == 0
+        assert stats.non_monotonic_rows == 0
+        assert (stats.n_rows, stats.n_users) == (3, 2)
 
 
 # Guarded, not unconditional: the exact-parity tests above must keep
@@ -305,15 +332,31 @@ if _HAVE_HYPOTHESIS:
     def test_fuzz_native_matches_python(tmp_path_factory, rows):
         # Adversarial corpora: arbitrary printable user keys, the full
         # float repr envelope incl. nan/inf/subnormals — the two engines
-        # must agree exactly (user order, per-user order, bit values).
+        # must agree exactly: identical output (user order, per-user
+        # order, bit values, stats) or the identical typed
+        # TraceOrderError (a generated NaN row).
         d = tmp_path_factory.mktemp("fuzz")
         p = str(d / "f.csv")
         with open(p, "w") as f:
             f.write("user,time\n")
             for u, t in rows:
                 f.write(f"{u},{t}\n")
-        _assert_same(loader.load_csv_native(p),
-                     traces.load_csv(p, engine="python"))
+
+        def run(engine):
+            try:
+                return traces.load_csv(p, engine=engine,
+                                       return_stats=True), None
+            except traces.TraceOrderError as e:
+                return None, str(e)
+
+        (got, got_err) = run("native")
+        (want, want_err) = run("python")
+        assert (got_err is None) == (want_err is None), (got_err, want_err)
+        if got_err is not None:
+            assert got_err == want_err  # same line, same wording
+        else:
+            _assert_same(got[0], want[0])
+            assert got[1] == want[1]
 else:
     @pytest.mark.skip(reason="hypothesis not installed — parity fuzz "
                              "skipped")

@@ -1,0 +1,653 @@
+"""Online serving runtime: crash recovery, ingest fault tolerance,
+graceful degradation.
+
+THE acceptance scenario (ISSUE 6): kill -9 mid-stream after batch N
+(``RQ_FAULT=ingest:crash_after_apply@batchN``), restart, recover from
+snapshot + journal replay, and the recovered carry AND every subsequent
+decision are bit-identical to an uninterrupted run — plus the same
+bit-identity for every other ``ingest:*`` fault kind, and an overload
+run whose shed counters reconcile exactly.  Everything deterministic,
+on CPU.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from redqueen_tpu import serving
+from redqueen_tpu.runtime import faultinject, integrity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One parameter set shared by every in-process run so reference digests
+# are comparable across tests.
+PARAMS = dict(n_feeds=6, q=1.0, seed=0, snapshot_every=3,
+              reorder_window=4, queue_capacity=64)
+N_BATCHES = 10
+
+
+def _batches():
+    return serving.synthetic_stream(0, N_BATCHES, PARAMS["n_feeds"],
+                                    events_per_batch=5)
+
+
+def _run(dir, fault=None):
+    """In-process faulted run: returns (digest, decisions)."""
+    rt = serving.ServingRuntime(dir=str(dir), **PARAMS)
+    with rt:
+        serving.drive(rt, _batches(), fault=fault)
+        digest = rt.state_digest()
+    return digest, serving.journal_decisions(str(dir)), rt
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """The uninterrupted run every fault scenario must reproduce
+    bitwise.  (journal_decisions returns the RETAINED history — journal
+    segments covered by every retained snapshot are pruned — so the
+    list ends at the last batch but may not start at 0.)"""
+    d = tmp_path_factory.mktemp("ref")
+    digest, decisions, rt = _run(d)
+    assert decisions and decisions[-1].seq == N_BATCHES - 1
+    return digest, decisions
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec parsing: firing and non-firing
+# ---------------------------------------------------------------------------
+
+
+class TestIngestFaultSpecs:
+    def test_parse_every_mode(self):
+        for mode in faultinject.INGEST_MODES:
+            spec = faultinject.parse_fault(f"ingest:{mode}@batch7")
+            assert spec.kind == "ingest"
+            f = faultinject.parse_ingest(spec.arg)
+            assert f == faultinject.IngestFault(mode, 7)
+
+    @pytest.mark.parametrize("bad", [
+        None, "dup", "warp@batch1", "dup@lane3", "dup@batchX",
+        "dup@batch-2",
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            faultinject.parse_ingest(bad)
+
+    def test_env_accessor_fires_only_for_ingest_kind(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_FAULT, "ingest:dup@batch2")
+        assert faultinject.ingest_fault() == \
+            faultinject.IngestFault("dup", 2)
+        # a different kind parses but does not fire here
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane0")
+        assert faultinject.ingest_fault() is None
+        monkeypatch.delenv(faultinject.ENV_FAULT)
+        assert faultinject.ingest_fault() is None
+
+    def test_maybe_inject_validates_ingest_specs_fast(self, monkeypatch):
+        # A typo'd spec must die at the first maybe_inject, not
+        # three layers into the serving runtime.
+        monkeypatch.setenv(faultinject.ENV_FAULT, "ingest:bogus@batch1")
+        with pytest.raises(ValueError, match="bogus"):
+            faultinject.maybe_inject()
+        # a VALID ingest spec is a no-op there (data-plane kind)
+        monkeypatch.setenv(faultinject.ENV_FAULT, "ingest:dup@batch1")
+        faultinject.maybe_inject()
+
+
+# ---------------------------------------------------------------------------
+# Ingest validation: typed rejection, never silent skips
+# ---------------------------------------------------------------------------
+
+
+class TestValidation:
+    def _b(self, seq=0, times=(1.0, 2.0), feeds=(0, 1)):
+        return serving.EventBatch(seq, np.asarray(times, np.float64),
+                                  np.asarray(feeds, np.int64))
+
+    def test_clean_batch_passes(self):
+        b = serving.validate_batch(self._b(), n_feeds=4)
+        assert b.feeds.dtype == np.int32
+
+    @pytest.mark.parametrize("batch,match", [
+        ("neg_seq", "non-negative"),
+        ("nan_time", "non-finite"),
+        ("inf_time", "non-finite"),
+        ("regress", "regress"),
+        ("oob_feed", "out of range"),
+        ("len_mismatch", "equal lengths"),
+        ("float_feeds", "integers"),
+    ])
+    def test_malformed_batches_raise_typed(self, batch, match):
+        bad = {
+            "neg_seq": self._b(seq=-1),
+            "nan_time": self._b(times=(1.0, np.nan)),
+            "inf_time": self._b(times=(np.inf, 2.0)),
+            "regress": self._b(times=(2.0, 1.0)),
+            "oob_feed": self._b(feeds=(0, 9)),
+            "len_mismatch": self._b(times=(1.0,), feeds=(0, 1)),
+            "float_feeds": serving.EventBatch(
+                0, np.asarray([1.0]), np.asarray([0.5])),
+        }[batch]
+        with pytest.raises(serving.IngestError, match=match):
+            serving.validate_batch(bad, n_feeds=4)
+
+    def test_oversized_batch_rejected_not_truncated(self):
+        b = self._b(times=tuple(np.arange(5.0)), feeds=(0,) * 5)
+        with pytest.raises(serving.IngestError, match="split it"):
+            serving.validate_batch(b, n_feeds=4, max_events=3)
+
+    def test_runtime_converts_ingest_error_to_rejection(self, tmp_path):
+        rt = serving.ServingRuntime(dir=None, **PARAMS)
+        adm = rt.submit(self._b(times=(1.0, np.nan)))
+        assert adm.status == "rejected" and "non-finite" in adm.reason
+        assert rt.metrics.rejected == 1
+        assert rt.metrics.reconciles(pending=rt.pending)
+
+    def test_non_numeric_times_are_typed_rejection_not_crash(self):
+        """numpy's coercion ValueError must not escape the submit
+        boundary bare — garbage times come back as a typed rejection
+        with the accounting still closed."""
+        with pytest.raises(serving.IngestError, match="not numeric"):
+            serving.validate_batch(
+                serving.EventBatch(0, ["bad"], np.asarray([0])),
+                n_feeds=4)
+        rt = serving.ServingRuntime(dir=None, **PARAMS)
+        adm = rt.submit(serving.EventBatch(0, ["bad"], np.asarray([0])))
+        assert adm.status == "rejected"
+        assert rt.metrics.reconciles(pending=rt.pending)
+
+    def test_config_mismatch_on_existing_dir_is_refused(self, tmp_path):
+        """Reopening a serving directory with different determinism-
+        critical parameters must fail loudly at construction, not wedge
+        the directory for the NEXT recovery."""
+        d = str(tmp_path / "srv")
+        serving.ServingRuntime(n_feeds=4, seed=0, dir=d).close()
+        with pytest.raises(ValueError, match="n_feeds"):
+            serving.ServingRuntime(n_feeds=8, seed=0, dir=d)
+        with pytest.raises(ValueError, match="seed"):
+            serving.ServingRuntime(n_feeds=4, seed=1, dir=d)
+        # matching parameters reopen fine
+        serving.ServingRuntime(n_feeds=4, seed=0, dir=d).close()
+
+
+# ---------------------------------------------------------------------------
+# Sequencer: idempotence + bounded reorder window
+# ---------------------------------------------------------------------------
+
+
+class TestSequencer:
+    def _b(self, seq):
+        return serving.EventBatch(seq, np.asarray([float(seq)]),
+                                  np.asarray([0], np.int32))
+
+    def test_in_order_stream_passes_through(self):
+        s = serving.Sequencer()
+        for i in range(5):
+            status, ready = s.offer(self._b(i))
+            assert status == "accepted"
+            assert [b.seq for b in ready] == [i]
+        assert s.duplicates == s.reordered == 0
+
+    def test_duplicates_drop(self):
+        s = serving.Sequencer()
+        s.offer(self._b(0))
+        assert s.offer(self._b(0)) == ("duplicate", [])
+        # a retransmit of a batch still HELD in the window also drops
+        # (counted), but reports "accepted" — it has NOT applied, so the
+        # source must not read the admission as an ack
+        s.offer(self._b(2))
+        assert s.offer(self._b(2)) == ("accepted", [])
+        assert s.duplicates == 2
+        assert s.classify(0) == "applied"
+        assert s.classify(2) == "held"
+        assert s.classify(1) == "new"
+
+    def test_reorder_within_window_drains_in_order(self):
+        s = serving.Sequencer(window=4)
+        assert s.offer(self._b(1)) == ("accepted", [])
+        assert s.missing_seqs() == [0]
+        status, ready = s.offer(self._b(0))
+        assert [b.seq for b in ready] == [0, 1]
+        assert s.reordered == 1
+
+    def test_beyond_window_is_typed_rejection(self):
+        s = serving.Sequencer(window=2)
+        with pytest.raises(serving.IngestError, match="reorder window"):
+            s.offer(self._b(5))
+        assert s.window_rejects == 1
+        assert s.held == 0  # bounded: nothing buffered for it
+
+
+# ---------------------------------------------------------------------------
+# Journal: torn-tail quarantine, mid-file corruption refusal
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def _write(self, path, n=3):
+        with serving.Journal(str(path)) as j:
+            for i in range(n):
+                j.append({"seq": i, "x": i * 10})
+
+    def test_roundtrip(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        self._write(p)
+        records, torn = serving.journal.replay(str(p))
+        assert torn is None
+        assert [r["seq"] for r in records] == [0, 1, 2]
+
+    def test_missing_journal_is_fresh_not_corrupt(self, tmp_path):
+        records, torn = serving.journal.replay(str(tmp_path / "no.jsonl"))
+        assert records == [] and torn is None
+
+    def test_torn_tail_quarantined_and_truncated(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        self._write(p)
+        info = serving.tear_tail(str(p))
+        assert info["record_now"] < info["record_was"]
+        records, torn = serving.journal.replay(str(p))
+        assert [r["seq"] for r in records] == [0, 1]
+        assert torn is not None and torn["records_kept"] == 2
+        # the torn bytes moved to a sidecar with a report; the journal
+        # itself is truncated back to the verified prefix
+        assert os.path.exists(torn["sidecar"])
+        assert os.path.exists(torn["report"])
+        rep = integrity.read_json(torn["report"],
+                                  schema="rq.quarantine-report/1")
+        assert rep["tail_bytes"] > 0
+        records2, torn2 = serving.journal.replay(str(p))
+        assert torn2 is None and len(records2) == 2
+        # appends continue cleanly after the truncation
+        with serving.Journal(str(p)) as j:
+            j.append({"seq": 2, "x": 20})
+        records3, _ = serving.journal.replay(str(p))
+        assert [r["seq"] for r in records3] == [0, 1, 2]
+
+    def test_bitflipped_complete_last_record_raises_not_quarantines(
+            self, tmp_path):
+        """A newline-terminated last record was fsynced whole and its
+        batch ACKNOWLEDGED — corruption there is real data loss and
+        must raise (JournalError), never be quarantined away as a
+        'torn tail' (which would silently drop an acked batch the
+        source will never retransmit)."""
+        p = tmp_path / "j.jsonl"
+        self._write(p)
+        data = bytearray(p.read_bytes())
+        pos = data.rfind(b'"x":') + 4
+        data[pos] = ord("7")
+        p.write_bytes(bytes(data))
+        assert data.endswith(b"\n")  # complete record, not torn
+        with pytest.raises(serving.JournalError, match="record 2"):
+            serving.journal.replay(str(p))
+
+    def test_unterminated_corrupt_tail_is_quarantined(self, tmp_path):
+        """Only an UNTERMINATED final line — the crash-torn-append
+        shape — takes the quarantine path."""
+        p = tmp_path / "j.jsonl"
+        self._write(p)
+        data = p.read_bytes()[:-1]  # drop the final newline...
+        data = data[:-10] + b'corrupted!'  # ...and scramble the tail
+        p.write_bytes(data)
+        records, torn = serving.journal.replay(str(p))
+        assert len(records) == 2 and torn is not None
+        assert os.path.exists(torn["sidecar"])
+
+    def test_rotation_bounds_journal_and_replay_spans_segments(
+            self, tmp_path):
+        """rotate() closes the live file into a segment; replay reads
+        segments + live in order; prune_segments drops segments covered
+        by every retained snapshot."""
+        p = tmp_path / "j.jsonl"
+        self._write(p, n=2)           # records 0, 1
+        seg1 = serving.journal.rotate(str(p), 1)
+        assert seg1 and os.path.exists(seg1)
+        assert not os.path.exists(p)  # live file consumed
+        with serving.Journal(str(p)) as j:
+            j.append({"seq": 2, "x": 20})
+        records, torn = serving.journal.replay(str(p))
+        assert [r["seq"] for r in records] == [0, 1, 2] and torn is None
+        # pruning at oldest-retained-snapshot 1 removes segment .1
+        removed = serving.journal.prune_segments(str(p), 1)
+        assert removed == [seg1]
+        records2, _ = serving.journal.replay(str(p))
+        assert [r["seq"] for r in records2] == [2]
+        # rotate of a missing/empty live file is a no-op
+        os.remove(p)
+        assert serving.journal.rotate(str(p), 5) is None
+
+    def test_corrupt_segment_record_refuses_replay(self, tmp_path):
+        """A rotated segment was complete at rotation: any failure in
+        it is real corruption, never quarantined as a torn tail."""
+        p = tmp_path / "j.jsonl"
+        self._write(p, n=2)
+        seg = serving.journal.rotate(str(p), 1)
+        data = bytearray(open(seg, "rb").read())
+        pos = data.rfind(b'"x":') + 4
+        data[pos] = ord("9")
+        open(seg, "wb").write(bytes(data))
+        with pytest.raises(serving.JournalError, match="record 1"):
+            serving.journal.replay(str(p))
+
+    def test_midfile_corruption_refuses_replay(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        self._write(p)
+        lines = p.read_bytes().split(b"\n")
+        lines[1] = lines[1].replace(b'"seq":1', b'"seq":9')
+        p.write_bytes(b"\n".join(lines))
+        with pytest.raises(serving.JournalError, match="record 1"):
+            serving.journal.replay(str(p))
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: SIGKILL mid-stream -> bit-identical recovery
+# ---------------------------------------------------------------------------
+
+
+def _stream_cli(dir, fault=None, resume=False, timeout=240):
+    env = {k: v for k, v in os.environ.items()
+           if k not in (faultinject.ENV_FAULT, faultinject.ENV_FAULT_POINT)}
+    env["JAX_PLATFORMS"] = "cpu"
+    if fault:
+        env[faultinject.ENV_FAULT] = fault
+    cmd = [sys.executable, "-m", "redqueen_tpu.serving.stream",
+           "--dir", str(dir), "--batches", "10"]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+@pytest.fixture(scope="module")
+def cli_reference(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli_ref")
+    r = _stream_cli(d)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return integrity.read_json(os.path.join(str(d), "final.json"),
+                               schema="rq.serving.final/1")
+
+
+@pytest.mark.parametrize("fault,crash_rc", [
+    ("ingest:crash_after_apply@batch4", 17),
+    ("ingest:torn_journal@batch4", 19),
+])
+def test_kill_midstream_recovers_bit_identically(tmp_path, cli_reference,
+                                                 fault, crash_rc):
+    """kill -9 after batch N (or mid-append of its journal record), in a
+    real subprocess; restart with --resume (snapshot restore + journal
+    replay + full retransmit); the final carry digest AND the complete
+    decision history must equal the uninterrupted run's, bit for bit."""
+    d = tmp_path / "crash"
+    r = _stream_cli(d, fault=fault)
+    assert r.returncode == crash_rc, (r.returncode, r.stderr[-2000:])
+    # the crash really was mid-stream: no final artifact landed
+    assert not os.path.exists(os.path.join(str(d), "final.json"))
+    r2 = _stream_cli(d, resume=True)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "recovered:" in r2.stderr
+    got = integrity.read_json(os.path.join(str(d), "final.json"),
+                              schema="rq.serving.final/1")
+    assert got["state_digest"] == cli_reference["state_digest"]
+    assert got["decisions"] == cli_reference["decisions"]
+    assert got["applied_seq"] == cli_reference["applied_seq"] == 9
+    if "torn_journal" in fault:
+        assert glob.glob(os.path.join(str(d), "journal.jsonl.torn-*"))
+
+
+def test_recovery_survives_corrupt_newest_snapshot(tmp_path, reference):
+    """Belt and braces: recovery must fall back past a snapshot that
+    fails to restore (``latest_valid_step`` quarantine) and REPLAY the
+    difference from the journal — still bit-identical."""
+    ref_digest, ref_decisions = reference
+    d = tmp_path / "srv"
+    _run(d)
+    snaps = os.path.join(str(d), "snapshots")
+    steps = sorted((int(n) for n in os.listdir(snaps) if n.isdigit()),
+                   reverse=True)
+    assert len(steps) >= 2
+    # corrupt every file of the newest step directory
+    for root, _, files in os.walk(os.path.join(snaps, str(steps[0]))):
+        for f in files:
+            with open(os.path.join(root, f), "wb") as fh:
+                fh.write(b"garbage")
+    rt, info = serving.recover(str(d))
+    with rt:
+        assert info.snapshot_seq in steps[1:]  # fell back past the bad one
+        assert info.replayed >= 1
+        assert rt.state_digest() == ref_digest
+    # the bad step was quarantined, not left trusted
+    assert glob.glob(os.path.join(snaps, f"{steps[0]}.corrupt-*"))
+
+
+# ---------------------------------------------------------------------------
+# Per-fault-kind bit-identity, in process (dup / reorder / drop)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode,counter", [
+    ("dup", "duplicates"),
+    ("reorder", "reordered"),
+    ("drop", "reordered"),
+])
+def test_delivery_faults_end_bit_identical(tmp_path, reference, mode,
+                                           counter):
+    ref_digest, ref_decisions = reference
+    fault = faultinject.IngestFault(mode, 4)
+    d = tmp_path / mode
+    digest, decisions, rt = _run(d, fault=fault)
+    assert digest == ref_digest
+    assert decisions == ref_decisions
+    # the fault actually FIRED (its counter moved)...
+    assert getattr(rt.metrics, counter) >= 1
+    assert rt.metrics.reconciles(pending=0)
+
+
+def test_no_fault_counters_stay_zero(tmp_path, reference):
+    """Non-firing case: a clean stream moves none of the fault
+    counters."""
+    d = tmp_path / "clean"
+    digest, _, rt = _run(d)
+    assert digest == reference[0]
+    m = rt.metrics
+    assert (m.duplicates, m.reordered, m.shed, m.rejected) == (0, 0, 0, 0)
+
+
+def test_runtime_ignores_other_fault_kinds(tmp_path, monkeypatch,
+                                           reference):
+    """A ``hang``/``corrupt`` RQ_FAULT in the environment must not fire
+    through the serving path (non-firing case for foreign kinds)."""
+    monkeypatch.setenv(faultinject.ENV_FAULT, "corrupt:truncate@/nope")
+    d = tmp_path / "foreign"
+    digest, decisions, rt = _run(d)
+    assert digest == reference[0]
+
+
+# ---------------------------------------------------------------------------
+# Edge-health quarantine: sick edges never stall healthy ones
+# ---------------------------------------------------------------------------
+
+
+class TestEdgeQuarantine:
+    def test_poisoned_edge_freezes_alone(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane2")
+        rt = serving.ServingRuntime(dir=None, **PARAMS)
+        for b in _batches():
+            rt.submit(b)
+            rt.poll()
+        h = np.asarray(rt._state.health)
+        assert h[2] != 0
+        assert (h[[i for i in range(PARAMS["n_feeds"]) if i != 2]]
+                == 0).all()
+        # decisions keep flowing with a finite intensity
+        d = rt.decide()
+        assert d is not None and np.isfinite(d.intensity)
+        # the metrics artifact reports the sick-edge count
+        rep = rt.metrics.report(
+            pending=rt.pending,
+            extra={"health_sick_edges": int(np.count_nonzero(h))})
+        assert rep["health_sick_edges"] == 1
+
+    def test_poison_edge_is_deterministic(self):
+        s1 = serving.poison_edge(
+            serving.init_feed_state(4, 0), 1, "nan")
+        s2 = serving.poison_edge(
+            serving.init_feed_state(4, 0), 1, "nan")
+        assert serving.state_digest(s1) == serving.state_digest(s2)
+
+
+# ---------------------------------------------------------------------------
+# Graceful degradation under overload
+# ---------------------------------------------------------------------------
+
+
+class TestOverload:
+    def test_bounded_queue_sheds_and_reconciles(self, tmp_path):
+        """Ingest faster than the decision path drains: the queue stays
+        bounded, overflow is SHED (recorded by seq), backpressure is
+        signalled, nothing deadlocks, and after the drain every
+        submitted batch is accounted for:
+        ingested == applied + shed + rejected + duplicates."""
+        rt = serving.ServingRuntime(
+            n_feeds=4, q=1.0, seed=0, dir=str(tmp_path / "ov"),
+            snapshot_every=1000, reorder_window=64, queue_capacity=8)
+        batches = serving.synthetic_stream(1, 40, 4, events_per_batch=4)
+        saw_backpressure = False
+        with rt:
+            for b in batches:  # no poll: consumer stalled
+                adm = rt.submit(b)
+                saw_backpressure |= adm.backpressure
+                assert len(rt._queue) <= 8  # bounded, always
+            m = rt.metrics
+            assert m.shed > 0 and saw_backpressure
+            assert sorted(m.shed_seqs) == m.shed_seqs  # exact seqs kept
+            assert m.reconciles(pending=rt.pending)
+            # consumer wakes up: drain, then the source retransmits the
+            # shed batches (admission is open again)
+            rt.poll()
+            for b in batches:
+                if int(b.seq) > rt.applied_seq:
+                    rt.submit(b)
+                    rt.poll()
+            rt.poll()
+            assert rt.pending == 0
+            assert rt.applied_seq == 39
+            # closed accounting, no pending term left
+            assert m.ingested == (m.applied + m.shed + m.rejected
+                                  + m.duplicates)
+            payload = rt.write_metrics()
+        # the artifact is enveloped, schema-tagged, and self-consistent
+        got = integrity.read_json(
+            os.path.join(str(tmp_path / "ov"), "metrics.json"),
+            schema=serving.METRICS_SCHEMA)
+        assert got == payload
+        assert got["reconciles"] is True
+        assert got["shed"] == len(got["shed_seqs"]) > 0
+        assert got["decision_latency"]["p50_ms"] is not None
+        assert got["decision_latency"]["p99_ms"] >= \
+            got["decision_latency"]["p50_ms"]
+        assert got["events_per_sec"] > 0
+
+    def test_duplicate_retransmit_under_overload_is_not_shed(self):
+        """A retransmit of an ALREADY-APPLIED batch arriving while the
+        queue is full must come back 'duplicate' (an ack the source
+        needs), never 'shed' — shed_seqs records only real drops."""
+        rt = serving.ServingRuntime(
+            n_feeds=4, q=1.0, seed=0, dir=None, snapshot_every=1000,
+            reorder_window=64, queue_capacity=2)
+        batches = serving.synthetic_stream(1, 8, 4, events_per_batch=4)
+        rt.submit(batches[0])
+        rt.poll()  # seq 0 applied
+        for b in batches[1:]:  # stall the consumer, fill + overflow
+            rt.submit(b)
+        assert rt.metrics.shed > 0
+        adm = rt.submit(batches[0])  # retransmit of the applied batch
+        assert adm.status == "duplicate"
+        assert 0 not in rt.metrics.shed_seqs
+        assert rt.metrics.reconciles(pending=rt.pending)
+
+    def test_held_retransmit_is_not_acked_as_applied(self):
+        """A retransmit of a batch buffered in the reorder window (gap
+        still open) must come back 'accepted', not 'duplicate': the
+        batch is NOT durable yet, and a source treating 'duplicate' as
+        an ack would never retransmit it after a crash."""
+        rt = serving.ServingRuntime(dir=None, **PARAMS)
+        batches = _batches()
+        rt.submit(batches[1])              # held: gap at seq 0
+        adm = rt.submit(batches[1])        # retransmit of the held one
+        assert adm.status == "accepted"
+        assert 0 in adm.missing            # the gap is signalled
+        assert rt.metrics.duplicates == 1  # counted as redundant
+        rt.submit(batches[0])              # gap closes
+        rt.poll()
+        assert rt.applied_seq == 1
+        assert rt.submit(batches[1]).status == "duplicate"  # NOW an ack
+
+    def test_metrics_state_is_bounded(self):
+        """The overload accounting itself stays bounded: shed_seqs caps
+        at MAX_SHED_SEQS (total count stays exact, truncation flagged)
+        and latency percentiles use a sliding window."""
+        from redqueen_tpu.serving import metrics as smetrics
+
+        m = serving.ServingMetrics()
+        for i in range(smetrics.MAX_SHED_SEQS + 50):
+            m.observe_shed(i, 1)
+        for _ in range(smetrics.LATENCY_WINDOW + 50):
+            m.observe_apply(1, False, 0.001)
+        assert len(m.shed_seqs) == smetrics.MAX_SHED_SEQS
+        assert m.shed == smetrics.MAX_SHED_SEQS + 50
+        assert len(m._latencies) == smetrics.LATENCY_WINDOW
+        rep = m.report()
+        assert rep["shed_seqs_truncated"] is True
+        assert rep["shed"] == smetrics.MAX_SHED_SEQS + 50
+
+    def test_decide_serves_stale_rather_than_blocking(self):
+        rt = serving.ServingRuntime(dir=None, **PARAMS)
+        batches = _batches()
+        rt.submit(batches[0])
+        rt.poll()
+        for b in batches[1:5]:
+            rt.submit(b)  # backlog builds, nothing polled
+        d = rt.decide()
+        assert d is not None and d.seq == 0 and d.stale_batches == 4
+        assert rt.metrics.stale_decisions == 1
+        rt.poll()
+        d2 = rt.decide()
+        assert d2.stale_batches == 0 and d2.seq == 4
+
+    def test_poll_throttle_bounds_work_per_call(self):
+        rt = serving.ServingRuntime(dir=None, **PARAMS)
+        for b in _batches()[:6]:
+            rt.submit(b)
+        assert len(rt.poll(max_batches=2)) == 2
+        assert rt.pending == 4
+        rt.poll()
+        assert rt.pending == 0
+
+
+# ---------------------------------------------------------------------------
+# Snapshot cadence / recovery bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_uses_snapshot_plus_tail_replay(tmp_path, reference):
+    d = tmp_path / "srv"
+    digest, _, _ = _run(d)
+    retained = len(serving.journal_decisions(str(d)))
+    rt, info = serving.recover(str(d))
+    with rt:
+        assert rt.state_digest() == digest == reference[0]
+        assert info.snapshot_seq is not None
+        # only the records past the snapshot replayed; the rest of the
+        # RETAINED journal (pre-snapshot records) is skipped
+        assert info.replayed == (N_BATCHES - 1) - info.snapshot_seq
+        assert info.skipped == retained - info.replayed
+        assert info.torn is None
+        # a recovered runtime keeps serving: duplicates drop, new applies
+        for b in _batches():
+            rt.submit(b)
+        assert rt.poll() == []
+        assert rt.metrics.duplicates == N_BATCHES

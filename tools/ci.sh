@@ -32,16 +32,20 @@ echo "== resilience shim (legacy contract) =="
 # for external callers — run it too so a drift fails CI, not a caller.
 python tools/check_resilience.py
 
-echo "== integrity / self-healing / numerics fault-injection pass =="
+echo "== integrity / self-healing / numerics / serving fault-injection pass =="
 # Deliberately ALSO collected by tier-1 below (~40s double cost): this
 # pass fast-fails the corruption/self-healing/lane-quarantine contracts
 # before the long suite, while tier-1 stays byte-exact with the ROADMAP
 # verify command.  test_numerics.py carries the numeric:nan lane-
 # quarantine acceptance scenario (inject -> freeze -> record -> re-run
-# exactly the sick lane, bit-identically) on CPU.
+# exactly the sick lane, bit-identically) on CPU; test_serving.py
+# carries the ingest fault-injection suite incl. THE crash-recovery
+# acceptance scenario (SIGKILL after batch N -> snapshot + journal
+# replay -> bit-identical carry and decisions) for every ingest:* kind.
 env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
     tests/test_watchdog.py tests/test_watcher.py tests/test_numerics.py \
-    tests/test_numerics_properties.py tests/test_rqlint.py \
+    tests/test_numerics_properties.py tests/test_serving.py \
+    tests/test_rqlint.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tier-1 suite =="
