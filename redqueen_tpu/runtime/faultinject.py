@@ -53,6 +53,31 @@ Two ways in:
                             validated at :func:`maybe_inject` but
                             APPLIED by the serving stream driver /
                             runtime via :func:`ingest_fault`
+      shard:mode@shardK[,batchN]
+                            deterministic SHARD-granularity fault in the
+                            sharded serving cluster
+                            (:mod:`redqueen_tpu.serving.cluster`), at
+                            shard K's fault domain (mode: crash | wedge
+                            | torn_journal | corrupt_snapshot), fired
+                            when shard K handles its sub-batch with
+                            sequence number N (omitted = the first
+                            opportunity).  ``crash`` drops the shard's
+                            in-memory carry/queue right after batch N is
+                            applied+journaled (the SIGKILL leave-behind
+                            at fault-domain granularity); ``wedge``
+                            stalls the shard's apply past the router's
+                            deadline (timeout → degraded → backoff
+                            path); ``torn_journal`` tears batch N's
+                            journal record mid-append before the crash
+                            (N was never acknowledged);
+                            ``corrupt_snapshot`` scribbles the shard's
+                            newest landed snapshot before the crash
+                            (recovery must fall back + replay more
+                            journal).  Data-plane kind: validated at
+                            :func:`maybe_inject`, APPLIED by the
+                            cluster's :class:`ShardRouter` via
+                            :func:`shard_fault` — healthy shards keep
+                            serving throughout
 
   ``RQ_FAULT_POINT`` (optional) restricts injection to the matching
   ``maybe_inject(point)`` call site.
@@ -88,6 +113,10 @@ __all__ = [
     "INGEST_MODES",
     "parse_ingest",
     "ingest_fault",
+    "ShardFault",
+    "SHARD_MODES",
+    "parse_shard",
+    "shard_fault",
     "hang_forever",
     "crash_with",
     "flaky",
@@ -128,12 +157,13 @@ def parse_fault(spec: str) -> FaultSpec:
     kind, _, arg = spec.strip().partition(":")
     kind = kind.strip().lower()
     if kind not in ("hang", "crash", "transient", "oom", "corrupt",
-                    "numeric", "ingest"):
+                    "numeric", "ingest", "shard"):
         raise ValueError(f"unknown fault spec {spec!r} "
                          f"(want hang|crash|transient|oom[:arg], "
                          f"corrupt:mode@path, "
-                         f"numeric:mode@laneN[,chunkM], or "
-                         f"ingest:mode@batchN)")
+                         f"numeric:mode@laneN[,chunkM], "
+                         f"ingest:mode@batchN, or "
+                         f"shard:mode@shardK[,batchN])")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -192,6 +222,11 @@ def inject(spec: FaultSpec) -> None:
         # Same data-plane contract as ``numeric``: validated here, applied
         # by the serving stream driver / runtime via ingest_fault().
         parse_ingest(spec.arg)
+    elif spec.kind == "shard":
+        # Same data-plane contract: validated here (typo'd specs die at
+        # the first maybe_inject), applied by the serving cluster's
+        # ShardRouter via shard_fault().
+        parse_shard(spec.arg)
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -363,6 +398,73 @@ def ingest_fault() -> Optional[IngestFault]:
     if parsed.kind != "ingest":
         return None
     return parse_ingest(parsed.arg)
+
+
+# --- shard (serving-cluster data-plane) faults: fault-domain failures -----
+
+SHARD_MODES = ("crash", "wedge", "torn_journal", "corrupt_snapshot")
+
+
+class ShardFault(NamedTuple):
+    """Parsed ``shard:mode@shardK[,batchN]`` spec.  ``shard`` is a shard
+    index of the serving cluster (one fault DOMAIN: its own journal,
+    snapshot dir, sequencer, and health state); ``batch`` is the
+    sub-batch SEQUENCE NUMBER at which the fault fires (None = the first
+    batch shard K handles), so the same spec hits the same point in an
+    uninterrupted run and in a replay-after-recovery run."""
+
+    mode: str            # crash | wedge | torn_journal | corrupt_snapshot
+    shard: int
+    batch: Optional[int]
+
+
+def parse_shard(arg: Optional[str]) -> ShardFault:
+    """Parse the argument of a ``shard`` fault spec."""
+    if not arg or "@" not in arg:
+        raise ValueError(
+            f"{ENV_FAULT}=shard needs 'mode@shardK[,batchN]' "
+            f"(mode: {'|'.join(SHARD_MODES)})")
+    mode, _, where = arg.partition("@")
+    mode = mode.strip().lower()
+    if mode not in SHARD_MODES:
+        raise ValueError(f"unknown shard fault mode {mode!r} "
+                         f"(want {'|'.join(SHARD_MODES)})")
+    shard_s, _, batch_s = where.partition(",")
+    shard_s = shard_s.strip().lower()
+    batch_s = batch_s.strip().lower()
+    if not shard_s.startswith("shard"):
+        raise ValueError(f"shard fault needs 'shardK', got {shard_s!r}")
+    try:
+        shard = int(shard_s[5:])
+    except ValueError as e:
+        raise ValueError(f"bad shard in shard fault: {shard_s!r}") from e
+    if shard < 0:
+        raise ValueError(f"shard fault shard must be >= 0, got {shard}")
+    batch: Optional[int] = None
+    if batch_s:
+        if not batch_s.startswith("batch"):
+            raise ValueError(
+                f"shard fault qualifier must be 'batchN', got {batch_s!r}")
+        try:
+            batch = int(batch_s[5:])
+        except ValueError as e:
+            raise ValueError(f"bad batch in shard fault: {batch_s!r}") from e
+        if batch < 0:
+            raise ValueError(
+                f"shard fault batch must be >= 0, got {batch}")
+    return ShardFault(mode, shard, batch)
+
+
+def shard_fault() -> Optional[ShardFault]:
+    """The env-configured shard fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "shard":
+        return None
+    return parse_shard(parsed.arg)
 
 
 # --- picklable callable faults (spawned-child targets for tests) ---------

@@ -23,22 +23,49 @@ deterministic fault injection) made load-bearing:
 - :mod:`~redqueen_tpu.serving.metrics`  — steady-state counters +
   latency percentiles, landed as the enveloped ``rq.serving.metrics/1``
   artifact;
+- :mod:`~redqueen_tpu.serving.cluster`  — sharded fault domains
+  (:class:`ServingCluster` / ShardRouter): per-shard journals +
+  snapshots + sequencers, health-aware routing
+  (healthy→degraded→quarantined), in-place crash recovery while
+  healthy shards keep serving, and the digest-asserted
+  :func:`reshard` N→M state migration;
+- :mod:`~redqueen_tpu.serving.corpus`   — corpus replay: native-loader
+  rows merged into one time-ordered stream and served as sequenced
+  micro-batches (``python -m redqueen_tpu.serving.corpus``);
 - :mod:`~redqueen_tpu.serving.stream`   — the deterministic stream
-  driver / CLI (``python -m redqueen_tpu.serving.stream``), where the
-  ``RQ_FAULT=ingest:*`` delivery faults are applied.
+  driver / CLI (``python -m redqueen_tpu.serving.stream``, single or
+  ``--shards N``), where the ``RQ_FAULT=ingest:*`` delivery faults are
+  applied.
 
 Every failure mode runs deterministically in CI on CPU via
-``runtime.faultinject``'s ``ingest`` fault kinds; see
-``docs/DESIGN.md`` "Online serving & ingest fault tolerance".
+``runtime.faultinject``'s ``ingest`` and ``shard`` fault kinds; see
+``docs/DESIGN.md`` "Online serving & ingest fault tolerance" and
+"Sharded serving & fault domains".
 """
 
 from __future__ import annotations
 
-from . import events, ingest, journal, metrics, service, state  # noqa: F401
+from . import cluster, events, ingest, journal, metrics, service, state  # noqa: F401
+from .cluster import (
+    CLUSTER_SCHEMA,
+    ClusterAdmission,
+    ClusterDecision,
+    RESHARD_SCHEMA,
+    ServingCluster,
+    ShardRouter,
+    partition,
+    reshard,
+    shard_seed,
+)
 from .events import EventBatch, IngestError, synthetic_stream, validate_batch
 from .ingest import Sequencer
 from .journal import JOURNAL_SCHEMA, Journal, JournalError, tear_tail
-from .metrics import METRICS_SCHEMA, ServingMetrics
+from .metrics import (
+    CLUSTER_METRICS_SCHEMA,
+    ClusterMetrics,
+    METRICS_SCHEMA,
+    ServingMetrics,
+)
 from .service import (
     Admission,
     CONFIG_SCHEMA,
@@ -67,27 +94,42 @@ __all__ = [
     "tear_tail",
     "ServingMetrics",
     "METRICS_SCHEMA",
+    "ClusterMetrics",
+    "CLUSTER_METRICS_SCHEMA",
     "ServingRuntime",
     "Admission",
     "RecoveryInfo",
     "recover",
     "journal_decisions",
     "CONFIG_SCHEMA",
+    "ServingCluster",
+    "ShardRouter",
+    "ClusterAdmission",
+    "ClusterDecision",
+    "partition",
+    "shard_seed",
+    "reshard",
+    "CLUSTER_SCHEMA",
+    "RESHARD_SCHEMA",
     "FeedState",
     "Decision",
     "init_feed_state",
     "make_apply_fn",
-    "state_digest",
     "poison_edge",
+    "state_digest",
     "drive",
     "FINAL_SCHEMA",
+    "CLUSTER_FINAL_SCHEMA",
+    "cluster_final_payload",
 ]
 
 # ``stream`` is served lazily (PEP 562): eager import would trip runpy's
 # found-in-sys.modules warning on every ``python -m
 # redqueen_tpu.serving.stream`` invocation (the module doubles as the
-# CLI entry point).
-_STREAM_NAMES = ("stream", "drive", "FINAL_SCHEMA")
+# CLI entry point).  (``corpus`` is importable directly; it is not
+# re-exported here for the same -m reason.)
+_STREAM_NAMES = ("stream", "drive", "FINAL_SCHEMA",
+                 "CLUSTER_FINAL_SCHEMA", "cluster_final_payload")
 
 
 def __getattr__(name):

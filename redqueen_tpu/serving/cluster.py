@@ -1,0 +1,918 @@
+"""Sharded serving fault domains: per-shard journals, health-aware
+routing, and crash isolation at corpus scale.
+
+One :class:`ServingCluster` partitions the feed-edge state by EDGE HASH
+into ``n_shards`` independent fault domains.  Each shard is a full
+PR 6 :class:`~redqueen_tpu.serving.service.ServingRuntime` — its OWN
+journal segments, orbax snapshot tree, ``Sequencer``, carry, and health
+state under ``<dir>/shard-KKKK/`` — so recovery, torn-tail quarantine,
+and overload shedding are decided per shard, never per service: one
+wedged apply, torn journal, or killed carry takes down 1/N of the edge
+graph while the other shards keep serving.
+
+**Routing (the ShardRouter role).**  ``submit`` validates the global
+micro-batch once, splits it by the deterministic edge-hash partition
+(:func:`partition` — hash-ordered round-robin dealing, balanced to ±1
+edge, pure function of ``(n_feeds, n_shards, PARTITION_VERSION)``), and
+offers every shard its sub-batch **under the global sequence number**
+(empty slices included) — so each shard's journal is independently
+replayable and each shard's decision stream is a pure function of
+``(shard carry, global stream)``.  ``poll`` dispatches one sub-batch at
+a time per shard with timeout detection, exponential poll-round backoff
+for wedged shards, and per-shard health tracking:
+
+    healthy --timeout/transient--> degraded --HEAL_AFTER clean--> healthy
+    degraded --QUARANTINE_AFTER consecutive failures--> quarantined
+    any --crash / torn journal / journal-append failure--> quarantined
+    quarantined --recover_shard (snapshot + digest-asserted replay)-->
+        degraded (probation)
+
+**Crash isolation.**  A crashed shard loses exactly what SIGKILL leaves
+behind: its in-memory carry, queue, and reorder window die; its fsynced
+journal records and snapshots survive.  ``recover_shard`` rebuilds the
+shard in place through :func:`serving.service.recover` (newest provable
+snapshot + digest-asserted journal replay — bit-identical carry AND
+decisions) while healthy shards keep serving; sub-batches offered to a
+quarantined shard are shed-with-recorded-seqs (``shed_unavailable``),
+and the batches that died un-applied inside the crashed shard are
+reclassified ``lost_on_crash`` — the router-side
+:class:`~redqueen_tpu.serving.metrics.ClusterMetrics` ledger keeps the
+closed accounting identity ``ingested == applied + shed + rejected +
+duplicates (+ pending)`` true per shard and cluster-wide at every
+instant, including mid-recovery.
+
+**Fault injection.**  Every failure mode runs deterministically in CI on
+CPU via ``runtime.faultinject``'s ``shard`` kinds
+(``RQ_FAULT=shard:crash|wedge|torn_journal|corrupt_snapshot@shardK
+[,batchN]``), applied by the router at exact sub-batch sequence numbers;
+:meth:`ServingCluster.kill_shard` is the same teardown as an operator
+chaos hook.
+
+**Reshard (grow without genesis replay).**  :func:`reshard` migrates a
+drained N-shard directory to M shards by per-edge state migration: the
+per-edge ``(rank, health)`` carry, the cluster clock, and the stream
+position move to the new partition, each new shard lands an immediate
+snapshot at the migrated seq (recovery never replays from genesis), and
+the whole move is **digest-asserted** — the canonical per-edge
+:meth:`~ServingCluster.edge_digest` must be bit-identical before and
+after, or the reshard raises instead of serving silently-migrated-wrong
+state.  Per-shard lifetime counters (``n_events``/``n_posts``) reset at
+a reshard (they are fault-domain metrics, not stream state); the stream
+position (``seq``/``n_batches``) migrates.
+
+See docs/DESIGN.md "Sharded serving & fault domains".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..runtime import faultinject as _faultinject
+from ..runtime import integrity as _integrity
+from .events import EventBatch, IngestError, validate_batch
+from .metrics import ClusterMetrics
+from .service import (RecoveryInfo, ServingRuntime, SNAPSHOTS_DIRNAME,
+                      recover as _recover_runtime)
+
+__all__ = ["ServingCluster", "ShardRouter", "ClusterAdmission",
+           "ClusterDecision", "partition", "shard_seed", "reshard",
+           "CLUSTER_SCHEMA", "RESHARD_SCHEMA", "PARTITION_VERSION",
+           "HEALTHY", "DEGRADED", "QUARANTINED", "HEAL_AFTER",
+           "QUARANTINE_AFTER", "WEDGE_FIRES", "MAX_BACKOFF_ROUNDS"]
+
+CLUSTER_SCHEMA = "rq.serving.cluster/1"
+RESHARD_SCHEMA = "rq.serving.reshard/1"
+_CLUSTER_CONFIG = "cluster.json"
+
+# Bump when the partition function changes: a directory written under a
+# different partition CANNOT be reopened (edges would silently route to
+# the wrong journals) — the config check refuses instead.
+PARTITION_VERSION = 1
+
+# Health states + state-machine constants (see the module docstring).
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+QUARANTINED = "quarantined"
+HEAL_AFTER = 3          # consecutive clean applies: degraded -> healthy
+QUARANTINE_AFTER = 3    # consecutive timeouts: degraded -> quarantined
+WEDGE_FIRES = 2         # injected-wedge timeouts before the stall clears
+MAX_BACKOFF_ROUNDS = 8  # cap on the wedged-shard poll-round backoff
+RECOVERY_GIVE_UP = 3    # failed auto-recoveries before poll() raises
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over uint64 (vectorized; wraparound is the
+    point)."""
+    x = np.asarray(x, np.uint64)
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def partition(n_feeds: int, n_shards: int) -> np.ndarray:
+    """``assign[feed] = owning shard``: edges are ordered by their
+    splitmix64 hash, then dealt round-robin — decorrelated from feed-id
+    locality like a plain ``hash % N`` but balanced BY CONSTRUCTION
+    (shard sizes differ by at most one edge, so no shard can come up
+    empty while ``n_shards <= n_feeds``).  Pure function of
+    ``(n_feeds, n_shards)`` under :data:`PARTITION_VERSION`."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if n_shards > n_feeds:
+        raise ValueError(
+            f"n_shards={n_shards} > n_feeds={n_feeds}: every shard must "
+            f"own at least one edge")
+    h = _mix64(np.arange(n_feeds, dtype=np.uint64))
+    order = np.argsort(h, kind="stable")
+    assign = np.empty(n_feeds, np.int64)
+    assign[order] = np.arange(n_feeds, dtype=np.int64) % n_shards
+    return assign
+
+
+def shard_seed(seed: int, shard: int) -> int:
+    """Deterministic per-shard PRNG seed derivation — distinct shards
+    must draw from distinct decision streams (the PR 4 RQ501 lesson:
+    never reuse one key across independent consumers)."""
+    return (int(seed) * 1_000_003 + 7_919 * (int(shard) + 1)) \
+        % (2 ** 31 - 1)
+
+
+class ClusterAdmission(NamedTuple):
+    """One global ``submit``'s outcome: ``status`` summarizes
+    (``accepted`` = every shard accepted or acked a duplicate;
+    ``partial`` = at least one shard shed / was unavailable / rejected;
+    ``shed`` = no shard kept it; ``rejected`` = failed global
+    validation before fan-out); ``per_shard`` is the exact per-shard
+    admission status list."""
+
+    status: str
+    seq: Optional[int] = None
+    backpressure: bool = False
+    reason: Optional[str] = None
+    per_shard: Tuple[str, ...] = ()
+
+
+class ClusterDecision(NamedTuple):
+    """The cluster read path's aggregate: summed intensity over the
+    shards that have decided, ``post`` if any shard's latest decision
+    posted, total unapplied backlog as staleness, and how many fault
+    domains are reporting vs quarantined (degraded-serving visibility,
+    never a blocked read)."""
+
+    seq: int                 # min applied seq over reporting shards
+    post: bool
+    intensity: float
+    stale_batches: int
+    shards_reporting: int
+    shards_quarantined: int
+
+
+class _ShardSlot:
+    """One fault domain's router-side bookkeeping (the runtime itself is
+    replaced wholesale on crash/recovery; this slot identity persists)."""
+
+    __slots__ = ("k", "dir", "feeds", "s_slice", "runtime", "health",
+                 "fail_streak", "clean_streak", "skip_rounds",
+                 "recover_failures", "outstanding")
+
+    def __init__(self, k: int, dir: Optional[str], feeds: np.ndarray,
+                 s_slice: np.ndarray):
+        self.k = k
+        self.dir = dir
+        self.feeds = feeds          # global feed ids owned (ascending)
+        self.s_slice = s_slice
+        self.runtime: Optional[ServingRuntime] = None
+        self.health = HEALTHY
+        self.fail_streak = 0
+        self.clean_streak = 0
+        self.skip_rounds = 0
+        self.recover_failures = 0
+        # seq -> (arrival stamp, n_events): accepted but not yet applied
+        # (mirrors the shard's queue + reorder window; reclassified
+        # lost_on_crash if the carry dies under them)
+        self.outstanding: Dict[int, Tuple[float, int]] = {}
+
+
+class ServingCluster:
+    """See the module docstring.  Single-writer like the per-shard
+    runtime: one process owns the cluster directory."""
+
+    def __init__(self, n_feeds: int, n_shards: int,
+                 dir: Optional[str] = None, q: float = 1.0,
+                 s_sink: Optional[np.ndarray] = None, seed: int = 0,
+                 start_seq: int = 0, snapshot_every: int = 8,
+                 reorder_window: int = 8, queue_capacity: int = 64,
+                 max_batch_events: int = 256, clock=time.monotonic,
+                 auto_recover: bool = True, _open_runtimes: bool = True):
+        self.n_feeds = int(n_feeds)
+        self.n_shards = int(n_shards)
+        self.dir = dir
+        self.q = float(q)
+        self.seed = int(seed)
+        self.start_seq = int(start_seq)
+        self.snapshot_every = int(snapshot_every)
+        self.reorder_window = int(reorder_window)
+        self.queue_capacity = int(queue_capacity)
+        self.max_batch_events = int(max_batch_events)
+        self.auto_recover = bool(auto_recover)
+        self._clock = clock
+        s = (np.ones(n_feeds) if s_sink is None
+             else np.asarray(s_sink, np.float64))
+        if s.shape != (self.n_feeds,):
+            raise ValueError(
+                f"s_sink must have shape ({n_feeds},), got {s.shape}")
+        self._s_sink = s
+
+        self._assign = partition(self.n_feeds, self.n_shards)
+        # local index of each global feed within its owning shard
+        self._local_index = np.empty(self.n_feeds, np.int32)
+        self._slots: List[_ShardSlot] = []
+        for k in range(self.n_shards):
+            feeds = np.flatnonzero(self._assign == k)
+            self._local_index[feeds] = np.arange(len(feeds),
+                                                 dtype=np.int32)
+            sdir = (None if dir is None
+                    else os.path.join(dir, f"shard-{k:04d}"))
+            self._slots.append(_ShardSlot(k, sdir, feeds, s[feeds]))
+
+        if dir is not None:
+            os.makedirs(dir, exist_ok=True)
+            self._check_or_write_config()
+
+        self.metrics = ClusterMetrics(self.n_shards, clock=clock)
+        self._fault = _faultinject.shard_fault()
+        if self._fault is not None and self._fault.shard >= self.n_shards:
+            # faultinject's contract: a spec that can never fire dies
+            # loudly, not as a vacuously-green chaos run.
+            raise ValueError(
+                f"RQ_FAULT targets shard {self._fault.shard} but this "
+                f"cluster has {self.n_shards} shard(s) (valid: 0.."
+                f"{self.n_shards - 1}) — the fault could never fire")
+        self._fault_spent = False
+        self._wedge_left = WEDGE_FIRES
+
+        if _open_runtimes:
+            for slot in self._slots:
+                slot.runtime = self._fresh_runtime(slot)
+
+    # ---- construction / config identity ----
+
+    def _config(self) -> Dict[str, Any]:
+        return {
+            "n_feeds": self.n_feeds, "n_shards": self.n_shards,
+            "q": self.q, "s_sink": [float(x) for x in self._s_sink],
+            "seed": self.seed, "start_seq": self.start_seq,
+            "snapshot_every": self.snapshot_every,
+            "reorder_window": self.reorder_window,
+            "queue_capacity": self.queue_capacity,
+            "max_batch_events": self.max_batch_events,
+            "partition_version": PARTITION_VERSION,
+        }
+
+    def _check_or_write_config(self) -> None:
+        cfg_path = os.path.join(self.dir, _CLUSTER_CONFIG)
+        cfg = self._config()
+        if os.path.exists(cfg_path):
+            # Same refusal contract as the per-shard config: the stored
+            # config is the directory's identity — a silently different
+            # partition/seed would route edges into the wrong journals.
+            stored = _integrity.read_json(cfg_path, schema=CLUSTER_SCHEMA)
+            for field in ("n_feeds", "n_shards", "q", "s_sink", "seed",
+                          "start_seq", "max_batch_events",
+                          "partition_version"):
+                if stored.get(field) != cfg[field]:
+                    raise ValueError(
+                        f"cluster dir {self.dir} was created with "
+                        f"{field}={stored.get(field)!r} but this cluster "
+                        f"was constructed with {field}={cfg[field]!r} — "
+                        f"edges would route to the wrong shards / replay "
+                        f"would diverge; recover() with the stored "
+                        f"config, reshard(), or use a fresh directory")
+        else:
+            _integrity.write_json(cfg_path, cfg, schema=CLUSTER_SCHEMA)
+
+    def _fresh_runtime(self, slot: _ShardSlot) -> ServingRuntime:
+        return ServingRuntime(
+            n_feeds=len(slot.feeds), q=self.q, s_sink=slot.s_slice,
+            seed=shard_seed(self.seed, slot.k), dir=slot.dir,
+            start_seq=self.start_seq, snapshot_every=self.snapshot_every,
+            reorder_window=self.reorder_window,
+            queue_capacity=self.queue_capacity,
+            max_batch_events=self.max_batch_events, clock=self._clock)
+
+    @classmethod
+    def recover(cls, dir: str, clock=time.monotonic,
+                auto_recover: bool = True
+                ) -> Tuple["ServingCluster", List[RecoveryInfo]]:
+        """Rebuild a cluster from its directory after a crash: read the
+        enveloped cluster config, then :func:`serving.service.recover`
+        EVERY shard fault domain independently (each one = newest
+        provable snapshot + digest-asserted journal replay).  Shards
+        killed at different points recover to different seqs; the
+        source's retransmit of everything past :attr:`applied_seq`
+        (the cluster min) reconverges them — duplicate drop absorbs the
+        rest."""
+        cfg = _integrity.read_json(os.path.join(dir, _CLUSTER_CONFIG),
+                                   schema=CLUSTER_SCHEMA)
+        if cfg.get("partition_version") != PARTITION_VERSION:
+            raise ValueError(
+                f"cluster dir {dir} uses partition_version="
+                f"{cfg.get('partition_version')!r}, this code is "
+                f"{PARTITION_VERSION} — reshard() with the old code "
+                f"first")
+        cl = cls(n_feeds=int(cfg["n_feeds"]),
+                 n_shards=int(cfg["n_shards"]), dir=dir,
+                 q=float(cfg["q"]),
+                 s_sink=np.asarray(cfg["s_sink"], np.float64),
+                 seed=int(cfg["seed"]), start_seq=int(cfg["start_seq"]),
+                 snapshot_every=int(cfg["snapshot_every"]),
+                 reorder_window=int(cfg["reorder_window"]),
+                 queue_capacity=int(cfg["queue_capacity"]),
+                 max_batch_events=int(cfg["max_batch_events"]),
+                 clock=clock, auto_recover=auto_recover,
+                 _open_runtimes=False)
+        infos: List[RecoveryInfo] = []
+        for slot in cl._slots:
+            rt, info = _recover_runtime(slot.dir, clock=clock)
+            slot.runtime = rt
+            infos.append(info)
+        return cl, infos
+
+    # ---- routing: the ingest path ----
+
+    def _split_batch(self, batch: EventBatch) -> List[EventBatch]:
+        """One sub-batch per shard in ONE pass over the events (a
+        per-shard boolean mask would make the measured ingest path
+        O(n_shards x events) per global batch): stable-sort the events
+        by owning shard — intra-shard event order is preserved — and
+        slice the contiguous runs."""
+        seq = int(batch.seq)
+        if len(batch.feeds) == 0:
+            empty = EventBatch(seq, np.empty(0, np.float64),
+                               np.empty(0, np.int32))
+            return [empty] * self.n_shards
+        assign = self._assign[batch.feeds]
+        order = np.argsort(assign, kind="stable")
+        times_s = batch.times[order]
+        local_s = self._local_index[batch.feeds[order]]
+        bounds = np.searchsorted(assign[order],
+                                 np.arange(self.n_shards + 1))
+        return [EventBatch(seq, times_s[bounds[k]:bounds[k + 1]],
+                           local_s[bounds[k]:bounds[k + 1]])
+                for k in range(self.n_shards)]
+
+    def submit(self, batch: EventBatch) -> ClusterAdmission:
+        """Admit one GLOBAL micro-batch: validate once, fan out one
+        sub-batch per shard under the global seq (empty slices included
+        — every shard's journal tracks the full stream position).  Never
+        raises on bad input; a quarantined shard's slice is shed with
+        its seq recorded (``shed_unavailable``) so the source
+        retransmits it after recovery."""
+        try:
+            batch = validate_batch(batch, self.n_feeds,
+                                   max_events=self.max_batch_events)
+        except IngestError as e:
+            # Rejected before fan-out: one rejected sub-outcome per
+            # shard keeps the ledger's sub-batch units uniform.
+            self.metrics.global_rejected += 1
+            for k in range(self.n_shards):
+                self.metrics.observe_submitted(k)
+                self.metrics.observe_rejected(k)
+            return ClusterAdmission(
+                "rejected", seq=e.seq, reason=str(e),
+                per_shard=("rejected",) * self.n_shards)
+        seq = int(batch.seq)
+        subs = self._split_batch(batch)
+        now = self._clock()
+        statuses: List[str] = []
+        backpressure = False
+        for slot in self._slots:
+            self.metrics.observe_submitted(slot.k)
+            if slot.runtime is None:
+                statuses.append("unavailable")
+                self.metrics.observe_shed_unavailable(slot.k, seq)
+                backpressure = True
+                continue
+            sub = subs[slot.k]
+            adm = slot.runtime.submit(sub, _validated=True)
+            statuses.append(adm.status)
+            backpressure |= adm.backpressure
+            if adm.status == "accepted":
+                if seq in slot.outstanding:
+                    # retransmit of a batch still held in the shard's
+                    # reorder window: redundant delivery, not durable —
+                    # the ledger counts the extra submission a duplicate
+                    self.metrics.observe_duplicate(slot.k)
+                else:
+                    slot.outstanding[seq] = (now, sub.n_events)
+            elif adm.status == "duplicate":
+                self.metrics.observe_duplicate(slot.k)
+            elif adm.status == "shed":
+                self.metrics.observe_shed_queue(slot.k, seq)
+            else:  # "rejected" — per-shard validation (shouldn't happen
+                self.metrics.observe_rejected(slot.k)  # post-global)
+        if all(st in ("accepted", "duplicate") for st in statuses):
+            status = "accepted"
+        elif all(st in ("shed", "unavailable") for st in statuses):
+            status = "shed"
+        else:
+            status = "partial"
+        return ClusterAdmission(status, seq=seq,
+                                backpressure=backpressure,
+                                per_shard=tuple(statuses))
+
+    # ---- routing: the apply path (health-aware dispatch) ----
+
+    def poll(self, max_batches_per_shard: Optional[int] = None
+             ) -> Dict[int, List[Any]]:
+        """One dispatch round: every serviceable shard applies up to
+        ``max_batches_per_shard`` queued sub-batches (all, by default),
+        one at a time so faults and health observations land at exact
+        sequence numbers.  Wedged shards back off (skip rounds,
+        exponential, capped); quarantined shards auto-recover in place
+        when ``auto_recover`` (healthy shards are NOT blocked on it —
+        they were already drained by the time recovery runs, and their
+        admissions never depend on the dead shard).  Returns the
+        per-shard decision lists."""
+        out: Dict[int, List[Any]] = {}
+        for slot in self._slots:
+            if slot.runtime is None:
+                if self.auto_recover and slot.dir is not None \
+                        and slot.skip_rounds == 0:
+                    self._try_auto_recover(slot)
+                elif slot.skip_rounds > 0:
+                    slot.skip_rounds -= 1
+                if slot.runtime is None:
+                    out[slot.k] = []
+                    continue
+            if slot.skip_rounds > 0:
+                slot.skip_rounds -= 1  # backoff: the wedged shard rests
+                out[slot.k] = []
+                continue
+            out[slot.k] = self._poll_slot(slot, max_batches_per_shard)
+        return out
+
+    def _poll_slot(self, slot: _ShardSlot,
+                   max_batches: Optional[int]) -> List[Any]:
+        decisions: List[Any] = []
+        fault = None if self._fault_spent else self._fault
+        while max_batches is None or len(decisions) < max_batches:
+            seq = slot.runtime.next_queued_seq()
+            if seq is None:
+                break
+            if (fault is not None and fault.mode == "wedge"
+                    and fault.shard == slot.k
+                    and (fault.batch is None or fault.batch == seq)):
+                if self._wedge_left > 0:
+                    # The deadline-expiry detection point: the dispatch
+                    # did not come back in time, the batch stays queued,
+                    # the shard degrades and backs off.
+                    self._wedge_left -= 1
+                    self._on_timeout(
+                        slot, f"apply deadline expired at sub-batch "
+                              f"{seq} (injected wedge)")
+                    break
+                self._fault_spent = True
+                fault = None
+            try:
+                ds = slot.runtime.poll(max_batches=1)
+            except Exception as e:  # noqa: BLE001 — any apply/journal
+                # failure means the fault domain can no longer be made
+                # durable: quarantine it, keep the cluster serving.
+                self._crash_slot(slot, f"apply failed: {e}")
+                break
+            if not ds:
+                break
+            d = ds[0]
+            fire = (fault is not None and fault.shard == slot.k
+                    and fault.mode in ("crash", "torn_journal",
+                                       "corrupt_snapshot")
+                    and (fault.batch is None or fault.batch == d.seq))
+            if fire and fault.mode == "torn_journal":
+                # The append for this batch went out torn and the shard
+                # died before acknowledging: the decision never left the
+                # dying fault domain, so it is NOT observed applied —
+                # the seq stays outstanding and reclassifies as lost.
+                self._fault_spent = True
+                from .journal import tear_tail
+
+                if slot.runtime.journal_path:
+                    tear_tail(slot.runtime.journal_path)
+                self._crash_slot(
+                    slot, f"journal append torn at sub-batch {d.seq} "
+                          f"(injected)")
+                break
+            arrival = slot.outstanding.pop(int(d.seq), None)
+            latency = (None if arrival is None
+                       else self._clock() - arrival[0])
+            n_events = 0 if arrival is None else arrival[1]
+            self.metrics.observe_applied(slot.k, n_events, d.post,
+                                         latency)
+            decisions.append(d)
+            self._on_clean(slot)
+            if fire:  # crash | corrupt_snapshot: batch d.seq was acked
+                self._fault_spent = True
+                if fault.mode == "corrupt_snapshot":
+                    self._corrupt_newest_snapshot(slot)
+                self._crash_slot(
+                    slot, f"{fault.mode} after sub-batch {d.seq} "
+                          f"(injected)")
+                break
+        return decisions
+
+    # ---- health state machine ----
+
+    def _on_clean(self, slot: _ShardSlot) -> None:
+        slot.fail_streak = 0
+        if slot.health == DEGRADED:
+            slot.clean_streak += 1
+            if slot.clean_streak >= HEAL_AFTER:
+                slot.health = HEALTHY
+                slot.clean_streak = 0
+
+    def _on_timeout(self, slot: _ShardSlot, reason: str) -> None:
+        slot.fail_streak += 1
+        slot.clean_streak = 0
+        if slot.health == HEALTHY:
+            slot.health = DEGRADED
+        backoff = min(2 ** slot.fail_streak, MAX_BACKOFF_ROUNDS)
+        self.metrics.observe_timeout(slot.k, backoff)
+        if slot.fail_streak >= QUARANTINE_AFTER:
+            # A shard that will not come back is presumed dead: its
+            # volatile state cannot be trusted mid-apply — same teardown
+            # as a crash, recovery from durable state only.
+            self._crash_slot(
+                slot, f"quarantined after {slot.fail_streak} "
+                      f"consecutive timeouts: {reason}")
+        else:
+            slot.skip_rounds = backoff
+
+    def _crash_slot(self, slot: _ShardSlot, reason: str) -> None:
+        rt, slot.runtime = slot.runtime, None
+        slot.health = QUARANTINED
+        slot.fail_streak = slot.clean_streak = slot.skip_rounds = 0
+        if rt is not None:
+            # Releases the journal fd only — every acknowledged record
+            # was already fsynced; the carry/queue/reorder window are
+            # dropped un-flushed, exactly the SIGKILL leave-behind.
+            try:
+                rt.close()
+            except OSError:
+                pass
+        for seq in sorted(slot.outstanding):
+            self.metrics.observe_lost_on_crash(slot.k, seq)
+        slot.outstanding.clear()
+        self.metrics.observe_crash(slot.k, reason)
+
+    def _corrupt_newest_snapshot(self, slot: _ShardSlot) -> None:
+        """The ``corrupt_snapshot`` fault body: scribble every file of
+        the shard's newest landed orbax step (recovery must fall back
+        past it via ``latest_valid_step`` and replay more journal)."""
+        if slot.dir is None:
+            return
+        snaps = os.path.join(slot.dir, SNAPSHOTS_DIRNAME)
+        if not os.path.isdir(snaps):
+            return
+        steps = sorted((int(n) for n in os.listdir(snaps)
+                        if n.isdigit()), reverse=True)
+        if not steps:
+            return
+        for root, _, files in os.walk(os.path.join(snaps,
+                                                   str(steps[0]))):
+            for f in files:
+                with open(os.path.join(root, f), "wb") as fh:
+                    fh.write(b"garbage (injected corrupt_snapshot)")
+
+    def _try_auto_recover(self, slot: _ShardSlot) -> None:
+        try:
+            self.recover_shard(slot.k)
+        except Exception as e:  # noqa: BLE001 — a failed recovery must
+            # not take down the healthy shards; back off and retry, give
+            # up loudly after RECOVERY_GIVE_UP attempts.
+            slot.recover_failures += 1
+            slot.skip_rounds = MAX_BACKOFF_ROUNDS
+            self.metrics.observe_crash(
+                slot.k, f"recovery attempt {slot.recover_failures} "
+                        f"failed: {e}")
+            if slot.recover_failures >= RECOVERY_GIVE_UP:
+                raise RuntimeError(
+                    f"shard {slot.k} failed {slot.recover_failures} "
+                    f"recovery attempts (last: {e}) — the fault domain "
+                    f"at {slot.dir} needs operator attention") from e
+
+    # ---- crash / recovery (the operator surface) ----
+
+    def kill_shard(self, k: int, reason: str = "operator kill") -> None:
+        """Chaos hook: destroy shard ``k``'s volatile state exactly the
+        way the ``shard:crash`` fault does (carry, queue, and reorder
+        window die; fsynced journal + snapshots survive).  What the
+        MTTR bench and the chaos acceptance test drive."""
+        slot = self._slots[k]
+        if slot.runtime is None:
+            raise ValueError(f"shard {k} is already quarantined")
+        self._crash_slot(slot, reason)
+
+    def recover_shard(self, k: int) -> RecoveryInfo:
+        """Recover quarantined shard ``k`` in place: newest provable
+        snapshot + digest-asserted journal replay (bit-identical carry
+        and decision stream), then probation (``degraded`` until
+        ``HEAL_AFTER`` clean applies).  Healthy shards are untouched."""
+        slot = self._slots[k]
+        if slot.runtime is not None:
+            raise ValueError(f"shard {k} is not quarantined")
+        if slot.dir is None:
+            raise ValueError(
+                f"shard {k} has no directory — an in-memory cluster "
+                f"cannot recover a crashed fault domain")
+        t0 = self._clock()
+        rt, info = _recover_runtime(slot.dir, clock=self._clock)
+        ms = (self._clock() - t0) * 1e3
+        slot.runtime = rt
+        slot.health = DEGRADED
+        slot.fail_streak = slot.clean_streak = slot.skip_rounds = 0
+        slot.recover_failures = 0
+        self.metrics.observe_recovery(k, info.replayed, ms)
+        return info
+
+    # ---- read / inspection paths ----
+
+    @property
+    def pending(self) -> int:
+        return sum(s.runtime.pending for s in self._slots
+                   if s.runtime is not None)
+
+    @property
+    def pending_by_shard(self) -> List[int]:
+        return [0 if s.runtime is None else s.runtime.pending
+                for s in self._slots]
+
+    @property
+    def health_by_shard(self) -> List[str]:
+        return [s.health for s in self._slots]
+
+    @property
+    def shard_dirs(self) -> List[Optional[str]]:
+        return [s.dir for s in self._slots]
+
+    @property
+    def edges_per_shard(self) -> List[int]:
+        return [int(len(s.feeds)) for s in self._slots]
+
+    @property
+    def applied_seq(self) -> int:
+        """The cluster's acknowledged stream position: the MIN applied
+        seq over shards (a quarantined shard counts -1 — everything
+        must be retransmitted until it recovers and reports)."""
+        return min((-1 if s.runtime is None else s.runtime.applied_seq)
+                   for s in self._slots)
+
+    def decide(self) -> Optional[ClusterDecision]:
+        """The non-blocking cluster read: aggregate the latest applied
+        decision of every reporting shard (quarantined shards are
+        excluded and COUNTED — degraded serving is visible, never a
+        blocked read).  None until a first batch applies somewhere."""
+        self.metrics.decisions_served += 1
+        per = []
+        for slot in self._slots:
+            if slot.runtime is None:
+                continue
+            d = slot.runtime.decide()
+            if d is not None:
+                per.append(d)
+        if not per:
+            return None
+        stale = self.pending
+        if stale:
+            self.metrics.stale_decisions += 1
+        return ClusterDecision(
+            seq=min(d.seq for d in per),
+            post=any(d.post for d in per),
+            intensity=float(sum(d.intensity for d in per)),
+            stale_batches=stale,
+            shards_reporting=len(per),
+            shards_quarantined=sum(1 for s in self._slots
+                                   if s.runtime is None))
+
+    def shard_digests(self) -> Dict[int, Optional[str]]:
+        return {s.k: (None if s.runtime is None
+                      else s.runtime.state_digest())
+                for s in self._slots}
+
+    def cluster_digest(self,
+                       digests: Optional[Dict[int, Optional[str]]] = None
+                       ) -> str:
+        """sha256 over the per-shard carry digests (every shard must be
+        live) — the whole-cluster bit-identity witness the chaos tests
+        compare.  Pass ``digests`` (a :meth:`shard_digests` result) to
+        reuse already-computed digests: each one is a full device→host
+        transfer + hash of the shard carry."""
+        h = hashlib.sha256()
+        if digests is None:
+            digests = self.shard_digests()
+        for k, d in sorted(digests.items()):
+            if d is None:
+                raise ValueError(
+                    f"shard {k} is quarantined — recover it before "
+                    f"taking a cluster digest")
+            h.update(f"{k}:{d}\n".encode())
+        return h.hexdigest()
+
+    def _gather_edges(self) -> Tuple[np.ndarray, np.ndarray, int, float,
+                                     int]:
+        """Assemble the global per-edge carry ``(rank, health)`` plus
+        the stream position ``(seq, cluster clock, n_batches)`` from the
+        live shards — one explicit device→host boundary per shard.
+        Requires every shard live and at the SAME seq (drained)."""
+        import jax
+
+        rank = np.zeros(self.n_feeds, np.float32)
+        health = np.zeros(self.n_feeds, np.uint32)
+        seqs, ts, nbs = [], [], []
+        for slot in self._slots:
+            if slot.runtime is None:
+                raise ValueError(
+                    f"shard {slot.k} is quarantined — recover before "
+                    f"gathering edge state")
+            st = slot.runtime.carry
+            r, h, sq, t, nb = jax.device_get(
+                (st.rank, st.health, st.seq, st.t, st.n_batches))
+            rank[slot.feeds] = r
+            health[slot.feeds] = h
+            seqs.append(int(sq))
+            ts.append(float(t))
+            nbs.append(int(nb))
+        if len(set(seqs)) != 1:
+            raise ValueError(
+                f"shards disagree on applied seq ({seqs}) — drain "
+                f"(retransmit + poll) before gathering edge state")
+        return rank, health, seqs[0], max(ts), max(nbs)
+
+    def edge_digest(self) -> str:
+        """Canonical digest of the cluster's PER-EDGE serving state —
+        global ``(rank, health)`` by feed id, the stream seq, and the
+        cluster clock — independent of the partition, so it is THE
+        reshard witness: an N→M migration must preserve it bitwise."""
+        rank, health, seq, t_max, _ = self._gather_edges()
+        h = hashlib.sha256()
+        h.update(np.int64(self.n_feeds).tobytes())
+        h.update(np.int64(seq).tobytes())
+        h.update(np.float32(t_max).tobytes())
+        h.update(rank.tobytes())
+        h.update(health.tobytes())
+        return h.hexdigest()
+
+    # ---- durability / artifacts ----
+
+    def snapshot_all(self) -> Dict[int, Optional[int]]:
+        return {s.k: s.runtime.snapshot() for s in self._slots
+                if s.runtime is not None}
+
+    def write_metrics(self, path: Optional[str] = None,
+                      extra: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        """The ``rq.serving.metrics/2`` artifact (defaults into the
+        cluster directory)."""
+        if path is None:
+            if self.dir is None:
+                raise ValueError("no cluster directory and no path given")
+            path = os.path.join(self.dir, "metrics.json")
+        base = {"n_feeds": self.n_feeds, "q": self.q,
+                "applied_seq": self.applied_seq}
+        if extra:
+            base.update(extra)
+        return self.metrics.write(path, self.pending_by_shard,
+                                  self.health_by_shard, extra=base)
+
+    def close(self) -> None:
+        for slot in self._slots:
+            if slot.runtime is not None:
+                slot.runtime.close()
+
+    def reset_metrics(self) -> None:
+        """Fresh router ledger (bench warm-up exclusion); refused while
+        sub-batches are pending anywhere — see
+        ``ServingRuntime.reset_metrics``."""
+        if self.pending:
+            raise ValueError(
+                f"cannot reset metrics with {self.pending} sub-batches "
+                f"pending — drain (poll) first")
+        for slot in self._slots:
+            if slot.runtime is not None:
+                slot.runtime.reset_metrics()
+            slot.outstanding.clear()
+        self.metrics = ClusterMetrics(self.n_shards, clock=self._clock)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# The class IS the router (ISSUE 7 naming): routing, health, and
+# recovery live on the cluster object itself — no extra indirection.
+ShardRouter = ServingCluster
+
+
+# ---------------------------------------------------------------------------
+# Reshard: digest-asserted N -> M state migration (grow without genesis
+# replay)
+# ---------------------------------------------------------------------------
+
+def reshard(src_dir: str, dst_dir: str, n_shards: int,
+            clock=time.monotonic) -> Dict[str, Any]:
+    """Migrate a DRAINED cluster directory from its current shard count
+    to ``n_shards`` fault domains under ``dst_dir`` (which must not
+    exist or be empty; ``src_dir`` is left intact as the rollback).
+
+    Protocol: recover every source shard (provable snapshot + journal
+    replay — nothing unproven migrates), require a uniform applied seq
+    (an undrained cluster refuses), gather the global per-edge
+    ``(rank, health)`` carry and the stream position, deal the edges to
+    the NEW partition, install the migrated carry into each fresh shard
+    and land an IMMEDIATE snapshot at the migrated seq (post-reshard
+    recovery never replays from genesis), then **assert the per-edge
+    digest is bit-identical across the move** — a divergent migration
+    raises instead of serving silently-wrong state.  Per-shard decision
+    keys re-derive from the new shard ids (decisions from ``seq+1`` on
+    are deterministic in the new geometry); per-shard lifetime counters
+    (``n_events``/``n_posts``) reset — they are fault-domain metrics,
+    not stream state.  Returns the enveloped report also written to
+    ``<dst_dir>/reshard.json`` (schema ``rq.serving.reshard/1``)."""
+    import jax.numpy as jnp
+
+    src, _ = ServingCluster.recover(src_dir, clock=clock,
+                                    auto_recover=False)
+    try:
+        rank_g, health_g, seq, t_max, n_batches = src._gather_edges()
+        edge_before = src.edge_digest()
+        cfg = src._config()
+    finally:
+        src.close()
+
+    if os.path.exists(dst_dir) and os.listdir(dst_dir):
+        raise ValueError(
+            f"reshard destination {dst_dir} is not empty — refusing to "
+            f"mix with existing serving state")
+    dst = ServingCluster(
+        n_feeds=int(cfg["n_feeds"]), n_shards=int(n_shards), dir=dst_dir,
+        q=float(cfg["q"]), s_sink=np.asarray(cfg["s_sink"], np.float64),
+        seed=int(cfg["seed"]), start_seq=int(cfg["start_seq"]),
+        snapshot_every=int(cfg["snapshot_every"]),
+        reorder_window=int(cfg["reorder_window"]),
+        queue_capacity=int(cfg["queue_capacity"]),
+        max_batch_events=int(cfg["max_batch_events"]), clock=clock)
+    try:
+        for slot in dst._slots:
+            st = slot.runtime.carry
+            migrated = st.replace(
+                rank=jnp.asarray(rank_g[slot.feeds]),
+                health=jnp.asarray(health_g[slot.feeds]),
+                t=jnp.asarray(t_max, st.t.dtype),
+                seq=jnp.asarray(seq, jnp.int32),
+                n_batches=jnp.asarray(n_batches, jnp.int32))
+            slot.runtime.install_carry(migrated)
+            slot.runtime.snapshot()
+        edge_after = dst.edge_digest()
+        if edge_after != edge_before:
+            raise RuntimeError(
+                f"reshard diverged: per-edge digest "
+                f"{edge_after[:12]}.. after migration != "
+                f"{edge_before[:12]}.. before — refusing to serve "
+                f"migrated state (src left intact at {src_dir}, "
+                f"divergent destination removed)")
+        report = {
+            "src_dir": os.path.abspath(src_dir),
+            "dst_dir": os.path.abspath(dst_dir),
+            "n_shards_src": int(cfg["n_shards"]),
+            "n_shards_dst": int(n_shards),
+            "n_feeds": int(cfg["n_feeds"]),
+            "seq": int(seq),
+            "n_batches": int(n_batches),
+            "edge_digest": edge_before,
+            "edges_per_shard": [int(len(s.feeds)) for s in dst._slots],
+            "verified": True,
+        }
+        _integrity.write_json(os.path.join(dst_dir, "reshard.json"),
+                              report, schema=RESHARD_SCHEMA)
+    except BaseException:
+        # A half-built destination is a fully-formed cluster directory
+        # holding UNVERIFIED migrated state — left on disk, a later
+        # ServingCluster.recover(dst_dir) would serve exactly the
+        # silently-wrong state the digest assert refuses, so the
+        # destination (created by us: it was empty at entry) dies with
+        # the failure.
+        dst.close()
+        shutil.rmtree(dst_dir, ignore_errors=True)
+        raise
+    dst.close()
+    return report

@@ -1,5 +1,5 @@
 """Steady-state serving metrics: counters, latency percentiles, and the
-enveloped ``rq.serving.metrics/1`` artifact.
+enveloped ``rq.serving.metrics/1`` / ``rq.serving.metrics/2`` artifacts.
 
 Accounting is CLOSED by construction and asserted in CI: every submitted
 batch ends in exactly one of {applied, shed, rejected, duplicate, still
@@ -13,6 +13,21 @@ wall-clock submit→decision per applied batch (``time.monotonic``),
 reported as p50/p99; events/s sustained divides applied events by the
 busy window.  The artifact is written through ``runtime.integrity`` so
 it carries the standard checksummed envelope.
+
+Two schema versions:
+
+- :class:`ServingMetrics` → ``rq.serving.metrics/1``: one single-domain
+  runtime's counters (PR 6).
+- :class:`ClusterMetrics` → ``rq.serving.metrics/2``: the sharded
+  cluster's ROUTER-side accounting — one breakdown per shard fault
+  domain plus cluster aggregates, health states, and recovery stats.
+  Router counters are authoritative across shard crashes (a recovered
+  shard starts a fresh in-process metrics block, but the router observed
+  every admission and every decision, so the cluster identity
+  ``ingested == applied + shed + rejected + duplicates (+ pending)``
+  reconciles per shard AND cluster-wide, including mid-recovery — the
+  units are SUB-batches: every global micro-batch fans out to exactly
+  one sub-outcome per shard).
 """
 
 from __future__ import annotations
@@ -25,10 +40,12 @@ import numpy as np
 
 from ..runtime import integrity as _integrity
 
-__all__ = ["ServingMetrics", "METRICS_SCHEMA", "MAX_SHED_SEQS",
-           "LATENCY_WINDOW"]
+__all__ = ["ServingMetrics", "ClusterMetrics", "METRICS_SCHEMA",
+           "CLUSTER_METRICS_SCHEMA", "MAX_SHED_SEQS", "LATENCY_WINDOW",
+           "MAX_SEQS_PER_SHARD"]
 
 METRICS_SCHEMA = "rq.serving.metrics/1"
+CLUSTER_METRICS_SCHEMA = "rq.serving.metrics/2"
 
 # Hard caps keeping a long-lived runtime's metrics state bounded (the
 # overload contract promises bounded MEMORY, which must include the
@@ -38,6 +55,23 @@ METRICS_SCHEMA = "rq.serving.metrics/1"
 # the most recent LATENCY_WINDOW applies.
 MAX_SHED_SEQS = 1024
 LATENCY_WINDOW = 8192
+# Per-shard cap on each recorded seq list (shed/lost) in ClusterMetrics —
+# totals stay exact, truncation is flagged, memory stays bounded per
+# fault domain.
+MAX_SEQS_PER_SHARD = 256
+
+
+def _latency_percentiles(latencies) -> Dict[str, Optional[float]]:
+    """One percentile definition for BOTH artifact versions — the /1
+    and /2 `decision_latency` blocks must never drift apart."""
+    if not latencies:
+        return {"p50_ms": None, "p99_ms": None, "max_ms": None}
+    lat = np.asarray(latencies)
+    return {
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "max_ms": round(float(lat.max()) * 1e3, 3),
+    }
 
 
 class ServingMetrics:
@@ -81,14 +115,7 @@ class ServingMetrics:
             self.shed_seqs.append(int(seq))
 
     def latency_percentiles(self) -> Dict[str, Optional[float]]:
-        if not self._latencies:
-            return {"p50_ms": None, "p99_ms": None, "max_ms": None}
-        lat = np.asarray(self._latencies)
-        return {
-            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
-            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
-            "max_ms": round(float(lat.max()) * 1e3, 3),
-        }
+        return _latency_percentiles(self._latencies)
 
     def reconciles(self, pending: int = 0) -> bool:
         """The closed-accounting identity (pending = batches accepted
@@ -131,4 +158,229 @@ class ServingMetrics:
         artifact (atomic + checksummed); returns the payload."""
         payload = self.report(pending=pending, extra=extra)
         _integrity.write_json(path, payload, schema=METRICS_SCHEMA)
+        return payload
+
+
+class _ShardStats:
+    """One shard fault domain's router-side counters.  Mutated only by
+    :class:`ClusterMetrics` observers; every sub-batch the router offers
+    the shard ends in exactly one bucket (or pending), so
+
+        submitted == applied + shed_queue + shed_unavailable
+                     + lost_on_crash + rejected + duplicates + pending
+
+    holds at every instant — including while the shard is quarantined
+    (its accepted-but-unapplied sub-batches were reclassified
+    ``lost_on_crash`` the moment the carry died; pending is then 0)."""
+
+    __slots__ = ("submitted", "applied", "events_applied", "posts",
+                 "shed_queue", "shed_unavailable", "lost_on_crash",
+                 "rejected", "duplicates", "timeouts", "backoff_rounds",
+                 "crashes", "recoveries", "replayed", "recovery_ms",
+                 "shed_seqs", "lost_seqs", "last_crash_reason")
+
+    def __init__(self):
+        self.submitted = 0
+        self.applied = 0
+        self.events_applied = 0
+        self.posts = 0
+        self.shed_queue = 0
+        self.shed_unavailable = 0
+        self.lost_on_crash = 0
+        self.rejected = 0
+        self.duplicates = 0
+        self.timeouts = 0
+        self.backoff_rounds = 0
+        self.crashes = 0
+        self.recoveries = 0
+        self.replayed = 0
+        self.recovery_ms: List[float] = []
+        self.shed_seqs: List[int] = []       # queue + unavailable sheds
+        self.lost_seqs: List[int] = []
+        self.last_crash_reason: Optional[str] = None
+
+    @property
+    def shed_total(self) -> int:
+        return self.shed_queue + self.shed_unavailable + self.lost_on_crash
+
+    def reconciles(self, pending: int) -> bool:
+        return self.submitted == (self.applied + self.shed_total
+                                  + self.rejected + self.duplicates
+                                  + int(pending))
+
+    def as_dict(self, pending: int, health: str) -> Dict[str, Any]:
+        return {
+            "health": health,
+            "submitted": self.submitted,
+            "applied": self.applied,
+            "events_applied": self.events_applied,
+            "posts": self.posts,
+            "shed_queue": self.shed_queue,
+            "shed_unavailable": self.shed_unavailable,
+            "lost_on_crash": self.lost_on_crash,
+            "rejected": self.rejected,
+            "duplicates": self.duplicates,
+            "pending": int(pending),
+            "reconciles": self.reconciles(pending),
+            "timeouts": self.timeouts,
+            "backoff_rounds": self.backoff_rounds,
+            "crashes": self.crashes,
+            "last_crash_reason": self.last_crash_reason,
+            "recoveries": self.recoveries,
+            "replayed": self.replayed,
+            "recovery_ms": [round(x, 3) for x in self.recovery_ms],
+            "shed_seqs": list(self.shed_seqs),
+            "lost_seqs": list(self.lost_seqs),
+            "seqs_truncated": (
+                self.shed_queue + self.shed_unavailable
+                > len(self.shed_seqs)
+                or self.lost_on_crash > len(self.lost_seqs)),
+        }
+
+
+def _capped_append(seqs: List[int], seq: int) -> None:
+    if len(seqs) < MAX_SEQS_PER_SHARD:
+        seqs.append(int(seq))
+
+
+class ClusterMetrics:
+    """Router-side accounting for the sharded serving cluster — the
+    authoritative ledger across shard crashes (per-shard in-process
+    metrics die with the shard; the router's view of admissions and
+    decisions does not).  Units are SUB-batches: one global micro-batch
+    = one sub-outcome per shard, so per-shard identities sum to the
+    cluster identity exactly."""
+
+    def __init__(self, n_shards: int, clock=time.monotonic):
+        self._clock = clock
+        self.t_start = clock()
+        self.n_shards = int(n_shards)
+        self.shards = [_ShardStats() for _ in range(n_shards)]
+        self.global_rejected = 0   # rejected before fan-out (bad batch)
+        self.decisions_served = 0
+        self.stale_decisions = 0
+        self._latencies: collections.deque = collections.deque(
+            maxlen=LATENCY_WINDOW)
+
+    # -- observers (the router calls exactly one per sub-batch outcome) --
+
+    def observe_submitted(self, shard: int) -> None:
+        self.shards[shard].submitted += 1
+
+    def observe_applied(self, shard: int, n_events: int, posted: bool,
+                        latency_s: Optional[float]) -> None:
+        s = self.shards[shard]
+        s.applied += 1
+        s.events_applied += int(n_events)
+        s.posts += int(bool(posted))
+        if latency_s is not None:
+            self._latencies.append(float(latency_s))
+
+    def observe_shed_queue(self, shard: int, seq: int) -> None:
+        s = self.shards[shard]
+        s.shed_queue += 1
+        _capped_append(s.shed_seqs, seq)
+
+    def observe_shed_unavailable(self, shard: int, seq: int) -> None:
+        s = self.shards[shard]
+        s.shed_unavailable += 1
+        _capped_append(s.shed_seqs, seq)
+
+    def observe_lost_on_crash(self, shard: int, seq: int) -> None:
+        s = self.shards[shard]
+        s.lost_on_crash += 1
+        _capped_append(s.lost_seqs, seq)
+
+    def observe_rejected(self, shard: int) -> None:
+        self.shards[shard].rejected += 1
+
+    def observe_duplicate(self, shard: int) -> None:
+        self.shards[shard].duplicates += 1
+
+    def observe_timeout(self, shard: int, backoff_rounds: int) -> None:
+        s = self.shards[shard]
+        s.timeouts += 1
+        s.backoff_rounds += int(backoff_rounds)
+
+    def observe_crash(self, shard: int, reason: str) -> None:
+        s = self.shards[shard]
+        s.crashes += 1
+        s.last_crash_reason = str(reason)
+
+    def observe_recovery(self, shard: int, replayed: int,
+                         ms: float) -> None:
+        s = self.shards[shard]
+        s.recoveries += 1
+        s.replayed += int(replayed)
+        s.recovery_ms.append(float(ms))
+
+    # -- reporting --
+
+    def latency_percentiles(self) -> Dict[str, Optional[float]]:
+        return _latency_percentiles(self._latencies)
+
+    def reconciles(self, pending_by_shard: List[int]) -> bool:
+        """True iff EVERY shard's sub-batch identity closes (the cluster
+        aggregate then closes by summation)."""
+        return all(s.reconciles(p)
+                   for s, p in zip(self.shards, pending_by_shard))
+
+    def report(self, pending_by_shard: List[int],
+               health_by_shard: List[str],
+               extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        if len(pending_by_shard) != self.n_shards or \
+                len(health_by_shard) != self.n_shards:
+            raise ValueError(
+                f"need one pending/health entry per shard "
+                f"({self.n_shards}), got {len(pending_by_shard)}/"
+                f"{len(health_by_shard)}")
+        busy_s = max(self._clock() - self.t_start, 1e-9)
+        agg = {k: sum(getattr(s, k) for s in self.shards)
+               for k in ("submitted", "applied", "events_applied",
+                         "posts", "shed_queue", "shed_unavailable",
+                         "lost_on_crash", "rejected", "duplicates",
+                         "timeouts", "crashes", "recoveries",
+                         "replayed")}
+        pending = sum(int(p) for p in pending_by_shard)
+        out: Dict[str, Any] = {
+            "version": 2,
+            "n_shards": self.n_shards,
+            "ingested": agg["submitted"],
+            "applied": agg["applied"],
+            "shed": (agg["shed_queue"] + agg["shed_unavailable"]
+                     + agg["lost_on_crash"]),
+            "rejected": agg["rejected"],
+            "duplicates": agg["duplicates"],
+            "pending": pending,
+            "reconciles": self.reconciles(pending_by_shard),
+            "events_applied": agg["events_applied"],
+            "posts": agg["posts"],
+            "timeouts": agg["timeouts"],
+            "crashes": agg["crashes"],
+            "recoveries": agg["recoveries"],
+            "replayed": agg["replayed"],
+            "global_rejected_batches": self.global_rejected,
+            "decisions_served": self.decisions_served,
+            "stale_decisions": self.stale_decisions,
+            "busy_s": round(busy_s, 6),
+            "events_per_sec": round(agg["events_applied"] / busy_s, 1),
+            "batches_per_sec": round(agg["applied"] / busy_s, 1),
+            "decision_latency": self.latency_percentiles(),
+            "shards": [s.as_dict(p, h)
+                       for s, p, h in zip(self.shards, pending_by_shard,
+                                          health_by_shard)],
+        }
+        if extra:
+            out.update(extra)
+        return out
+
+    def write(self, path: str, pending_by_shard: List[int],
+              health_by_shard: List[str],
+              extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Land the report as the enveloped ``rq.serving.metrics/2``
+        artifact (atomic + checksummed); returns the payload."""
+        payload = self.report(pending_by_shard, health_by_shard,
+                              extra=extra)
+        _integrity.write_json(path, payload,
+                              schema=CLUSTER_METRICS_SCHEMA)
         return payload

@@ -57,11 +57,14 @@ from .state import (Decision, FeedState, init_feed_state, make_apply_fn,
                     poison_edge, state_digest)
 
 __all__ = ["ServingRuntime", "Admission", "RecoveryInfo", "recover",
-           "journal_decisions", "CONFIG_SCHEMA"]
+           "journal_decisions", "CONFIG_SCHEMA", "SNAPSHOTS_DIRNAME"]
 
 CONFIG_SCHEMA = "rq.serving.config/1"
 _JOURNAL = "journal.jsonl"
-_SNAPSHOTS = "snapshots"
+# Public: the cluster layer (serving.cluster) addresses a shard's
+# snapshot tree for the corrupt_snapshot fault + recovery assertions.
+SNAPSHOTS_DIRNAME = "snapshots"
+_SNAPSHOTS = SNAPSHOTS_DIRNAME
 _CONFIG = "config.json"
 
 
@@ -228,19 +231,89 @@ class ServingRuntime:
     def applied_seq(self) -> int:
         return int(np.asarray(self._state.seq))
 
-    def submit(self, batch: EventBatch) -> Admission:
+    @property
+    def carry(self) -> FeedState:
+        """Read-only view of the live carry — the cluster layer's state-
+        migration (reshard) and edge-digest paths read it through one
+        explicit ``jax.device_get`` boundary on their side; mutating it
+        would desynchronize the journal, so don't."""
+        return self._state
+
+    @property
+    def journal_path(self) -> Optional[str]:
+        """The LIVE journal file (None when running without a directory)
+        — what the cluster's ``shard:torn_journal`` fault tears."""
+        return None if self._journal is None else self._journal.path
+
+    def next_queued_seq(self) -> Optional[int]:
+        """Sequence number of the batch the next ``poll(max_batches=1)``
+        would apply, or None when the queue is empty — the cluster
+        router's per-batch dispatch peek (it polls one batch at a time
+        so shard faults land at exact sequence numbers)."""
+        return int(self._queue[0][0].seq) if self._queue else None
+
+    def reset_metrics(self) -> None:
+        """Start a fresh metrics block (same contract as recovery: the
+        report describes steady state from this instant).  Refused while
+        batches are pending — zeroing the counters under a live backlog
+        would break the closed accounting identity."""
+        if self.pending:
+            raise ValueError(
+                f"cannot reset metrics with {self.pending} batches "
+                f"pending — drain (poll) first")
+        self.metrics = ServingMetrics(clock=self._clock)
+        # submit() copies the sequencer's lifetime counters into the
+        # report by absolute overwrite — pre-reset duplicate/reorder
+        # traffic would resurface as phantom counts and break the
+        # closed identity, so they reset with the ledger.
+        self._seq.duplicates = 0
+        self._seq.reordered = 0
+        self._seq.window_rejects = 0
+
+    def install_carry(self, state: FeedState) -> None:
+        """Replace the carry with a MIGRATED one (the cluster reshard
+        path).  Only legal on a fresh runtime — nothing applied, nothing
+        queued, nothing journaled — and the caller must ``snapshot()``
+        right after so the migrated state has a durable recovery base
+        (the journal holds no records for it)."""
+        # Freshness witness is the carry's apply counter, NOT
+        # applied_seq: a fresh runtime built with start_seq=S sits at
+        # applied_seq=S-1 (>= 0 for any S > 0), but n_batches is 0
+        # until something actually applies.
+        n_applied = int(np.asarray(self._state.n_batches))
+        if self.pending or n_applied:
+            raise ValueError(
+                f"install_carry needs a fresh runtime (pending="
+                f"{self.pending}, batches applied={n_applied}) — "
+                f"migrating over live serving state would desync the "
+                f"journal")
+        if state.rank.shape != (self.n_feeds,):
+            raise ValueError(
+                f"migrated carry has {state.rank.shape[0]} edges, this "
+                f"runtime serves {self.n_feeds}")
+        self._state = state
+        self._seq.next_seq = int(np.asarray(state.seq)) + 1
+
+    def submit(self, batch: EventBatch,
+               _validated: bool = False) -> Admission:
         """Admit one micro-batch; never raises on bad input — typed
         failures come back as the admission status (the source-facing
-        boundary must stay up under garbage)."""
+        boundary must stay up under garbage).  ``_validated`` is the
+        cluster router's trusted path: a sub-batch it fans out is a
+        masked slice of a batch that already passed ``validate_batch``
+        (coerced dtypes, non-decreasing times, in-range local feeds by
+        construction), so re-validating every slice would double the
+        O(events) host work on the measured ingest path."""
         self.metrics.ingested += 1
         backpressure = self.pending >= max(self.queue_capacity * 3 // 4, 1)
-        try:
-            batch = validate_batch(batch, self.n_feeds,
-                                   max_events=self.max_batch_events)
-        except IngestError as e:
-            self.metrics.rejected += 1
-            return Admission("rejected", seq=e.seq, reason=str(e),
-                             backpressure=backpressure)
+        if not _validated:
+            try:
+                batch = validate_batch(batch, self.n_feeds,
+                                       max_events=self.max_batch_events)
+            except IngestError as e:
+                self.metrics.rejected += 1
+                return Admission("rejected", seq=e.seq, reason=str(e),
+                                 backpressure=backpressure)
         cls = self._seq.classify(batch.seq)
         if cls != "new":
             # Redundant deliveries drop BEFORE the capacity check — they

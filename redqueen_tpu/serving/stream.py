@@ -3,7 +3,8 @@ and CLI entry point (``python -m redqueen_tpu.serving.stream``).
 
 Plays a :func:`serving.events.synthetic_stream` (pure function of its
 seed — a restarted driver regenerates byte-identical batches, which IS
-the retransmit model) into a :class:`ServingRuntime`, applying the
+the retransmit model) into a :class:`ServingRuntime` — or, with
+``--shards N``, a sharded :class:`ServingCluster` — applying the
 env-configured ``ingest`` fault (``RQ_FAULT=ingest:mode@batchN``,
 ``runtime.faultinject``) at the delivery layer where each failure mode
 physically lives:
@@ -16,14 +17,27 @@ physically lives:
                      itself (``serving.service._apply_one``): a tear of
                      batch N's journal record mid-append + hard exit,
                      or ``os._exit`` right after batch N is applied +
-                     journaled (the kill -9 acceptance scenario).
+                     journaled (the kill -9 acceptance scenario).  In
+                     cluster mode the per-shard runtimes inherit these,
+                     so the WHOLE process dies at shard granularity —
+                     the first shard to apply sub-batch N exits
+                     mid-global-batch, leaving shards at DIFFERENT
+                     seqs; ``--resume`` must reconverge them.
 
-On a clean finish the driver lands ``<dir>/final.json`` (enveloped,
-schema ``rq.serving.final/1``): carry digest, journal decision history,
-and the metrics report — everything the crash-recovery acceptance test
-compares bitwise between an uninterrupted run and a killed+recovered
-one.  Exit codes: 0 clean; 17 crash_after_apply (runtime); 19
-torn_journal (driver).
+Cluster mode additionally honors the ``shard:*`` fault kinds
+(``RQ_FAULT=shard:crash|wedge|torn_journal|corrupt_snapshot@shardK
+[,batchN]``) applied by the in-process ShardRouter: the DRIVER SURVIVES
+those (exit 0) — one fault domain dies and recovers in place while the
+others keep serving, which is the chaos acceptance scenario.
+
+On a clean finish the driver lands ``<dir>/final.json`` — schema
+``rq.serving.final/1`` (single) or ``rq.serving.cluster.final/1``
+(cluster: cluster + per-shard digests, the partition-independent edge
+digest, per-shard journal decision histories, the ``/2`` metrics
+report) — everything the acceptance tests compare bitwise between an
+uninterrupted run and a faulted/killed+recovered one.  Exit codes: 0
+clean (incl. survived shard faults); 17 crash_after_apply (runtime); 19
+torn_journal (runtime driver).
 """
 
 from __future__ import annotations
@@ -35,12 +49,15 @@ from typing import List, Optional
 
 from ..runtime import faultinject as _faultinject
 from ..runtime import integrity as _integrity
+from .cluster import ServingCluster
 from .events import EventBatch, synthetic_stream
 from .service import ServingRuntime, journal_decisions, recover
 
-__all__ = ["drive", "main", "FINAL_SCHEMA"]
+__all__ = ["drive", "main", "FINAL_SCHEMA", "CLUSTER_FINAL_SCHEMA",
+           "cluster_final_payload"]
 
 FINAL_SCHEMA = "rq.serving.final/1"
+CLUSTER_FINAL_SCHEMA = "rq.serving.cluster.final/1"
 
 
 def _delivery_order(batches: List[EventBatch],
@@ -97,6 +114,37 @@ def _final_payload(rt: ServingRuntime) -> dict:
     }
 
 
+def cluster_final_payload(cl: ServingCluster) -> dict:
+    """The cluster run's comparable outcome: per-shard carry digests +
+    RETAINED journal decision histories, the whole-cluster digest, and
+    the partition-independent edge digest — what the chaos acceptance
+    tests compare bitwise between an uninterrupted run and a
+    faulted+recovered one (metrics ride along but differ by design:
+    they record the recoveries)."""
+    digests = cl.shard_digests()
+    shards = []
+    for k, sdir in enumerate(cl.shard_dirs):
+        shards.append({
+            "shard": k,
+            "n_edges": cl.edges_per_shard[k],
+            "digest": digests[k],
+            "decisions": [
+                {"seq": d.seq, "post": d.post,
+                 "post_time": d.post_time, "intensity": d.intensity}
+                for d in journal_decisions(sdir)
+            ],
+        })
+    return {
+        "cluster_digest": cl.cluster_digest(digests=digests),
+        "edge_digest": cl.edge_digest(),
+        "applied_seq": cl.applied_seq,
+        "n_shards": cl.n_shards,
+        "shards": shards,
+        "metrics": cl.metrics.report(cl.pending_by_shard,
+                                     cl.health_by_shard),
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m redqueen_tpu.serving.stream",
@@ -113,6 +161,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--snapshot-every", type=int, default=4)
     ap.add_argument("--window", type=int, default=4)
     ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--shards", type=int, default=0,
+                    help="run a sharded ServingCluster with N fault "
+                         "domains instead of the single-domain runtime "
+                         "(0 = single); shard:* faults apply here")
     ap.add_argument("--resume", action="store_true",
                     help="recover from --dir (snapshot + journal "
                          "replay) instead of starting fresh, then "
@@ -123,6 +175,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     fault = _faultinject.ingest_fault()
     batches = synthetic_stream(args.seed, args.batches, args.feeds,
                                events_per_batch=args.events_per_batch)
+
+    if args.shards:
+        if args.resume:
+            cl, infos = ServingCluster.recover(args.dir)
+            for k, info in enumerate(infos):
+                print(f"recovered shard {k}: "
+                      f"snapshot_seq={info.snapshot_seq} "
+                      f"replayed={info.replayed} "
+                      f"skipped={info.skipped} "
+                      f"torn={'yes' if info.torn else 'no'} "
+                      f"seq={info.recovered_seq}", file=sys.stderr)
+        else:
+            cl = ServingCluster(
+                n_feeds=args.feeds, n_shards=args.shards, q=args.q,
+                seed=args.seed, dir=args.dir,
+                snapshot_every=args.snapshot_every,
+                reorder_window=args.window,
+                queue_capacity=args.queue_capacity)
+        with cl:
+            drive(cl, batches, fault=fault)
+            cl.write_metrics()
+            _integrity.write_json(
+                os.path.join(args.dir, "final.json"),
+                cluster_final_payload(cl),
+                schema=CLUSTER_FINAL_SCHEMA)
+        return 0
+
     if args.resume:
         rt, info = recover(args.dir)
         print(f"recovered: snapshot_seq={info.snapshot_seq} "

@@ -551,6 +551,25 @@ class TestOverload:
             got["decision_latency"]["p50_ms"]
         assert got["events_per_sec"] > 0
 
+    def test_reset_metrics_forgets_pre_reset_duplicates(self):
+        """Regression: the sequencer's lifetime counters feed the report
+        by absolute overwrite, so reset_metrics must zero them too —
+        otherwise pre-reset duplicate/reorder traffic resurfaces as
+        phantom counts in the fresh ledger and reconciles goes false."""
+        rt = serving.ServingRuntime(
+            n_feeds=4, q=1.0, seed=0, dir=None, snapshot_every=1000,
+            reorder_window=8, queue_capacity=8)
+        batches = serving.synthetic_stream(1, 3, 4, events_per_batch=4)
+        rt.submit(batches[0])
+        rt.poll()
+        assert rt.submit(batches[0]).status == "duplicate"
+        rt.reset_metrics()
+        rt.submit(batches[1])
+        rt.poll()
+        m = rt.metrics
+        assert m.ingested == 1 and m.applied == 1 and m.duplicates == 0
+        assert m.reconciles(pending=rt.pending)
+
     def test_duplicate_retransmit_under_overload_is_not_shed(self):
         """A retransmit of an ALREADY-APPLIED batch arriving while the
         queue is full must come back 'duplicate' (an ack the source

@@ -41,11 +41,16 @@ echo "== integrity / self-healing / numerics / serving fault-injection pass =="
 # exactly the sick lane, bit-identically) on CPU; test_serving.py
 # carries the ingest fault-injection suite incl. THE crash-recovery
 # acceptance scenario (SIGKILL after batch N -> snapshot + journal
-# replay -> bit-identical carry and decisions) for every ingest:* kind.
+# replay -> bit-identical carry and decisions) for every ingest:* kind;
+# test_serving_cluster.py carries the shard-chaos suite (kill 1 of N
+# fault domains mid-stream under load -> survivors never stall or shed,
+# the recovered shard's decision stream is bit-identical to an
+# uninterrupted run, cluster accounting reconciles) for every shard:*
+# kind plus the digest-asserted reshard path.
 env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
     tests/test_watchdog.py tests/test_watcher.py tests/test_numerics.py \
     tests/test_numerics_properties.py tests/test_serving.py \
-    tests/test_rqlint.py \
+    tests/test_serving_cluster.py tests/test_rqlint.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tier-1 suite =="
