@@ -342,28 +342,39 @@ def bench_serving(quick: bool = False, out_path: str = None, log=log):
 
 
 def bench_serving_cluster(n_shards: int, quick: bool = False,
-                          out_path: str = None, log=log):
-    """``--serving --shards N``: the sharded-cluster serving bench.
+                          out_path: str = None,
+                          placement: str = "in-process", log=log):
+    """``--serving --shards N [--workers]``: the sharded-cluster
+    serving bench.
 
-    Two phases, both with the same warm-up exclusion as
+    Three phases, all with the same warm-up exclusion as
     :func:`bench_serving`:
 
     1. **Scaling sweep** — steady-state events/s and decision latency at
        1, 2, 4, ... up to ``n_shards`` fault domains (same global
        stream, journal fsync per sub-batch in the measured path), so the
        per-shard fault-isolation overhead is a committed number, not a
-       guess.
-    2. **Kill-one-shard chaos** — at ``n_shards``, kill fault domain 0
+       guess.  ``--workers`` runs the sweep with every shard in its own
+       subprocess: the N fsyncs/applies run in true parallel instead of
+       serializing behind one GIL — the placement's throughput claim.
+    2. **Placement comparison** (worker placement only) — the SAME
+       workload at ``n_shards`` in process, committed next to the
+       worker number so "worker mode beats in-process at equal shard
+       count" is measured in one artifact, never asserted.
+    3. **Kill-one-shard chaos** — at ``n_shards``, kill fault domain 0
        mid-stream (``auto_recover`` off so the outage window is
-       driver-controlled), keep serving the second half of the stream on
-       the surviving shards (measuring their throughput during the
-       outage), then recover the dead shard in place (snapshot +
-       digest-asserted journal replay — the MTTR number) and retransmit
-       until the cluster reconverges.  The artifact is the chaos
-       cluster's own ``rq.serving.metrics/2`` report — crashes,
-       lost-on-crash and shed-unavailable seqs, recovery replay counts,
-       and a closed accounting identity THROUGH the outage — with the
-       sweep + MTTR numbers under ``"bench"``.
+       driver-controlled; under ``--workers`` this is a REAL SIGKILL of
+       a live worker process), keep serving the second half of the
+       stream on the surviving shards (measuring their throughput
+       during the outage), then recover the dead shard in place
+       (snapshot + digest-asserted journal replay — the MTTR number;
+       worker MTTR honestly includes the replacement process's spawn +
+       jax import + first compile) and retransmit until the cluster
+       reconverges.  The artifact is the chaos cluster's own
+       ``rq.serving.metrics/2`` report — crashes, lost-on-crash and
+       shed-unavailable seqs, recovery replay counts, and a closed
+       accounting identity THROUGH the outage — with the sweep +
+       comparison + MTTR numbers under ``"bench"``.
     """
     import os as _os
     import shutil
@@ -380,31 +391,38 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
     batches = serving.synthetic_stream(0, n_batches + warm, n_feeds,
                                        events_per_batch=epb)
 
-    def make_cluster(k, d, **kw):
+    def make_cluster(k, d, placement=placement, **kw):
         return serving.ServingCluster(
             n_feeds=n_feeds, n_shards=k, dir=d, snapshot_every=10 ** 9,
             queue_capacity=256, reorder_window=8, max_batch_events=mbe,
-            **kw)
+            placement=placement, **kw)
+
+    def run_steady(cl):
+        """Warm the measured cluster, then serve the stream steady-state
+        and return its metrics report."""
+        for b in batches[:warm]:
+            cl.submit(b)
+            cl.poll()
+        cl.reset_metrics()
+        for b in batches[warm:]:
+            cl.submit(b)
+            cl.poll()
+        return cl.metrics.report(cl.pending_by_shard,
+                                 cl.health_by_shard)
 
     sweep_counts = [k for k in (1, 2, 4, 8, 16, 32) if k < n_shards]
     sweep_counts.append(n_shards)
     root = tempfile.mkdtemp(prefix="rq-serving-cluster-bench-")
     sweep = []
+    in_process_comparison = None
     try:
         for k in sweep_counts:
             with make_cluster(k, _os.path.join(root, f"sweep-{k}")) as cl:
-                for b in batches[:warm]:
-                    cl.submit(b)
-                    cl.poll()
-                cl.reset_metrics()
-                for b in batches[warm:]:
-                    cl.submit(b)
-                    cl.poll()
-                rep = cl.metrics.report(cl.pending_by_shard,
-                                        cl.health_by_shard)
+                rep = run_steady(cl)
             lat = rep["decision_latency"]
             sweep.append({
                 "n_shards": k,
+                "placement": placement,
                 "events_per_sec": rep["events_per_sec"],
                 "batches_per_sec": rep["batches_per_sec"],
                 "decision_p50_ms": lat["p50_ms"],
@@ -412,9 +430,29 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
                 "decision_max_ms": lat["max_ms"],
                 "reconciles": rep["reconciles"],
             })
-            log(f"serving sweep: {k} shard(s) -> "
+            log(f"serving sweep [{placement}]: {k} shard(s) -> "
                 f"{rep['events_per_sec']:,.0f} events/s, decision "
                 f"p50 {lat['p50_ms']}ms p99 {lat['p99_ms']}ms")
+
+        if placement == "workers":
+            # The acceptance comparison: same workload, same shard
+            # count, shards back in the router's process.
+            with make_cluster(n_shards, _os.path.join(root, "inproc"),
+                              placement="in-process") as cl:
+                rep = run_steady(cl)
+            in_process_comparison = {
+                "n_shards": n_shards,
+                "events_per_sec": rep["events_per_sec"],
+                "batches_per_sec": rep["batches_per_sec"],
+                "decision_p50_ms":
+                    rep["decision_latency"]["p50_ms"],
+                "decision_p99_ms":
+                    rep["decision_latency"]["p99_ms"],
+                "reconciles": rep["reconciles"],
+            }
+            log(f"serving comparison [in-process]: {n_shards} "
+                f"shard(s) -> {rep['events_per_sec']:,.0f} events/s "
+                f"(worker mode: {sweep[-1]['events_per_sec']:,.0f})")
 
         # ---- kill-one-shard chaos phase (at n_shards) ----
         kill_at = n_batches // 2
@@ -479,17 +517,19 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
             payload = cl.write_metrics(
                 out_path or "SERVING_BENCH.json",
                 extra={"bench": {
+                    "placement": placement,
                     "warmup_batches_excluded": warm,
                     "events_per_batch": epb,
                     "sweep": sweep,
+                    "in_process_comparison": in_process_comparison,
                     "kill_one_shard": chaos,
                 }})
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
     steady = sweep[-1]
-    log(f"serving chaos: shard 0 of {n_shards} killed for "
-        f"{chaos['outage_batches']} batches; survivors served "
+    log(f"serving chaos [{placement}]: shard 0 of {n_shards} killed "
+        f"for {chaos['outage_batches']} batches; survivors served "
         f"{chaos['healthy_events_per_sec_during_outage']:,.0f} events/s "
         f"during the outage (steady {steady['events_per_sec']:,.0f}); "
         f"recovery replayed {chaos['replayed_on_recovery']} records in "
@@ -498,17 +538,20 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
         f"reconciles={payload['reconciles']}")
     return {
         "metric": f"sharded serving events/sec ({n_feeds} feeds, "
-                  f"{n_shards} shards, journaled, ~{epb} ev/batch)",
+                  f"{n_shards} shards, {placement}, journaled, "
+                  f"~{epb} ev/batch)",
         "value": steady["events_per_sec"],
         "unit": "events/s",
         "vs_baseline": (round(steady["events_per_sec"]
                               / sweep[0]["events_per_sec"], 2)
                         if sweep[0]["events_per_sec"] else None),
+        "placement": placement,
         "decision_p50_ms": steady["decision_p50_ms"],
         "decision_p99_ms": steady["decision_p99_ms"],
         "decision_max_ms": steady["decision_max_ms"],
         "warmup_batches_excluded": warm,
         "sweep": sweep,
+        "in_process_comparison": in_process_comparison,
         "kill_one_shard": chaos,
         "reconciles": payload["reconciles"],
     }
@@ -529,6 +572,16 @@ def main():
                          "instead (scaling sweep up to N fault domains "
                          "+ kill-one-shard MTTR); writes the enveloped "
                          "rq.serving.metrics/2 artifact (--serving-out)")
+    ap.add_argument("--workers", action="store_true",
+                    help="with --serving --shards N: place every shard "
+                         "in its own subprocess worker (serving.worker) "
+                         "— the sweep measures true parallel fsync/"
+                         "apply, and the artifact carries the same-N "
+                         "in-process comparison (--in-process is the "
+                         "default placement)")
+    ap.add_argument("--in-process", dest="workers", action="store_false",
+                    help="with --serving --shards N: keep every shard "
+                         "in this process (default)")
     ap.add_argument("--serving-out", default="SERVING_BENCH.json",
                     help="artifact path for --serving "
                          "(default: SERVING_BENCH.json)")
@@ -566,9 +619,14 @@ def main():
     platform = jax.devices()[0].platform
 
     if args.serving:
+        if args.workers and not args.shards:
+            ap.error("--workers needs --serving --shards N (worker "
+                     "placement is a cluster mode)")
         if args.shards:
-            res = bench_serving_cluster(args.shards, quick=args.quick,
-                                        out_path=args.serving_out)
+            res = bench_serving_cluster(
+                args.shards, quick=args.quick,
+                out_path=args.serving_out,
+                placement="workers" if args.workers else "in-process")
         else:
             res = bench_serving(quick=args.quick,
                                 out_path=args.serving_out)

@@ -30,34 +30,62 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from .config import (
-    ConfigValidationError,
-    GraphBuilder,
-    SimConfig,
-    SourceParams,
-    stack_components,
-)
-from .sim import (
-    EventLog,
-    NumericalHealthError,
-    resume,
-    simulate,
-    simulate_batch,
-)
-from .presets import PRESETS, build_preset, run_preset
-from .sweep import SweepResult, run_sweep, run_sweep_star
+import os as _os
 
-# Subpackages re-exported for discoverability. models/ops load eagerly (the
-# driver registers the built-in policies), and the sweep re-export above
-# pulls in parallel.bigf/shard at package import too (the price of a
-# flat `redqueen_tpu.run_sweep`); oracle and data stay import-on-use.
-from . import utils  # noqa: F401
+# Serving worker children (RQ_SERVING_WORKER=1, set by
+# serving.worker.WorkerHandle.spawn) must spawn cheap and stay jax-free
+# until their first open/recover request loads the shard — the same
+# import discipline the watchdog processes keep.  Under the flag the
+# eager jax-pulling re-exports below are skipped; the module-level
+# __getattr__ (PEP 562) resolves every one of them lazily, so the public
+# surface is identical either way — only the import COST moves.
+_RQ_MINIMAL_IMPORT = bool(_os.environ.get("RQ_SERVING_WORKER"))
 
 # The resilience runtime (supervised dispatch, retry/backoff, TPU->CPU
 # degradation, preemption safety, fault injection) is stdlib-only at
 # import time — eager re-export costs nothing and every entry point
-# needs it.
+# needs it (the serving worker child included: faultinject drives its
+# injected process faults).
 from . import runtime  # noqa: F401
+
+# name -> owning submodule: THE definition of the re-exported surface.
+# The eager loop below and the PEP 562 fallback both read it, so a new
+# export is added exactly once and behaves identically on both the
+# normal and the minimal-import (worker-child) path.
+_LAZY_ATTRS = {
+    "ConfigValidationError": ".config", "GraphBuilder": ".config",
+    "SimConfig": ".config", "SourceParams": ".config",
+    "stack_components": ".config",
+    "EventLog": ".sim", "NumericalHealthError": ".sim",
+    "resume": ".sim", "simulate": ".sim", "simulate_batch": ".sim",
+    "PRESETS": ".presets", "build_preset": ".presets",
+    "run_preset": ".presets",
+    "SweepResult": ".sweep", "run_sweep": ".sweep",
+    "run_sweep_star": ".sweep",
+    "utils": None,
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_ATTRS:
+        import importlib
+
+        target = _LAZY_ATTRS[name]
+        if target is None:  # a subpackage re-export
+            return importlib.import_module("." + name, __name__)
+        return getattr(importlib.import_module(target, __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+if not _RQ_MINIMAL_IMPORT:
+    # Eager re-exports, derived from the same map the lazy path serves.
+    # models/ops load eagerly through .sim (the driver registers the
+    # built-in policies), and .sweep pulls in parallel.bigf/shard at
+    # package import too (the price of a flat `redqueen_tpu.run_sweep`);
+    # oracle and data stay import-on-use.
+    for _n in _LAZY_ATTRS:
+        globals()[_n] = __getattr__(_n)
+    del _n
 
 __all__ = [
     "runtime",

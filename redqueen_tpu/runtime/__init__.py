@@ -83,8 +83,14 @@ _NUMERICS_NAMES = (
 
 def __getattr__(name):
     if name == "numerics" or name in _NUMERICS_NAMES:
-        from . import numerics
+        # import_module, NOT ``from . import``: the fromlist protocol
+        # hasattr-checks this package for the submodule, which re-enters
+        # this hook before the import binds the attribute — infinite
+        # recursion on the first lazy touch (seen from the jax-free
+        # serving-worker import path).
+        import importlib
 
+        numerics = importlib.import_module(".numerics", __name__)
         return numerics if name == "numerics" else getattr(numerics, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

@@ -53,6 +53,30 @@ Two ways in:
                             validated at :func:`maybe_inject` but
                             APPLIED by the serving stream driver /
                             runtime via :func:`ingest_fault`
+      worker:mode@shardK[,batchN]
+                            deterministic PROCESS-level fault in an
+                            out-of-process shard worker
+                            (:mod:`redqueen_tpu.serving.worker`), fired
+                            by worker K itself when it handles the
+                            sub-batch with sequence number N (omitted =
+                            the first opportunity).  ``kill`` SIGKILLs
+                            the worker process right after batch N is
+                            applied+journaled, before the response frame
+                            goes out (a REAL crash domain — the router
+                            sees child exit / EOF); ``hang`` wedges the
+                            worker on the request that would apply batch
+                            N (the request is dropped, never answered —
+                            the router's per-request deadline expires;
+                            bounded fires so the stream reconverges);
+                            ``eof`` tears the response frame in half and
+                            exits (torn-frame + EOF path); ``garbage``
+                            replaces the response with non-protocol
+                            bytes (checksum/magic violation — the router
+                            must kill the poisoned connection).
+                            Data-plane kind: validated at
+                            :func:`maybe_inject`, APPLIED by the worker
+                            child via :func:`worker_fault` — the router
+                            and the other workers keep serving
       shard:mode@shardK[,batchN]
                             deterministic SHARD-granularity fault in the
                             sharded serving cluster
@@ -117,6 +141,10 @@ __all__ = [
     "SHARD_MODES",
     "parse_shard",
     "shard_fault",
+    "WorkerFault",
+    "WORKER_MODES",
+    "parse_worker",
+    "worker_fault",
     "hang_forever",
     "crash_with",
     "flaky",
@@ -157,13 +185,14 @@ def parse_fault(spec: str) -> FaultSpec:
     kind, _, arg = spec.strip().partition(":")
     kind = kind.strip().lower()
     if kind not in ("hang", "crash", "transient", "oom", "corrupt",
-                    "numeric", "ingest", "shard"):
+                    "numeric", "ingest", "shard", "worker"):
         raise ValueError(f"unknown fault spec {spec!r} "
                          f"(want hang|crash|transient|oom[:arg], "
                          f"corrupt:mode@path, "
                          f"numeric:mode@laneN[,chunkM], "
-                         f"ingest:mode@batchN, or "
-                         f"shard:mode@shardK[,batchN])")
+                         f"ingest:mode@batchN, "
+                         f"shard:mode@shardK[,batchN], or "
+                         f"worker:mode@shardK[,batchN])")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -227,6 +256,10 @@ def inject(spec: FaultSpec) -> None:
         # the first maybe_inject), applied by the serving cluster's
         # ShardRouter via shard_fault().
         parse_shard(spec.arg)
+    elif spec.kind == "worker":
+        # Same data-plane contract: validated here, applied by the
+        # out-of-process shard worker via worker_fault().
+        parse_worker(spec.arg)
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -418,41 +451,49 @@ class ShardFault(NamedTuple):
     batch: Optional[int]
 
 
-def parse_shard(arg: Optional[str]) -> ShardFault:
-    """Parse the argument of a ``shard`` fault spec."""
+def _parse_shard_addressed(arg: Optional[str], kind: str,
+                           modes: Tuple[str, ...]
+                           ) -> Tuple[str, int, Optional[int]]:
+    """Shared parser for the ``mode@shardK[,batchN]`` spec shape the
+    ``shard`` and ``worker`` kinds both use."""
     if not arg or "@" not in arg:
         raise ValueError(
-            f"{ENV_FAULT}=shard needs 'mode@shardK[,batchN]' "
-            f"(mode: {'|'.join(SHARD_MODES)})")
+            f"{ENV_FAULT}={kind} needs 'mode@shardK[,batchN]' "
+            f"(mode: {'|'.join(modes)})")
     mode, _, where = arg.partition("@")
     mode = mode.strip().lower()
-    if mode not in SHARD_MODES:
-        raise ValueError(f"unknown shard fault mode {mode!r} "
-                         f"(want {'|'.join(SHARD_MODES)})")
+    if mode not in modes:
+        raise ValueError(f"unknown {kind} fault mode {mode!r} "
+                         f"(want {'|'.join(modes)})")
     shard_s, _, batch_s = where.partition(",")
     shard_s = shard_s.strip().lower()
     batch_s = batch_s.strip().lower()
     if not shard_s.startswith("shard"):
-        raise ValueError(f"shard fault needs 'shardK', got {shard_s!r}")
+        raise ValueError(f"{kind} fault needs 'shardK', got {shard_s!r}")
     try:
         shard = int(shard_s[5:])
     except ValueError as e:
-        raise ValueError(f"bad shard in shard fault: {shard_s!r}") from e
+        raise ValueError(f"bad shard in {kind} fault: {shard_s!r}") from e
     if shard < 0:
-        raise ValueError(f"shard fault shard must be >= 0, got {shard}")
+        raise ValueError(f"{kind} fault shard must be >= 0, got {shard}")
     batch: Optional[int] = None
     if batch_s:
         if not batch_s.startswith("batch"):
             raise ValueError(
-                f"shard fault qualifier must be 'batchN', got {batch_s!r}")
+                f"{kind} fault qualifier must be 'batchN', got {batch_s!r}")
         try:
             batch = int(batch_s[5:])
         except ValueError as e:
-            raise ValueError(f"bad batch in shard fault: {batch_s!r}") from e
+            raise ValueError(f"bad batch in {kind} fault: {batch_s!r}") from e
         if batch < 0:
             raise ValueError(
-                f"shard fault batch must be >= 0, got {batch}")
-    return ShardFault(mode, shard, batch)
+                f"{kind} fault batch must be >= 0, got {batch}")
+    return mode, shard, batch
+
+
+def parse_shard(arg: Optional[str]) -> ShardFault:
+    """Parse the argument of a ``shard`` fault spec."""
+    return ShardFault(*_parse_shard_addressed(arg, "shard", SHARD_MODES))
 
 
 def shard_fault() -> Optional[ShardFault]:
@@ -465,6 +506,41 @@ def shard_fault() -> Optional[ShardFault]:
     if parsed.kind != "shard":
         return None
     return parse_shard(parsed.arg)
+
+
+# --- worker (out-of-process shard) faults: real process-level failures ----
+
+WORKER_MODES = ("kill", "hang", "eof", "garbage")
+
+
+class WorkerFault(NamedTuple):
+    """Parsed ``worker:mode@shardK[,batchN]`` spec.  ``shard`` is the
+    worker's shard index (one REAL process fault domain); ``batch`` the
+    sub-batch sequence number at which the worker injures itself (None
+    = first opportunity), so the same spec hits the same stream point
+    in an uninterrupted run and in a restart-and-retransmit run."""
+
+    mode: str            # kill | hang | eof | garbage
+    shard: int
+    batch: Optional[int]
+
+
+def parse_worker(arg: Optional[str]) -> WorkerFault:
+    """Parse the argument of a ``worker`` fault spec."""
+    return WorkerFault(*_parse_shard_addressed(arg, "worker",
+                                               WORKER_MODES))
+
+
+def worker_fault() -> Optional[WorkerFault]:
+    """The env-configured worker fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "worker":
+        return None
+    return parse_worker(parsed.arg)
 
 
 # --- picklable callable faults (spawned-child targets for tests) ---------

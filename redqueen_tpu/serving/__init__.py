@@ -45,43 +45,8 @@ Every failure mode runs deterministically in CI on CPU via
 
 from __future__ import annotations
 
-from . import cluster, events, ingest, journal, metrics, service, state  # noqa: F401
-from .cluster import (
-    CLUSTER_SCHEMA,
-    ClusterAdmission,
-    ClusterDecision,
-    RESHARD_SCHEMA,
-    ServingCluster,
-    ShardRouter,
-    partition,
-    reshard,
-    shard_seed,
-)
-from .events import EventBatch, IngestError, synthetic_stream, validate_batch
-from .ingest import Sequencer
-from .journal import JOURNAL_SCHEMA, Journal, JournalError, tear_tail
-from .metrics import (
-    CLUSTER_METRICS_SCHEMA,
-    ClusterMetrics,
-    METRICS_SCHEMA,
-    ServingMetrics,
-)
-from .service import (
-    Admission,
-    CONFIG_SCHEMA,
-    RecoveryInfo,
-    ServingRuntime,
-    journal_decisions,
-    recover,
-)
-from .state import (
-    Decision,
-    FeedState,
-    init_feed_state,
-    make_apply_fn,
-    poison_edge,
-    state_digest,
-)
+import os as _os
+
 __all__ = [
     "EventBatch",
     "IngestError",
@@ -123,24 +88,73 @@ __all__ = [
     "cluster_final_payload",
 ]
 
-# ``stream`` is served lazily (PEP 562): eager import would trip runpy's
-# found-in-sys.modules warning on every ``python -m
-# redqueen_tpu.serving.stream`` invocation (the module doubles as the
-# CLI entry point).  (``corpus`` is importable directly; it is not
-# re-exported here for the same -m reason.)
+# ``stream`` and ``worker`` are served lazily (PEP 562): eager import
+# would trip runpy's found-in-sys.modules warning on every ``python -m
+# redqueen_tpu.serving.{stream,worker}`` invocation (both double as CLI
+# entry points).  (``corpus`` is importable directly; it is not
+# re-exported here for the same -m reason.)  Everything else in
+# ``_LAZY_ATTRS`` (name -> owning submodule) is THE definition of the
+# re-exported surface: the eager loop at the bottom and the PEP 562
+# fallback both read it, so a new export is added exactly once and
+# behaves identically on both the normal and the minimal-import
+# (RQ_SERVING_WORKER=1 worker-child) path.
 _STREAM_NAMES = ("stream", "drive", "FINAL_SCHEMA",
                  "CLUSTER_FINAL_SCHEMA", "cluster_final_payload")
+# Never imported eagerly: ``worker`` doubles as a -m entry point (the
+# runpy reason above) and ``transport`` only matters to worker-placement
+# code that imports it by module path anyway.
+_LAZY_ONLY = ("worker", "transport")
+_LAZY_ATTRS = {
+    "worker": None, "transport": None,
+    "cluster": None, "events": None, "ingest": None, "journal": None,
+    "metrics": None, "service": None, "state": None,
+    "CLUSTER_SCHEMA": ".cluster", "ClusterAdmission": ".cluster",
+    "ClusterDecision": ".cluster", "RESHARD_SCHEMA": ".cluster",
+    "ServingCluster": ".cluster", "ShardRouter": ".cluster",
+    "partition": ".cluster", "reshard": ".cluster",
+    "shard_seed": ".cluster",
+    "EventBatch": ".events", "IngestError": ".events",
+    "synthetic_stream": ".events", "validate_batch": ".events",
+    "Sequencer": ".ingest",
+    "JOURNAL_SCHEMA": ".journal", "Journal": ".journal",
+    "JournalError": ".journal", "tear_tail": ".journal",
+    "CLUSTER_METRICS_SCHEMA": ".metrics", "ClusterMetrics": ".metrics",
+    "METRICS_SCHEMA": ".metrics", "ServingMetrics": ".metrics",
+    "Admission": ".service", "CONFIG_SCHEMA": ".service",
+    "RecoveryInfo": ".service", "ServingRuntime": ".service",
+    "journal_decisions": ".service", "recover": ".service",
+    "Decision": ".state", "FeedState": ".state",
+    "init_feed_state": ".state", "make_apply_fn": ".state",
+    "poison_edge": ".state", "state_digest": ".state",
+}
 
 
 def __getattr__(name):
-    if name in _STREAM_NAMES:
-        import importlib
+    import importlib
 
-        # import_module (not ``from . import``): the fromlist protocol
-        # getattrs the package for the submodule and would re-enter this
-        # hook before the import finishes binding the attribute.
+    # import_module (not ``from . import``): the fromlist protocol
+    # getattrs the package for the submodule and would re-enter this
+    # hook before the import finishes binding the attribute.
+    if name in _STREAM_NAMES:
         _stream = importlib.import_module(".stream", __name__)
         if name == "stream":
             return _stream
         return getattr(_stream, name)
+    if name in _LAZY_ATTRS:
+        target = _LAZY_ATTRS[name]
+        if target is None:  # a submodule
+            return importlib.import_module("." + name, __name__)
+        return getattr(importlib.import_module(target, __name__), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# Worker children (RQ_SERVING_WORKER=1) skip the eager jax-pulling
+# imports (cluster -> service -> state -> jax); the package __getattr__
+# above resolves every public name lazily, so the surface is identical
+# — a worker subprocess just doesn't PAY for it until its shard loads.
+# See redqueen_tpu/__init__ for the same guard one level up.
+if not _os.environ.get("RQ_SERVING_WORKER"):
+    for _n in _LAZY_ATTRS:
+        if _n not in _LAZY_ONLY:
+            globals()[_n] = __getattr__(_n)
+    del _n

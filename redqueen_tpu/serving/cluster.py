@@ -48,6 +48,33 @@ CPU via ``runtime.faultinject``'s ``shard`` kinds
 :meth:`ServingCluster.kill_shard` is the same teardown as an operator
 chaos hook.
 
+**Worker placement (out-of-process shards).**  ``placement="workers"``
+moves every fault domain into its own SUBPROCESS
+(:mod:`serving.worker`): the shard directory layout, journals,
+snapshots, and recovery protocol are unchanged on disk — in-process and
+worker placements are interchangeable and bit-identical — but the crash
+domain becomes REAL: a SIGSEGV/OOM/SIGKILL in one shard is a child
+exit the router observes, not a cluster death, and the N per-shard
+journal fsyncs run in N processes in parallel instead of serializing
+behind one GIL.  The router drives each worker over the checksummed
+frame protocol (:mod:`serving.transport`) with split
+``start_*``/``finish_*`` calls, so submits and polls fan out to every
+worker before any response is collected — that overlap is the
+parallel-serving win.  Failure classification maps transport shapes
+onto the SAME health state machine: a request deadline expiry or stale
+heartbeat is a timeout (degrade + backoff, quarantine-and-SIGKILL
+after ``QUARANTINE_AFTER``), a child exit / pipe EOF is a crash, and a
+poisoned byte stream (checksum/magic/desync, or a worker-side error
+reply) tears the worker down — never a router crash, never a
+silently-trusted payload.  A dead worker is restarted under the
+``runtime.supervisor`` :class:`~redqueen_tpu.runtime.supervisor
+.RetryPolicy` (crash-loop exponential backoff, give-up →
+quarantined-for-the-operator) and recovers IN PLACE from its own
+journal while the survivors keep serving.  Worker-level faults
+(``RQ_FAULT=worker:kill|hang|eof|garbage@shardK[,batchN]``) are applied
+by the worker child itself at exact sub-batch seqs, so the
+SIGKILL-a-real-process chaos scenario runs deterministically on CPU.
+
 **Reshard (grow without genesis replay).**  :func:`reshard` migrates a
 drained N-shard directory to M shards by per-edge state migration: the
 per-edge ``(rank, health)`` carry, the cluster clock, and the stream
@@ -75,16 +102,24 @@ import numpy as np
 
 from ..runtime import faultinject as _faultinject
 from ..runtime import integrity as _integrity
+from ..runtime.supervisor import RetryPolicy
 from .events import EventBatch, IngestError, validate_batch
 from .metrics import ClusterMetrics
 from .service import (RecoveryInfo, ServingRuntime, SNAPSHOTS_DIRNAME,
                       recover as _recover_runtime)
+from .transport import TransportError, TransportTimeout
+
+# NOTE: serving.worker is imported lazily (in _spawn_worker) — it
+# doubles as a ``python -m`` entry point, and an eager import here
+# would trip runpy's found-in-sys.modules warning on every manual
+# invocation.
 
 __all__ = ["ServingCluster", "ShardRouter", "ClusterAdmission",
            "ClusterDecision", "partition", "shard_seed", "reshard",
            "CLUSTER_SCHEMA", "RESHARD_SCHEMA", "PARTITION_VERSION",
-           "HEALTHY", "DEGRADED", "QUARANTINED", "HEAL_AFTER",
-           "QUARANTINE_AFTER", "WEDGE_FIRES", "MAX_BACKOFF_ROUNDS"]
+           "PLACEMENTS", "HEALTHY", "DEGRADED", "QUARANTINED",
+           "HEAL_AFTER", "QUARANTINE_AFTER", "WEDGE_FIRES",
+           "MAX_BACKOFF_ROUNDS", "DEFAULT_RESTART_POLICY"]
 
 CLUSTER_SCHEMA = "rq.serving.cluster/1"
 RESHARD_SCHEMA = "rq.serving.reshard/1"
@@ -104,6 +139,23 @@ QUARANTINE_AFTER = 3    # consecutive timeouts: degraded -> quarantined
 WEDGE_FIRES = 2         # injected-wedge timeouts before the stall clears
 MAX_BACKOFF_ROUNDS = 8  # cap on the wedged-shard poll-round backoff
 RECOVERY_GIVE_UP = 3    # failed auto-recoveries before poll() raises
+
+# Shard placement modes: every fault domain lives in the router's
+# process ("in-process", PR 7) or in its own supervised subprocess
+# ("workers").  Interchangeable on disk — NOT part of the directory
+# identity.
+PLACEMENTS = ("in-process", "workers")
+
+# Worker restart schedule (placement="workers"): the runtime.supervisor
+# RetryPolicy drives the crash-loop backoff — restart n of a crash
+# streak waits delay(n), and max_attempts consecutive FAILED recoveries
+# is the give-up bound (the shard stays quarantined and poll() raises
+# for the operator).  seed=0: the jitter — and with it the whole chaos
+# timeline — is deterministic in CI.
+DEFAULT_RESTART_POLICY = RetryPolicy(
+    max_attempts=RECOVERY_GIVE_UP, base_delay_s=0.25, multiplier=2.0,
+    max_delay_s=10.0, jitter=0.1, seed=0)
+_CRASH_STREAK_CAP = 10  # backoff exponent cap (delay saturates anyway)
 
 
 def _mix64(x: np.ndarray) -> np.ndarray:
@@ -182,7 +234,8 @@ class _ShardSlot:
 
     __slots__ = ("k", "dir", "feeds", "s_slice", "runtime", "health",
                  "fail_streak", "clean_streak", "skip_rounds",
-                 "recover_failures", "outstanding")
+                 "recover_failures", "crash_streak", "restart_at",
+                 "outstanding")
 
     def __init__(self, k: int, dir: Optional[str], feeds: np.ndarray,
                  s_slice: np.ndarray):
@@ -190,12 +243,17 @@ class _ShardSlot:
         self.dir = dir
         self.feeds = feeds          # global feed ids owned (ascending)
         self.s_slice = s_slice
-        self.runtime: Optional[ServingRuntime] = None
+        # In-process: a ServingRuntime.  Worker placement: a
+        # WorkerHandle presenting the same surface over the frame
+        # protocol.  None = quarantined (no live fault domain).
+        self.runtime: Optional[Any] = None
         self.health = HEALTHY
         self.fail_streak = 0
         self.clean_streak = 0
         self.skip_rounds = 0
         self.recover_failures = 0
+        self.crash_streak = 0       # consecutive crashes since last heal
+        self.restart_at = 0.0       # worker restart gate (RetryPolicy)
         # seq -> (arrival stamp, n_events): accepted but not yet applied
         # (mirrors the shard's queue + reorder window; reclassified
         # lost_on_crash if the carry dies under them)
@@ -211,8 +269,24 @@ class ServingCluster:
                  s_sink: Optional[np.ndarray] = None, seed: int = 0,
                  start_seq: int = 0, snapshot_every: int = 8,
                  reorder_window: int = 8, queue_capacity: int = 64,
-                 max_batch_events: int = 256, clock=time.monotonic,
+                 max_batch_events: int = 256, fsync_every_n: int = 1,
+                 placement: str = "in-process",
+                 restart_policy: Optional[RetryPolicy] = None,
+                 worker_request_timeout_s: float = 30.0,
+                 worker_open_timeout_s: float = 300.0,
+                 worker_heartbeat_every_s: float = 1.0,
+                 worker_heartbeat_timeout_s: float = 30.0,
+                 worker_read_timeout_s: float = 5.0,
+                 clock=time.monotonic,
                  auto_recover: bool = True, _open_runtimes: bool = True):
+        if placement not in PLACEMENTS:
+            raise ValueError(f"placement must be one of {PLACEMENTS}, "
+                             f"got {placement!r}")
+        if placement == "workers" and dir is None:
+            raise ValueError(
+                "placement='workers' needs a cluster directory — a "
+                "worker subprocess owns its shard's on-disk state; an "
+                "in-memory fault domain cannot leave the process")
         self.n_feeds = int(n_feeds)
         self.n_shards = int(n_shards)
         self.dir = dir
@@ -223,6 +297,19 @@ class ServingCluster:
         self.reorder_window = int(reorder_window)
         self.queue_capacity = int(queue_capacity)
         self.max_batch_events = int(max_batch_events)
+        if int(fsync_every_n) < 1:
+            raise ValueError(
+                f"fsync_every_n must be >= 1, got {fsync_every_n}")
+        self.fsync_every_n = int(fsync_every_n)
+        self.placement = placement
+        self.restart_policy = restart_policy or DEFAULT_RESTART_POLICY
+        self._restart_rng = self.restart_policy.rng()
+        self.worker_request_timeout_s = float(worker_request_timeout_s)
+        self.worker_open_timeout_s = float(worker_open_timeout_s)
+        self.worker_heartbeat_every_s = float(worker_heartbeat_every_s)
+        self.worker_heartbeat_timeout_s = float(
+            worker_heartbeat_timeout_s)
+        self.worker_read_timeout_s = float(worker_read_timeout_s)
         self.auto_recover = bool(auto_recover)
         self._clock = clock
         s = (np.ones(n_feeds) if s_sink is None
@@ -257,12 +344,35 @@ class ServingCluster:
                 f"RQ_FAULT targets shard {self._fault.shard} but this "
                 f"cluster has {self.n_shards} shard(s) (valid: 0.."
                 f"{self.n_shards - 1}) — the fault could never fire")
+        if self._fault is not None and self.placement == "workers":
+            raise ValueError(
+                f"RQ_FAULT=shard:{self._fault.mode} is applied by the "
+                f"IN-PROCESS router and could never fire under "
+                f"placement='workers' — use the worker:* kinds (the "
+                f"worker child injures itself at the same seqs)")
+        wfault = _faultinject.worker_fault()
+        if wfault is not None:
+            if self.placement != "workers":
+                raise ValueError(
+                    f"RQ_FAULT=worker:{wfault.mode} targets an "
+                    f"out-of-process shard worker but this cluster runs "
+                    f"placement={self.placement!r} — the fault could "
+                    f"never fire")
+            if wfault.shard >= self.n_shards:
+                raise ValueError(
+                    f"RQ_FAULT targets worker shard {wfault.shard} but "
+                    f"this cluster has {self.n_shards} shard(s) (valid: "
+                    f"0..{self.n_shards - 1}) — the fault could never "
+                    f"fire")
         self._fault_spent = False
         self._wedge_left = WEDGE_FIRES
 
         if _open_runtimes:
-            for slot in self._slots:
-                slot.runtime = self._fresh_runtime(slot)
+            if self.placement == "workers":
+                self._open_workers(recover=False)
+            else:
+                for slot in self._slots:
+                    slot.runtime = self._fresh_runtime(slot)
 
     # ---- construction / config identity ----
 
@@ -276,6 +386,12 @@ class ServingCluster:
             "queue_capacity": self.queue_capacity,
             "max_batch_events": self.max_batch_events,
             "partition_version": PARTITION_VERSION,
+            # Durability knob (group commit) — recorded so recover()
+            # reuses it, EXCLUDED from the identity refusal below: it
+            # changes when records hit media, never what they say.
+            # (placement is likewise not identity: in-process and
+            # worker modes are interchangeable over the same directory.)
+            "fsync_every_n": self.fsync_every_n,
         }
 
     def _check_or_write_config(self) -> None:
@@ -307,11 +423,78 @@ class ServingCluster:
             start_seq=self.start_seq, snapshot_every=self.snapshot_every,
             reorder_window=self.reorder_window,
             queue_capacity=self.queue_capacity,
-            max_batch_events=self.max_batch_events, clock=self._clock)
+            max_batch_events=self.max_batch_events,
+            fsync_every_n=self.fsync_every_n, clock=self._clock)
+
+    # ---- worker placement plumbing ----
+
+    def _worker_config(self, slot: _ShardSlot) -> Dict[str, Any]:
+        """The ``open`` request payload — the exact ServingRuntime
+        constructor args :meth:`_fresh_runtime` would use, so the two
+        placements build bit-identical shard state."""
+        return {"n_feeds": int(len(slot.feeds)), "q": self.q,
+                "s_sink": [float(x) for x in slot.s_slice],
+                "seed": shard_seed(self.seed, slot.k),
+                "start_seq": self.start_seq,
+                "snapshot_every": self.snapshot_every,
+                "reorder_window": self.reorder_window,
+                "queue_capacity": self.queue_capacity,
+                "max_batch_events": self.max_batch_events,
+                "fsync_every_n": self.fsync_every_n}
+
+    def _spawn_worker(self, slot: _ShardSlot) -> "WorkerHandle":  # noqa: F821
+        from .worker import WorkerHandle
+
+        return WorkerHandle.spawn(
+            slot.dir, slot.k,
+            heartbeat_every_s=self.worker_heartbeat_every_s,
+            request_timeout_s=self.worker_request_timeout_s,
+            open_timeout_s=self.worker_open_timeout_s,
+            read_timeout_s=self.worker_read_timeout_s,
+            clock=self._clock)
+
+    def _open_workers(self, recover: bool) -> List[RecoveryInfo]:
+        """Spawn one worker per shard and open/recover them ALL in
+        flight (the fan-out parallelism the placement exists for: N
+        jax imports + first compiles overlap instead of serializing).
+        Any failure tears every worker down and raises — a cluster
+        must come up whole or not at all."""
+        infos: List[RecoveryInfo] = []
+        try:
+            for slot in self._slots:
+                slot.runtime = self._spawn_worker(slot)
+            pending = []
+            for slot in self._slots:
+                h = slot.runtime
+                pending.append((slot, h.start_recover() if recover
+                                else h.start_open(
+                                    self._worker_config(slot))))
+            for slot, rid in pending:
+                if recover:
+                    infos.append(slot.runtime.finish_recover(rid))
+                else:
+                    slot.runtime.finish_open(rid)
+        except (TransportError, OSError) as e:
+            for slot in self._slots:
+                if slot.runtime is not None:
+                    slot.runtime.kill()
+                    slot.runtime = None
+            raise RuntimeError(
+                f"worker cluster failed to "
+                f"{'recover' if recover else 'open'}: "
+                f"{type(e).__name__}: {e}") from e
+        return infos
 
     @classmethod
     def recover(cls, dir: str, clock=time.monotonic,
-                auto_recover: bool = True
+                auto_recover: bool = True,
+                placement: str = "in-process",
+                restart_policy: Optional[RetryPolicy] = None,
+                worker_request_timeout_s: float = 30.0,
+                worker_open_timeout_s: float = 300.0,
+                worker_heartbeat_every_s: float = 1.0,
+                worker_heartbeat_timeout_s: float = 30.0,
+                worker_read_timeout_s: float = 5.0,
                 ) -> Tuple["ServingCluster", List[RecoveryInfo]]:
         """Rebuild a cluster from its directory after a crash: read the
         enveloped cluster config, then :func:`serving.service.recover`
@@ -320,7 +503,10 @@ class ServingCluster:
         killed at different points recover to different seqs; the
         source's retransmit of everything past :attr:`applied_seq`
         (the cluster min) reconverges them — duplicate drop absorbs the
-        rest."""
+        rest.  ``placement`` picks where the recovered shards live (the
+        directory does not care — either placement recovers the other's
+        state bit-identically); with ``"workers"`` every shard recovers
+        in its own subprocess, all in flight."""
         cfg = _integrity.read_json(os.path.join(dir, _CLUSTER_CONFIG),
                                    schema=CLUSTER_SCHEMA)
         if cfg.get("partition_version") != PARTITION_VERSION:
@@ -338,8 +524,17 @@ class ServingCluster:
                  reorder_window=int(cfg["reorder_window"]),
                  queue_capacity=int(cfg["queue_capacity"]),
                  max_batch_events=int(cfg["max_batch_events"]),
+                 fsync_every_n=int(cfg.get("fsync_every_n", 1)),
+                 placement=placement, restart_policy=restart_policy,
+                 worker_request_timeout_s=worker_request_timeout_s,
+                 worker_open_timeout_s=worker_open_timeout_s,
+                 worker_heartbeat_every_s=worker_heartbeat_every_s,
+                 worker_heartbeat_timeout_s=worker_heartbeat_timeout_s,
+                 worker_read_timeout_s=worker_read_timeout_s,
                  clock=clock, auto_recover=auto_recover,
                  _open_runtimes=False)
+        if placement == "workers":
+            return cl, cl._open_workers(recover=True)
         infos: List[RecoveryInfo] = []
         for slot in cl._slots:
             rt, info = _recover_runtime(slot.dir, clock=clock)
@@ -393,33 +588,73 @@ class ServingCluster:
         seq = int(batch.seq)
         subs = self._split_batch(batch)
         now = self._clock()
-        statuses: List[str] = []
+        statuses: List[Optional[str]] = [None] * self.n_shards
         backpressure = False
-        for slot in self._slots:
-            self.metrics.observe_submitted(slot.k)
-            if slot.runtime is None:
-                statuses.append("unavailable")
-                self.metrics.observe_shed_unavailable(slot.k, seq)
-                backpressure = True
-                continue
-            sub = subs[slot.k]
-            adm = slot.runtime.submit(sub, _validated=True)
-            statuses.append(adm.status)
-            backpressure |= adm.backpressure
-            if adm.status == "accepted":
-                if seq in slot.outstanding:
-                    # retransmit of a batch still held in the shard's
-                    # reorder window: redundant delivery, not durable —
-                    # the ledger counts the extra submission a duplicate
-                    self.metrics.observe_duplicate(slot.k)
-                else:
-                    slot.outstanding[seq] = (now, sub.n_events)
-            elif adm.status == "duplicate":
-                self.metrics.observe_duplicate(slot.k)
-            elif adm.status == "shed":
-                self.metrics.observe_shed_queue(slot.k, seq)
-            else:  # "rejected" — per-shard validation (shouldn't happen
-                self.metrics.observe_rejected(slot.k)  # post-global)
+        if self.placement == "workers":
+            # Fan the sub-batches out to EVERY live worker before
+            # collecting any admission — N journal fsyncs in flight at
+            # once (the parallel-ingest win).  A worker that dies
+            # mid-submit is torn down and its slice shed-with-seq: the
+            # sub-batch was never acked, so the source retransmits it
+            # (if the worker did journal it first, the retransmit comes
+            # back "duplicate" — an ack, absorbed).
+            sent: List[Tuple[_ShardSlot, int]] = []
+            for slot in self._slots:
+                self.metrics.observe_submitted(slot.k)
+                if slot.runtime is None:
+                    statuses[slot.k] = "unavailable"
+                    self.metrics.observe_shed_unavailable(slot.k, seq)
+                    backpressure = True
+                    continue
+                try:
+                    sent.append((slot,
+                                 slot.runtime.start_submit(subs[slot.k])))
+                except TransportError as e:
+                    self._crash_slot(
+                        slot, f"worker died on submit send: {e}")
+                    statuses[slot.k] = "unavailable"
+                    self.metrics.observe_shed_unavailable(slot.k, seq)
+                    backpressure = True
+            for slot, rid in sent:
+                try:
+                    adm = slot.runtime.finish_submit(rid)
+                except TransportTimeout as e:
+                    # Alive but past the deadline (e.g. still inside a
+                    # long apply the previous poll round timed out on):
+                    # degrade + backoff, never SIGKILL a busy worker.
+                    # The slice is not acked — the source retransmits
+                    # it and duplicate drop absorbs any overshoot if
+                    # the worker did journal it before answering late.
+                    self._on_timeout(slot, f"submit deadline expired: "
+                                           f"{e}")
+                    statuses[slot.k] = "unavailable"
+                    self.metrics.observe_shed_unavailable(slot.k, seq)
+                    backpressure = True
+                    continue
+                except TransportError as e:
+                    self._crash_slot(
+                        slot, f"submit to worker failed: "
+                              f"{type(e).__name__}: {e}")
+                    statuses[slot.k] = "unavailable"
+                    self.metrics.observe_shed_unavailable(slot.k, seq)
+                    backpressure = True
+                    continue
+                statuses[slot.k] = adm.status
+                backpressure |= self._note_admission(
+                    slot, adm, subs[slot.k].n_events, seq, now)
+        else:
+            for slot in self._slots:
+                self.metrics.observe_submitted(slot.k)
+                if slot.runtime is None:
+                    statuses[slot.k] = "unavailable"
+                    self.metrics.observe_shed_unavailable(slot.k, seq)
+                    backpressure = True
+                    continue
+                sub = subs[slot.k]
+                adm = slot.runtime.submit(sub, _validated=True)
+                statuses[slot.k] = adm.status
+                backpressure |= self._note_admission(
+                    slot, adm, sub.n_events, seq, now)
         if all(st in ("accepted", "duplicate") for st in statuses):
             status = "accepted"
         elif all(st in ("shed", "unavailable") for st in statuses):
@@ -429,6 +664,26 @@ class ServingCluster:
         return ClusterAdmission(status, seq=seq,
                                 backpressure=backpressure,
                                 per_shard=tuple(statuses))
+
+    def _note_admission(self, slot: _ShardSlot, adm, n_events: int,
+                        seq: int, now: float) -> bool:
+        """Ledger one sub-batch admission (both placements share this
+        exactly); returns the admission's backpressure bit."""
+        if adm.status == "accepted":
+            if seq in slot.outstanding:
+                # retransmit of a batch still held in the shard's
+                # reorder window: redundant delivery, not durable —
+                # the ledger counts the extra submission a duplicate
+                self.metrics.observe_duplicate(slot.k)
+            else:
+                slot.outstanding[seq] = (now, int(n_events))
+        elif adm.status == "duplicate":
+            self.metrics.observe_duplicate(slot.k)
+        elif adm.status == "shed":
+            self.metrics.observe_shed_queue(slot.k, seq)
+        else:  # "rejected" — per-shard validation (shouldn't happen
+            self.metrics.observe_rejected(slot.k)  # post-global)
+        return bool(adm.backpressure)
 
     # ---- routing: the apply path (health-aware dispatch) ----
 
@@ -443,6 +698,8 @@ class ServingCluster:
         they were already drained by the time recovery runs, and their
         admissions never depend on the dead shard).  Returns the
         per-shard decision lists."""
+        if self.placement == "workers":
+            return self._poll_workers(max_batches_per_shard)
         out: Dict[int, List[Any]] = {}
         for slot in self._slots:
             if slot.runtime is None:
@@ -460,6 +717,111 @@ class ServingCluster:
                 continue
             out[slot.k] = self._poll_slot(slot, max_batches_per_shard)
         return out
+
+    def _poll_workers(self, max_batches: Optional[int]
+                      ) -> Dict[int, List[Any]]:
+        """One worker-placement dispatch round: liveness-check every
+        slot (child exit = crash, stale heartbeat = hang), fan
+        ``poll`` out to every serviceable worker, THEN collect — the
+        N workers apply and fsync concurrently while the router waits
+        once.  Transport failures classify onto the same health state
+        machine as in-process faults; a quarantined worker restarts
+        under the RetryPolicy gate and recovers from its own journal
+        while the survivors' requests are already in flight."""
+        out: Dict[int, List[Any]] = {k: [] for k in
+                                     range(self.n_shards)}
+        dispatch: List[Tuple[_ShardSlot, int]] = []
+        for slot in self._slots:
+            if slot.runtime is None:
+                if self.auto_recover \
+                        and self._clock() >= slot.restart_at:
+                    self._try_auto_recover(slot)
+                if slot.runtime is None:
+                    continue
+            if slot.skip_rounds > 0:
+                slot.skip_rounds -= 1  # backoff: the wedged shard rests
+                continue
+            h = slot.runtime
+            # Crash detection via child exit: cheaper and earlier than
+            # discovering the EOF on the next request.
+            if not h.alive():
+                self._crash_slot(
+                    slot, f"worker process exited "
+                          f"rc={h.proc.returncode}")
+                continue
+            h.drain_beats()
+            self._salvage_stale(slot, out[slot.k])
+            # Heartbeat-staleness hang detection: the worker owes a
+            # beat every worker_heartbeat_every_s even when idle — an
+            # age past the bound means the child is alive but wedged.
+            age = h.beat_age()
+            if age > self.worker_heartbeat_timeout_s:
+                self._on_timeout(
+                    slot, f"worker heartbeat stale {age:.1f}s > "
+                          f"{self.worker_heartbeat_timeout_s:.1f}s")
+                continue
+            try:
+                dispatch.append((slot, h.start_poll(max_batches)))
+            except TransportError as e:
+                self._crash_slot(slot,
+                                 f"worker died on poll send: {e}")
+        for slot, rid in dispatch:
+            h = slot.runtime
+            try:
+                ds = h.finish_poll(rid)
+            except TransportTimeout:
+                # The wedged-worker shape: the request deadline expired
+                # with the child still running.  The sub-batch stays
+                # queued worker-side; degrade, back off, retry — and
+                # a late answer is salvaged by id, never misattributed.
+                self._on_timeout(
+                    slot, f"poll deadline "
+                          f"{h.request_timeout_s:.1f}s expired "
+                          f"(worker alive but unresponsive)")
+                continue
+            except TransportError as e:
+                # EOF (died mid-response — torn frame included),
+                # FrameError (poisoned byte stream), or WorkerOpError
+                # (the worker's runtime raised): the fault domain
+                # cannot be trusted mid-stream — SIGKILL + quarantine,
+                # recovery from durable state only.
+                self._crash_slot(
+                    slot,
+                    f"poll failed: {type(e).__name__}: {e}")
+                continue
+            self._observe_decisions(slot, ds, out[slot.k], clean=True)
+            self._salvage_stale(slot, out[slot.k])
+        return out
+
+    def _observe_decisions(self, slot: _ShardSlot, decisions: List[Any],
+                           into: List[Any], clean: bool) -> None:
+        """Ledger applied decisions (both placements share this
+        exactly): pop the outstanding seq, observe latency/events,
+        collect the decision, and count clean applies toward heal."""
+        for d in decisions:
+            arrival = slot.outstanding.pop(int(d.seq), None)
+            latency = (None if arrival is None
+                       else self._clock() - arrival[0])
+            n_events = 0 if arrival is None else arrival[1]
+            self.metrics.observe_applied(slot.k, n_events, d.post,
+                                         latency)
+            into.append(d)
+            if clean:
+                self._on_clean(slot)
+
+    def _salvage_stale(self, slot: _ShardSlot,
+                       into: List[Any]) -> None:
+        """Ledger the decisions of poll responses that answered after
+        their request timed out: the worker APPLIED and JOURNALED those
+        batches, so the router must observe them or the accounting
+        identity would leak.  Late answers are not evidence of health
+        (``clean=False``) — the shard heals on in-deadline replies."""
+        if slot.runtime is None:
+            return
+        for value in slot.runtime.drain_stale_polls():
+            ds = [slot.runtime._decision(d)
+                  for d in value.get("decisions", [])]
+            self._observe_decisions(slot, ds, into, clean=False)
 
     def _poll_slot(self, slot: _ShardSlot,
                    max_batches: Optional[int]) -> List[Any]:
@@ -511,14 +873,7 @@ class ServingCluster:
                     slot, f"journal append torn at sub-batch {d.seq} "
                           f"(injected)")
                 break
-            arrival = slot.outstanding.pop(int(d.seq), None)
-            latency = (None if arrival is None
-                       else self._clock() - arrival[0])
-            n_events = 0 if arrival is None else arrival[1]
-            self.metrics.observe_applied(slot.k, n_events, d.post,
-                                         latency)
-            decisions.append(d)
-            self._on_clean(slot)
+            self._observe_decisions(slot, [d], decisions, clean=True)
             if fire:  # crash | corrupt_snapshot: batch d.seq was acked
                 self._fault_spent = True
                 if fault.mode == "corrupt_snapshot":
@@ -538,6 +893,9 @@ class ServingCluster:
             if slot.clean_streak >= HEAL_AFTER:
                 slot.health = HEALTHY
                 slot.clean_streak = 0
+                # A heal ends the crash loop: the restart backoff
+                # schedule starts over at the next (unrelated) crash.
+                slot.crash_streak = 0
 
     def _on_timeout(self, slot: _ShardSlot, reason: str) -> None:
         slot.fail_streak += 1
@@ -560,14 +918,31 @@ class ServingCluster:
         rt, slot.runtime = slot.runtime, None
         slot.health = QUARANTINED
         slot.fail_streak = slot.clean_streak = slot.skip_rounds = 0
+        slot.crash_streak += 1
+        if self.placement == "workers":
+            # Crash-loop backoff (runtime.supervisor RetryPolicy): the
+            # n-th crash of a streak gates its restart delay(n) out —
+            # a worker that dies on every recovery can't hot-loop the
+            # spawn+jax-import cost.  A heal resets the streak.
+            slot.restart_at = self._clock() + self.restart_policy.delay(
+                min(slot.crash_streak, _CRASH_STREAK_CAP),
+                self._restart_rng)
         if rt is not None:
-            # Releases the journal fd only — every acknowledged record
-            # was already fsynced; the carry/queue/reorder window are
-            # dropped un-flushed, exactly the SIGKILL leave-behind.
-            try:
-                rt.close()
-            except OSError:
-                pass
+            teardown = getattr(rt, "kill", None)
+            if teardown is not None:
+                # A worker handle: SIGKILL the real process (wedged or
+                # poisoned children don't get a graceful goodbye) and
+                # close the pipes.  Never raises.
+                teardown()
+            else:
+                # In-process: releases the journal fd only — every
+                # acknowledged record was already fsynced; the carry/
+                # queue/reorder window are dropped un-flushed, exactly
+                # the SIGKILL leave-behind.
+                try:
+                    rt.close()
+                except OSError:
+                    pass
         for seq in sorted(slot.outstanding):
             self.metrics.observe_lost_on_crash(slot.k, seq)
         slot.outstanding.clear()
@@ -597,13 +972,24 @@ class ServingCluster:
             self.recover_shard(slot.k)
         except Exception as e:  # noqa: BLE001 — a failed recovery must
             # not take down the healthy shards; back off and retry, give
-            # up loudly after RECOVERY_GIVE_UP attempts.
+            # up loudly after the bound (RECOVERY_GIVE_UP in process,
+            # the RetryPolicy's max_attempts for worker restarts).
             slot.recover_failures += 1
-            slot.skip_rounds = MAX_BACKOFF_ROUNDS
+            if self.placement == "workers":
+                give_up = self.restart_policy.max_attempts
+                slot.restart_at = (self._clock()
+                                   + self.restart_policy.delay(
+                                       min(slot.crash_streak
+                                           + slot.recover_failures,
+                                           _CRASH_STREAK_CAP),
+                                       self._restart_rng))
+            else:
+                give_up = RECOVERY_GIVE_UP
+                slot.skip_rounds = MAX_BACKOFF_ROUNDS
             self.metrics.observe_crash(
                 slot.k, f"recovery attempt {slot.recover_failures} "
                         f"failed: {e}")
-            if slot.recover_failures >= RECOVERY_GIVE_UP:
+            if slot.recover_failures >= give_up:
                 raise RuntimeError(
                     f"shard {slot.k} failed {slot.recover_failures} "
                     f"recovery attempts (last: {e}) — the fault domain "
@@ -625,7 +1011,10 @@ class ServingCluster:
         """Recover quarantined shard ``k`` in place: newest provable
         snapshot + digest-asserted journal replay (bit-identical carry
         and decision stream), then probation (``degraded`` until
-        ``HEAL_AFTER`` clean applies).  Healthy shards are untouched."""
+        ``HEAL_AFTER`` clean applies).  Healthy shards are untouched —
+        under worker placement they are literally other processes, so
+        the replacement worker's spawn + jax import + replay never
+        blocks their serving."""
         slot = self._slots[k]
         if slot.runtime is not None:
             raise ValueError(f"shard {k} is not quarantined")
@@ -634,7 +1023,18 @@ class ServingCluster:
                 f"shard {k} has no directory — an in-memory cluster "
                 f"cannot recover a crashed fault domain")
         t0 = self._clock()
-        rt, info = _recover_runtime(slot.dir, clock=self._clock)
+        if self.placement == "workers":
+            handle = self._spawn_worker(slot)
+            try:
+                info = handle.finish_recover(handle.start_recover())
+            except TransportError as e:
+                handle.kill()
+                raise RuntimeError(
+                    f"replacement worker for shard {k} failed to "
+                    f"recover: {type(e).__name__}: {e}") from e
+            rt = handle
+        else:
+            rt, info = _recover_runtime(slot.dir, clock=self._clock)
         ms = (self._clock() - t0) * 1e3
         slot.runtime = rt
         slot.health = DEGRADED
@@ -645,15 +1045,33 @@ class ServingCluster:
 
     # ---- read / inspection paths ----
 
+    def _slot_pending(self, slot: _ShardSlot) -> int:
+        """One shard's pending count; a worker that died since the last
+        round classifies as a crash here (its pending died with it —
+        the outstanding seqs were reclassified lost)."""
+        if slot.runtime is None:
+            return 0
+        try:
+            return int(slot.runtime.pending)
+        except TransportTimeout as e:
+            # The short read deadline expired with the child alive —
+            # busy or stalled, not proven dead: degrade and back off,
+            # exactly like a poll deadline.  SIGKILLing a healthy
+            # worker over one slow read would convert a hiccup into a
+            # full journal-replay recovery.
+            self._on_timeout(slot, f"status read timed out: {e}")
+            return 0
+        except TransportError as e:
+            self._crash_slot(slot, f"worker died on status: {e}")
+            return 0
+
     @property
     def pending(self) -> int:
-        return sum(s.runtime.pending for s in self._slots
-                   if s.runtime is not None)
+        return sum(self._slot_pending(s) for s in self._slots)
 
     @property
     def pending_by_shard(self) -> List[int]:
-        return [0 if s.runtime is None else s.runtime.pending
-                for s in self._slots]
+        return [self._slot_pending(s) for s in self._slots]
 
     @property
     def health_by_shard(self) -> List[str]:
@@ -672,8 +1090,23 @@ class ServingCluster:
         """The cluster's acknowledged stream position: the MIN applied
         seq over shards (a quarantined shard counts -1 — everything
         must be retransmitted until it recovers and reports)."""
-        return min((-1 if s.runtime is None else s.runtime.applied_seq)
-                   for s in self._slots)
+        seqs = []
+        for s in self._slots:
+            if s.runtime is None:
+                seqs.append(-1)
+                continue
+            try:
+                seqs.append(int(s.runtime.applied_seq))
+            except TransportTimeout as e:
+                # Alive but slow: degrade (see _slot_pending) and
+                # report -1 — the source retransmits, duplicate drop
+                # absorbs any overshoot once the shard answers again.
+                self._on_timeout(s, f"status read timed out: {e}")
+                seqs.append(-1)
+            except TransportError as e:
+                self._crash_slot(s, f"worker died on status: {e}")
+                seqs.append(-1)
+        return min(seqs)
 
     def decide(self) -> Optional[ClusterDecision]:
         """The non-blocking cluster read: aggregate the latest applied
@@ -685,7 +1118,19 @@ class ServingCluster:
         for slot in self._slots:
             if slot.runtime is None:
                 continue
-            d = slot.runtime.decide()
+            try:
+                d = slot.runtime.decide()
+            except TransportTimeout as e:
+                # Alive but past the short read deadline: one fewer
+                # reporter THIS read, degrade + backoff — never a
+                # SIGKILL over a slow answer.
+                self._on_timeout(slot, f"decide read timed out: {e}")
+                continue
+            except TransportError as e:
+                # A dead worker degrades the read (one fewer reporter),
+                # never blocks it.
+                self._crash_slot(slot, f"worker died on decide: {e}")
+                continue
             if d is not None:
                 per.append(d)
         if not per:
@@ -703,9 +1148,20 @@ class ServingCluster:
                                    if s.runtime is None))
 
     def shard_digests(self) -> Dict[int, Optional[str]]:
-        return {s.k: (None if s.runtime is None
-                      else s.runtime.state_digest())
-                for s in self._slots}
+        out: Dict[int, Optional[str]] = {}
+        for s in self._slots:
+            if s.runtime is None:
+                out[s.k] = None
+                continue
+            try:
+                out[s.k] = s.runtime.state_digest()
+            except TransportTimeout as e:
+                self._on_timeout(s, f"digest read timed out: {e}")
+                out[s.k] = None
+            except TransportError as e:
+                self._crash_slot(s, f"worker died on digest: {e}")
+                out[s.k] = None
+        return out
 
     def cluster_digest(self,
                        digests: Optional[Dict[int, Optional[str]]] = None
@@ -730,10 +1186,11 @@ class ServingCluster:
                                      int]:
         """Assemble the global per-edge carry ``(rank, health)`` plus
         the stream position ``(seq, cluster clock, n_batches)`` from the
-        live shards — one explicit device→host boundary per shard.
-        Requires every shard live and at the SAME seq (drained)."""
-        import jax
-
+        live shards.  ``ServingRuntime.gather`` owns the one explicit
+        device→host boundary per shard; ``WorkerHandle.gather`` answers
+        it bit-identically over the frame protocol, so both placements
+        produce byte-equal edge digests.  Requires every shard live and
+        at the SAME seq (drained)."""
         rank = np.zeros(self.n_feeds, np.float32)
         health = np.zeros(self.n_feeds, np.uint32)
         seqs, ts, nbs = [], [], []
@@ -742,9 +1199,7 @@ class ServingCluster:
                 raise ValueError(
                     f"shard {slot.k} is quarantined — recover before "
                     f"gathering edge state")
-            st = slot.runtime.carry
-            r, h, sq, t, nb = jax.device_get(
-                (st.rank, st.health, st.seq, st.t, st.n_batches))
+            r, h, sq, t, nb = slot.runtime.gather()
             rank[slot.feeds] = r
             health[slot.feeds] = h
             seqs.append(int(sq))
@@ -773,8 +1228,17 @@ class ServingCluster:
     # ---- durability / artifacts ----
 
     def snapshot_all(self) -> Dict[int, Optional[int]]:
-        return {s.k: s.runtime.snapshot() for s in self._slots
-                if s.runtime is not None}
+        out: Dict[int, Optional[int]] = {}
+        for s in self._slots:
+            if s.runtime is None:
+                continue
+            try:
+                out[s.k] = s.runtime.snapshot()
+            except TransportTimeout as e:
+                self._on_timeout(s, f"snapshot deadline expired: {e}")
+            except TransportError as e:
+                self._crash_slot(s, f"worker died on snapshot: {e}")
+        return out
 
     def write_metrics(self, path: Optional[str] = None,
                       extra: Optional[Dict[str, Any]] = None
@@ -807,7 +1271,14 @@ class ServingCluster:
                 f"pending — drain (poll) first")
         for slot in self._slots:
             if slot.runtime is not None:
-                slot.runtime.reset_metrics()
+                try:
+                    slot.runtime.reset_metrics()
+                except TransportTimeout as e:
+                    self._on_timeout(
+                        slot, f"reset_metrics timed out: {e}")
+                except TransportError as e:
+                    self._crash_slot(
+                        slot, f"worker died on reset_metrics: {e}")
             slot.outstanding.clear()
         self.metrics = ClusterMetrics(self.n_shards, clock=self._clock)
 
@@ -870,7 +1341,8 @@ def reshard(src_dir: str, dst_dir: str, n_shards: int,
         snapshot_every=int(cfg["snapshot_every"]),
         reorder_window=int(cfg["reorder_window"]),
         queue_capacity=int(cfg["queue_capacity"]),
-        max_batch_events=int(cfg["max_batch_events"]), clock=clock)
+        max_batch_events=int(cfg["max_batch_events"]),
+        fsync_every_n=int(cfg.get("fsync_every_n", 1)), clock=clock)
     try:
         for slot in dst._slots:
             st = slot.runtime.carry

@@ -93,14 +93,18 @@ def serve_corpus(csv_path: str, dir: Optional[str], n_shards: int,
                  batch_events: int = 512, engine: str = "auto",
                  max_rows: Optional[int] = None, seed: int = 0,
                  q: float = 1.0, snapshot_every: int = 256,
-                 queue_capacity: int = 64, clock=time.monotonic,
+                 queue_capacity: int = 64,
+                 placement: str = "in-process", clock=time.monotonic,
                  log=None) -> dict:
     """End-to-end corpus serving: load (native C++ loader when it
     builds), merge, batch, and drive the full stream through a sharded
     :class:`~redqueen_tpu.serving.cluster.ServingCluster` (submit+poll
     per batch — the steady-state serving shape, journal fsync in the
-    measured path when ``dir`` is given).  Returns the summary payload
-    (also landed as ``<dir>/corpus.json`` when ``dir`` is set)."""
+    measured path when ``dir`` is given).  ``placement="workers"``
+    replays through out-of-process shard workers (requires ``dir``) —
+    same batches, bit-identical decisions, N-process parallel applies.
+    Returns the summary payload (also landed as ``<dir>/corpus.json``
+    when ``dir`` is set)."""
     from ..data import traces as traces_mod
     from ..native import loader as native_loader
     from .cluster import ServingCluster
@@ -124,7 +128,7 @@ def serve_corpus(csv_path: str, dir: Optional[str], n_shards: int,
     cl = ServingCluster(
         n_feeds=n_feeds, n_shards=n_shards, dir=dir, q=q, seed=seed,
         snapshot_every=snapshot_every, queue_capacity=queue_capacity,
-        max_batch_events=batch_events, clock=clock)
+        max_batch_events=batch_events, placement=placement, clock=clock)
     n_batches = 0
     t1 = clock()
     with cl:
@@ -209,12 +213,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--q", type=float, default=1.0)
     ap.add_argument("--snapshot-every", type=int, default=256)
+    ap.add_argument("--workers", action="store_true",
+                    help="replay through out-of-process shard workers "
+                         "(requires --dir; serving.worker)")
     args = ap.parse_args(argv)
+    if args.workers and args.dir is None:
+        ap.error("--workers needs --dir (a worker subprocess owns its "
+                 "shard's on-disk state)")
     payload = serve_corpus(
         args.csv, args.dir, args.shards,
         batch_events=args.batch_events, engine=args.engine,
         max_rows=args.max_rows, seed=args.seed, q=args.q,
         snapshot_every=args.snapshot_every,
+        placement="workers" if args.workers else "in-process",
         log=lambda *a: print(*a, file=sys.stderr, flush=True))
     import json
 

@@ -116,7 +116,7 @@ class ServingRuntime:
                  dir: Optional[str] = None, start_seq: int = 0,
                  snapshot_every: int = 8, reorder_window: int = 8,
                  queue_capacity: int = 64, max_batch_events: int = 256,
-                 clock=time.monotonic,
+                 fsync_every_n: int = 1, clock=time.monotonic,
                  _state: Optional[FeedState] = None):
         import jax.numpy as jnp
 
@@ -147,6 +147,10 @@ class ServingRuntime:
         self.snapshot_every = int(snapshot_every)
         self.queue_capacity = int(queue_capacity)
         self.max_batch_events = int(max_batch_events)
+        if int(fsync_every_n) < 1:
+            raise ValueError(
+                f"fsync_every_n must be >= 1, got {fsync_every_n}")
+        self.fsync_every_n = int(fsync_every_n)
         self._clock = clock
         self._s_sink = jnp.asarray(s, jnp.float32)
         self._q = jnp.asarray(self.q, jnp.float32)
@@ -181,6 +185,11 @@ class ServingRuntime:
                 "reorder_window": int(reorder_window),
                 "queue_capacity": self.queue_capacity,
                 "max_batch_events": self.max_batch_events,
+                # Durability knob, NOT replay identity: group-commit
+                # changes when records hit media, never what they say —
+                # so it is recorded (recover() reuses it) but excluded
+                # from the mismatch refusal below.
+                "fsync_every_n": self.fsync_every_n,
             }
             if os.path.exists(cfg_path):
                 # The stored config is the directory's identity: the
@@ -207,7 +216,8 @@ class ServingRuntime:
             else:
                 _integrity.write_json(cfg_path, cfg,
                                       schema=CONFIG_SCHEMA)
-            self._journal = Journal(os.path.join(dir, _JOURNAL))
+            self._journal = Journal(os.path.join(dir, _JOURNAL),
+                                    fsync_every_n=self.fsync_every_n)
 
     # ---- ingest path ----
 
@@ -496,7 +506,8 @@ class ServingRuntime:
             steps = [int(n) for n in os.listdir(snap_dir) if n.isdigit()]
             if steps:
                 _journal_mod.prune_segments(path, min(steps))
-            self._journal = Journal(path)
+            self._journal = Journal(path,
+                                    fsync_every_n=self.fsync_every_n)
         return seq
 
     def write_metrics(self, path: Optional[str] = None) -> Dict[str, Any]:
@@ -515,6 +526,21 @@ class ServingRuntime:
 
     def state_digest(self) -> str:
         return state_digest(self._state)
+
+    def gather(self) -> Tuple[np.ndarray, np.ndarray, int, float, int]:
+        """The per-edge carry as host arrays — ``(rank f32[F], health
+        u32[F], seq, t, n_batches)`` through ONE explicit device→host
+        boundary.  The cluster's edge-digest / reshard paths drive this
+        uniformly for in-process runtimes and out-of-process workers
+        (``serving.worker.WorkerHandle.gather`` answers bit-identically
+        over the frame protocol)."""
+        import jax
+
+        st = self._state
+        r, h, sq, t, nb = jax.device_get(
+            (st.rank, st.health, st.seq, st.t, st.n_batches))
+        return (np.asarray(r, np.float32), np.asarray(h, np.uint32),
+                int(sq), float(t), int(nb))
 
     def close(self) -> None:
         if self._journal is not None:
@@ -600,7 +626,9 @@ def recover(dir: str, clock=time.monotonic
         snapshot_every=int(cfg["snapshot_every"]),
         reorder_window=int(cfg["reorder_window"]),
         queue_capacity=int(cfg["queue_capacity"]),
-        max_batch_events=E, clock=clock, _state=state)
+        max_batch_events=E,
+        fsync_every_n=int(cfg.get("fsync_every_n", 1)),
+        clock=clock, _state=state)
     rt._last_decision = last_decision
     info = RecoveryInfo(
         snapshot_seq=step, replayed=replayed, skipped=skipped, torn=torn,

@@ -30,6 +30,15 @@ Cluster mode additionally honors the ``shard:*`` fault kinds
 those (exit 0) — one fault domain dies and recovers in place while the
 others keep serving, which is the chaos acceptance scenario.
 
+``--workers`` moves every shard into its own supervised subprocess
+(``serving.worker``; requires ``--shards``) — same directories, same
+journals, bit-identical decisions, REAL crash domains.  There the
+``worker:kill|hang|eof|garbage@shardK[,batchN]`` kinds apply (each
+worker child injures itself at the addressed sub-batch seq); the driver
+survives those too (exit 0) — a SIGKILLed worker is restarted under the
+RetryPolicy and recovers from its own journal while the survivor
+processes keep serving in parallel.
+
 On a clean finish the driver lands ``<dir>/final.json`` — schema
 ``rq.serving.final/1`` (single) or ``rq.serving.cluster.final/1``
 (cluster: cluster + per-shard digests, the partition-independent edge
@@ -80,10 +89,13 @@ def _delivery_order(batches: List[EventBatch],
 
 
 def drive(rt: ServingRuntime, batches: List[EventBatch],
-          fault=None, max_retransmit_rounds: int = 4) -> None:
+          fault=None, max_retransmit_rounds: int = 4,
+          retry_delay_s: float = 0.3) -> None:
     """Deliver ``batches`` (fault-shaped), drain, and retransmit until
     the runtime has applied everything it was offered or the retransmit
     budget is exhausted (then the gap is the caller's to assert on)."""
+    import time as _time
+
     for b in _delivery_order(batches, fault):
         rt.submit(b)
         rt.poll()
@@ -95,6 +107,12 @@ def drive(rt: ServingRuntime, batches: List[EventBatch],
         missing = [b for b in batches if int(b.seq) > rt.applied_seq]
         if not missing:
             break
+        # A real source's retransmit arrives later in wall time; the
+        # delay also lets a crashed WORKER pass its RetryPolicy restart
+        # gate (the in-process recovery path is synchronous and never
+        # needs it — this only runs when batches are actually missing).
+        if retry_delay_s:
+            _time.sleep(retry_delay_s)
         for b in missing:
             rt.submit(b)
             rt.poll()
@@ -165,6 +183,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="run a sharded ServingCluster with N fault "
                          "domains instead of the single-domain runtime "
                          "(0 = single); shard:* faults apply here")
+    ap.add_argument("--workers", action="store_true",
+                    help="with --shards: place every fault domain in "
+                         "its own supervised subprocess (serving."
+                         "worker) — real crash domains, parallel "
+                         "journal fsyncs; worker:* faults apply here "
+                         "(--in-process is the default placement)")
+    ap.add_argument("--in-process", dest="workers", action="store_false",
+                    help="keep all shards in this process (default; "
+                         "the PR 7 placement)")
     ap.add_argument("--resume", action="store_true",
                     help="recover from --dir (snapshot + journal "
                          "replay) instead of starting fresh, then "
@@ -176,9 +203,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     batches = synthetic_stream(args.seed, args.batches, args.feeds,
                                events_per_batch=args.events_per_batch)
 
+    if args.workers and not args.shards:
+        ap.error("--workers needs --shards N (worker placement is a "
+                 "cluster mode)")
     if args.shards:
+        placement = "workers" if args.workers else "in-process"
         if args.resume:
-            cl, infos = ServingCluster.recover(args.dir)
+            cl, infos = ServingCluster.recover(args.dir,
+                                               placement=placement)
             for k, info in enumerate(infos):
                 print(f"recovered shard {k}: "
                       f"snapshot_seq={info.snapshot_seq} "
@@ -192,7 +224,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 seed=args.seed, dir=args.dir,
                 snapshot_every=args.snapshot_every,
                 reorder_window=args.window,
-                queue_capacity=args.queue_capacity)
+                queue_capacity=args.queue_capacity,
+                placement=placement)
         with cl:
             drive(cl, batches, fault=fault)
             cl.write_metrics()

@@ -1,0 +1,198 @@
+"""Length-prefixed, checksummed frame protocol between the shard router
+and its out-of-process workers.
+
+One frame = ``MAGIC(4) | length(u32 BE) | crc32(u32 BE) | payload`` with
+a UTF-8 JSON payload.  The checksum covers the payload bytes, so a
+bit-flip in transit is a DETECTED :class:`FrameError`, never a silently
+trusted message; a declared length past :data:`MAX_FRAME_BYTES` is
+refused before a single payload byte is read (a garbage length field
+must not drive an allocation).  Frames ride ordinary pipes — the worker
+owns one pipe pair per process, which is exactly the fault-domain
+boundary: a SIGKILLed worker is an EOF, a wedged one is a timeout, a
+corrupted one is a checksum mismatch, and each maps to its own typed
+error so the router can degrade that one shard instead of guessing.
+
+Error taxonomy (all subclass :class:`TransportError`):
+
+- :class:`FrameError`      — the byte stream is poisoned (bad magic,
+  checksum mismatch, oversized declared length, non-JSON payload, or a
+  protocol-level desync).  The connection cannot be resynchronized —
+  the router must tear the worker down.
+- :class:`TransportEOF`    — the peer closed the pipe (clean after a
+  frame boundary, or torn mid-frame: ``partial_bytes`` says which).
+- :class:`TransportTimeout` — no complete frame before the deadline
+  (the wedged-worker shape; the peer may still be alive).
+
+Stdlib only; safe to import before jax — the worker child stays
+importable without a backend until it loads its shard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import struct
+import time
+import zlib
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "MAGIC",
+    "HEADER_BYTES",
+    "MAX_FRAME_BYTES",
+    "TransportError",
+    "FrameError",
+    "TransportEOF",
+    "TransportTimeout",
+    "encode_frame",
+    "write_frame",
+    "FrameReader",
+]
+
+MAGIC = b"RQF1"
+_HEADER = struct.Struct(">4sII")  # magic, payload length, crc32(payload)
+HEADER_BYTES = _HEADER.size
+# Generous bound (a million-edge gather is ~20 MB of JSON) that still
+# refuses a garbage length field before it drives an allocation.
+MAX_FRAME_BYTES = 64 << 20
+
+
+class TransportError(RuntimeError):
+    """Base of every worker-transport failure."""
+
+
+class FrameError(TransportError):
+    """The byte stream is poisoned (bad magic / checksum / length /
+    payload, or a response that violates the request protocol).  There
+    is no way to find the next frame boundary in a corrupt stream, so
+    the connection must be torn down, never resynchronized by guess."""
+
+
+class TransportEOF(TransportError):
+    """The peer closed the pipe.  ``partial_bytes`` > 0 means the close
+    tore a frame mid-transmission (the crash-mid-response shape)."""
+
+    def __init__(self, message: str, partial_bytes: int = 0):
+        self.partial_bytes = int(partial_bytes)
+        super().__init__(message)
+
+
+class TransportTimeout(TransportError):
+    """No complete frame arrived before the deadline — the peer may be
+    wedged (distinct from dead: EOF) or merely slow."""
+
+
+def encode_frame(payload: Dict[str, Any]) -> bytes:
+    """One frame as bytes: header + JSON payload.  ``allow_nan`` stays
+    on (Python json round-trips NaN/Inf) — serving carries quarantined
+    non-finite ranks through ``gather`` frames."""
+    body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise FrameError(
+            f"refusing to send a {len(body)}-byte frame "
+            f"(MAX_FRAME_BYTES={MAX_FRAME_BYTES})")
+    return _HEADER.pack(MAGIC, len(body), zlib.crc32(body)) + body
+
+
+def write_frame(fd: int, payload: Dict[str, Any]) -> None:
+    """Write one frame to a pipe fd.  A single writer per pipe by
+    construction (the worker's main loop / the router's handle), so
+    frames never interleave; short writes are completed in a loop."""
+    data = encode_frame(payload)
+    view = memoryview(data)
+    while view:
+        n = os.write(fd, view)
+        view = view[n:]
+
+
+class FrameReader:
+    """Buffered frame reader over a pipe fd with deadline support.
+
+    One instance owns the read side; :meth:`read_frame` returns the next
+    decoded payload dict or raises the typed transport errors above.
+    ``timeout_s=None`` blocks, ``0`` polls (used to drain heartbeat
+    frames without waiting)."""
+
+    def __init__(self, fd: int, clock=time.monotonic):
+        self._fd = fd
+        self._buf = bytearray()
+        self._clock = clock
+        self._eof = False
+
+    def _fill(self, deadline: Optional[float]) -> bool:
+        """Pull more bytes; False on timeout, raises on EOF with data
+        pending (torn frame handled by the caller)."""
+        if self._eof:
+            return True
+        if deadline is not None:
+            # Clamp, never early-return: an expired (or zero) deadline
+            # must still POLL the fd once — ``timeout_s=0`` is the
+            # heartbeat-drain contract, and frames already delivered to
+            # the pipe must be readable without waiting.
+            remaining = max(0.0, deadline - self._clock())
+            r, _, _ = select.select([self._fd], [], [], remaining)
+            if not r:
+                return False
+        chunk = os.read(self._fd, 1 << 16)
+        if not chunk:
+            self._eof = True
+        else:
+            self._buf.extend(chunk)
+        return True
+
+    def read_frame(self, timeout_s: Optional[float] = None
+                   ) -> Dict[str, Any]:
+        """Next payload dict.  Raises :class:`TransportTimeout` when no
+        complete frame lands in ``timeout_s``, :class:`TransportEOF` on
+        a closed pipe (``partial_bytes`` set for a torn frame), and
+        :class:`FrameError` for every corruption shape."""
+        deadline = (None if timeout_s is None
+                    else self._clock() + float(timeout_s))
+        while True:
+            frame = self._try_decode()
+            if frame is not None:
+                return frame
+            if self._eof:
+                n = len(self._buf)
+                raise TransportEOF(
+                    f"peer closed the pipe"
+                    + (f" mid-frame ({n} torn bytes pending)" if n
+                       else ""), partial_bytes=n)
+            if not self._fill(deadline):
+                raise TransportTimeout(
+                    f"no complete frame within {timeout_s}s "
+                    f"({len(self._buf)} bytes buffered)")
+
+    def _try_decode(self) -> Optional[Dict[str, Any]]:
+        if len(self._buf) < HEADER_BYTES:
+            return None
+        magic, length, crc = _HEADER.unpack_from(self._buf)
+        if magic != MAGIC:
+            raise FrameError(
+                f"bad frame magic {bytes(magic)!r} (want {MAGIC!r}) — "
+                f"the stream is poisoned")
+        if length > MAX_FRAME_BYTES:
+            raise FrameError(
+                f"declared frame length {length} exceeds "
+                f"MAX_FRAME_BYTES={MAX_FRAME_BYTES} — refusing before "
+                f"reading the payload")
+        if len(self._buf) < HEADER_BYTES + length:
+            return None
+        body = bytes(self._buf[HEADER_BYTES:HEADER_BYTES + length])
+        del self._buf[:HEADER_BYTES + length]
+        got = zlib.crc32(body)
+        if got != crc:
+            raise FrameError(
+                f"frame checksum mismatch (crc32 {got:#010x} != "
+                f"declared {crc:#010x}) — payload corrupted in transit")
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise FrameError(
+                f"frame payload is not valid JSON: {e}") from e
+        if not isinstance(payload, dict):
+            raise FrameError(
+                f"frame payload must be an object, got "
+                f"{type(payload).__name__}")
+        return payload

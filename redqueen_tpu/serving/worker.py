@@ -1,0 +1,640 @@
+"""Out-of-process shard workers: real crash domains for the serving
+cluster.
+
+PR 7's fault domains were in-process simulations — one Python process,
+one GIL, one fsync queue, one fate: a real SIGSEGV/OOM in any shard
+still killed the whole cluster, and every "crash" the chaos suite
+proved was an in-process teardown.  This module moves each shard into a
+SUBPROCESS worker that owns its ``shard-KKKK/`` directory (journal,
+snapshots, sequencer — unchanged on disk, so in-process and worker
+placement are interchangeable and recovery stays digest-asserted and
+bit-identical) and speaks the :mod:`~redqueen_tpu.serving.transport`
+frame protocol over its stdin/stdout pipes:
+
+- **Child** (``python -m redqueen_tpu.serving.worker --dir D --shard
+  K``): jax-free until the first ``open``/``recover`` request loads its
+  shard (the watchdog-process import discipline); serves one request at
+  a time in lockstep, emits heartbeat frames when idle so the router
+  can tell idle-alive from dead, and redirects fd 1 to stderr at
+  startup so no stray ``print`` can poison the frame stream.
+- **Router side** (:class:`WorkerHandle`): spawn / open / recover,
+  request-response with ids (stale responses from a recovered timeout
+  are discarded by id, never misattributed), per-request deadlines,
+  heartbeat-age tracking, pipelined ``start_poll``/``finish_poll`` for
+  true fan-out parallelism, and a SIGKILL teardown for poisoned or
+  quarantined workers.  The handle presents the same surface the
+  cluster router drives on an in-process ``ServingRuntime`` (submit /
+  poll / decide / snapshot / digest / gather), so
+  ``serving.cluster.ServingCluster`` treats both placements through one
+  code path and the on-disk state stays the single source of truth.
+
+Worker-level faults (``RQ_FAULT=worker:kill|hang|eof|garbage@shardK
+[,batchN]``, :mod:`runtime.faultinject`) are applied by the worker
+ITSELF at exact sub-batch sequence numbers, so SIGKILL-a-real-process,
+wedged-worker-timeout, torn-frame, and protocol-garbage paths all run
+deterministically on CPU in CI.
+
+Module-level imports are stdlib + numpy + the jax-free serving pieces
+only; everything that pulls jax loads lazily when a shard does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime import faultinject as _faultinject
+from .events import EventBatch
+from .transport import (FrameError, FrameReader, TransportEOF,
+                        TransportError, TransportTimeout, encode_frame,
+                        write_frame)
+
+__all__ = ["WorkerHandle", "WorkerOpError", "main",
+           "HANG_FIRES", "ENV_HANG_FIRES",
+           "DEFAULT_REQUEST_TIMEOUT_S", "DEFAULT_OPEN_TIMEOUT_S",
+           "DEFAULT_HEARTBEAT_EVERY_S", "DEFAULT_READ_TIMEOUT_S"]
+
+# An injected hang drops (never answers) this many requests targeting
+# its batch, then the worker serves normally — bounded like the
+# router's WEDGE_FIRES so the stream reconverges: fires < the router's
+# QUARANTINE_AFTER means degrade+backoff+heal; the env override drives
+# the quarantine->SIGKILL->restart path in tests.
+HANG_FIRES = 2
+ENV_HANG_FIRES = "RQ_WORKER_HANG_FIRES"
+
+DEFAULT_REQUEST_TIMEOUT_S = 60.0
+# open/recover pay the jax import + first-apply compile; a crashed
+# worker's replacement pays it again mid-serve, so the bound is its own.
+DEFAULT_OPEN_TIMEOUT_S = 300.0
+DEFAULT_HEARTBEAT_EVERY_S = 1.0
+# The cheap read ops (decide / status) get their own, much shorter
+# deadline: they are the cluster's never-blocks read path — a wedged
+# worker must cost a read milliseconds-to-seconds, not the full apply
+# budget.
+DEFAULT_READ_TIMEOUT_S = 5.0
+
+
+class WorkerOpError(TransportError):
+    """The worker answered a request with ``ok=false`` — its runtime
+    raised (journal-append failure, open/recover error, ...).  The
+    shard's fault domain can no longer be trusted mid-stream; the
+    router treats it like a crash."""
+
+    def __init__(self, op: str, error: str, message: str):
+        self.op = op
+        self.error = error
+        super().__init__(f"worker {op} failed: {error}: {message}")
+
+
+# ---------------------------------------------------------------------------
+# The worker child
+# ---------------------------------------------------------------------------
+
+
+def _decision_dict(d) -> Dict[str, Any]:
+    return {"seq": int(d.seq), "post": bool(d.post),
+            "post_time": float(d.post_time),
+            "intensity": float(d.intensity)}
+
+
+class _Worker:
+    """One shard's serving loop behind the frame protocol.  Owns the
+    runtime from ``open``/``recover`` on; one request at a time."""
+
+    def __init__(self, dir: str, shard: int, proto_fd: int,
+                 heartbeat_every_s: float):
+        self.dir = dir
+        self.shard = int(shard)
+        self.proto_fd = proto_fd
+        self.hb_every = float(heartbeat_every_s)
+        self.rt = None
+        self._reader = FrameReader(sys.stdin.fileno())
+        fault = _faultinject.worker_fault()
+        self._fault = (fault if fault is not None
+                       and fault.shard == self.shard else None)
+        self._hang_left = int(os.environ.get(ENV_HANG_FIRES, HANG_FIRES))
+        self._poison_response = False  # garbage fault armed this reply
+
+    # -- protocol plumbing --
+
+    def _beat(self) -> None:
+        write_frame(self.proto_fd, {"kind": "beat", "pid": os.getpid()})
+
+    def _respond(self, req_id: int, value: Any, op: str) -> None:
+        # ``op`` is echoed so the router can salvage a STALE poll
+        # response (one that answered a request the router already timed
+        # out on) instead of dropping applied decisions on the floor.
+        frame = {"kind": "resp", "id": req_id, "op": op, "ok": True,
+                 "value": value}
+        if self._poison_response:
+            # The garbage fault: non-protocol bytes instead of the
+            # response — no magic, no checksum; the router's FrameReader
+            # must refuse them and kill this (still running) process.
+            self._poison_response = False
+            os.write(self.proto_fd, b"\x00\xffGARBAGE-NOT-A-FRAME" * 16)
+            return
+        write_frame(self.proto_fd, frame)
+
+    def _fail(self, req_id: int, op: str, e: BaseException) -> None:
+        write_frame(self.proto_fd, {
+            "kind": "resp", "id": req_id, "op": op, "ok": False,
+            "error": type(e).__name__, "message": str(e)})
+
+    # -- fault helpers --
+
+    def _fires(self, seq: int) -> bool:
+        f = self._fault
+        return f is not None and (f.batch is None or f.batch == int(seq))
+
+    # -- request handlers --
+
+    def _handle_open(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        from .service import ServingRuntime
+
+        cfg = req["config"]
+        self.rt = ServingRuntime(
+            n_feeds=int(cfg["n_feeds"]), q=float(cfg["q"]),
+            s_sink=np.asarray(cfg["s_sink"], np.float64),
+            seed=int(cfg["seed"]), dir=self.dir,
+            start_seq=int(cfg["start_seq"]),
+            snapshot_every=int(cfg["snapshot_every"]),
+            reorder_window=int(cfg["reorder_window"]),
+            queue_capacity=int(cfg["queue_capacity"]),
+            max_batch_events=int(cfg["max_batch_events"]),
+            fsync_every_n=int(cfg.get("fsync_every_n", 1)))
+        return {"applied_seq": self.rt.applied_seq, "pid": os.getpid()}
+
+    def _handle_recover(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        from .service import recover
+
+        self.rt, info = recover(self.dir)
+        return {"applied_seq": self.rt.applied_seq, "pid": os.getpid(),
+                "info": {"snapshot_seq": info.snapshot_seq,
+                         "replayed": info.replayed,
+                         "skipped": info.skipped,
+                         "torn": info.torn,
+                         "recovered_seq": info.recovered_seq}}
+
+    def _handle_submit(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        batch = EventBatch(int(req["seq"]),
+                           np.asarray(req["times"], np.float64),
+                           np.asarray(req["feeds"], np.int32))
+        adm = self.rt.submit(batch, _validated=True)
+        return {"status": adm.status, "seq": adm.seq,
+                "backpressure": adm.backpressure, "reason": adm.reason,
+                "missing": list(adm.missing)}
+
+    def _handle_poll(self, req: Dict[str, Any]
+                     ) -> Optional[Dict[str, Any]]:
+        """Apply queued sub-batches one at a time so worker faults land
+        at exact sequence numbers.  Returns None when the request must
+        be DROPPED (the injected hang: the router's deadline expires)."""
+        max_b = req.get("max_batches")
+        decisions: List[Dict[str, Any]] = []
+        while max_b is None or len(decisions) < int(max_b):
+            nq = self.rt.next_queued_seq()
+            if nq is None:
+                break
+            f = self._fault
+            if f is not None and f.mode == "hang" and self._fires(nq) \
+                    and self._hang_left > 0:
+                if decisions:
+                    # Report the progress already applied; wedge on the
+                    # next request, when the target batch heads the
+                    # queue — a dropped request never hides applied
+                    # decisions from the router's ledger.
+                    break
+                self._hang_left -= 1
+                if self._hang_left == 0:
+                    self._fault = None
+                print(f"worker {self.shard}: injected hang at sub-batch "
+                      f"{nq} (dropping the request)", file=sys.stderr,
+                      flush=True)
+                return None
+            ds = self.rt.poll(max_batches=1)
+            if not ds:
+                break
+            d = ds[0]
+            decisions.append(_decision_dict(d))
+            if f is not None and self._fires(d.seq):
+                if f.mode == "kill":
+                    # Batch d.seq is applied + journaled; the ack frame
+                    # never leaves — a REAL process crash domain.
+                    os.kill(os.getpid(), signal.SIGKILL)
+                elif f.mode == "eof":
+                    self._fault = None
+                    torn = encode_frame({
+                        "kind": "resp", "id": int(req["id"]),
+                        "op": "poll", "ok": True,
+                        "value": self._poll_value(decisions)})
+                    os.write(self.proto_fd, torn[:len(torn) // 2])
+                    os._exit(0)
+                elif f.mode == "garbage":
+                    self._fault = None
+                    self._poison_response = True
+        return self._poll_value(decisions)
+
+    def _poll_value(self, decisions: List[Dict[str, Any]]
+                    ) -> Dict[str, Any]:
+        return {"decisions": decisions, "pending": self.rt.pending,
+                "applied_seq": self.rt.applied_seq}
+
+    def _handle(self, req: Dict[str, Any]) -> Tuple[bool, Any]:
+        """Dispatch one request; returns ``(respond, value)``."""
+        op = req.get("op")
+        if op == "open":
+            return True, self._handle_open(req)
+        if op == "recover":
+            return True, self._handle_recover(req)
+        if op == "submit":
+            return True, self._handle_submit(req)
+        if op == "poll":
+            value = self._handle_poll(req)
+            return value is not None, value
+        if op == "decide":
+            d = self.rt.decide()
+            return True, {"decision": None if d is None
+                          else _decision_dict(d),
+                          "pending": self.rt.pending}
+        if op == "status":
+            return True, {"pending": self.rt.pending,
+                          "applied_seq": self.rt.applied_seq,
+                          "next_queued_seq": self.rt.next_queued_seq()}
+        if op == "snapshot":
+            return True, {"step": self.rt.snapshot()}
+        if op == "digest":
+            return True, {"digest": self.rt.state_digest()}
+        if op == "gather":
+            r, h, sq, t, nb = self.rt.gather()
+            return True, {"rank": [float(x) for x in r],
+                          "health": [int(x) for x in h],
+                          "seq": sq, "t": t, "n_batches": nb}
+        if op == "reset_metrics":
+            self.rt.reset_metrics()
+            return True, {}
+        raise ValueError(f"unknown worker op {op!r}")
+
+    def serve(self) -> int:
+        """The main loop: requests in lockstep, heartbeats when idle."""
+        while True:
+            try:
+                req = self._reader.read_frame(timeout_s=self.hb_every)
+            except TransportTimeout:
+                self._beat()
+                continue
+            except TransportEOF:
+                # Router went away: release the journal and exit clean.
+                if self.rt is not None:
+                    self.rt.close()
+                return 0
+            req_id = int(req.get("id", -1))
+            op = str(req.get("op"))
+            if op == "shutdown":
+                if self.rt is not None:
+                    self.rt.close()
+                self._respond(req_id, {}, op)
+                return 0
+            try:
+                respond, value = self._handle(req)
+            except Exception as e:  # noqa: BLE001 — classified router-side
+                self._fail(req_id, op, e)
+                continue
+            if respond:
+                self._respond(req_id, value, op)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m redqueen_tpu.serving.worker",
+        description="one shard fault domain as a subprocess worker "
+                    "(frame protocol on stdin/stdout; spawned by "
+                    "ServingCluster in worker placement)")
+    ap.add_argument("--dir", required=True,
+                    help="this shard's serving directory "
+                         "(<cluster>/shard-KKKK)")
+    ap.add_argument("--shard", type=int, required=True,
+                    help="shard index (worker:* fault addressing)")
+    ap.add_argument("--heartbeat-every", type=float,
+                    default=DEFAULT_HEARTBEAT_EVERY_S,
+                    help="idle heartbeat-frame interval, seconds")
+    args = ap.parse_args(argv)
+
+    # Claim fd 1 for the frame protocol and point everything that
+    # thinks it is printing to stdout at stderr instead — one stray
+    # print() (jax, a library, a debug line) must not poison the frame
+    # stream.
+    proto_fd = os.dup(1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    worker = _Worker(args.dir, args.shard, proto_fd,
+                     args.heartbeat_every)
+    worker._beat()  # birth announcement: the router's first liveness
+    return worker.serve()
+
+
+# ---------------------------------------------------------------------------
+# Router-side handle
+# ---------------------------------------------------------------------------
+
+
+class WorkerHandle:
+    """The router's end of one worker: spawn, lockstep request/response
+    with ids and deadlines, heartbeat-age tracking, SIGKILL teardown.
+    Presents the ``ServingRuntime`` surface the cluster router drives
+    (submit / poll / decide / snapshot / digest / gather / ...), plus
+    ``start_*``/``finish_*`` split calls so the router can fan a request
+    out to every worker before collecting any response — that overlap
+    IS the parallel-serving win."""
+
+    def __init__(self, proc: subprocess.Popen, shard: int,
+                 request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+                 open_timeout_s: float = DEFAULT_OPEN_TIMEOUT_S,
+                 read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                 clock=time.monotonic):
+        self.proc = proc
+        self.shard = int(shard)
+        self.request_timeout_s = float(request_timeout_s)
+        self.open_timeout_s = float(open_timeout_s)
+        self.read_timeout_s = float(read_timeout_s)
+        self._clock = clock
+        self._reader = FrameReader(proc.stdout.fileno(), clock=clock)
+        self._next_id = 0
+        self._last_frame_t = clock()
+        # Salvaged values of poll responses that answered a request the
+        # router already timed out on — their decisions were APPLIED and
+        # JOURNALED by the worker, so dropping them would desync the
+        # router's outstanding ledger.  The router drains these after
+        # every poll round (drain_stale_polls).
+        self._stale_polls: List[Dict[str, Any]] = []
+
+    @classmethod
+    def spawn(cls, dir: str, shard: int,
+              heartbeat_every_s: float = DEFAULT_HEARTBEAT_EVERY_S,
+              request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+              open_timeout_s: float = DEFAULT_OPEN_TIMEOUT_S,
+              read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+              env: Optional[Dict[str, str]] = None,
+              clock=time.monotonic) -> "WorkerHandle":
+        """Start the child process (it stays jax-free and cheap until
+        ``start_open``/``start_recover`` loads the shard).  ``env``
+        entries override the inherited environment — the cluster pins
+        the child's backend to its own here."""
+        cmd = [sys.executable, "-m", "redqueen_tpu.serving.worker",
+               "--dir", str(dir), "--shard", str(int(shard)),
+               "--heartbeat-every", str(float(heartbeat_every_s))]
+        child_env = dict(os.environ)
+        # The minimal-import flag: the child's package imports skip the
+        # eager jax-pulling re-exports (PEP 562 lazy fallbacks keep the
+        # surface whole), so a worker spawns cheap and stays jax-free
+        # until open/recover loads its shard — the watchdog-process
+        # import discipline, proven by the subprocess test.
+        child_env["RQ_SERVING_WORKER"] = "1"
+        if env:
+            child_env.update(env)
+        proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE, env=child_env)
+        return cls(proc, shard, request_timeout_s=request_timeout_s,
+                   open_timeout_s=open_timeout_s,
+                   read_timeout_s=read_timeout_s, clock=clock)
+
+    # -- low-level protocol --
+
+    def _send(self, op: str, **fields) -> int:
+        self._next_id += 1
+        req_id = self._next_id
+        frame = {"kind": "req", "id": req_id, "op": op, **fields}
+        try:
+            write_frame(self.proc.stdin.fileno(), frame)
+        except (OSError, ValueError) as e:
+            raise TransportEOF(
+                f"worker {self.shard} pipe closed on send: {e}") from e
+        return req_id
+
+    def _note_stale(self, frame: Dict[str, Any]) -> None:
+        """A response to a request the router gave up on: keep applied
+        poll results (their decisions are journaled facts the ledger
+        must see), drop everything else (a retried request re-answers)."""
+        if frame.get("op") == "poll" and frame.get("ok") \
+                and isinstance(frame.get("value"), dict):
+            self._stale_polls.append(frame["value"])
+
+    def drain_stale_polls(self) -> List[Dict[str, Any]]:
+        """Salvaged poll values observed since the last drain (oldest
+        first); clears the buffer."""
+        out, self._stale_polls = self._stale_polls, []
+        return out
+
+    def _wait(self, req_id: int, timeout_s: float, op: str) -> Any:
+        deadline = self._clock() + timeout_s
+        while True:
+            remaining = deadline - self._clock()
+            frame = self._reader.read_frame(timeout_s=max(remaining, 0))
+            self._last_frame_t = self._clock()
+            kind = frame.get("kind")
+            if kind == "beat":
+                continue
+            if kind != "resp":
+                raise FrameError(
+                    f"worker {self.shard} sent frame kind {kind!r} "
+                    f"(want resp/beat) — protocol desync")
+            resp_id = int(frame.get("id", -1))
+            if resp_id < req_id:
+                self._note_stale(frame)  # answer to a timed-out request
+                continue
+            if resp_id > req_id:
+                raise FrameError(
+                    f"worker {self.shard} answered request {resp_id} "
+                    f"while {req_id} is outstanding — protocol desync")
+            if not frame.get("ok"):
+                raise WorkerOpError(op, str(frame.get("error")),
+                                    str(frame.get("message")))
+            return frame.get("value")
+
+    # The cheap read ops: never touch the journal or the jitted apply,
+    # so they run on the short read deadline — the cluster's
+    # never-blocks read path must cost a wedged worker seconds, not the
+    # full apply budget.
+    READ_OPS = ("decide", "status")
+
+    def request(self, op: str, timeout_s: Optional[float] = None,
+                **fields) -> Any:
+        if timeout_s is None:
+            timeout_s = (self.read_timeout_s if op in self.READ_OPS
+                         else self.request_timeout_s)
+        return self._wait(self._send(op, **fields), timeout_s, op)
+
+    # -- liveness --
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def drain_beats(self) -> None:
+        """Consume any frames already buffered (heartbeats pile up
+        while the router is busy elsewhere) without blocking, so
+        :meth:`beat_age` reflects the worker, not the router.  A resp
+        frame found here is by construction stale (nothing is
+        outstanding when the router drains) — salvaged like
+        :meth:`_wait` does, never silently eaten."""
+        while True:
+            try:
+                frame = self._reader.read_frame(timeout_s=0)
+            except TransportTimeout:
+                return
+            except TransportError:
+                return  # poisoned/dead: the next real request classifies
+            self._last_frame_t = self._clock()
+            if frame.get("kind") == "resp":
+                self._note_stale(frame)
+
+    def beat_age(self) -> float:
+        """Seconds since the last frame observed from this worker."""
+        return self._clock() - self._last_frame_t
+
+    # -- teardown --
+
+    def kill(self) -> None:
+        """SIGKILL + reap + close pipes — the teardown for a crashed,
+        wedged-past-quarantine, or protocol-poisoned worker.  Never
+        raises."""
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=10.0)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+        for f in (self.proc.stdin, self.proc.stdout):
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Graceful shutdown: ask, wait, then SIGKILL stragglers."""
+        if self.alive():
+            try:
+                self.request("shutdown", timeout_s=timeout_s)
+            except TransportError:
+                pass
+        self.kill()
+
+    # -- the ServingRuntime surface the cluster router drives --
+
+    def start_open(self, config: Dict[str, Any]) -> int:
+        return self._send("open", config=config)
+
+    def finish_open(self, req_id: int) -> int:
+        return int(self._wait(req_id, self.open_timeout_s,
+                              "open")["applied_seq"])
+
+    def start_recover(self) -> int:
+        return self._send("recover")
+
+    def finish_recover(self, req_id: int):
+        from .service import RecoveryInfo
+
+        value = self._wait(req_id, self.open_timeout_s, "recover")
+        i = value["info"]
+        return RecoveryInfo(
+            snapshot_seq=i["snapshot_seq"], replayed=int(i["replayed"]),
+            skipped=int(i["skipped"]), torn=i["torn"],
+            recovered_seq=int(i["recovered_seq"]))
+
+    def start_submit(self, batch: EventBatch) -> int:
+        return self._send("submit", seq=int(batch.seq),
+                          times=[float(t) for t in batch.times],
+                          feeds=[int(f) for f in batch.feeds])
+
+    def finish_submit(self, req_id: int):
+        from .service import Admission
+
+        value = self._wait(req_id, self.request_timeout_s, "submit")
+        return Admission(status=value["status"], seq=value["seq"],
+                         backpressure=bool(value["backpressure"]),
+                         reason=value["reason"],
+                         missing=tuple(value["missing"]))
+
+    def submit(self, batch: EventBatch, _validated: bool = False):
+        return self.finish_submit(self.start_submit(batch))
+
+    def start_poll(self, max_batches: Optional[int] = None) -> int:
+        return self._send("poll", max_batches=max_batches)
+
+    def finish_poll(self, req_id: int) -> List[Any]:
+        value = self._wait(req_id, self.request_timeout_s, "poll")
+        return [self._decision(d) for d in value["decisions"]]
+
+    def poll(self, max_batches: Optional[int] = None) -> List[Any]:
+        return self.finish_poll(self.start_poll(max_batches))
+
+    @staticmethod
+    def _decision(d: Dict[str, Any]):
+        from .state import Decision
+
+        return Decision(seq=int(d["seq"]), post=bool(d["post"]),
+                        post_time=float(d["post_time"]),
+                        intensity=float(d["intensity"]))
+
+    def decide(self):
+        value = self.request("decide")
+        d = value["decision"]
+        if d is None:
+            return None
+        return self._decision(d)._replace(
+            stale_batches=int(value["pending"]))
+
+    @property
+    def pending(self) -> int:
+        return int(self.request("status")["pending"])
+
+    @property
+    def applied_seq(self) -> int:
+        return int(self.request("status")["applied_seq"])
+
+    def next_queued_seq(self) -> Optional[int]:
+        nq = self.request("status")["next_queued_seq"]
+        return None if nq is None else int(nq)
+
+    def snapshot(self) -> Optional[int]:
+        step = self.request("snapshot")["step"]
+        return None if step is None else int(step)
+
+    def state_digest(self) -> str:
+        return str(self.request("digest")["digest"])
+
+    def reset_metrics(self) -> None:
+        self.request("reset_metrics")
+
+    def gather(self) -> Tuple[np.ndarray, np.ndarray, int, float, int]:
+        """The shard's per-edge carry for the cluster's edge-digest /
+        reshard gather: ``(rank f32[F], health u32[F], seq, t,
+        n_batches)``.  Python floats round-trip float32 values exactly
+        through JSON (NaN/Inf included), so the gathered digest is
+        bit-identical to an in-process gather."""
+        v = self.request("gather")
+        return (np.asarray(v["rank"], np.float32),
+                np.asarray(v["health"], np.uint32),
+                int(v["seq"]), float(v["t"]), int(v["n_batches"]))
+
+    @property
+    def journal_path(self) -> Optional[str]:
+        return None  # the journal lives in the worker process
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

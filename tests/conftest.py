@@ -9,6 +9,14 @@ must stay fast/deterministic).
 import os
 import sys
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy process-spawning scenarios excluded from the tier-1 "
+        "gate (-m 'not slow'); tools/ci.sh runs them unfiltered in the "
+        "explicit fault-injection suites before tier-1")
+
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
