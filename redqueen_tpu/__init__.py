@@ -65,12 +65,18 @@ _LAZY_ATTRS = {
     "utils": None,
 }
 
+# The learning subsystem stays import-on-use on BOTH paths (its solvers
+# pull jax + the full sim stack; nothing at the top level needs it
+# eagerly) — resolved by the PEP 562 fallback below, never the eager
+# loop.
+_IMPORT_ON_USE = {"learn": None}
+
 
 def __getattr__(name):
-    if name in _LAZY_ATTRS:
+    if name in _LAZY_ATTRS or name in _IMPORT_ON_USE:
         import importlib
 
-        target = _LAZY_ATTRS[name]
+        target = _LAZY_ATTRS.get(name, _IMPORT_ON_USE.get(name))
         if target is None:  # a subpackage re-export
             return importlib.import_module("." + name, __name__)
         return getattr(importlib.import_module(target, __name__), name)
@@ -107,4 +113,5 @@ __all__ = [
     "ConfigValidationError",
     "NumericalHealthError",
     "utils",
+    "learn",
 ]

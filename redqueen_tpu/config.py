@@ -236,7 +236,82 @@ class GraphBuilder:
         rate = _require_finite("Poisson rate", rate, idx, minimum=0.0)
         return self._add(KIND_POISSON, sinks, rate=rate)
 
-    def add_hawkes(self, l0: float, alpha: float, beta: float, sinks=None) -> int:
+    def add_hawkes(self, l0, alpha=None, beta=None, sinks=None):
+        """One self-exciting source from scalars ``(l0, alpha, beta)`` —
+        or a whole LEARNED model: pass a
+        :class:`~redqueen_tpu.learn.hawkes_mle.HawkesFit` (anything with
+        ``mu``/``alpha``/``beta`` arrays), or ``(mu[D], alpha, beta[D])``
+        arrays directly (``alpha`` [D] per-dim jumps or [D, D] jump
+        matrix — the diagonal is kept, off-diagonal cross-excitation is
+        warned about, never silently dropped).  Array/fit inputs add one
+        source per dimension through the SAME scalar path, so every
+        domain check and the supercritical warning apply to learned
+        parameters exactly as to hand-written specs; returns the list of
+        source rows (``sinks`` applies to each — use
+        ``learn.control.add_fit_walls`` for per-dimension wiring)."""
+        if alpha is None and beta is None and all(
+                hasattr(l0, f) for f in ("mu", "alpha", "beta")):
+            fit = l0
+            health = np.asarray(getattr(fit, "health", 0), np.uint32)
+            sick = np.flatnonzero(np.atleast_1d(health))
+            if sick.size:
+                warnings.warn(
+                    f"HawkesFit has {sick.size} quarantined dimension(s) "
+                    f"{sick.tolist()[:8]} (health bits set): their "
+                    f"parameters are sanitized fallbacks, not estimates "
+                    f"— the corresponding sources will simulate the "
+                    f"fallback", stacklevel=2)
+            return self.add_hawkes(np.asarray(fit.mu),
+                                   np.asarray(fit.alpha),
+                                   np.asarray(fit.beta), sinks=sinks)
+        if np.ndim(l0) > 0 or np.ndim(alpha) > 0 or np.ndim(beta) > 0:
+            if alpha is None or beta is None:
+                raise TypeError(
+                    "add_hawkes takes (l0, alpha, beta) scalars, a "
+                    "HawkesFit, or (mu[D], alpha, beta[D]) arrays — "
+                    "array mu needs alpha and beta too")
+            mu_v = np.atleast_1d(np.asarray(l0, np.float64))
+            beta_v = np.atleast_1d(np.asarray(beta, np.float64))
+            a_v = np.asarray(alpha, np.float64)
+            if a_v.ndim == 2:
+                # One warning policy for the diagonal projection,
+                # shared with learn.control.builder_params: the measure
+                # is off-diagonal BRANCHING mass (alpha/beta — what the
+                # process loses dynamically), not raw alpha mass, which
+                # disagrees under heterogeneous decays.  (Import is
+                # local: learn pulls the solver stack, which nothing
+                # else in config needs.)
+                from .learn.control import CROSS_EXCITATION_WARN
+
+                b_safe = (beta_v if beta_v.shape == (a_v.shape[1],)
+                          and (beta_v > 0).all()
+                          else np.ones(a_v.shape[1]))
+                br = np.abs(a_v) / np.maximum(b_safe[None, :], 1e-300)
+                total = float(br.sum())
+                off = total - float(np.abs(np.diag(br)).sum())
+                if off > CROSS_EXCITATION_WARN * max(total, 1e-300):
+                    warnings.warn(
+                        f"alpha matrix carries substantial off-diagonal "
+                        f"branching mass ({off / max(total, 1e-300):.1%}"
+                        f"): per-source Hawkes walls are self-exciting "
+                        f"only, so the simulation keeps the DIAGONAL "
+                        f"and the feeds will be tamer than the fitted "
+                        f"model", stacklevel=2)
+                a_v = np.diag(a_v).copy()
+            a_v = np.atleast_1d(a_v)
+            if not (mu_v.shape == a_v.shape == beta_v.shape
+                    and mu_v.ndim == 1):
+                raise ConfigValidationError(
+                    f"array add_hawkes needs matching [D] mu/alpha/beta "
+                    f"(alpha may be [D, D]), got {mu_v.shape} / "
+                    f"{a_v.shape} / {beta_v.shape}")
+            return [self.add_hawkes(float(mu_v[k]), float(a_v[k]),
+                                    float(beta_v[k]), sinks=sinks)
+                    for k in range(len(mu_v))]
+        if alpha is None or beta is None:
+            raise TypeError(
+                "add_hawkes takes (l0, alpha, beta) scalars, a HawkesFit, "
+                "or (mu[D], alpha, beta[D]) arrays")
         idx = len(self._rows)
         l0 = _require_finite("Hawkes l0 (base rate)", l0, idx, minimum=0.0)
         alpha = _require_finite("Hawkes alpha (jump size)", alpha, idx,

@@ -1,0 +1,66 @@
+"""redqueen_tpu.learn — corpus-scale multivariate Hawkes estimation.
+
+The learning subsystem closes the simulate→fit→control loop (ROADMAP
+item 3): fit ``(mu, alpha, beta)`` of an exponential-kernel multivariate
+Hawkes model from any event log the repo produces, then feed the learned
+parameters back into a RedQueen-controlled simulation.
+
+- ``learn.ingest``     — adapters (simulator ``EventLog``, native-loader
+  corpus rows, serving journal segments) → one chunked fit format.
+- ``learn.loglik``     — the exact O(n) recursive log-likelihood (shared
+  scan; per-dimension health bits via ``runtime.numerics``).
+- ``learn.hawkes_mle`` — the two solvers (MM/EM, Frank-Wolfe) behind
+  :func:`fit_hawkes`; enveloped ``rq.learn.fit/1`` resume checkpoints.
+- ``learn.control``    — fitted :class:`HawkesFit` → ``config.add_hawkes``
+  sources for re-simulation under control.
+- ``learn.ckpt``       — the shared fit-checkpoint envelope (also used by
+  ``models.rmtpp.fit``).
+
+Importing this package pulls jax (the solvers are kernel-side code);
+jax-free contexts (the watchdog, the rqlint CLI) simply don't import it
+— same policy as ``redqueen_tpu.ops``.
+"""
+
+from __future__ import annotations
+
+from .ckpt import FIT_SCHEMA
+from .control import (
+    add_fit_walls,
+    builder_params,
+    control_component,
+    control_cost,
+    cross_excitation_mass,
+)
+from .hawkes_mle import SOLVERS, FitError, HawkesFit, fit_hawkes
+from .ingest import (
+    ChunkedEvents,
+    EventStream,
+    StreamValidationError,
+    chunk_events,
+    from_event_log,
+    from_journal,
+    from_traces,
+)
+from .loglik import LoglikResult, hawkes_loglik
+
+__all__ = [
+    "EventStream",
+    "ChunkedEvents",
+    "StreamValidationError",
+    "chunk_events",
+    "from_event_log",
+    "from_traces",
+    "from_journal",
+    "hawkes_loglik",
+    "LoglikResult",
+    "fit_hawkes",
+    "HawkesFit",
+    "FitError",
+    "SOLVERS",
+    "FIT_SCHEMA",
+    "builder_params",
+    "cross_excitation_mass",
+    "add_fit_walls",
+    "control_component",
+    "control_cost",
+]

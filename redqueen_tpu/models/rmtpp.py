@@ -175,19 +175,73 @@ def nll_loss(weights, taus, mask, hidden: int):
 
 def fit(key, taus, mask, hidden: int = 16, steps: int = 300,
         lr: float = 1e-2, weights=None, opt_state=None,
-        optimizer: Optional[optax.GradientTransformation] = None):
+        optimizer: Optional[optax.GradientTransformation] = None,
+        ckpt_path: Optional[str] = None, ckpt_every: int = 50):
     """Fit RMTPP weights to observed gap sequences (full-batch Adam).
 
     Returns (weights, opt_state, losses). Pass ``weights``/``opt_state`` to
-    continue training (checkpoint/resume via utils.checkpoint).
+    continue training manually — or pass ``ckpt_path`` and a KILLED fit
+    rerun with the same arguments resumes itself: every ``ckpt_every``
+    steps the full training state (weights + optimizer moments + loss
+    curve) lands as an enveloped ``rq.learn.fit/1`` artifact
+    (``learn.ckpt`` → ``runtime.integrity``: atomic, checksummed,
+    quarantined when corrupt), keyed by a fingerprint of the data +
+    hyperparameters; after each save the fit heartbeats and honors a
+    pending SIGTERM/SIGINT, like every other durable boundary in the
+    repo.  A stored state whose fingerprint or tree structure mismatches
+    (edited corpus, different ``hidden``/``lr``/optimizer) is ignored —
+    trajectories never mix.  With a custom ``optimizer``, resume assumes
+    the SAME optimizer is passed again (the state restores into its
+    structure; a mismatch restarts from scratch).  ``steps`` is a
+    BUDGET, not part of the fingerprint: rerunning with a larger
+    ``steps`` trains onward from the checkpoint, and rerunning with a
+    smaller one returns the further-trained stored state as-is (its
+    loss curve may be longer than ``steps`` — training is never thrown
+    away or overwritten backwards).
     """
     taus = jnp.asarray(taus)
     mask = jnp.asarray(mask, bool)
+    custom_opt = optimizer is not None
     optimizer = optax.adam(lr) if optimizer is None else optimizer
     if weights is None:
         weights = init_weights(key, hidden)
     if opt_state is None:
         opt_state = optimizer.init(weights)
+
+    start, host_losses, fp = 0, [], None
+    if ckpt_path is not None:
+        from ..learn import ckpt as _ckpt
+
+        # explicit device->host boundary: the fingerprint hashes the
+        # corpus BYTES once per fit, before any training dispatch.  The
+        # initial state is part of the trajectory identity too: the PRNG
+        # key (it seeds init_weights) and any caller-provided
+        # weights/opt_state leaves — without them, a different-seed
+        # rerun on the same ckpt_path would silently return the previous
+        # seed's trained weights.
+        init_leaves = jax.tree_util.tree_leaves((weights, opt_state))
+        key_h, taus_h, mask_h, init_h = jax.device_get(
+            (key, taus, mask, init_leaves))
+        fp = _ckpt.fingerprint_arrays(
+            dict(model="rmtpp", hidden=int(hidden), lr=float(lr),
+                 optimizer="custom" if custom_opt else "adam"),
+            np.asarray(key_h), np.asarray(taus_h), np.asarray(mask_h),
+            *[np.asarray(le) for le in init_h])
+        loaded = _ckpt.load_fit(ckpt_path, fp)
+        if loaded is not None:
+            step0, arrays, _meta = loaded
+            leaves, treedef = jax.tree_util.tree_flatten(
+                (weights, opt_state))
+            stored = [arrays.get(f"leaf_{i:05d}") for i in
+                      range(len(leaves))]
+            if (f"leaf_{len(leaves):05d}" not in arrays
+                    and all(s is not None and s.shape == np.shape(le)
+                            for s, le in zip(stored, leaves))):
+                weights, opt_state = jax.tree_util.tree_unflatten(
+                    treedef, [jnp.asarray(s) for s in stored])
+                host_losses = list(np.asarray(arrays["curve"],
+                                              np.float64))
+                start = min(int(step0), int(steps))
 
     @jax.jit
     def train_step(weights, opt_state):
@@ -195,15 +249,53 @@ def fit(key, taus, mask, hidden: int = 16, steps: int = 300,
         updates, opt_state = optimizer.update(grads, opt_state)
         return optax.apply_updates(weights, updates), opt_state, loss
 
+    def save(step):
+        from ..learn import ckpt as _ckpt
+        from ..runtime import preempt as _preempt
+        from ..runtime.supervisor import heartbeat as _heartbeat
+
+        # one batched transfer for the whole training state (per-leaf
+        # device_get would round-trip once per weight/moment tensor)
+        leaves = jax.device_get(
+            jax.tree_util.tree_flatten((weights, opt_state))[0])
+        arrays = {f"leaf_{i:05d}": np.asarray(le)
+                  for i, le in enumerate(leaves)}
+        arrays["curve"] = np.asarray(host_losses, np.float64)
+        _ckpt.save_fit(ckpt_path, fp, step, arrays,
+                       meta=dict(model="rmtpp", hidden=int(hidden)))
+        _heartbeat()
+        _preempt.check_preempt(f"rmtpp.fit step {step}")
+
     losses = []
-    for _ in range(steps):
+    last_saved = start
+    for i in range(start, steps):
         weights, opt_state, loss = train_step(weights, opt_state)
         # keep the per-step loss ON DEVICE: float(loss) here would force
         # a host sync every optimizer step (the hidden round-trip RQ701
-        # exists for); one batched device_get below fetches the curve
+        # exists for); one batched device_get per checkpoint window (or
+        # per fit, without ckpt_path) fetches the curve
         losses.append(loss)
-    return weights, opt_state, np.asarray(jax.device_get(losses),
-                                          np.float64)
+        if ckpt_path is not None and ckpt_every and (i + 1) % ckpt_every == 0:
+            host_losses.extend(np.asarray(jax.device_get(losses),
+                                          np.float64))
+            losses = []
+            save(i + 1)
+            last_saved = i + 1
+    if losses:
+        host_losses.extend(np.asarray(jax.device_get(losses), np.float64))
+    if ckpt_path is not None and last_saved < steps:
+        save(steps)
+    return weights, opt_state, np.asarray(host_losses, np.float64)
+
+
+def _per_trace_nll(weights, taus, mask, hidden: int):
+    """Per-trace total NLL + event counts over a batch — ONE explicit
+    transfer for both vectors (the per-trace diagnostic ``fit_traces``
+    surfaces; per-trace because a corpus's fit quality is heavy-tailed
+    exactly like its users)."""
+    per = jax.vmap(lambda t, m: sequence_nll(weights, t, m, hidden))(taus, mask)
+    per_host, ev_host = jax.device_get((per, mask.sum(axis=-1)))  # rqlint: disable=RQ701 deliberate scoring boundary: one batched transfer for both vectors
+    return np.asarray(per_host, np.float64), np.asarray(ev_host, np.int64)
 
 
 def _per_event_nll(weights, taus, mask, hidden: int) -> float:
@@ -217,7 +309,8 @@ def _per_event_nll(weights, taus, mask, hidden: int) -> float:
 
 
 def fit_traces(key, traces, hidden: int = 16, steps: int = 300,
-               lr: float = 1e-2, holdout_frac: float = 0.25):
+               lr: float = 1e-2, holdout_frac: float = 0.25,
+               ckpt_path: Optional[str] = None, ckpt_every: int = 50):
     """Fit RMTPP to a posting corpus (list of ascending time arrays, e.g.
     ``data.traces.synthetic_twitter``) with a held-out split — the
     learned-broadcasting training loop (BASELINE config 5 / SURVEY.md
@@ -245,13 +338,22 @@ def fit_traces(key, traces, hidden: int = 16, steps: int = 300,
     # consume it is exactly the correlated-stream hazard RQ501 exists for.
     weights, _, losses = fit(jax.random.fold_in(key, 1), taus[~hold],
                              mask[~hold], hidden=hidden,
-                             steps=steps, lr=lr, weights=w0)
+                             steps=steps, lr=lr, weights=w0,
+                             ckpt_path=ckpt_path, ckpt_every=ckpt_every)
+    per_nll, per_ev = _per_trace_nll(weights, taus[hold], mask[hold],
+                                     hidden)
     info = {
-        "heldout_nll": _per_event_nll(weights, taus[hold], mask[hold], hidden),
+        "heldout_nll": float(per_nll.sum()) / max(int(per_ev.sum()), 1),
         "heldout_nll_init": _per_event_nll(w0, taus[hold], mask[hold], hidden),
         "train_users": int((~hold).sum()),
         "heldout_users": int(hold.sum()),
         "heldout_events": int(mask[hold].sum()),
+        # The per-trace diagnostic (satellite of the learn subsystem):
+        # the same vmapped NLLs the scalar score reduces, surfaced so a
+        # caller can see WHICH held-out users the fit serves badly.
+        "heldout_per_trace_nll": per_nll.tolist(),
+        "heldout_per_trace_events": per_ev.tolist(),
+        "heldout_user_indices": np.flatnonzero(hold).tolist(),
     }
     return weights, losses, info
 

@@ -37,9 +37,14 @@ from ..runtime import integrity as _integrity
 
 __all__ = ["Journal", "JournalError", "replay", "tear_tail",
            "rotate", "prune_segments", "segment_paths",
-           "JOURNAL_SCHEMA"]
+           "JOURNAL_SCHEMA", "JOURNAL_FILENAME"]
 
 JOURNAL_SCHEMA = "rq.serving.journal/1"
+
+# The on-disk journal filename inside a runtime/shard directory — a
+# cross-subsystem contract: the serving runtime writes it and external
+# consumers (learn.ingest.from_journal) locate it by this name.
+JOURNAL_FILENAME = "journal.jsonl"
 
 
 class JournalError(RuntimeError):

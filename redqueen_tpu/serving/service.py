@@ -51,7 +51,7 @@ from ..runtime import faultinject as _faultinject
 from ..runtime import integrity as _integrity
 from .events import EventBatch, IngestError, validate_batch
 from .ingest import Sequencer
-from .journal import Journal, replay as journal_replay
+from .journal import JOURNAL_FILENAME, Journal, replay as journal_replay
 from .metrics import ServingMetrics
 from .state import (Decision, FeedState, init_feed_state, make_apply_fn,
                     poison_edge, state_digest)
@@ -60,7 +60,7 @@ __all__ = ["ServingRuntime", "Admission", "RecoveryInfo", "recover",
            "journal_decisions", "CONFIG_SCHEMA", "SNAPSHOTS_DIRNAME"]
 
 CONFIG_SCHEMA = "rq.serving.config/1"
-_JOURNAL = "journal.jsonl"
+_JOURNAL = JOURNAL_FILENAME  # shared contract lives in serving.journal
 # Public: the cluster layer (serving.cluster) addresses a shard's
 # snapshot tree for the corrupt_snapshot fault + recovery assertions.
 SNAPSHOTS_DIRNAME = "snapshots"

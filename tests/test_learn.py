@@ -1,0 +1,628 @@
+"""The learning subsystem (``redqueen_tpu.learn``): ingest adapters,
+exact likelihood, both solvers, per-dimension quarantine, checkpoint
+resume, the ``config.add_hawkes`` learned-parameter seam, and THE
+simulate→fit→recover acceptance scenario (known (mu, alpha, beta) on a
+3-dim graph, both solvers recover within the documented tolerances —
+``experiments.closed_loop.TOLERANCES`` — seeded, on CPU).
+
+The full closed loop (re-simulate under RedQueen control with the fitted
+parameters, fitted-vs-true control cost) is ``@pytest.mark.slow``:
+tools/ci.sh runs it unfiltered in the learn pass before tier-1.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from redqueen_tpu import GraphBuilder, simulate  # noqa: E402
+from redqueen_tpu.learn import (  # noqa: E402
+    ChunkedEvents,
+    FitError,
+    HawkesFit,
+    StreamValidationError,
+    chunk_events,
+    control,
+    fit_hawkes,
+    from_event_log,
+    from_journal,
+    from_traces,
+    hawkes_loglik,
+)
+from redqueen_tpu.learn import ckpt as learn_ckpt  # noqa: E402
+from redqueen_tpu.learn import hawkes_mle  # noqa: E402
+from redqueen_tpu.learn.ingest import make_stream  # noqa: E402
+
+from experiments.closed_loop import TOLERANCES, true_params  # noqa: E402
+
+MU_T, ALPHA_T, BETA_T = true_params(3)
+T_FIT = 600.0
+
+
+@pytest.fixture(scope="module")
+def sim_stream():
+    """The acceptance scenario's input: the repo's OWN simulator output
+    for a known 3-dim self-exciting world."""
+    gb = GraphBuilder(n_sinks=3, end_time=T_FIT)
+    rows = gb.add_hawkes(MU_T, ALPHA_T, BETA_T)
+    cfg, params, adj = gb.build(capacity=4096)
+    log = simulate(cfg, params, adj, seed=7)
+    return from_event_log(log, sources=rows)
+
+
+def _np_loglik(times, dims, D, T, mu, alpha, beta):
+    """O(n^2) reference log-likelihood (f64, direct double sum)."""
+    ll = 0.0
+    for k in range(len(times)):
+        i = dims[k]
+        lam = mu[i]
+        for l in range(k):
+            j = dims[l]
+            lam += alpha[i, j] * np.exp(-beta[j] * (times[k] - times[l]))
+        ll += np.log(lam)
+    comp = mu.sum() * T
+    for l in range(len(times)):
+        j = dims[l]
+        comp += (alpha[:, j].sum()
+                 * (1 - np.exp(-beta[j] * (T - times[l]))) / beta[j])
+    return ll - comp
+
+
+# ---------------------------------------------------------------------------
+# ingest
+# ---------------------------------------------------------------------------
+
+class TestIngest:
+    def test_make_stream_validation(self):
+        with pytest.raises(StreamValidationError):
+            make_stream([1.0, 0.5], [0, 0], 1, t_end=2.0)  # decreasing
+        with pytest.raises(StreamValidationError):
+            make_stream([0.5], [1], 1, t_end=2.0)  # dim out of range
+        with pytest.raises(StreamValidationError):
+            make_stream([0.5], [0], 1, t_end=0.4)  # event past horizon
+        with pytest.raises(StreamValidationError):
+            make_stream([np.nan], [0], 1, t_end=1.0)
+        s = make_stream([0.1, 0.1, 0.9], [1, 0, 1], 2, t_end=1.0)
+        assert s.n_events == 3
+        assert s.counts().tolist() == [1.0, 2.0]
+
+    def test_chunk_events_pad_and_mask(self):
+        s = make_stream(np.linspace(0.1, 9.9, 100), np.zeros(100, int),
+                        1, t_end=10.0)
+        ch = chunk_events(s, chunk_size=16)
+        assert isinstance(ch, ChunkedEvents)
+        C, K = ch.dt.shape
+        assert K == 16 and C == 8  # ceil(100/16)=7 -> pow2 pad 8
+        assert int(ch.mask.sum()) == 100
+        # pad tail is an exact no-op: dt == 0 there
+        assert float(np.abs(ch.dt[~ch.mask]).max(initial=0.0)) == 0.0
+        # dt reconstructs the times in f64-differenced f32
+        t_rec = np.cumsum(ch.dt.reshape(-1)[:100].astype(np.float64))
+        np.testing.assert_allclose(t_rec, s.times, rtol=1e-5)
+
+    def test_chunk_bucketing_bounded_shapes(self):
+        from redqueen_tpu.learn.ingest import _pad_chunks
+
+        assert _pad_chunks(1) == 1
+        assert _pad_chunks(3) == 4
+        assert _pad_chunks(256) == 256
+        assert _pad_chunks(257) == 512 or _pad_chunks(257) == 512
+        # above the knee: multiples of 256, never pow2 doubling
+        assert _pad_chunks(2095) == 2304
+
+    def test_from_event_log_maps_sources(self, sim_stream):
+        assert sim_stream.n_dims == 3
+        assert sim_stream.n_events > 500
+        assert sim_stream.t_end == T_FIT
+        # times ascending, dims in range (make_stream validated)
+        assert np.all(np.diff(sim_stream.times) >= 0)
+
+    def test_from_event_log_batched_needs_lane(self):
+        from redqueen_tpu import simulate_batch
+        from redqueen_tpu.config import stack_components
+
+        gb = GraphBuilder(n_sinks=2, end_time=50.0)
+        gb.add_hawkes(0.5, 0.3, 1.0, sinks=[0])
+        gb.add_poisson(0.5, sinks=[1])
+        cfg, params, adj = gb.build(capacity=1024)
+        p, a = stack_components([params] * 2, [adj] * 2)
+        log = simulate_batch(cfg, p, a, np.arange(2))
+        with pytest.raises(ValueError, match="lane"):
+            from_event_log(log)
+        s = from_event_log(log, sources=[0], lane=1)
+        assert s.n_dims == 1  # Poisson row filtered out
+
+    def test_from_traces_hash_grouping(self):
+        traces = [np.sort(np.random.RandomState(u).uniform(0, 10, 5))
+                  for u in range(20)]
+        s = from_traces(traces, n_dims=4, t_end=10.0)
+        assert s.n_dims == 4 and s.n_events == 100
+        # deterministic assignment: same input -> same stream
+        s2 = from_traces(traces, n_dims=4, t_end=10.0)
+        np.testing.assert_array_equal(s.dims, s2.dims)
+        # one dim per user when n_dims is None
+        s3 = from_traces(traces, t_end=10.0)
+        assert s3.n_dims == 20
+
+    def test_from_journal(self, tmp_path):
+        from redqueen_tpu.serving.journal import Journal
+
+        d = tmp_path / "srv"
+        d.mkdir()
+        with Journal(str(d / "journal.jsonl")) as j:
+            j.append({"seq": 0, "times": [0.3, 0.1], "feeds": [1, 0],
+                      "decision": {}, "state_digest": "x"})
+            j.append({"seq": 1, "times": [0.7], "feeds": [2],
+                      "decision": {}, "state_digest": "x"})
+        s = from_journal(str(d), t_end=1.0)
+        assert s.n_events == 3
+        assert np.all(np.diff(s.times) >= 0)  # merged + sorted
+        assert s.n_dims == 3
+        # grouping works for journals too
+        s2 = from_journal(str(d), n_dims=2, t_end=1.0)
+        assert s2.n_dims == 2
+        # explicit observation window (epoch-style corpora)
+        s3 = from_journal(str(d), t_end=2.0, t_start=0.05)
+        assert s3.t_start == 0.05 and s3.t_end == 2.0
+
+    def test_from_journal_namespaces_shard_local_feeds(self, tmp_path):
+        """Shard journals record shard-LOCAL feed slots: feed 0 of
+        shard 0 and feed 0 of shard 1 are different real feeds and must
+        land in different dimensions."""
+        from redqueen_tpu.serving.journal import Journal
+
+        d = tmp_path / "cluster"
+        for k, t in ((0, 0.1), (1, 0.2)):
+            sd = d / f"shard-{k:04d}"
+            sd.mkdir(parents=True)
+            with Journal(str(sd / "journal.jsonl")) as j:
+                j.append({"seq": 0, "times": [t], "feeds": [0],
+                          "decision": {}, "state_digest": "x"})
+        s = from_journal(str(d), t_end=1.0)
+        assert s.n_events == 2
+        assert s.n_dims == 2
+        assert len(set(s.dims.tolist())) == 2  # NOT collapsed onto one
+
+
+# ---------------------------------------------------------------------------
+# likelihood
+# ---------------------------------------------------------------------------
+
+class TestLoglik:
+    def test_matches_quadratic_reference(self):
+        rng = np.random.RandomState(3)
+        n, D, T = 150, 3, 20.0
+        times = np.sort(rng.uniform(0, T, n))
+        dims = rng.randint(0, D, n)
+        mu = np.array([0.4, 0.8, 0.2])
+        alpha = rng.uniform(0.0, 0.5, (D, D))
+        beta = np.array([2.0, 1.0, 3.0])
+        ref = _np_loglik(times, dims, D, T, mu, alpha, beta)
+        s = make_stream(times, dims, D, t_end=T)
+        res = hawkes_loglik(s, mu, alpha, beta)
+        assert res.health.tolist() == [0, 0, 0]
+        np.testing.assert_allclose(res.loglik, ref, rtol=2e-4)
+        # the two terms decompose
+        np.testing.assert_allclose(
+            res.loglik, res.loglik_events - res.compensator, rtol=1e-6)
+
+    def test_degenerate_dim_flags_health(self):
+        # dim 0 has events but mu=0 and no excitation: lambda == 0 at its
+        # own events -> per-dimension health bit, finite (clamped) score.
+        s = make_stream([0.5, 1.0], [0, 1], 2, t_end=2.0)
+        res = hawkes_loglik(s, [0.0, 1.0], np.zeros((2, 2)), [1.0, 1.0])
+        assert res.health[0] != 0 and res.health[1] == 0
+        assert np.isfinite(res.loglik)
+
+
+# ---------------------------------------------------------------------------
+# fitting — THE acceptance scenario + solver behavior
+# ---------------------------------------------------------------------------
+
+class TestFitRecover:
+    @pytest.mark.parametrize("solver,iters", [("em", 150), ("fw", 300)])
+    def test_simulate_fit_recover(self, sim_stream, solver, iters):
+        """Acceptance: both solvers recover the simulator's known
+        parameters within the documented tolerances."""
+        fit = fit_hawkes(sim_stream, solver=solver, max_iters=iters,
+                         tol=1e-7)
+        assert isinstance(fit, HawkesFit)
+        assert fit.health.tolist() == [0, 0, 0]
+        br = np.diag(fit.branching())
+        br_true = ALPHA_T / BETA_T
+        assert np.max(np.abs(br - br_true)) <= \
+            TOLERANCES["branching_abs_err"]
+        assert np.max(np.abs(fit.mu - MU_T) / MU_T) <= \
+            TOLERANCES["mu_rel_err"]
+        assert np.max(np.abs(fit.beta - BETA_T) / BETA_T) <= \
+            TOLERANCES["beta_rel_err"]
+        # cross-excitation of an independent world fits near zero
+        assert control.cross_excitation_mass(fit) < 0.35
+        # the fitted model scores at least as well as the truth (MLE)
+        ll_true = hawkes_loglik(sim_stream, MU_T, np.diag(ALPHA_T),
+                                BETA_T).loglik
+        assert fit.final_loglik >= ll_true - 1.0
+
+    def test_em_loglik_monotone(self, sim_stream):
+        fit = fit_hawkes(sim_stream, solver="em", max_iters=40, tol=0.0)
+        curve = fit.loglik
+        assert len(curve) == 40
+        # EM ascent (the beta MM surrogate may dip within noise)
+        drops = np.diff(curve)
+        assert drops.min() >= -abs(curve[-1]) * 1e-3
+
+    def test_fw_gap_certificate_converges(self, sim_stream):
+        # f32 gradients floor the duality gap around ~3e-3 relative —
+        # 5e-3 is the realistic certificate at this precision.
+        fit = fit_hawkes(sim_stream, solver="fw", max_iters=500,
+                         tol=5e-3)
+        assert fit.converged
+        assert fit.n_iter < 500
+
+    def test_rejects_bad_args(self, sim_stream):
+        with pytest.raises(ValueError, match="solver"):
+            fit_hawkes(sim_stream, solver="sgd")
+        with pytest.raises(ValueError, match="rho"):
+            fit_hawkes(sim_stream, solver="fw", rho=1.5)
+        with pytest.raises(ValueError, match="max_iters"):
+            fit_hawkes(sim_stream, max_iters=0)
+        with pytest.raises(TypeError):
+            fit_hawkes([1, 2, 3])
+
+
+class TestQuarantine:
+    def _poisoning(self, monkeypatch, dims_to_poison):
+        orig = hawkes_mle._em_iter
+
+        def poisoned(*a, **k):
+            mu, alpha, beta, ll, health = orig(*a, **k)
+            for d in dims_to_poison:
+                mu = mu.at[d].set(jnp.nan)
+            return mu, alpha, beta, ll, health
+
+        monkeypatch.setattr(hawkes_mle, "_em_iter", poisoned)
+
+    def test_one_sick_dim_is_sanitized_not_fatal(self, monkeypatch):
+        times = np.sort(np.random.RandomState(0).uniform(0, 50, 200))
+        s = make_stream(times, np.arange(200) % 3, 3, t_end=50.0)
+        self._poisoning(monkeypatch, [0])
+        fit = fit_hawkes(s, solver="em", max_iters=8)
+        assert fit.health[0] != 0
+        assert fit.health[1] == 0 and fit.health[2] == 0
+        # sanitized fallbacks: finite, non-negative, zeroed coupling
+        assert np.isfinite(fit.mu).all() and (fit.mu >= 0).all()
+        assert np.isfinite(fit.alpha).all() and (fit.alpha >= 0).all()
+        assert fit.alpha[0].sum() == 0 and fit.alpha[:, 0].sum() == 0
+
+    def test_all_dims_dead_raises_fit_error(self, monkeypatch):
+        times = np.sort(np.random.RandomState(0).uniform(0, 50, 90))
+        s = make_stream(times, np.arange(90) % 3, 3, t_end=50.0)
+        self._poisoning(monkeypatch, [0, 1, 2])
+        with pytest.raises(FitError) as ei:
+            fit_hawkes(s, solver="em", max_iters=8)
+        assert len(ei.value.reasons) == 3
+
+    def test_never_nan_on_pathological_stream(self):
+        # extreme-but-valid: a burst of equal timestamps, huge horizon,
+        # one empty dimension
+        times = np.concatenate([np.full(50, 1e-6), [1e6]])
+        dims = np.concatenate([np.zeros(50, int), [1]])
+        s = make_stream(times, dims, 3, t_end=2e6)
+        for solver in ("em", "fw"):
+            fit = fit_hawkes(s, solver=solver, max_iters=10,
+                             fw_beta_warmup=3)
+            assert np.isfinite(fit.mu).all() and (fit.mu >= 0).all()
+            assert np.isfinite(fit.alpha).all() and (fit.alpha >= 0).all()
+            assert np.isfinite(fit.beta).all() and (fit.beta > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume (rq.learn.fit/1)
+# ---------------------------------------------------------------------------
+
+class TestFitCheckpoint:
+    def _stream(self):
+        rng = np.random.RandomState(5)
+        times = np.sort(rng.uniform(0, 100, 400))
+        return make_stream(times, rng.randint(0, 2, 400), 2, t_end=100.0)
+
+    @pytest.mark.parametrize("solver", ["em", "fw"])
+    def test_resume_is_bit_identical(self, tmp_path, solver):
+        s = self._stream()
+        p = str(tmp_path / f"fit_{solver}.npz")
+        kw = dict(solver=solver, tol=0.0, sync_every=4, ckpt_every=8,
+                  fw_beta_warmup=4)
+        # interrupted at 16 of 48 iterations, then resumed
+        fit_a = fit_hawkes(s, max_iters=16, ckpt_path=p, **kw)
+        assert os.path.exists(p)
+        fit_b = fit_hawkes(s, max_iters=48, ckpt_path=p, **kw)
+        assert fit_b.n_iter == 48
+        # uninterrupted reference
+        fit_c = fit_hawkes(s, max_iters=48, **kw)
+        np.testing.assert_array_equal(fit_b.mu, fit_c.mu)
+        np.testing.assert_array_equal(fit_b.alpha, fit_c.alpha)
+        np.testing.assert_array_equal(fit_b.beta, fit_c.beta)
+        np.testing.assert_array_equal(fit_b.loglik, fit_c.loglik)
+        # the interrupted prefix agrees with the full trajectory too
+        np.testing.assert_array_equal(fit_a.loglik,
+                                      fit_c.loglik[: len(fit_a.loglik)])
+
+    def test_changed_inputs_restart_not_mix(self, tmp_path):
+        s = self._stream()
+        p = str(tmp_path / "fit.npz")
+        fit_hawkes(s, solver="em", max_iters=16, tol=0.0, ckpt_path=p,
+                   ckpt_every=8)
+        # different chunk_size -> different fingerprint -> fresh fit
+        fit = fit_hawkes(s, solver="em", max_iters=8, tol=0.0,
+                         ckpt_path=p, ckpt_every=8, chunk_size=2048)
+        assert fit.n_iter == 8  # did NOT resume from 16
+        assert len(fit.loglik) == 8
+
+    def test_corrupt_checkpoint_quarantined_and_refit(self, tmp_path):
+        s = self._stream()
+        p = str(tmp_path / "fit.npz")
+        fit_hawkes(s, solver="em", max_iters=16, tol=0.0, ckpt_path=p,
+                   ckpt_every=8)
+        raw = open(p, "rb").read()
+        open(p, "wb").write(raw[: len(raw) // 2])  # torn write
+        fit = fit_hawkes(s, solver="em", max_iters=8, tol=0.0,
+                         ckpt_path=p, ckpt_every=8)
+        assert fit.n_iter == 8  # restarted
+        # the bad bytes were quarantined, never trusted or deleted
+        assert any(".corrupt-" in f for f in os.listdir(tmp_path))
+
+    def test_preempt_clean(self, tmp_path):
+        import signal
+
+        from redqueen_tpu import runtime
+        from redqueen_tpu.runtime import preempt as _preempt
+
+        s = self._stream()
+        p = str(tmp_path / "fit.npz")
+        _preempt.reset()
+        try:
+            with runtime.preemption_guard(log=None):
+                os.kill(os.getpid(), signal.SIGTERM)
+                with pytest.raises(_preempt.PreemptedError):
+                    fit_hawkes(s, solver="em", max_iters=32, tol=0.0,
+                               ckpt_path=p, ckpt_every=8, sync_every=4)
+        finally:
+            _preempt.reset()
+        # the durable boundary landed BEFORE the preempt was honored
+        assert os.path.exists(p)
+        assert learn_ckpt.load_fit(p, "not-the-fingerprint") is None
+        fit = fit_hawkes(s, solver="em", max_iters=32, tol=0.0,
+                         ckpt_path=p, ckpt_every=8, sync_every=4)
+        assert fit.n_iter == 32
+
+
+# ---------------------------------------------------------------------------
+# config.add_hawkes learned-parameter seam + control
+# ---------------------------------------------------------------------------
+
+def _mk_fit(mu, alpha_diag, beta, health=None):
+    D = len(mu)
+    return HawkesFit(
+        mu=np.asarray(mu, np.float64),
+        alpha=np.diag(np.asarray(alpha_diag, np.float64)),
+        beta=np.asarray(beta, np.float64),
+        health=np.zeros(D, np.uint32) if health is None
+        else np.asarray(health, np.uint32),
+        loglik=np.zeros(1), final_loglik=0.0, converged=True, n_iter=1,
+        solver="em", n_events=10, n_dims=D, t_end=10.0, t_start=0.0)
+
+
+class TestAddHawkesLearned:
+    def test_fit_object_adds_per_dim_sources(self):
+        gb = GraphBuilder(n_sinks=3, end_time=10.0)
+        rows = gb.add_hawkes(_mk_fit([0.3, 0.4, 0.5], [0.2, 0.3, 0.4],
+                                     [1.0, 2.0, 3.0]))
+        assert rows == [0, 1, 2]
+        cfg, params, adj = gb.build()
+        np.testing.assert_allclose(np.asarray(params.l0), [0.3, 0.4, 0.5])
+        np.testing.assert_allclose(np.asarray(params.beta),
+                                   [1.0, 2.0, 3.0])
+
+    def test_supercritical_learned_params_warn_not_silent(self):
+        gb = GraphBuilder(n_sinks=2, end_time=10.0)
+        with pytest.warns(UserWarning, match="supercritical"):
+            gb.add_hawkes(_mk_fit([0.3, 0.3], [2.5, 0.1], [1.0, 1.0]))
+
+    def test_offdiag_alpha_matrix_warns(self):
+        gb = GraphBuilder(n_sinks=2, end_time=10.0)
+        alpha = np.array([[0.3, 0.2], [0.2, 0.3]])
+        with pytest.warns(UserWarning, match="off-diagonal"):
+            rows = gb.add_hawkes(np.array([0.1, 0.1]), alpha,
+                                 np.array([1.0, 1.0]))
+        assert rows == [0, 1]
+
+    def test_sick_dims_warn(self):
+        gb = GraphBuilder(n_sinks=2, end_time=10.0)
+        with pytest.warns(UserWarning, match="quarantined"):
+            gb.add_hawkes(_mk_fit([0.1, 0.1], [0.1, 0.1], [1.0, 1.0],
+                                  health=[1, 0]))
+
+    def test_learned_domain_checks_still_apply(self):
+        from redqueen_tpu import ConfigValidationError
+
+        gb = GraphBuilder(n_sinks=2, end_time=10.0)
+        with pytest.raises(ConfigValidationError):
+            gb.add_hawkes(np.array([0.1, -0.2]), np.array([0.1, 0.1]),
+                          np.array([1.0, 1.0]))
+        with pytest.raises(ConfigValidationError):
+            gb.add_hawkes(np.array([0.1]), np.array([0.1, 0.1]),
+                          np.array([1.0]))
+
+    def test_scalar_path_unchanged(self):
+        gb = GraphBuilder(n_sinks=1, end_time=10.0)
+        assert gb.add_hawkes(0.5, 0.3, 1.0) == 0
+        with pytest.raises(TypeError):
+            gb.add_hawkes(0.5)
+
+
+class TestControl:
+    def test_cross_excitation_mass(self):
+        fit = _mk_fit([0.1, 0.1], [0.2, 0.2], [1.0, 1.0])
+        assert control.cross_excitation_mass(fit) == 0.0
+        crossed = fit._replace(alpha=np.array([[0.1, 0.3], [0.3, 0.1]]))
+        assert control.cross_excitation_mass(crossed) > 0.5
+
+    def test_heavy_cross_excitation_warns(self):
+        crossed = _mk_fit([0.1, 0.1], [0.1, 0.1],
+                          [1.0, 1.0])._replace(
+            alpha=np.array([[0.1, 0.4], [0.4, 0.1]]))
+        with pytest.warns(UserWarning, match="off-diagonal"):
+            control.builder_params(crossed)
+
+    def test_control_component_layouts_match(self):
+        fit = _mk_fit([0.3, 0.4], [0.2, 0.2], [1.0, 1.5])
+        (cfg_f, p_f, a_f), opt_f = control.control_component(
+            fit, end_time=20.0, q=0.7)
+        (cfg_t, p_t, a_t), opt_t = control.control_component(
+            (fit.mu, np.diag(fit.alpha), fit.beta), end_time=20.0, q=0.7)
+        assert opt_f == opt_t == 0
+        assert cfg_f == cfg_t  # one compiled kernel serves both worlds
+        np.testing.assert_array_equal(np.asarray(p_f.kind),
+                                      np.asarray(p_t.kind))
+
+    def test_control_cost_shape(self):
+        from redqueen_tpu.sweep import SweepResult
+
+        res = SweepResult(
+            time_in_top_k=np.ones((1, 2)), average_rank=np.ones((1, 2)),
+            n_posts=np.full((1, 2), 3.0), int_rank2=np.full((1, 2), 5.0),
+            health=np.zeros((1, 2), np.uint32))
+        np.testing.assert_allclose(control.control_cost(res, q=2.0),
+                                   [[11.0, 11.0]])
+
+
+# ---------------------------------------------------------------------------
+# rmtpp checkpoint satellite
+# ---------------------------------------------------------------------------
+
+class TestRmtppCheckpoint:
+    def _data(self):
+        rng = np.random.RandomState(2)
+        taus = rng.exponential(1.0, (4, 6))
+        mask = np.ones((4, 6), bool)
+        return taus, mask
+
+    def test_fit_resume_bit_identical(self, tmp_path):
+        import jax.random as jr
+
+        from redqueen_tpu.models import rmtpp
+
+        taus, mask = self._data()
+        p = str(tmp_path / "rmtpp.npz")
+        key = jr.PRNGKey(0)
+        # interrupted at 10 of 30 steps (ckpt lands at step 10)
+        rmtpp.fit(key, taus, mask, hidden=4, steps=10, ckpt_path=p,
+                  ckpt_every=5)
+        w_b, _, losses_b = rmtpp.fit(key, taus, mask, hidden=4, steps=30,
+                                     ckpt_path=p, ckpt_every=5)
+        w_c, _, losses_c = rmtpp.fit(key, taus, mask, hidden=4, steps=30)
+        assert len(losses_b) == 30
+        np.testing.assert_array_equal(losses_b, losses_c)
+        for lb, lc in zip(jax.tree_util.tree_leaves(w_b),
+                          jax.tree_util.tree_leaves(w_c)):
+            np.testing.assert_array_equal(np.asarray(lb), np.asarray(lc))
+
+    def test_different_key_restarts_not_reuses(self, tmp_path):
+        """A different PRNG key is a different trajectory: reusing one
+        ckpt_path across seeds must refit, never return the previous
+        seed's weights (the fingerprint covers the initial state)."""
+        import jax.random as jr
+
+        from redqueen_tpu.models import rmtpp
+
+        taus, mask = self._data()
+        p = str(tmp_path / "rmtpp.npz")
+        rmtpp.fit(jr.PRNGKey(0), taus, mask, hidden=4, steps=10,
+                  ckpt_path=p, ckpt_every=5)
+        _, _, l1 = rmtpp.fit(jr.PRNGKey(1), taus, mask, hidden=4,
+                             steps=10, ckpt_path=p, ckpt_every=5)
+        _, _, l2 = rmtpp.fit(jr.PRNGKey(1), taus, mask, hidden=4,
+                             steps=10)
+        np.testing.assert_array_equal(l1, l2)  # key-1's own trajectory
+
+    def test_stale_hyperparams_restart(self, tmp_path):
+        import jax.random as jr
+
+        from redqueen_tpu.models import rmtpp
+
+        taus, mask = self._data()
+        p = str(tmp_path / "rmtpp.npz")
+        rmtpp.fit(jr.PRNGKey(0), taus, mask, hidden=4, steps=10,
+                  ckpt_path=p, ckpt_every=5)
+        # different lr -> fingerprint mismatch -> full 8-step curve
+        _, _, losses = rmtpp.fit(jr.PRNGKey(0), taus, mask, hidden=4,
+                                 steps=8, lr=5e-3, ckpt_path=p,
+                                 ckpt_every=5)
+        assert len(losses) == 8
+
+    def test_fit_traces_per_trace_nll_diagnostic(self):
+        import jax.random as jr
+
+        from redqueen_tpu.models import rmtpp
+
+        traces = [np.sort(np.random.RandomState(u).uniform(0, 20, 8))
+                  for u in range(8)]
+        _, _, info = rmtpp.fit_traces(jr.PRNGKey(1), traces, hidden=4,
+                                      steps=5)
+        per = np.asarray(info["heldout_per_trace_nll"])
+        ev = np.asarray(info["heldout_per_trace_events"])
+        assert per.shape == ev.shape == (info["heldout_users"],)
+        assert int(ev.sum()) == info["heldout_events"]
+        # the scalar score IS the reduction of the per-trace diagnostic
+        np.testing.assert_allclose(
+            info["heldout_nll"], per.sum() / max(ev.sum(), 1), rtol=1e-6)
+        assert len(info["heldout_user_indices"]) == info["heldout_users"]
+
+
+# ---------------------------------------------------------------------------
+# the full closed loop (slow: runs unfiltered in tools/ci.sh learn pass)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_closed_loop_acceptance(tmp_path):
+    """Simulate known params → fit both solvers → recover within the
+    documented tolerances → re-simulate under RedQueen control with the
+    fitted params → fitted-vs-true control cost within tolerance — the
+    ROADMAP item-3 acceptance scenario, end-to-end on CPU."""
+    from experiments.closed_loop import run
+
+    payload = run(D=3, T_fit=300.0, n_seeds=4, em_iters=80, fw_iters=150,
+                  ckpt_dir=str(tmp_path))
+    assert payload["passed"], payload
+    for s in ("em", "fw"):
+        assert payload["solvers"][s]["recovered_within_tol"]
+        assert payload["control_costs"][s]["rel_gap_vs_true"] <= \
+            TOLERANCES["control_cost_rel_gap"]
+    # resumable fit checkpoints landed for both solvers
+    assert sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz")) \
+        == ["closed_loop_em.npz", "closed_loop_fw.npz"]
+
+
+@pytest.mark.slow
+def test_learn_bench_smoke(tmp_path):
+    """`benchmarks/run.py --learn --quick` machinery end-to-end: the
+    rq.learn.bench/1 artifact lands enveloped with both phases."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    from benchmarks.run import bench_learn
+    from redqueen_tpu.runtime import integrity
+
+    out = str(tmp_path / "LEARN_BENCH.json")
+    res = bench_learn(quick=True, out_path=out, log=lambda *a: None)
+    assert res["unit"] == "events/s" and res["value"] > 0
+    payload = integrity.read_json(out, schema="rq.learn.bench/1")
+    assert payload["recover"]["em"]["iters"] > 0
+    assert payload["corpus"]["events_per_sec_fitted"] > 0
+    assert payload["corpus"]["wall_secs_warm_3iter"] >= \
+        payload["corpus"]["wall_secs_warm_1iter"]

@@ -59,6 +59,17 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
     tests/test_rqlint.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== learn suite (simulate->fit->control closed loop) =="
+# The learning subsystem's full pass, UNFILTERED: tier-1 runs the fast
+# subset (ingest/likelihood/solver/quarantine/checkpoint tests, incl.
+# THE simulate->fit->recover acceptance), while the @pytest.mark.slow
+# closed-loop acceptance (re-simulate under RedQueen control with the
+# fitted parameters, fitted-vs-true control cost within tolerance) and
+# the --learn bench smoke gate every CI run right here.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_learn.py \
+    tests/test_learn_properties.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log
 # || rc=$? keeps `set -e` from aborting before the pass-count summary:

@@ -1,4 +1,5 @@
-"""RQ301 — raw numerics in kernel code (``redqueen_tpu/ops/``).
+"""RQ301 — raw numerics in kernel code (``redqueen_tpu/ops/`` and
+``redqueen_tpu/learn/``).
 
 Kernel code must not use raw ``jnp.exp`` / ``jnp.log`` or raw
 ``/``-division on data values — the guarded primitives in
@@ -6,7 +7,10 @@ Kernel code must not use raw ``jnp.exp`` / ``jnp.log`` or raw
 ``safe_div``; bit-identical on healthy inputs) are the sanctioned route,
 because a raw exp/log/division on an unvalidated parameter is exactly
 how a degenerate sweep point manufactures the NaN the lane-health layer
-then has to quarantine.  A division is exempt only when its denominator
+then has to quarantine.  The learning subsystem's estimation kernels
+(the likelihood scan, the EM/Frank-Wolfe updates) are pinned the same
+way the simulation samplers are: a degenerate TRACE must flag a
+dimension's health bit, never NaN a fit.  A division is exempt only when its denominator
 is statically safe: a non-zero numeric constant expression, or a
 ``maximum(...)``-clamped value.  ``log1p`` is deliberately NOT in the
 raw set: its remaining ops/ call sites consume panel/threefry uniforms
@@ -75,7 +79,7 @@ class RawNumericsRule(Rule):
     name = "raw-kernel-numerics"
     description = ("kernel code uses raw jnp.exp/jnp.log or unclamped "
                    "/-division instead of runtime.numerics.safe_*")
-    paths = ("redqueen_tpu/ops/*.py",)
+    paths = ("redqueen_tpu/ops/*.py", "redqueen_tpu/learn/*.py")
 
     def check(self, ctx):
         for line, col, what in numeric_sites(ctx.tree):
