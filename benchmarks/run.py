@@ -386,80 +386,127 @@ def bench_learn(quick: bool = False, out_path: str = None, log=log):
 SERVING_WARMUP_BATCHES = 8
 
 
+# The wire-speed ingest-path knobs (ROADMAP item 2): batches per jitted
+# dispatch / journal record, and the async-group-commit durability
+# window.  Committed IN the artifact (``durability``) so no throughput
+# number is ever quoted without its durability cost.
+SERVING_COALESCE = 32
+SERVING_MAX_UNFLUSHED = 64
+SERVING_FLUSH_DELAY_MS = 25.0
+
+
+def _round_chunks(batches, size):
+    for i in range(0, len(batches), size):
+        yield batches[i:i + size]
+
+
 def bench_serving(quick: bool = False, out_path: str = None, log=log):
     """Steady-state serving micro-bench (CPU, small graph): drive a
     deterministic synthetic ingest stream through a journaled
-    ``ServingRuntime`` and report sustained events/s + p50/p99 decision
-    latency — the online-mode numbers the BENCH trajectory tracks
-    alongside the batch-sim events/s.  The artifact is the same
-    enveloped ``rq.serving.metrics/1`` schema the runtime itself emits.
+    ``ServingRuntime`` on the WIRE-SPEED path — coalesced applies (one
+    jitted dispatch + one journal record per round) over async group
+    commit — and report sustained events/s + decision latency (raw,
+    trimmed, and windowed percentiles).  The artifact is the same
+    enveloped ``rq.serving.metrics/1`` schema the runtime itself emits,
+    durability window included; a same-workload ``sync_comparison``
+    (fsync-before-ack, the PR 6 contract) rides along so the durability
+    cost of the throughput is measured, never implied.
 
-    Durability is IN the measured path on purpose (journal fsync per
-    micro-batch, the acknowledgement cost a real serving deployment
-    pays); snapshots are off (cadence-driven, not throughput-relevant).
-    The first :data:`SERVING_WARMUP_BATCHES` batches warm the measured
-    runtime and are excluded from the artifact (see the constant's
-    comment for why a separate warm-up runtime is not enough).
+    Journaling is IN the measured path on purpose; snapshots are off
+    (cadence-driven, not throughput-relevant).  The first
+    :data:`SERVING_WARMUP_BATCHES` batches warm the measured runtime
+    and are excluded from the artifact (see the constant's comment for
+    why a separate warm-up runtime is not enough).
     """
     import tempfile
 
     from redqueen_tpu import serving
 
     n_feeds = 256 if quick else 2048
-    n_batches = 200 if quick else 2000
+    n_batches = 256 if quick else 2048
     epb = 16 if quick else 64
     warm = SERVING_WARMUP_BATCHES
     batches = serving.synthetic_stream(0, n_batches + warm, n_feeds,
                                        events_per_batch=epb)
     mbe = 4 * epb
 
-    tmpdir = tempfile.mkdtemp(prefix="rq-serving-bench-")
-    try:
-        rt = serving.ServingRuntime(
-            n_feeds=n_feeds, dir=tmpdir, snapshot_every=10 ** 9,
-            queue_capacity=256, reorder_window=8, max_batch_events=mbe)
-        with rt:
-            for b in batches[:warm]:
-                rt.submit(b)
-                rt.poll()
-            rt.reset_metrics()  # steady state starts here
-            for b in batches[warm:]:
-                rt.submit(b)
-                rt.poll()
-            # default the artifact OUTSIDE tmpdir (removed below)
-            payload = rt.write_metrics(out_path or "SERVING_BENCH.json")
-    finally:
-        import shutil
+    def run(flush_mode):
+        tmpdir = tempfile.mkdtemp(prefix="rq-serving-bench-")
+        try:
+            rt = serving.ServingRuntime(
+                n_feeds=n_feeds, dir=tmpdir, snapshot_every=10 ** 9,
+                queue_capacity=2 * SERVING_COALESCE, reorder_window=8,
+                max_batch_events=mbe, coalesce=SERVING_COALESCE,
+                flush_mode=flush_mode,
+                max_unflushed_records=SERVING_MAX_UNFLUSHED,
+                max_flush_delay_ms=SERVING_FLUSH_DELAY_MS)
+            with rt:
+                for b in batches[:warm]:
+                    rt.submit(b)
+                    rt.poll()
+                rt.reset_metrics()  # steady state starts here
+                # One poll round per coalesce-width chunk: the round IS
+                # the dispatch/journal unit the wire-speed path
+                # amortizes over.
+                for chunk in _round_chunks(batches[warm:],
+                                           SERVING_COALESCE):
+                    for b in chunk:
+                        rt.submit(b)
+                    rt.poll()
+                if flush_mode == "group":
+                    # default the artifact OUTSIDE tmpdir (removed below)
+                    return rt.write_metrics(
+                        out_path or "SERVING_BENCH.json")
+                return rt.metrics.report(
+                    pending=rt.pending,
+                    extra={"durability": rt.durability()})
+        finally:
+            import shutil
 
-        # the journal/snapshot scratch dir has no value past the report
-        # (the artifact is out_path) — don't leave 2000 fsynced records
-        # in /tmp per invocation
-        shutil.rmtree(tmpdir, ignore_errors=True)
+            # the journal scratch dir has no value past the report —
+            # don't leave thousands of records in /tmp per invocation
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+    sync_rep = run("sync")
+    payload = run("group")
     lat = payload["decision_latency"]
-    log(f"serving: {payload['events_applied']} events in "
+    log(f"serving [group commit, coalesce={SERVING_COALESCE}]: "
+        f"{payload['events_applied']} events in "
         f"{payload['busy_s']:.3f}s -> {payload['events_per_sec']:,.0f} "
         f"events/s sustained ({payload['applied']} micro-batches, "
         f"journaled, {warm} warm-up batches excluded); decision "
         f"p50 {lat['p50_ms']}ms p99 {lat['p99_ms']}ms "
-        f"max {lat['max_ms']}ms")
+        f"(trimmed {lat['p99_trimmed_ms']}ms, windowed "
+        f"{lat['p99_window_median_ms']}ms) max {lat['max_ms']}ms; "
+        f"sync-ack comparison {sync_rep['events_per_sec']:,.0f} ev/s")
     return {
-        "metric": f"serving events/sec ({n_feeds} feeds, journaled, "
+        "metric": f"serving events/sec ({n_feeds} feeds, journaled "
+                  f"group-commit, coalesce={SERVING_COALESCE}, "
                   f"~{epb} ev/batch)",
         "value": payload["events_per_sec"],
         "unit": "events/s",
         "vs_baseline": None,
         "decision_p50_ms": lat["p50_ms"],
         "decision_p99_ms": lat["p99_ms"],
+        "decision_p99_trimmed_ms": lat["p99_trimmed_ms"],
+        "decision_p99_window_median_ms": lat["p99_window_median_ms"],
         "decision_max_ms": lat["max_ms"],
         "warmup_batches_excluded": warm,
         "batches_per_sec": payload["batches_per_sec"],
+        "durability": payload["durability"],
+        "sync_comparison": {
+            "events_per_sec": sync_rep["events_per_sec"],
+            "decision_p99_ms":
+                sync_rep["decision_latency"]["p99_ms"],
+            "durability": sync_rep["durability"],
+        },
         "reconciles": payload["reconciles"],
     }
 
 
 def bench_serving_cluster(n_shards: int, quick: bool = False,
                           out_path: str = None,
-                          placement: str = "in-process", log=log):
+                          placement: str = "in-process", log=log):  # noqa: C901
     """``--serving --shards N [--workers]``: the sharded-cluster
     serving bench.
 
@@ -498,20 +545,62 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
     import time as _time
 
     from redqueen_tpu import serving
+    from redqueen_tpu.runtime import integrity as _integrity
 
     n_feeds = 256 if quick else 2048
-    n_batches = 100 if quick else 1000
+    n_batches = 128 if quick else 2048
     epb = 16 if quick else 64
     warm = SERVING_WARMUP_BATCHES
     mbe = 4 * epb
     batches = serving.synthetic_stream(0, n_batches + warm, n_feeds,
                                        events_per_batch=epb)
+    round_size = SERVING_COALESCE
+
+    # The before/after contract: capture the previous committed
+    # headline (whatever durability/coalesce it ran under) before this
+    # run overwrites the artifact.
+    before = None
+    prev_path = out_path or "SERVING_BENCH.json"
+    if _os.path.exists(prev_path):
+        try:
+            prev = _integrity.read_json(prev_path, do_quarantine=False)
+            prev_sweep = prev.get("bench", {}).get("sweep") or []
+            before = {
+                # whole-artifact-window rate (chaos phase included)...
+                "events_per_sec": prev.get("events_per_sec"),
+                # ...and the steady-state sweep headline at its top
+                # shard count — the number the after/steady compares to.
+                "steady_events_per_sec": (
+                    prev_sweep[-1].get("events_per_sec")
+                    if prev_sweep else None),
+                "n_shards": prev.get("n_shards"),
+                "decision_latency": prev.get("decision_latency"),
+                "durability": prev.get(
+                    "durability", {"flush_mode": "sync",
+                                   "fsync_every_n": 1, "coalesce": 1}),
+                "bench": {"placement": prev.get("bench", {}).get(
+                    "placement")},
+            }
+        except Exception:  # noqa: BLE001 — a foreign/old artifact is
+            before = None  # context, never a reason to fail the bench
 
     def make_cluster(k, d, placement=placement, **kw):
         return serving.ServingCluster(
             n_feeds=n_feeds, n_shards=k, dir=d, snapshot_every=10 ** 9,
-            queue_capacity=256, reorder_window=8, max_batch_events=mbe,
+            queue_capacity=2 * round_size, reorder_window=8,
+            max_batch_events=mbe, coalesce=SERVING_COALESCE,
+            flush_mode="group",
+            max_unflushed_records=SERVING_MAX_UNFLUSHED,
+            max_flush_delay_ms=SERVING_FLUSH_DELAY_MS,
             placement=placement, **kw)
+
+    def serve_rounds(cl, stream):
+        """One submit_many + poll round per coalesce-width chunk — the
+        wire-speed ingest loop (one frame per round per shard, one
+        jitted dispatch + one journal record per round per shard)."""
+        for chunk in _round_chunks(stream, round_size):
+            cl.submit_many(chunk)
+            cl.poll()
 
     def run_steady(cl):
         """Warm the measured cluster, then serve the stream steady-state
@@ -520,9 +609,7 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
             cl.submit(b)
             cl.poll()
         cl.reset_metrics()
-        for b in batches[warm:]:
-            cl.submit(b)
-            cl.poll()
+        serve_rounds(cl, batches[warm:])
         return cl.metrics.report(cl.pending_by_shard,
                                  cl.health_by_shard)
 
@@ -543,6 +630,9 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
                 "batches_per_sec": rep["batches_per_sec"],
                 "decision_p50_ms": lat["p50_ms"],
                 "decision_p99_ms": lat["p99_ms"],
+                "decision_p99_trimmed_ms": lat["p99_trimmed_ms"],
+                "decision_p99_window_median_ms":
+                    lat["p99_window_median_ms"],
                 "decision_max_ms": lat["max_ms"],
                 "reconciles": rep["reconciles"],
             })
@@ -550,7 +640,7 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
                 f"{rep['events_per_sec']:,.0f} events/s, decision "
                 f"p50 {lat['p50_ms']}ms p99 {lat['p99_ms']}ms")
 
-        if placement == "workers":
+        if placement != "in-process":
             # The acceptance comparison: same workload, same shard
             # count, shards back in the router's process.
             with make_cluster(n_shards, _os.path.join(root, "inproc"),
@@ -570,31 +660,36 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
                 f"shard(s) -> {rep['events_per_sec']:,.0f} events/s "
                 f"(worker mode: {sweep[-1]['events_per_sec']:,.0f})")
 
-        # ---- kill-one-shard chaos phase (at n_shards) ----
+        # ---- chaos phase (at n_shards): kill one shard AND, under
+        # socket placement, partition another mid-stream ----
         kill_at = n_batches // 2
+        partition_target = 1 if (placement == "sockets"
+                                 and n_shards > 1) else None
         with make_cluster(n_shards, _os.path.join(root, "chaos"),
                           auto_recover=False) as cl:
             for b in batches[:warm]:
                 cl.submit(b)
                 cl.poll()
             cl.reset_metrics()
-            for b in batches[warm:warm + kill_at]:
-                cl.submit(b)
-                cl.poll()
+            serve_rounds(cl, batches[warm:warm + kill_at])
             events_before = sum(
                 s["events_applied"]
                 for s in cl.metrics.report(
                     cl.pending_by_shard, cl.health_by_shard)["shards"])
             cl.kill_shard(0, reason="bench: kill-one-shard MTTR")
+            if partition_target is not None:
+                # The compound failure: a REAL SIGKILL on shard 0 and a
+                # severed TCP link on shard 1 in the same outage window
+                # — the partitioned worker must redial + reattach +
+                # resync while the dead one's slices shed.
+                cl.partition_shard(partition_target)
             # poll() materializes every decision host-side (journal
             # append precedes the commit), so the region is synced.
             t_kill = _time.monotonic()  # rqlint: disable=RQ601
             # The outage window: surviving shards keep serving the
             # second half while fault domain 0 is down (its slices shed
-            # with recorded seqs).
-            for b in batches[warm + kill_at:]:
-                cl.submit(b)
-                cl.poll()
+            # with recorded seqs) and shard 1 heals its link.
+            serve_rounds(cl, batches[warm + kill_at:])
             outage_s = max(_time.monotonic() - t_kill, 1e-9)
             events_during = sum(
                 s["events_applied"]
@@ -608,24 +703,39 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
             mttr_recover_ms = (_time.monotonic() - t0) * 1e3
             # Retransmit everything past the recovered shard's position
             # (the source-retransmit contract); duplicates are absorbed
-            # by the survivors, the recovered shard applies its backlog.
-            for b in batches[warm + kill_at:]:
-                cl.submit(b)
-                cl.poll()
-            mttr_reconverge_ms = (_time.monotonic() - t0) * 1e3
+            # by the survivors, the recovered shard applies its backlog,
+            # and any group-commit loss window heals the same way.
             final_seq = batches[-1].seq
+            for attempt in range(8):
+                missing = [b for b in batches
+                           if int(b.seq) > cl.applied_seq]
+                if not missing:
+                    break  # re-checked BEFORE any sleep: the committed
+                    # mttr_reconverge_ms carries no idle padding
+                if attempt:
+                    _time.sleep(0.2)
+                serve_rounds(cl, missing)
+            mttr_reconverge_ms = (_time.monotonic() - t0) * 1e3
             if cl.applied_seq != final_seq:
                 raise RuntimeError(
                     f"cluster failed to reconverge: applied_seq="
                     f"{cl.applied_seq} != {final_seq}")
+            rep = cl.metrics.report(cl.pending_by_shard,
+                                    cl.health_by_shard)
             chaos = {
                 "n_shards": n_shards,
                 "killed_shard": 0,
+                "partitioned_shard": partition_target,
                 "outage_batches": n_batches - kill_at,
                 "outage_s": round(outage_s, 6),
                 "healthy_events_per_sec_during_outage": round(
                     events_during / outage_s, 1),
                 "replayed_on_recovery": info.replayed,
+                "lost_acked_seqs_in_window":
+                    list(info.lost_acked_seqs),
+                "reattaches": rep["reattaches"],
+                "resyncs": rep["resyncs"],
+                "lost_in_window": rep["lost_in_window"],
                 "mttr_recover_ms": round(mttr_recover_ms, 3),
                 "mttr_reconverge_ms": round(mttr_reconverge_ms, 3),
                 "reconverged_seq": int(final_seq),
@@ -636,6 +746,8 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
                     "placement": placement,
                     "warmup_batches_excluded": warm,
                     "events_per_batch": epb,
+                    "round_size": round_size,
+                    "before": before,
                     "sweep": sweep,
                     "in_process_comparison": in_process_comparison,
                     "kill_one_shard": chaos,
@@ -644,28 +756,41 @@ def bench_serving_cluster(n_shards: int, quick: bool = False,
         shutil.rmtree(root, ignore_errors=True)
 
     steady = sweep[-1]
-    log(f"serving chaos [{placement}]: shard 0 of {n_shards} killed "
-        f"for {chaos['outage_batches']} batches; survivors served "
+    log(f"serving chaos [{placement}]: shard 0 of {n_shards} killed"
+        + (f" + shard {partition_target} partitioned"
+           if partition_target is not None else "")
+        + f" for {chaos['outage_batches']} batches; survivors served "
         f"{chaos['healthy_events_per_sec_during_outage']:,.0f} events/s "
         f"during the outage (steady {steady['events_per_sec']:,.0f}); "
-        f"recovery replayed {chaos['replayed_on_recovery']} records in "
+        f"recovery replayed {chaos['replayed_on_recovery']} batches in "
         f"{chaos['mttr_recover_ms']:.0f}ms, reconverged in "
-        f"{chaos['mttr_reconverge_ms']:.0f}ms; "
+        f"{chaos['mttr_reconverge_ms']:.0f}ms; reattaches="
+        f"{chaos['reattaches']} resyncs={chaos['resyncs']}; "
         f"reconciles={payload['reconciles']}")
     return {
         "metric": f"sharded serving events/sec ({n_feeds} feeds, "
-                  f"{n_shards} shards, {placement}, journaled, "
+                  f"{n_shards} shards, {placement}, journaled "
+                  f"group-commit, coalesce={SERVING_COALESCE}, "
                   f"~{epb} ev/batch)",
         "value": steady["events_per_sec"],
         "unit": "events/s",
-        "vs_baseline": (round(steady["events_per_sec"]
-                              / sweep[0]["events_per_sec"], 2)
-                        if sweep[0]["events_per_sec"] else None),
+        "vs_baseline": (round(
+            steady["events_per_sec"]
+            / (before.get("steady_events_per_sec")
+               or before["events_per_sec"]), 2)
+            if before and (before.get("steady_events_per_sec")
+                           or before.get("events_per_sec"))
+            else None),
         "placement": placement,
         "decision_p50_ms": steady["decision_p50_ms"],
         "decision_p99_ms": steady["decision_p99_ms"],
+        "decision_p99_trimmed_ms": steady.get("decision_p99_trimmed_ms"),
+        "decision_p99_window_median_ms":
+            steady.get("decision_p99_window_median_ms"),
         "decision_max_ms": steady["decision_max_ms"],
         "warmup_batches_excluded": warm,
+        "durability": payload["durability"],
+        "before": before,
         "sweep": sweep,
         "in_process_comparison": in_process_comparison,
         "kill_one_shard": chaos,
@@ -698,6 +823,11 @@ def main():
     ap.add_argument("--in-process", dest="workers", action="store_false",
                     help="with --serving --shards N: keep every shard "
                          "in this process (default)")
+    ap.add_argument("--sockets", action="store_true",
+                    help="with --serving --shards N: subprocess workers "
+                         "over authenticated TCP (serving.transport) — "
+                         "the cross-host placement; the chaos phase "
+                         "kills one worker AND partitions another")
     ap.add_argument("--serving-out", default="SERVING_BENCH.json",
                     help="artifact path for --serving "
                          "(default: SERVING_BENCH.json)")
@@ -754,14 +884,18 @@ def main():
         return
 
     if args.serving:
-        if args.workers and not args.shards:
-            ap.error("--workers needs --serving --shards N (worker "
-                     "placement is a cluster mode)")
+        if (args.workers or args.sockets) and not args.shards:
+            ap.error("--workers/--sockets need --serving --shards N "
+                     "(worker placement is a cluster mode)")
+        if args.workers and args.sockets:
+            ap.error("--workers and --sockets are exclusive placements")
         if args.shards:
             res = bench_serving_cluster(
                 args.shards, quick=args.quick,
                 out_path=args.serving_out,
-                placement="workers" if args.workers else "in-process")
+                placement=("sockets" if args.sockets
+                           else "workers" if args.workers
+                           else "in-process"))
         else:
             res = bench_serving(quick=args.quick,
                                 out_path=args.serving_out)

@@ -42,13 +42,21 @@ Two ways in:
       ingest:mode@batchN    deterministic serving-ingest fault at
                             micro-batch sequence number N (mode: dup |
                             reorder | drop | torn_journal |
-                            crash_after_apply) — the online serving
-                            runtime's failure modes
+                            crash_after_apply | crash_in_window) — the
+                            online serving runtime's failure modes
                             (:mod:`redqueen_tpu.serving`): duplicated /
                             swapped / withheld delivery of batch N, a
                             torn journal tail after batch N's append,
-                            or a hard ``os._exit`` (kill -9 shape)
-                            right after batch N is applied+journaled.
+                            a hard ``os._exit`` (kill -9 shape) right
+                            after batch N is applied+journaled, or the
+                            POWER-LOSS shape (``crash_in_window``):
+                            batch N is applied, journaled, and ACKED,
+                            then every journal byte past the async
+                            group-commit durability watermark is
+                            dropped (``Journal.power_loss``) and the
+                            process dies — the bounded loss window a
+                            machine crash consumes, which recovery must
+                            report and retransmit must heal.
                             Like ``numeric`` this is a data-plane kind:
                             validated at :func:`maybe_inject` but
                             APPLIED by the serving stream driver /
@@ -77,6 +85,34 @@ Two ways in:
                             :func:`maybe_inject`, APPLIED by the worker
                             child via :func:`worker_fault` — the router
                             and the other workers keep serving
+      net:mode@shardK[,batchN]
+                            deterministic NETWORK fault on a SOCKET-
+                            placed shard worker's connection
+                            (:mod:`redqueen_tpu.serving.transport` TCP
+                            mode), fired by worker K itself around the
+                            request that applies sub-batch N (omitted =
+                            first opportunity).  ``drop`` silently
+                            discards one response frame (the router's
+                            per-request deadline expires; the applied
+                            decisions are healed by the resync
+                            protocol); ``delay`` answers one request
+                            late — past the router's deadline but
+                            within its salvage window (degrade +
+                            backoff, late answer salvaged by id);
+                            ``partition`` abruptly closes the
+                            connection with the response UNSENT, waits
+                            out a dead interval, then redials (the
+                            router reattaches the SAME live worker —
+                            no journal replay — and resyncs the missed
+                            decisions); ``reconnect`` closes + redials
+                            immediately and answers on the new
+                            connection (the clean link-flap shape).
+                            Data-plane kind: validated at
+                            :func:`maybe_inject`, APPLIED by the worker
+                            child via :func:`net_fault` — every mode
+                            maps onto the router's health machine
+                            (degrade/quarantine/heal), never a router
+                            crash
       shard:mode@shardK[,batchN]
                             deterministic SHARD-granularity fault in the
                             sharded serving cluster
@@ -145,6 +181,10 @@ __all__ = [
     "WORKER_MODES",
     "parse_worker",
     "worker_fault",
+    "NetFault",
+    "NET_MODES",
+    "parse_net",
+    "net_fault",
     "hang_forever",
     "crash_with",
     "flaky",
@@ -185,14 +225,15 @@ def parse_fault(spec: str) -> FaultSpec:
     kind, _, arg = spec.strip().partition(":")
     kind = kind.strip().lower()
     if kind not in ("hang", "crash", "transient", "oom", "corrupt",
-                    "numeric", "ingest", "shard", "worker"):
+                    "numeric", "ingest", "shard", "worker", "net"):
         raise ValueError(f"unknown fault spec {spec!r} "
                          f"(want hang|crash|transient|oom[:arg], "
                          f"corrupt:mode@path, "
                          f"numeric:mode@laneN[,chunkM], "
                          f"ingest:mode@batchN, "
-                         f"shard:mode@shardK[,batchN], or "
-                         f"worker:mode@shardK[,batchN])")
+                         f"shard:mode@shardK[,batchN], "
+                         f"worker:mode@shardK[,batchN], or "
+                         f"net:mode@shardK[,batchN])")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -260,6 +301,10 @@ def inject(spec: FaultSpec) -> None:
         # Same data-plane contract: validated here, applied by the
         # out-of-process shard worker via worker_fault().
         parse_worker(spec.arg)
+    elif spec.kind == "net":
+        # Same data-plane contract: validated here, applied by the
+        # socket-placed shard worker via net_fault().
+        parse_net(spec.arg)
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -385,7 +430,7 @@ def active_numeric_lane(batch_size: int) -> Optional[Tuple[int, str]]:
 # --- ingest (serving data-plane) faults: micro-batch delivery failures ----
 
 INGEST_MODES = ("dup", "reorder", "drop", "torn_journal",
-                "crash_after_apply")
+                "crash_after_apply", "crash_in_window")
 
 
 class IngestFault(NamedTuple):
@@ -541,6 +586,40 @@ def worker_fault() -> Optional[WorkerFault]:
     if parsed.kind != "worker":
         return None
     return parse_worker(parsed.arg)
+
+
+# --- net (socket-transport data-plane) faults: link failures --------------
+
+NET_MODES = ("drop", "delay", "partition", "reconnect")
+
+
+class NetFault(NamedTuple):
+    """Parsed ``net:mode@shardK[,batchN]`` spec.  ``shard`` is the
+    socket-placed worker whose CONNECTION injures itself; ``batch`` the
+    sub-batch sequence number around whose request the fault fires
+    (None = first opportunity), so the same spec hits the same stream
+    point in an uninterrupted run and a reconnect-and-resync run."""
+
+    mode: str            # drop | delay | partition | reconnect
+    shard: int
+    batch: Optional[int]
+
+
+def parse_net(arg: Optional[str]) -> NetFault:
+    """Parse the argument of a ``net`` fault spec."""
+    return NetFault(*_parse_shard_addressed(arg, "net", NET_MODES))
+
+
+def net_fault() -> Optional[NetFault]:
+    """The env-configured net fault, or None when ``RQ_FAULT`` is unset
+    or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "net":
+        return None
+    return parse_net(parsed.arg)
 
 
 # --- picklable callable faults (spawned-child targets for tests) ---------

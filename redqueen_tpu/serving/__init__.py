@@ -15,7 +15,10 @@ deterministic fault injection) made load-bearing:
   (:class:`FeedState`), jitted donated apply, per-edge health
   quarantine, canonical carry digest;
 - :mod:`~redqueen_tpu.serving.journal`  — crash-safe checksummed
-  append-only journal with torn-tail quarantine;
+  append-only journal with torn-tail quarantine, sync or ASYNC
+  GROUP-COMMIT durability (explicit bounded loss window, the
+  wire-speed ack contract — docs/DESIGN.md "Durability modes & the
+  ack contract");
 - :mod:`~redqueen_tpu.serving.service`  — :class:`ServingRuntime`
   (bounded queue, backpressure, shed accounting, stale-but-served
   decisions) and :func:`recover` (snapshot + journal replay,
@@ -37,10 +40,18 @@ deterministic fault injection) made load-bearing:
   ``--shards N``), where the ``RQ_FAULT=ingest:*`` delivery faults are
   applied.
 
+The cluster's shards live in-process, in supervised subprocess workers
+over pipes, or over authenticated TCP (``placement="sockets"`` — the
+cross-host mode with deterministic reconnect/reattach/resync and the
+``net:*`` link-fault kinds); the wire-speed ingest path (``coalesce``,
+``flush_mode="group"``, ``submit_many``) amortizes one jitted dispatch,
+one journal record, and one frame per poll round.
+
 Every failure mode runs deterministically in CI on CPU via
-``runtime.faultinject``'s ``ingest`` and ``shard`` fault kinds; see
-``docs/DESIGN.md`` "Online serving & ingest fault tolerance" and
-"Sharded serving & fault domains".
+``runtime.faultinject``'s ``ingest``, ``shard``, ``worker``, and
+``net`` fault kinds; see ``docs/DESIGN.md`` "Online serving & ingest
+fault tolerance", "Sharded serving & fault domains", and "Durability
+modes & the ack contract".
 """
 
 from __future__ import annotations
@@ -56,6 +67,8 @@ __all__ = [
     "Journal",
     "JournalError",
     "JOURNAL_SCHEMA",
+    "JOURNAL_GROUP_SCHEMA",
+    "FLUSH_MODES",
     "tear_tail",
     "ServingMetrics",
     "METRICS_SCHEMA",
@@ -76,10 +89,13 @@ __all__ = [
     "reshard",
     "CLUSTER_SCHEMA",
     "RESHARD_SCHEMA",
+    "PLACEMENTS",
+    "WORKER_PLACEMENTS",
     "FeedState",
     "Decision",
     "init_feed_state",
     "make_apply_fn",
+    "make_coalesced_apply_fn",
     "poison_edge",
     "state_digest",
     "drive",
@@ -112,11 +128,13 @@ _LAZY_ATTRS = {
     "ClusterDecision": ".cluster", "RESHARD_SCHEMA": ".cluster",
     "ServingCluster": ".cluster", "ShardRouter": ".cluster",
     "partition": ".cluster", "reshard": ".cluster",
-    "shard_seed": ".cluster",
+    "shard_seed": ".cluster", "PLACEMENTS": ".cluster",
+    "WORKER_PLACEMENTS": ".cluster",
     "EventBatch": ".events", "IngestError": ".events",
     "synthetic_stream": ".events", "validate_batch": ".events",
     "Sequencer": ".ingest",
     "JOURNAL_SCHEMA": ".journal", "Journal": ".journal",
+    "JOURNAL_GROUP_SCHEMA": ".journal", "FLUSH_MODES": ".journal",
     "JournalError": ".journal", "tear_tail": ".journal",
     "CLUSTER_METRICS_SCHEMA": ".metrics", "ClusterMetrics": ".metrics",
     "METRICS_SCHEMA": ".metrics", "ServingMetrics": ".metrics",
@@ -125,6 +143,7 @@ _LAZY_ATTRS = {
     "journal_decisions": ".service", "recover": ".service",
     "Decision": ".state", "FeedState": ".state",
     "init_feed_state": ".state", "make_apply_fn": ".state",
+    "make_coalesced_apply_fn": ".state",
     "poison_edge": ".state", "state_digest": ".state",
 }
 

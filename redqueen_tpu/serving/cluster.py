@@ -75,6 +75,35 @@ journal while the survivors keep serving.  Worker-level faults
 by the worker child itself at exact sub-batch seqs, so the
 SIGKILL-a-real-process chaos scenario runs deterministically on CPU.
 
+**Socket placement (cross-host shards).**  ``placement="sockets"``
+keeps everything above but moves the frames onto authenticated TCP
+(:class:`transport.Listener` per shard, hello token via
+``RQ_WORKER_TOKEN``): a worker may run on ANY host that can dial the
+router.  The network becomes a first-class failure domain with its own
+healing path — a dead LINK is not a dead WORKER: the worker redials
+under a deterministic RetryPolicy, the router reattaches the same live
+process (pid-matched hello, ``worker_reattach_grace_s``), classifies
+the episode as a timeout (degrade → probation), and RESYNCS the
+decisions whose response frames the link ate from the worker's bounded
+recent-ring (``replay_decisions``) — no journal replay for a mere
+partition, and the accounting identity stays closed (a resync and a
+salvaged late frame can never double-count: both filter to
+still-outstanding seqs).  ``net:drop|delay|partition|reconnect@shardK
+[,batchN]`` fault kinds drive every link failure deterministically in
+CI; :meth:`partition_shard` is the router-side chaos hook; and
+:meth:`remote_worker_commands` + ``SocketWorkerHandle.await_external``
+are the remote-spawn recipe.  See docs/DESIGN.md "Durability modes &
+the ack contract".
+
+**Wire-speed ingest.**  ``coalesce=K`` + ``flush_mode="group"`` +
+:meth:`submit_many` form the high-throughput path (ROADMAP item 2):
+one frame per round per shard, one jitted dispatch + one journal
+record per round per worker, acks inside an explicit bounded
+durability window (``max_unflushed_records`` / ``max_flush_delay_ms``,
+recorded by :meth:`durability` in every metrics artifact; a consumed
+window is reported per shard as ``lost_in_window`` and healed by
+retransmit).
+
 **Reshard (grow without genesis replay).**  :func:`reshard` migrates a
 drained N-shard directory to M shards by per-edge state migration: the
 per-edge ``(rank, health)`` carry, the cluster clock, and the stream
@@ -107,7 +136,7 @@ from .events import EventBatch, IngestError, validate_batch
 from .metrics import ClusterMetrics
 from .service import (RecoveryInfo, ServingRuntime, SNAPSHOTS_DIRNAME,
                       recover as _recover_runtime)
-from .transport import TransportError, TransportTimeout
+from .transport import TransportEOF, TransportError, TransportTimeout
 
 # NOTE: serving.worker is imported lazily (in _spawn_worker) — it
 # doubles as a ``python -m`` entry point, and an eager import here
@@ -117,9 +146,10 @@ from .transport import TransportError, TransportTimeout
 __all__ = ["ServingCluster", "ShardRouter", "ClusterAdmission",
            "ClusterDecision", "partition", "shard_seed", "reshard",
            "CLUSTER_SCHEMA", "RESHARD_SCHEMA", "PARTITION_VERSION",
-           "PLACEMENTS", "HEALTHY", "DEGRADED", "QUARANTINED",
-           "HEAL_AFTER", "QUARANTINE_AFTER", "WEDGE_FIRES",
-           "MAX_BACKOFF_ROUNDS", "DEFAULT_RESTART_POLICY"]
+           "PLACEMENTS", "WORKER_PLACEMENTS", "HEALTHY", "DEGRADED",
+           "QUARANTINED", "HEAL_AFTER", "QUARANTINE_AFTER",
+           "WEDGE_FIRES", "MAX_BACKOFF_ROUNDS",
+           "DEFAULT_RESTART_POLICY"]
 
 CLUSTER_SCHEMA = "rq.serving.cluster/1"
 RESHARD_SCHEMA = "rq.serving.reshard/1"
@@ -141,10 +171,16 @@ MAX_BACKOFF_ROUNDS = 8  # cap on the wedged-shard poll-round backoff
 RECOVERY_GIVE_UP = 3    # failed auto-recoveries before poll() raises
 
 # Shard placement modes: every fault domain lives in the router's
-# process ("in-process", PR 7) or in its own supervised subprocess
-# ("workers").  Interchangeable on disk — NOT part of the directory
-# identity.
-PLACEMENTS = ("in-process", "workers")
+# process ("in-process", PR 7), in its own supervised subprocess over
+# pipes ("workers", PR 8), or in a subprocess over an authenticated TCP
+# connection ("sockets" — same frame protocol, plus reconnect: the
+# cross-host placement, where a shard worker may run on ANY host that
+# can dial the router's per-shard listener).  Interchangeable on disk —
+# NOT part of the directory identity.
+PLACEMENTS = ("in-process", "workers", "sockets")
+# The placements whose shards live out of process (drive WorkerHandle
+# surfaces over frames).
+WORKER_PLACEMENTS = ("workers", "sockets")
 
 # Worker restart schedule (placement="workers"): the runtime.supervisor
 # RetryPolicy drives the crash-loop backoff — restart n of a crash
@@ -235,7 +271,7 @@ class _ShardSlot:
     __slots__ = ("k", "dir", "feeds", "s_slice", "runtime", "health",
                  "fail_streak", "clean_streak", "skip_rounds",
                  "recover_failures", "crash_streak", "restart_at",
-                 "outstanding")
+                 "outstanding", "listener", "acked_seq")
 
     def __init__(self, k: int, dir: Optional[str], feeds: np.ndarray,
                  s_slice: np.ndarray):
@@ -243,6 +279,12 @@ class _ShardSlot:
         self.dir = dir
         self.feeds = feeds          # global feed ids owned (ascending)
         self.s_slice = s_slice
+        # Socket placement: the per-shard accept point (survives worker
+        # restarts — the replacement dials the same address).
+        self.listener: Optional[Any] = None
+        # Highest seq OBSERVED applied (the ack watermark): what the
+        # group-commit loss report compares against at recovery.
+        self.acked_seq = -1
         # In-process: a ServingRuntime.  Worker placement: a
         # WorkerHandle presenting the same surface over the frame
         # protocol.  None = quarantined (no live fault domain).
@@ -270,6 +312,9 @@ class ServingCluster:
                  start_seq: int = 0, snapshot_every: int = 8,
                  reorder_window: int = 8, queue_capacity: int = 64,
                  max_batch_events: int = 256, fsync_every_n: int = 1,
+                 flush_mode: str = "sync",
+                 max_unflushed_records: int = 64,
+                 max_flush_delay_ms: float = 50.0, coalesce: int = 1,
                  placement: str = "in-process",
                  restart_policy: Optional[RetryPolicy] = None,
                  worker_request_timeout_s: float = 30.0,
@@ -277,16 +322,20 @@ class ServingCluster:
                  worker_heartbeat_every_s: float = 1.0,
                  worker_heartbeat_timeout_s: float = 30.0,
                  worker_read_timeout_s: float = 5.0,
+                 worker_reattach_grace_s: float = 8.0,
+                 listen_host: str = "127.0.0.1",
+                 token: Optional[str] = None,
+                 external_workers: bool = False,
                  clock=time.monotonic,
                  auto_recover: bool = True, _open_runtimes: bool = True):
         if placement not in PLACEMENTS:
             raise ValueError(f"placement must be one of {PLACEMENTS}, "
                              f"got {placement!r}")
-        if placement == "workers" and dir is None:
+        if placement in WORKER_PLACEMENTS and dir is None:
             raise ValueError(
-                "placement='workers' needs a cluster directory — a "
-                "worker subprocess owns its shard's on-disk state; an "
-                "in-memory fault domain cannot leave the process")
+                f"placement={placement!r} needs a cluster directory — a "
+                f"worker subprocess owns its shard's on-disk state; an "
+                f"in-memory fault domain cannot leave the process")
         self.n_feeds = int(n_feeds)
         self.n_shards = int(n_shards)
         self.dir = dir
@@ -301,6 +350,17 @@ class ServingCluster:
             raise ValueError(
                 f"fsync_every_n must be >= 1, got {fsync_every_n}")
         self.fsync_every_n = int(fsync_every_n)
+        from .journal import FLUSH_MODES as _FLUSH_MODES
+
+        if flush_mode not in _FLUSH_MODES:
+            raise ValueError(f"flush_mode must be one of "
+                             f"{_FLUSH_MODES}, got {flush_mode!r}")
+        self.flush_mode = str(flush_mode)
+        self.max_unflushed_records = int(max_unflushed_records)
+        self.max_flush_delay_ms = float(max_flush_delay_ms)
+        if int(coalesce) < 1:
+            raise ValueError(f"coalesce must be >= 1, got {coalesce}")
+        self.coalesce = int(coalesce)
         self.placement = placement
         self.restart_policy = restart_policy or DEFAULT_RESTART_POLICY
         self._restart_rng = self.restart_policy.rng()
@@ -310,6 +370,18 @@ class ServingCluster:
         self.worker_heartbeat_timeout_s = float(
             worker_heartbeat_timeout_s)
         self.worker_read_timeout_s = float(worker_read_timeout_s)
+        self.worker_reattach_grace_s = float(worker_reattach_grace_s)
+        self.listen_host = str(listen_host)
+        # The per-cluster socket credential: hello frames must carry it
+        # (and, on reattach, the same pid) or the connection is refused.
+        self.token = (token if token is not None
+                      else os.urandom(16).hex())
+        if external_workers and placement != "sockets":
+            raise ValueError(
+                f"external_workers=True needs placement='sockets' "
+                f"(only a TCP listener can adopt a worker someone else "
+                f"spawned), got placement={placement!r}")
+        self.external_workers = bool(external_workers)
         self.auto_recover = bool(auto_recover)
         self._clock = clock
         s = (np.ones(n_feeds) if s_sink is None
@@ -344,15 +416,15 @@ class ServingCluster:
                 f"RQ_FAULT targets shard {self._fault.shard} but this "
                 f"cluster has {self.n_shards} shard(s) (valid: 0.."
                 f"{self.n_shards - 1}) — the fault could never fire")
-        if self._fault is not None and self.placement == "workers":
+        if self._fault is not None and self._worker_mode:
             raise ValueError(
                 f"RQ_FAULT=shard:{self._fault.mode} is applied by the "
                 f"IN-PROCESS router and could never fire under "
-                f"placement='workers' — use the worker:* kinds (the "
-                f"worker child injures itself at the same seqs)")
+                f"placement={self.placement!r} — use the worker:* kinds "
+                f"(the worker child injures itself at the same seqs)")
         wfault = _faultinject.worker_fault()
         if wfault is not None:
-            if self.placement != "workers":
+            if not self._worker_mode:
                 raise ValueError(
                     f"RQ_FAULT=worker:{wfault.mode} targets an "
                     f"out-of-process shard worker but this cluster runs "
@@ -364,15 +436,44 @@ class ServingCluster:
                     f"this cluster has {self.n_shards} shard(s) (valid: "
                     f"0..{self.n_shards - 1}) — the fault could never "
                     f"fire")
+        nfault = _faultinject.net_fault()
+        if nfault is not None:
+            if self.placement != "sockets":
+                raise ValueError(
+                    f"RQ_FAULT=net:{nfault.mode} targets a SOCKET "
+                    f"worker's connection but this cluster runs "
+                    f"placement={self.placement!r} — the fault could "
+                    f"never fire (pipes cannot partition)")
+            if nfault.shard >= self.n_shards:
+                raise ValueError(
+                    f"RQ_FAULT targets net shard {nfault.shard} but "
+                    f"this cluster has {self.n_shards} shard(s) (valid: "
+                    f"0..{self.n_shards - 1}) — the fault could never "
+                    f"fire")
         self._fault_spent = False
         self._wedge_left = WEDGE_FIRES
 
         if _open_runtimes:
-            if self.placement == "workers":
+            if self.external_workers:
+                # The operator's workers dial in later
+                # (adopt_external_worker); create the listeners now so
+                # remote_worker_commands() can print the addresses.
+                from .transport import Listener
+
+                for slot in self._slots:
+                    slot.listener = Listener(host=self.listen_host,
+                                             clock=self._clock)
+            elif self._worker_mode:
                 self._open_workers(recover=False)
             else:
                 for slot in self._slots:
                     slot.runtime = self._fresh_runtime(slot)
+
+    @property
+    def _worker_mode(self) -> bool:
+        """True when shards live out of process (pipe or socket
+        placement) — the router drives WorkerHandle surfaces."""
+        return self.placement in WORKER_PLACEMENTS
 
     # ---- construction / config identity ----
 
@@ -386,12 +487,18 @@ class ServingCluster:
             "queue_capacity": self.queue_capacity,
             "max_batch_events": self.max_batch_events,
             "partition_version": PARTITION_VERSION,
-            # Durability knob (group commit) — recorded so recover()
-            # reuses it, EXCLUDED from the identity refusal below: it
-            # changes when records hit media, never what they say.
-            # (placement is likewise not identity: in-process and
-            # worker modes are interchangeable over the same directory.)
+            # Durability/throughput knobs — recorded so recover()
+            # reuses them, EXCLUDED from the identity refusal below:
+            # group commit changes when records hit media and coalescing
+            # changes how many batches share a dispatch/record, never
+            # what either says.  (placement is likewise not identity:
+            # in-process, worker, and socket modes are interchangeable
+            # over the same directory.)
             "fsync_every_n": self.fsync_every_n,
+            "flush_mode": self.flush_mode,
+            "max_unflushed_records": self.max_unflushed_records,
+            "max_flush_delay_ms": self.max_flush_delay_ms,
+            "coalesce": self.coalesce,
         }
 
     def _check_or_write_config(self) -> None:
@@ -424,7 +531,11 @@ class ServingCluster:
             reorder_window=self.reorder_window,
             queue_capacity=self.queue_capacity,
             max_batch_events=self.max_batch_events,
-            fsync_every_n=self.fsync_every_n, clock=self._clock)
+            fsync_every_n=self.fsync_every_n,
+            flush_mode=self.flush_mode,
+            max_unflushed_records=self.max_unflushed_records,
+            max_flush_delay_ms=self.max_flush_delay_ms,
+            coalesce=self.coalesce, clock=self._clock)
 
     # ---- worker placement plumbing ----
 
@@ -440,11 +551,28 @@ class ServingCluster:
                 "reorder_window": self.reorder_window,
                 "queue_capacity": self.queue_capacity,
                 "max_batch_events": self.max_batch_events,
-                "fsync_every_n": self.fsync_every_n}
+                "fsync_every_n": self.fsync_every_n,
+                "flush_mode": self.flush_mode,
+                "max_unflushed_records": self.max_unflushed_records,
+                "max_flush_delay_ms": self.max_flush_delay_ms,
+                "coalesce": self.coalesce}
 
     def _spawn_worker(self, slot: _ShardSlot) -> "WorkerHandle":  # noqa: F821
-        from .worker import WorkerHandle
+        from .worker import SocketWorkerHandle, WorkerHandle
 
+        if self.placement == "sockets":
+            if slot.listener is None:
+                from .transport import Listener
+
+                slot.listener = Listener(host=self.listen_host,
+                                         clock=self._clock)
+            return SocketWorkerHandle.spawn_socket(
+                slot.dir, slot.k, slot.listener, self.token,
+                heartbeat_every_s=self.worker_heartbeat_every_s,
+                request_timeout_s=self.worker_request_timeout_s,
+                open_timeout_s=self.worker_open_timeout_s,
+                read_timeout_s=self.worker_read_timeout_s,
+                clock=self._clock)
         return WorkerHandle.spawn(
             slot.dir, slot.k,
             heartbeat_every_s=self.worker_heartbeat_every_s,
@@ -453,6 +581,33 @@ class ServingCluster:
             read_timeout_s=self.worker_read_timeout_s,
             clock=self._clock)
 
+    def remote_worker_commands(self) -> List[Dict[str, Any]]:
+        """The REMOTE-SPAWN recipe (socket placement): one entry per
+        shard — the argv to run on any host that can reach this
+        router's listeners, plus the env var carrying the cluster token
+        (value supplied out of band, never printed).  The shard
+        directory path in the argv is as THIS host sees it; a remote
+        worker needs the same path visible (shared filesystem) or a
+        synced copy."""
+        if self.placement != "sockets":
+            raise ValueError(
+                f"remote spawn needs placement='sockets', this cluster "
+                f"runs {self.placement!r}")
+        from .transport import Listener
+        from .worker import SocketWorkerHandle
+
+        out = []
+        for slot in self._slots:
+            if slot.listener is None:
+                slot.listener = Listener(host=self.listen_host,
+                                         clock=self._clock)
+            out.append({
+                "shard": slot.k,
+                **SocketWorkerHandle.remote_command(
+                    slot.dir, slot.k, slot.listener.address,
+                    self.worker_heartbeat_every_s)})
+        return out
+
     def _open_workers(self, recover: bool) -> List[RecoveryInfo]:
         """Spawn one worker per shard and open/recover them ALL in
         flight (the fan-out parallelism the placement exists for: N
@@ -460,9 +615,33 @@ class ServingCluster:
         Any failure tears every worker down and raises — a cluster
         must come up whole or not at all."""
         infos: List[RecoveryInfo] = []
+        procs: List[Any] = []
         try:
-            for slot in self._slots:
-                slot.runtime = self._spawn_worker(slot)
+            if self.placement == "sockets":
+                # Launch ALL children first, then accept each hello —
+                # interpreter start + package import + dial overlap
+                # across shards (the same in-flight discipline the
+                # open/recover fan-out below uses).
+                from .transport import Listener
+                from .worker import SocketWorkerHandle
+
+                for slot in self._slots:
+                    if slot.listener is None:
+                        slot.listener = Listener(host=self.listen_host,
+                                                 clock=self._clock)
+                    procs.append(SocketWorkerHandle.launch(
+                        slot.dir, slot.k, slot.listener, self.token,
+                        heartbeat_every_s=self.worker_heartbeat_every_s))
+                for slot, proc in zip(self._slots, procs):
+                    slot.runtime = SocketWorkerHandle.from_child(
+                        proc, slot.k, slot.listener, self.token,
+                        request_timeout_s=self.worker_request_timeout_s,
+                        open_timeout_s=self.worker_open_timeout_s,
+                        read_timeout_s=self.worker_read_timeout_s,
+                        clock=self._clock)
+            else:
+                for slot in self._slots:
+                    slot.runtime = self._spawn_worker(slot)
             pending = []
             for slot in self._slots:
                 h = slot.runtime
@@ -479,6 +658,15 @@ class ServingCluster:
                 if slot.runtime is not None:
                     slot.runtime.kill()
                     slot.runtime = None
+            for proc in procs:
+                # launched-but-never-adopted children (the adopt loop
+                # raised before reaching them) must not outlive the
+                # failed open
+                if proc.poll() is None:
+                    try:
+                        proc.kill()
+                    except OSError:
+                        pass
             raise RuntimeError(
                 f"worker cluster failed to "
                 f"{'recover' if recover else 'open'}: "
@@ -525,6 +713,12 @@ class ServingCluster:
                  queue_capacity=int(cfg["queue_capacity"]),
                  max_batch_events=int(cfg["max_batch_events"]),
                  fsync_every_n=int(cfg.get("fsync_every_n", 1)),
+                 flush_mode=str(cfg.get("flush_mode", "sync")),
+                 max_unflushed_records=int(
+                     cfg.get("max_unflushed_records", 64)),
+                 max_flush_delay_ms=float(
+                     cfg.get("max_flush_delay_ms", 50.0)),
+                 coalesce=int(cfg.get("coalesce", 1)),
                  placement=placement, restart_policy=restart_policy,
                  worker_request_timeout_s=worker_request_timeout_s,
                  worker_open_timeout_s=worker_open_timeout_s,
@@ -533,7 +727,7 @@ class ServingCluster:
                  worker_read_timeout_s=worker_read_timeout_s,
                  clock=clock, auto_recover=auto_recover,
                  _open_runtimes=False)
-        if placement == "workers":
+        if placement in WORKER_PLACEMENTS:
             return cl, cl._open_workers(recover=True)
         infos: List[RecoveryInfo] = []
         for slot in cl._slots:
@@ -590,7 +784,7 @@ class ServingCluster:
         now = self._clock()
         statuses: List[Optional[str]] = [None] * self.n_shards
         backpressure = False
-        if self.placement == "workers":
+        if self._worker_mode:
             # Fan the sub-batches out to EVERY live worker before
             # collecting any admission — N journal fsyncs in flight at
             # once (the parallel-ingest win).  A worker that dies
@@ -610,8 +804,11 @@ class ServingCluster:
                     sent.append((slot,
                                  slot.runtime.start_submit(subs[slot.k])))
                 except TransportError as e:
-                    self._crash_slot(
-                        slot, f"worker died on submit send: {e}")
+                    # A severed socket link reattaches (degrade) rather
+                    # than crashing the worker; either way this round's
+                    # slice is shed-with-seq and retransmit covers it.
+                    self._lost_link(
+                        slot, e, f"worker died on submit send: {e}")
                     statuses[slot.k] = "unavailable"
                     self.metrics.observe_shed_unavailable(slot.k, seq)
                     backpressure = True
@@ -632,9 +829,9 @@ class ServingCluster:
                     backpressure = True
                     continue
                 except TransportError as e:
-                    self._crash_slot(
-                        slot, f"submit to worker failed: "
-                              f"{type(e).__name__}: {e}")
+                    self._lost_link(
+                        slot, e, f"submit to worker failed: "
+                                 f"{type(e).__name__}: {e}")
                     statuses[slot.k] = "unavailable"
                     self.metrics.observe_shed_unavailable(slot.k, seq)
                     backpressure = True
@@ -664,6 +861,116 @@ class ServingCluster:
         return ClusterAdmission(status, seq=seq,
                                 backpressure=backpressure,
                                 per_shard=tuple(statuses))
+
+    def submit_many(self, batches: List[EventBatch]
+                    ) -> List[ClusterAdmission]:
+        """Admit a whole ROUND of global micro-batches with ONE frame
+        round-trip per shard (``submit_many`` op) instead of one per
+        batch — the batched-frame half of the wire-speed ingest path.
+        Semantically identical to calling :meth:`submit` per batch (same
+        validation, same per-shard admissions, same ledger); only the
+        transport amortization differs.  In-process placement simply
+        loops (there is no frame to batch)."""
+        if not batches:
+            return []
+        if not self._worker_mode:
+            return [self.submit(b) for b in batches]
+        prepared = []  # (batch|None, subs|None, admission-or-None)
+        for batch in batches:
+            try:
+                v = validate_batch(batch, self.n_feeds,
+                                   max_events=self.max_batch_events)
+            except IngestError as e:
+                self.metrics.global_rejected += 1
+                for k in range(self.n_shards):
+                    self.metrics.observe_submitted(k)
+                    self.metrics.observe_rejected(k)
+                prepared.append((None, None, ClusterAdmission(
+                    "rejected", seq=e.seq, reason=str(e),
+                    per_shard=("rejected",) * self.n_shards)))
+                continue
+            prepared.append((v, self._split_batch(v), None))
+        now = self._clock()
+        n_valid = sum(1 for b, _, _ in prepared if b is not None)
+        statuses: Dict[int, List[Optional[str]]] = {}
+        bps: Dict[int, List[bool]] = {}
+        sent: List[Tuple[_ShardSlot, int]] = []
+
+        def shed_round(slot: _ShardSlot) -> None:
+            """One shard's whole-round failure outcome — the single
+            place the unavailable accounting lives (four failure paths
+            share it; a missed copy would skew the closed identity)."""
+            statuses[slot.k] = ["unavailable"] * n_valid
+            bps[slot.k] = [True] * n_valid
+            for b, _, _ in prepared:
+                if b is not None:
+                    self.metrics.observe_shed_unavailable(
+                        slot.k, int(b.seq))
+
+        for slot in self._slots:
+            for _ in range(n_valid):
+                self.metrics.observe_submitted(slot.k)
+            if slot.runtime is None:
+                shed_round(slot)
+                continue
+            shard_batches = [subs[slot.k] for b, subs, _ in prepared
+                             if b is not None]
+            try:
+                sent.append((slot, slot.runtime.start_submit_many(
+                    shard_batches)))
+            except TransportError as e:
+                self._lost_link(slot, e,
+                                f"worker died on submit_many send: {e}")
+                shed_round(slot)
+        for slot, rid in sent:
+            try:
+                adms = slot.runtime.finish_submit_many(rid)
+            except TransportTimeout as e:
+                self._on_timeout(slot,
+                                 f"submit_many deadline expired: {e}")
+                shed_round(slot)
+                continue
+            except TransportError as e:
+                self._lost_link(slot, e,
+                                f"submit_many to worker failed: "
+                                f"{type(e).__name__}: {e}")
+                shed_round(slot)
+                continue
+            sts: List[Optional[str]] = []
+            bp_list: List[bool] = []
+            i = 0
+            for b, subs, _ in prepared:
+                if b is None:
+                    continue
+                adm = adms[i]
+                i += 1
+                sts.append(adm.status)
+                bp_list.append(self._note_admission(
+                    slot, adm, subs[slot.k].n_events, int(b.seq), now))
+            statuses[slot.k] = sts
+            bps[slot.k] = bp_list
+        out: List[ClusterAdmission] = []
+        vi = 0
+        for b, _subs, rejected in prepared:
+            if rejected is not None:
+                out.append(rejected)
+                continue
+            per = tuple(statuses[k][vi] for k in range(self.n_shards))
+            # Per-BATCH backpressure, same as submit(): only the
+            # batches whose own admissions signalled it — a source must
+            # not over-throttle a whole round for one shed slice.
+            bp = any(bps[k][vi] for k in range(self.n_shards))
+            vi += 1
+            if all(st in ("accepted", "duplicate") for st in per):
+                status = "accepted"
+            elif all(st in ("shed", "unavailable") for st in per):
+                status = "shed"
+            else:
+                status = "partial"
+            out.append(ClusterAdmission(status, seq=int(b.seq),
+                                        backpressure=bp,
+                                        per_shard=per))
+        return out
 
     def _note_admission(self, slot: _ShardSlot, adm, n_events: int,
                         seq: int, now: float) -> bool:
@@ -698,7 +1005,7 @@ class ServingCluster:
         they were already drained by the time recovery runs, and their
         admissions never depend on the dead shard).  Returns the
         per-shard decision lists."""
-        if self.placement == "workers":
+        if self._worker_mode:
             return self._poll_workers(max_batches_per_shard)
         out: Dict[int, List[Any]] = {}
         for slot in self._slots:
@@ -763,8 +1070,8 @@ class ServingCluster:
             try:
                 dispatch.append((slot, h.start_poll(max_batches)))
             except TransportError as e:
-                self._crash_slot(slot,
-                                 f"worker died on poll send: {e}")
+                self._lost_link(slot, e,
+                                f"worker died on poll send: {e}")
         for slot, rid in dispatch:
             h = slot.runtime
             try:
@@ -779,9 +1086,38 @@ class ServingCluster:
                           f"{h.request_timeout_s:.1f}s expired "
                           f"(worker alive but unresponsive)")
                 continue
+            except TransportEOF as e:
+                # A dead LINK is not yet a dead WORKER under socket
+                # placement: give the same live process a grace window
+                # to redial (partition heal), then retry the response
+                # wait once — a reconnect-mode worker answers on the
+                # new connection; a partition-mode worker's response is
+                # gone and the retry times out (resync heals later).
+                if not self._lost_link(
+                        slot, e,
+                        f"poll failed: {type(e).__name__}: {e}"):
+                    continue
+                try:
+                    # Short retry deadline: only a clean link flap
+                    # (net:reconnect) re-delivers the response; a real
+                    # partition ate it and resync heals that — the
+                    # whole round must not stall on the apply budget.
+                    ds = h.finish_poll(rid, timeout_s=h.read_timeout_s)
+                except TransportTimeout:
+                    # The partition ate the response — the EXPECTED
+                    # outcome, already paid for by the reattach's
+                    # timeout strike; a second strike here would burn
+                    # 2/3 of the quarantine budget per episode.  Resync
+                    # recovers the journaled decisions.
+                    self._maybe_resync(slot, out[slot.k])
+                    continue
+                except TransportError as e2:
+                    self._crash_slot(
+                        slot, f"poll failed after reattach: "
+                              f"{type(e2).__name__}: {e2}")
+                    continue
             except TransportError as e:
-                # EOF (died mid-response — torn frame included),
-                # FrameError (poisoned byte stream), or WorkerOpError
+                # FrameError (poisoned byte stream) or WorkerOpError
                 # (the worker's runtime raised): the fault domain
                 # cannot be trusted mid-stream — SIGKILL + quarantine,
                 # recovery from durable state only.
@@ -791,7 +1127,74 @@ class ServingCluster:
                 continue
             self._observe_decisions(slot, ds, out[slot.k], clean=True)
             self._salvage_stale(slot, out[slot.k])
+            self._maybe_resync(slot, out[slot.k])
         return out
+
+    def _lost_link(self, slot: _ShardSlot, e: Exception,
+                   reason: str, wait: bool = True) -> bool:
+        """Classify a transport failure: under socket placement an EOF
+        from a still-running worker gets a reattach grace (the worker
+        redials under its RetryPolicy) — heal as a TIMEOUT (degrade,
+        probation) and resync, never a journal recovery.  Everything
+        else is a crash.  Returns True iff the link was reattached.
+
+        ``wait=False`` is the READ-path contract (decide/status/
+        digest): those ops are bounded by the short read deadline, so
+        they may only adopt an ALREADY-redialed worker (near-zero
+        grace) — a still-down link degrades the read immediately (one
+        fewer reporter) and the next poll round pays the full grace."""
+        h = slot.runtime
+        if (isinstance(e, TransportEOF) and h is not None
+                and getattr(h, "listener", None) is not None
+                and h.alive()):
+            grace = self.worker_reattach_grace_s if wait else 0.05
+            if h.try_reattach(grace):
+                self.metrics.observe_reattach(slot.k)
+                self._on_timeout(slot, f"link lost, worker "
+                                       f"reattached: {reason}")
+                return True
+            if not wait:
+                # Alive but not yet redialed: degrade this read, leave
+                # the worker for the poll round's full-grace reattach.
+                self._on_timeout(slot, f"link down (read path): "
+                                       f"{reason}")
+                return False
+        self._crash_slot(slot, reason)
+        return False
+
+    def _maybe_resync(self, slot: _ShardSlot, into: List[Any]) -> None:
+        """Heal the ledger after lost response frames: any outstanding
+        seq at or below the worker's last reported ``applied_seq`` was
+        applied+journaled worker-side but its decisions never reached
+        the router (net drop / partition / reconnect).  Pull them from
+        the worker's recent-ring (``replay_decisions``); an incomplete
+        ring sends the shard to the journal-recovery path rather than
+        trusting a hole."""
+        h = slot.runtime
+        if h is None or not slot.outstanding:
+            return
+        top = getattr(h, "last_polled_seq", None)
+        if top is None:
+            return
+        missed = [s for s in slot.outstanding if s <= top]
+        if not missed:
+            return
+        try:
+            ds, complete = h.replay_decisions(min(missed) - 1)
+        except TransportError as e:
+            self._lost_link(slot, e,
+                            f"resync failed: {type(e).__name__}: {e}")
+            return
+        if not complete:
+            self._crash_slot(
+                slot, f"resync ring incomplete for seqs {missed} — "
+                      f"recovering from the journal instead")
+            return
+        ds = [d for d in ds if int(d.seq) in slot.outstanding]
+        self.metrics.observe_resync(slot.k, len(ds))
+        # Late facts, not health evidence (clean=False) — the shard
+        # heals on in-deadline replies.
+        self._observe_decisions(slot, ds, into, clean=False)
 
     def _observe_decisions(self, slot: _ShardSlot, decisions: List[Any],
                            into: List[Any], clean: bool) -> None:
@@ -800,11 +1203,23 @@ class ServingCluster:
         collect the decision, and count clean applies toward heal."""
         for d in decisions:
             arrival = slot.outstanding.pop(int(d.seq), None)
-            latency = (None if arrival is None
-                       else self._clock() - arrival[0])
-            n_events = 0 if arrival is None else arrival[1]
+            if arrival is None:
+                # This seq was never ledgered "accepted" — its
+                # admission response died with the link and the slice
+                # was recorded shed_unavailable.  The books are already
+                # balanced (shed now, duplicate-ack on the healing
+                # retransmit), so ALSO counting an apply here would
+                # break the closed identity: submitted=1 but
+                # shed+applied=2.
+                continue
+            latency = self._clock() - arrival[0]
+            n_events = arrival[1]
             self.metrics.observe_applied(slot.k, n_events, d.post,
                                          latency)
+            # The ack watermark: what the group-commit loss report
+            # compares against when this shard next recovers.
+            if int(d.seq) > slot.acked_seq:
+                slot.acked_seq = int(d.seq)
             into.append(d)
             if clean:
                 self._on_clean(slot)
@@ -821,12 +1236,31 @@ class ServingCluster:
         for value in slot.runtime.drain_stale_polls():
             ds = [slot.runtime._decision(d)
                   for d in value.get("decisions", [])]
+            # Only seqs still outstanding: a late answer may race the
+            # resync protocol (or a crash reclassification) for the
+            # same seqs, and observing a seq twice would double-count
+            # ``applied`` and break the closed identity.
+            ds = [d for d in ds if int(d.seq) in slot.outstanding]
             self._observe_decisions(slot, ds, into, clean=False)
 
     def _poll_slot(self, slot: _ShardSlot,
                    max_batches: Optional[int]) -> List[Any]:
         decisions: List[Any] = []
         fault = None if self._fault_spent else self._fault
+        if fault is None:
+            # No shard fault armed: let the runtime drain its queue in
+            # coalesced groups (one dispatch + one record per group) —
+            # the in-process router only steps batch-by-batch to land
+            # injected faults at exact seqs.
+            try:
+                ds = slot.runtime.poll(max_batches=max_batches)
+            except Exception as e:  # noqa: BLE001 — apply/journal
+                # failure: the fault domain can no longer be made
+                # durable; quarantine it, keep the cluster serving.
+                self._crash_slot(slot, f"apply failed: {e}")
+                return decisions
+            self._observe_decisions(slot, ds, decisions, clean=True)
+            return decisions
         while max_batches is None or len(decisions) < max_batches:
             seq = slot.runtime.next_queued_seq()
             if seq is None:
@@ -919,7 +1353,7 @@ class ServingCluster:
         slot.health = QUARANTINED
         slot.fail_streak = slot.clean_streak = slot.skip_rounds = 0
         slot.crash_streak += 1
-        if self.placement == "workers":
+        if self._worker_mode:
             # Crash-loop backoff (runtime.supervisor RetryPolicy): the
             # n-th crash of a streak gates its restart delay(n) out —
             # a worker that dies on every recovery can't hot-loop the
@@ -968,6 +1402,9 @@ class ServingCluster:
                     fh.write(b"garbage (injected corrupt_snapshot)")
 
     def _try_auto_recover(self, slot: _ShardSlot) -> None:
+        if self.external_workers:
+            return  # the operator owns the processes; adoption is
+            # explicit (adopt_external_worker), never an auto-respawn
         try:
             self.recover_shard(slot.k)
         except Exception as e:  # noqa: BLE001 — a failed recovery must
@@ -975,7 +1412,7 @@ class ServingCluster:
             # up loudly after the bound (RECOVERY_GIVE_UP in process,
             # the RetryPolicy's max_attempts for worker restarts).
             slot.recover_failures += 1
-            if self.placement == "workers":
+            if self._worker_mode:
                 give_up = self.restart_policy.max_attempts
                 slot.restart_at = (self._clock()
                                    + self.restart_policy.delay(
@@ -1007,6 +1444,84 @@ class ServingCluster:
             raise ValueError(f"shard {k} is already quarantined")
         self._crash_slot(slot, reason)
 
+    def adopt_external_worker(self, k: int,
+                              accept_timeout_s: float = 300.0,
+                              recover: bool = False):
+        """PUBLIC remote-spawn adoption (socket placement): wait for an
+        operator-launched worker — another host, a container scheduler
+        — to dial shard ``k``'s listener (run the
+        :meth:`remote_worker_commands` recipe there), authenticate it,
+        and ``open`` (fresh) or ``recover`` (existing on-disk state,
+        with the group-commit loss window reported against the router's
+        ack watermark) its shard.  Returns the ``RecoveryInfo`` when
+        ``recover`` else None.  The cluster never SIGKILLs an adopted
+        worker's process (the remote supervisor owns it); a dead one is
+        quarantined until the operator adopts a replacement."""
+        if self.placement != "sockets":
+            raise ValueError(
+                f"adopt_external_worker needs placement='sockets', "
+                f"this cluster runs {self.placement!r}")
+        from .transport import Listener
+        from .worker import SocketWorkerHandle
+
+        slot = self._slots[k]
+        if slot.runtime is not None:
+            raise ValueError(f"shard {k} already has a live worker — "
+                             f"kill_shard it first")
+        if slot.listener is None:
+            slot.listener = Listener(host=self.listen_host,
+                                     clock=self._clock)
+        h = SocketWorkerHandle.await_external(
+            slot.k, slot.listener, self.token,
+            accept_timeout_s=accept_timeout_s,
+            request_timeout_s=self.worker_request_timeout_s,
+            open_timeout_s=self.worker_open_timeout_s,
+            read_timeout_s=self.worker_read_timeout_s,
+            clock=self._clock)
+        info = None
+        try:
+            if recover:
+                info = h.finish_recover(h.start_recover(
+                    acked_seq=(slot.acked_seq if slot.acked_seq >= 0
+                               else None)))
+            else:
+                h.finish_open(h.start_open(self._worker_config(slot)))
+        except TransportError as e:
+            h.kill()
+            raise RuntimeError(
+                f"adopted worker for shard {k} failed to "
+                f"{'recover' if recover else 'open'}: "
+                f"{type(e).__name__}: {e}") from e
+        slot.runtime = h
+        # Probation only after a crash history; a first adoption serves
+        # healthy.
+        slot.health = DEGRADED if slot.crash_streak else HEALTHY
+        slot.fail_streak = slot.clean_streak = slot.skip_rounds = 0
+        slot.recover_failures = 0
+        if info is not None:
+            self.metrics.observe_recovery(k, info.replayed, 0.0)
+            for seq in info.lost_acked_seqs:
+                self.metrics.observe_lost_in_window(k, seq)
+        return info
+
+    def partition_shard(self, k: int) -> None:
+        """Chaos hook (socket placement): sever shard ``k``'s connection
+        abruptly — the ROUTER side of a network partition.  The worker
+        process survives with its runtime intact, redials under its
+        RetryPolicy, and the next poll round reattaches it (hello pid
+        must match) and resyncs the decisions the dead link ate — no
+        journal replay, no bit divergence, accounting reconciles."""
+        if self.placement != "sockets":
+            raise ValueError(
+                f"partition_shard needs placement='sockets' (a pipe "
+                f"cannot partition), this cluster runs "
+                f"{self.placement!r}")
+        slot = self._slots[k]
+        if slot.runtime is None:
+            raise ValueError(f"shard {k} is quarantined — no link to "
+                             f"partition")
+        slot.runtime.sever_link()
+
     def recover_shard(self, k: int) -> RecoveryInfo:
         """Recover quarantined shard ``k`` in place: newest provable
         snapshot + digest-asserted journal replay (bit-identical carry
@@ -1022,11 +1537,20 @@ class ServingCluster:
             raise ValueError(
                 f"shard {k} has no directory — an in-memory cluster "
                 f"cannot recover a crashed fault domain")
+        if self.external_workers:
+            raise ValueError(
+                f"shard {k}'s workers are operator-spawned "
+                f"(external_workers=True) — this router cannot restart "
+                f"a process it does not own; launch a replacement from "
+                f"remote_worker_commands() and "
+                f"adopt_external_worker({k}, recover=True)")
         t0 = self._clock()
-        if self.placement == "workers":
+        if self._worker_mode:
             handle = self._spawn_worker(slot)
             try:
-                info = handle.finish_recover(handle.start_recover())
+                info = handle.finish_recover(handle.start_recover(
+                    acked_seq=(slot.acked_seq if slot.acked_seq >= 0
+                               else None)))
             except TransportError as e:
                 handle.kill()
                 raise RuntimeError(
@@ -1034,13 +1558,23 @@ class ServingCluster:
                     f"recover: {type(e).__name__}: {e}") from e
             rt = handle
         else:
-            rt, info = _recover_runtime(slot.dir, clock=self._clock)
+            rt, info = _recover_runtime(
+                slot.dir, clock=self._clock,
+                acked_seq=(slot.acked_seq if slot.acked_seq >= 0
+                           else None))
         ms = (self._clock() - t0) * 1e3
         slot.runtime = rt
         slot.health = DEGRADED
         slot.fail_streak = slot.clean_streak = slot.skip_rounds = 0
         slot.recover_failures = 0
         self.metrics.observe_recovery(k, info.replayed, ms)
+        # The group-commit durability window a power-style crash
+        # consumed: acked seqs the journal did not keep.  Recorded
+        # (diagnostic, never silent), healed by the source's
+        # retransmit-past-applied_seq contract — each retransmit
+        # re-enters the ledger as its own (submitted, applied) pair.
+        for seq in info.lost_acked_seqs:
+            self.metrics.observe_lost_in_window(k, seq)
         return info
 
     # ---- read / inspection paths ----
@@ -1062,7 +1596,8 @@ class ServingCluster:
             self._on_timeout(slot, f"status read timed out: {e}")
             return 0
         except TransportError as e:
-            self._crash_slot(slot, f"worker died on status: {e}")
+            self._lost_link(slot, e, f"worker died on status: {e}",
+                            wait=False)
             return 0
 
     @property
@@ -1104,7 +1639,8 @@ class ServingCluster:
                 self._on_timeout(s, f"status read timed out: {e}")
                 seqs.append(-1)
             except TransportError as e:
-                self._crash_slot(s, f"worker died on status: {e}")
+                self._lost_link(s, e, f"worker died on status: {e}",
+                                wait=False)
                 seqs.append(-1)
         return min(seqs)
 
@@ -1128,8 +1664,11 @@ class ServingCluster:
                 continue
             except TransportError as e:
                 # A dead worker degrades the read (one fewer reporter),
-                # never blocks it.
-                self._crash_slot(slot, f"worker died on decide: {e}")
+                # never blocks it; a severed socket link reattaches
+                # only if the worker already redialed (wait=False: the
+                # read path never pays the full grace).
+                self._lost_link(slot, e, f"worker died on decide: {e}",
+                                wait=False)
                 continue
             if d is not None:
                 per.append(d)
@@ -1159,7 +1698,8 @@ class ServingCluster:
                 self._on_timeout(s, f"digest read timed out: {e}")
                 out[s.k] = None
             except TransportError as e:
-                self._crash_slot(s, f"worker died on digest: {e}")
+                self._lost_link(s, e, f"worker died on digest: {e}",
+                                wait=False)
                 out[s.k] = None
         return out
 
@@ -1237,7 +1777,7 @@ class ServingCluster:
             except TransportTimeout as e:
                 self._on_timeout(s, f"snapshot deadline expired: {e}")
             except TransportError as e:
-                self._crash_slot(s, f"worker died on snapshot: {e}")
+                self._lost_link(s, e, f"worker died on snapshot: {e}")
         return out
 
     def write_metrics(self, path: Optional[str] = None,
@@ -1250,16 +1790,32 @@ class ServingCluster:
                 raise ValueError("no cluster directory and no path given")
             path = os.path.join(self.dir, "metrics.json")
         base = {"n_feeds": self.n_feeds, "q": self.q,
-                "applied_seq": self.applied_seq}
+                "applied_seq": self.applied_seq,
+                "durability": self.durability()}
         if extra:
             base.update(extra)
         return self.metrics.write(path, self.pending_by_shard,
                                   self.health_by_shard, extra=base)
 
+    def durability(self) -> Dict[str, Any]:
+        """The cluster's configured durability window (identical on
+        every shard) — committed in the ``rq.serving.metrics/2``
+        artifact so no throughput number is ever quoted without its
+        durability cost (``journal.durability_info`` is the one
+        definition)."""
+        from .journal import durability_info
+
+        return durability_info(self.flush_mode, self.fsync_every_n,
+                               self.max_unflushed_records,
+                               self.max_flush_delay_ms, self.coalesce)
+
     def close(self) -> None:
         for slot in self._slots:
             if slot.runtime is not None:
                 slot.runtime.close()
+            if slot.listener is not None:
+                slot.listener.close()
+                slot.listener = None
 
     def reset_metrics(self) -> None:
         """Fresh router ledger (bench warm-up exclusion); refused while
@@ -1277,8 +1833,8 @@ class ServingCluster:
                     self._on_timeout(
                         slot, f"reset_metrics timed out: {e}")
                 except TransportError as e:
-                    self._crash_slot(
-                        slot, f"worker died on reset_metrics: {e}")
+                    self._lost_link(
+                        slot, e, f"worker died on reset_metrics: {e}")
             slot.outstanding.clear()
         self.metrics = ClusterMetrics(self.n_shards, clock=self._clock)
 
@@ -1342,7 +1898,11 @@ def reshard(src_dir: str, dst_dir: str, n_shards: int,
         reorder_window=int(cfg["reorder_window"]),
         queue_capacity=int(cfg["queue_capacity"]),
         max_batch_events=int(cfg["max_batch_events"]),
-        fsync_every_n=int(cfg.get("fsync_every_n", 1)), clock=clock)
+        fsync_every_n=int(cfg.get("fsync_every_n", 1)),
+        flush_mode=str(cfg.get("flush_mode", "sync")),
+        max_unflushed_records=int(cfg.get("max_unflushed_records", 64)),
+        max_flush_delay_ms=float(cfg.get("max_flush_delay_ms", 50.0)),
+        coalesce=int(cfg.get("coalesce", 1)), clock=clock)
     try:
         for slot in dst._slots:
             st = slot.runtime.carry

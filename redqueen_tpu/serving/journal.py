@@ -1,12 +1,15 @@
 """Crash-safe state journaling: checksummed append-only record log.
 
 The journal is the serving runtime's write-ahead source of truth: every
-applied micro-batch lands as ONE JSONL record — the batch's events, the
-decision taken, and the post-apply carry digest — wrapped in the same
-checksummed envelope format as every other artifact in the repo
-(``runtime.integrity.make_envelope``; schema ``rq.serving.journal/1``).
-Appends are flushed + fsynced before the apply is acknowledged, so a
-SIGKILL at ANY instruction boundary leaves one of exactly two shapes:
+applied micro-batch (schema ``rq.serving.journal/1``) or coalesced GROUP
+of micro-batches (schema ``rq.serving.journal/2`` — the wire-speed
+ingest path journals one record per poll round) lands as ONE JSONL
+record — the events, the decision(s) taken, and the post-apply carry
+digest — wrapped in the same checksummed envelope format as every other
+artifact in the repo (``runtime.integrity.make_envelope``).
+Under the default durability mode appends are flushed + fsynced before
+the apply is acknowledged, so a SIGKILL at ANY instruction boundary
+leaves one of exactly two shapes:
 
 - every acknowledged batch is a complete, verifiable record;
 - plus at most one **torn tail** — a partial last line from an append the
@@ -31,20 +34,78 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..runtime import integrity as _integrity
 
 __all__ = ["Journal", "JournalError", "replay", "tear_tail",
            "rotate", "prune_segments", "segment_paths",
-           "JOURNAL_SCHEMA", "JOURNAL_FILENAME"]
+           "durability_info",
+           "JOURNAL_SCHEMA", "JOURNAL_GROUP_SCHEMA", "JOURNAL_FILENAME",
+           "FLUSH_MODES"]
 
 JOURNAL_SCHEMA = "rq.serving.journal/1"
+# One coalesced poll ROUND per record: {"seqs", "counts", flat "times"/
+# "feeds", "decisions", "state_digest"} — times/feeds stay flat so
+# flat-array consumers (learn.ingest.from_journal) read both schemas
+# through one code path.
+JOURNAL_GROUP_SCHEMA = "rq.serving.journal/2"
+
+# Durability modes (the ack contract; see docs/DESIGN.md "Durability
+# modes & the ack contract"):
+#
+# - "sync"  — append() returns only after the record is flushed, and
+#   fsynced every ``fsync_every_n``-th append (n=1: every append — the
+#   PR 6 contract: the ack IS the fsync).
+# - "group" — ASYNC GROUP COMMIT: append() returns after the OS-level
+#   flush; a background thread forces the fsync within
+#   ``max_flush_delay_ms``, and append() forces it inline the moment
+#   ``max_unflushed_records`` acked records are in flight.  The ack
+#   races the fsync inside an EXPLICIT, bounded durability window: a
+#   power-style crash loses at most ``max_unflushed_records`` acked
+#   records (or ``max_flush_delay_ms`` of acks, whichever bound fires
+#   first); recovery reports exactly which acked seqs were lost
+#   (``RecoveryInfo.lost_acked_seqs``) and the source's retransmit
+#   heals them.  A plain process SIGKILL loses nothing: the flushed
+#   bytes survive in the page cache.
+FLUSH_MODES = ("sync", "group")
 
 # The on-disk journal filename inside a runtime/shard directory — a
 # cross-subsystem contract: the serving runtime writes it and external
 # consumers (learn.ingest.from_journal) locate it by this name.
 JOURNAL_FILENAME = "journal.jsonl"
+
+
+def durability_info(flush_mode: str, fsync_every_n: int,
+                    max_unflushed_records: int,
+                    max_flush_delay_ms: float,
+                    coalesce: int) -> Dict[str, Any]:
+    """THE durability-window description (one definition — the runtime
+    and the cluster both embed it in their metrics artifacts, and the
+    two must never drift): what an ack MEANS under this configuration,
+    and the bounded loss a machine-level crash may consume.  See
+    docs/DESIGN.md "Durability modes & the ack contract"."""
+    if flush_mode == "group":
+        window_records = int(max_unflushed_records) - 1
+    else:
+        window_records = int(fsync_every_n) - 1
+    return {
+        "flush_mode": str(flush_mode),
+        "fsync_every_n": int(fsync_every_n),
+        "max_unflushed_records": int(max_unflushed_records),
+        "max_flush_delay_ms": float(max_flush_delay_ms),
+        "coalesce": int(coalesce),
+        # True iff an ack implies the record is on media (the PR 6
+        # contract); False means the ack races the fsync inside the
+        # bounded window below.
+        "ack_is_durable": window_records == 0,
+        # A machine-level crash loses at most this many acked journal
+        # RECORDS; one record covers up to ``coalesce`` batches, so the
+        # batch bound is the product.
+        "loss_window_records": window_records,
+        "loss_window_batches": window_records * int(coalesce),
+    }
 
 
 class JournalError(RuntimeError):
@@ -65,55 +126,226 @@ class JournalError(RuntimeError):
 
 class Journal:
     """Append-only writer.  One instance owns the file handle; appends
-    are atomic at the OS-write level (single ``write`` of one line) and
-    durable (flush + fsync) before :meth:`append` returns — the "applied"
-    acknowledgement the serving runtime gives its source is backed by
-    this fsync.
+    are atomic at the OS-write level (single ``write`` of one line).
 
-    ``fsync_every_n`` is the GROUP-COMMIT option (default 1 = fsync per
-    append, today's behavior): with n > 1 the fsync lands every n-th
-    append (and at :meth:`sync`/:meth:`close`/rotation), trading the
-    per-batch fsync — the measured per-shard isolation tax — for a
-    BOUNDED durability loss window: a hard crash may lose up to the
-    last n-1 acknowledged records (they were flushed to the OS, not
-    forced to media).  Recovery semantics are unchanged: replay still
-    verifies the surviving prefix record-by-record and quarantines a
-    torn tail; the source's retransmit-past-``applied_seq`` contract
-    re-covers the lost suffix exactly as it covers a crash between
-    batches.  See docs/DESIGN.md "Out-of-process shard workers"."""
+    ``flush_mode="sync"`` (default): appends are durable (flush + fsync)
+    before :meth:`append` returns — the "applied" acknowledgement the
+    serving runtime gives its source is backed by this fsync.
+    ``fsync_every_n`` is the SYNCHRONOUS group-commit option (default 1
+    = fsync per append): with n > 1 the fsync lands every n-th append
+    (and at :meth:`sync`/:meth:`close`/rotation), trading the per-batch
+    fsync for a bounded loss window of n-1 acked records.
 
-    def __init__(self, path: str, fsync_every_n: int = 1):
+    ``flush_mode="group"`` is ASYNC group commit — the wire-speed mode:
+    :meth:`append` returns after the OS-level flush, a daemon thread
+    forces the fsync within ``max_flush_delay_ms``, and the window is
+    hard-bounded because append() fsyncs INLINE once
+    ``max_unflushed_records`` acked records are un-forced.  The
+    durability watermark (:attr:`durable_seq` / ``durable_offset``) is
+    what a power-style crash provably keeps; everything acked past it is
+    the documented loss window, healed by retransmit (see the module
+    docstring and docs/DESIGN.md "Durability modes & the ack
+    contract")."""
+
+    def __init__(self, path: str, fsync_every_n: int = 1,
+                 flush_mode: str = "sync",
+                 max_unflushed_records: int = 64,
+                 max_flush_delay_ms: float = 50.0):
         if int(fsync_every_n) < 1:
             raise ValueError(
                 f"fsync_every_n must be >= 1, got {fsync_every_n}")
+        if flush_mode not in FLUSH_MODES:
+            raise ValueError(f"flush_mode must be one of {FLUSH_MODES}, "
+                             f"got {flush_mode!r}")
+        if int(max_unflushed_records) < 1:
+            raise ValueError(f"max_unflushed_records must be >= 1, got "
+                             f"{max_unflushed_records}")
+        if float(max_flush_delay_ms) <= 0:
+            raise ValueError(f"max_flush_delay_ms must be > 0, got "
+                             f"{max_flush_delay_ms}")
         self.path = path
         self.fsync_every_n = int(fsync_every_n)
+        self.flush_mode = flush_mode
+        self.max_unflushed_records = int(max_unflushed_records)
+        self.max_flush_delay_ms = float(max_flush_delay_ms)
         self._unsynced = 0
         self._f = open(path, "a", encoding="utf-8")
+        # Durability watermark.  Pre-existing bytes were fsynced by the
+        # writer that produced them (close/rotation/recovery all sync),
+        # so the baseline is the current EOF; ``durable_seq`` is None
+        # until this instance forces its first fsync (records before
+        # this instance are outside its ack window by construction).
+        self._lock = threading.Lock()
+        self._written_offset = self._f.tell()
+        self._written_seq: Optional[int] = None
+        self._written_records = 0
+        self._durable_offset = self._written_offset
+        self._durable_seq: Optional[int] = None
+        self._durable_records = 0
+        self._stop = threading.Event()
+        self._flush_errors = 0
+        self._flusher: Optional[threading.Thread] = None
+        if self.flush_mode == "group":
+            self._flusher = threading.Thread(
+                target=self._flush_loop, daemon=True,
+                name=f"journal-flush:{os.path.basename(path)}")
+            self._flusher.start()
 
-    def append(self, payload: Dict[str, Any]) -> None:
-        env = _integrity.make_envelope(payload, schema=JOURNAL_SCHEMA)
+    # -- durability watermark (what a power-style crash provably keeps) --
+
+    @property
+    def durable_offset(self) -> int:
+        with self._lock:
+            return self._durable_offset
+
+    @property
+    def durable_seq(self) -> Optional[int]:
+        """Highest appended seq known forced to media by THIS instance
+        (None before its first fsync — earlier records belong to a
+        previous, cleanly-synced instance)."""
+        with self._lock:
+            return self._durable_seq
+
+    @property
+    def flush_errors(self) -> int:
+        """Background-flush fsync failures survived so far (each one
+        delayed the time bound by one tick; persistent failure ends in
+        the inline fsync raising)."""
+        with self._lock:
+            return self._flush_errors
+
+    @property
+    def unsynced(self) -> int:
+        """Acked-but-not-yet-forced records — the live durability
+        window (always <= ``max_unflushed_records`` in group mode)."""
+        with self._lock:
+            return self._written_records - self._durable_records
+
+    def _fsync_locked(self) -> None:
+        """fsync + advance the watermark.  Caller holds ``_lock`` —
+        the INLINE path only (window bound, sync mode, close): blocking
+        the ack here is the contract, not a stall."""
+        os.fsync(self._f.fileno())
+        self._durable_offset = self._written_offset
+        self._durable_seq = self._written_seq
+        self._durable_records = self._written_records
+        self._unsynced = 0
+
+    def _flush_loop(self) -> None:
+        """The background group-commit flusher: every
+        ``max_flush_delay_ms`` it forces any acked-but-unfsynced tail to
+        media — the TIME bound of the durability window (the RECORD
+        bound is enforced inline by :meth:`append`).  The fsync runs
+        OUTSIDE the journal lock: on this class of filesystem an fsync
+        costs tens of milliseconds, and holding the lock across it
+        would stall every concurrent append — reintroducing exactly the
+        ack-blocks-on-media tax async group commit exists to remove.
+        The watermark is captured before the fsync and advanced after,
+        so it is always conservative (never claims more durable than
+        the fsync actually covered)."""
+        delay = self.max_flush_delay_ms / 1e3
+        while not self._stop.wait(delay):
+            with self._lock:
+                if self._f.closed \
+                        or self._written_records == self._durable_records:
+                    continue
+                off = self._written_offset
+                seq = self._written_seq
+                recs = self._written_records
+                fd = self._f.fileno()
+            try:
+                os.fsync(fd)
+            except ValueError:
+                return  # fd closed under us: clean shutdown race
+            except OSError:
+                # A transient fsync failure must not PERMANENTLY void
+                # the advertised time bound: count it (visible via
+                # ``flush_errors``) and retry next tick — the volume
+                # may heal.  A persistent failure still fails loudly:
+                # the window fills, append()'s INLINE fsync raises, and
+                # the runtime's fatal-append contract takes the
+                # process down.
+                with self._lock:
+                    self._flush_errors += 1
+                continue
+            with self._lock:
+                if off > self._durable_offset:
+                    self._durable_offset = off
+                    self._durable_seq = seq
+                    self._durable_records = recs
+                    self._unsynced = max(
+                        0, self._written_records - recs)
+
+    def append(self, payload: Dict[str, Any],
+               seq: Optional[int] = None) -> None:
+        """Append one record.  ``seq`` tags the record's LAST applied
+        sequence number for the durability watermark (group records pass
+        their trailing seq)."""
+        env = _integrity.make_envelope(
+            payload, schema=(JOURNAL_GROUP_SCHEMA if "seqs" in payload
+                             else JOURNAL_SCHEMA))
         line = json.dumps(env, separators=(",", ":")) + "\n"
-        self._f.write(line)
-        self._f.flush()
-        self._unsynced += 1
-        if self._unsynced >= self.fsync_every_n:
-            os.fsync(self._f.fileno())
-            self._unsynced = 0
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
+            self._written_offset = self._f.tell()
+            self._written_records += 1
+            if seq is not None:
+                self._written_seq = int(seq)
+            elif "seq" in payload:
+                self._written_seq = int(payload["seq"])
+            self._unsynced += 1
+            if self.flush_mode == "group":
+                # The record bound: the ack below may precede the fsync
+                # by at most max_unflushed_records records — when the
+                # window is full the append BLOCKS on the fsync (the
+                # hard bound; the background thread normally keeps the
+                # window far from full).
+                if (self._written_records - self._durable_records
+                        >= self.max_unflushed_records):
+                    self._fsync_locked()
+            elif self._unsynced >= self.fsync_every_n:
+                self._fsync_locked()
 
     def sync(self) -> None:
         """Force any group-commit tail to media now (a no-op at
-        ``fsync_every_n=1``)."""
-        if not self._f.closed and self._unsynced:
+        ``fsync_every_n=1`` in sync mode)."""
+        with self._lock:
+            if not self._f.closed \
+                    and self._written_records > self._durable_records:
+                self._f.flush()
+                self._fsync_locked()
+
+    def power_loss(self) -> Dict[str, Any]:
+        """TEST RIG (the ``ingest:crash_in_window`` fault body): drop
+        every byte past the durability watermark, exactly what a
+        machine-level crash (power loss, kernel panic) does to acked
+        records whose fsync had not yet landed.  A plain SIGKILL does
+        NOT do this — flushed bytes survive the process in the page
+        cache — so the loss window is simulated deterministically here.
+        Returns what was dropped, for assertions.  The journal is dead
+        afterwards (the caller exits)."""
+        with self._lock:
+            self._stop.set()
             self._f.flush()
-            os.fsync(self._f.fileno())
-            self._unsynced = 0
+            end = self._f.tell()
+            os.truncate(self.path, self._durable_offset)
+            return {"path": self.path,
+                    "durable_offset": self._durable_offset,
+                    "durable_seq": self._durable_seq,
+                    "dropped_bytes": end - self._durable_offset,
+                    "dropped_records": self._unsynced}
 
     def close(self) -> None:
-        if not self._f.closed:
-            self.sync()
-            self._f.flush()
-            self._f.close()
+        self._stop.set()
+        if self._flusher is not None and self._flusher.is_alive():
+            self._flusher.join(timeout=5.0)
+        with self._lock:
+            if not self._f.closed:
+                if self._written_records > self._durable_records:
+                    self._f.flush()
+                    self._fsync_locked()
+                self._f.close()
 
     def __enter__(self):
         return self
@@ -185,9 +417,14 @@ def _replay_file(path: str, quarantine_torn_tail: bool,
             continue
         try:
             obj = json.loads(raw.decode("utf-8"))
-            payload = _integrity.verify_envelope(
-                obj, schema=JOURNAL_SCHEMA,
-                where=f"{path} record {record_base + len(payloads)}")
+            where = f"{path} record {record_base + len(payloads)}"
+            payload = _integrity.verify_envelope(obj, where=where)
+            if obj.get("schema") not in (JOURNAL_SCHEMA,
+                                         JOURNAL_GROUP_SCHEMA):
+                raise _integrity.CorruptArtifactError(
+                    where, f"schema mismatch (want {JOURNAL_SCHEMA!r} "
+                           f"or {JOURNAL_GROUP_SCHEMA!r}, found "
+                           f"{obj.get('schema')!r})")
         except (ValueError, _integrity.CorruptArtifactError) as e:
             if not at_tail:
                 raise JournalError(path, record_base + len(payloads),

@@ -51,10 +51,11 @@ from ..runtime import faultinject as _faultinject
 from ..runtime import integrity as _integrity
 from .events import EventBatch, IngestError, validate_batch
 from .ingest import Sequencer
-from .journal import JOURNAL_FILENAME, Journal, replay as journal_replay
+from .journal import (FLUSH_MODES, JOURNAL_FILENAME, Journal,
+                      replay as journal_replay)
 from .metrics import ServingMetrics
 from .state import (Decision, FeedState, init_feed_state, make_apply_fn,
-                    poison_edge, state_digest)
+                    make_coalesced_apply_fn, poison_edge, state_digest)
 
 __all__ = ["ServingRuntime", "Admission", "RecoveryInfo", "recover",
            "journal_decisions", "CONFIG_SCHEMA", "SNAPSHOTS_DIRNAME"]
@@ -86,10 +87,15 @@ class RecoveryInfo(NamedTuple):
     journal contributed."""
 
     snapshot_seq: Optional[int]   # orbax step restored, None = fresh
-    replayed: int                 # journal records re-applied
-    skipped: int                  # records already inside the snapshot
+    replayed: int                 # journal batches re-applied
+    skipped: int                  # batches already inside the snapshot
     torn: Optional[Dict[str, Any]]  # quarantined-tail info, None = clean
     recovered_seq: int            # the carry's seq after recovery
+    # Acked seqs the journal did NOT keep — the group-commit durability
+    # window a power-style crash actually consumed.  Non-empty only when
+    # the caller told recover() its ack high-water mark (``acked_seq``);
+    # the source's retransmit past ``recovered_seq`` heals exactly these.
+    lost_acked_seqs: Tuple[int, ...] = ()
 
 
 def _pad_events(times, feeds, max_batch_events: int):
@@ -116,7 +122,10 @@ class ServingRuntime:
                  dir: Optional[str] = None, start_seq: int = 0,
                  snapshot_every: int = 8, reorder_window: int = 8,
                  queue_capacity: int = 64, max_batch_events: int = 256,
-                 fsync_every_n: int = 1, clock=time.monotonic,
+                 fsync_every_n: int = 1, flush_mode: str = "sync",
+                 max_unflushed_records: int = 64,
+                 max_flush_delay_ms: float = 50.0, coalesce: int = 1,
+                 clock=time.monotonic,
                  _state: Optional[FeedState] = None):
         import jax.numpy as jnp
 
@@ -151,10 +160,21 @@ class ServingRuntime:
             raise ValueError(
                 f"fsync_every_n must be >= 1, got {fsync_every_n}")
         self.fsync_every_n = int(fsync_every_n)
+        if flush_mode not in FLUSH_MODES:
+            raise ValueError(f"flush_mode must be one of {FLUSH_MODES}, "
+                             f"got {flush_mode!r}")
+        self.flush_mode = flush_mode
+        self.max_unflushed_records = int(max_unflushed_records)
+        self.max_flush_delay_ms = float(max_flush_delay_ms)
+        if int(coalesce) < 1:
+            raise ValueError(f"coalesce must be >= 1, got {coalesce}")
+        self.coalesce = int(coalesce)
         self._clock = clock
         self._s_sink = jnp.asarray(s, jnp.float32)
         self._q = jnp.asarray(self.q, jnp.float32)
         self._apply = make_apply_fn()
+        self._apply_many = (make_coalesced_apply_fn()
+                            if self.coalesce > 1 else None)
         self._queue: collections.deque = collections.deque()
         # arrival stamps for batches held in the reorder window (popped
         # when they drain into the queue; bounded by the window size)
@@ -185,11 +205,18 @@ class ServingRuntime:
                 "reorder_window": int(reorder_window),
                 "queue_capacity": self.queue_capacity,
                 "max_batch_events": self.max_batch_events,
-                # Durability knob, NOT replay identity: group-commit
-                # changes when records hit media, never what they say —
-                # so it is recorded (recover() reuses it) but excluded
+                # Durability/throughput knobs, NOT replay identity:
+                # group commit changes when records hit media and
+                # coalescing changes how many batches share a dispatch/
+                # record, never what either says (the coalesced apply is
+                # grouping-invariant bitwise — asserted in tests) — so
+                # they are recorded (recover() reuses them) but excluded
                 # from the mismatch refusal below.
                 "fsync_every_n": self.fsync_every_n,
+                "flush_mode": self.flush_mode,
+                "max_unflushed_records": self.max_unflushed_records,
+                "max_flush_delay_ms": self.max_flush_delay_ms,
+                "coalesce": self.coalesce,
             }
             if os.path.exists(cfg_path):
                 # The stored config is the directory's identity: the
@@ -216,8 +243,12 @@ class ServingRuntime:
             else:
                 _integrity.write_json(cfg_path, cfg,
                                       schema=CONFIG_SCHEMA)
-            self._journal = Journal(os.path.join(dir, _JOURNAL),
-                                    fsync_every_n=self.fsync_every_n)
+            self._journal = Journal(
+                os.path.join(dir, _JOURNAL),
+                fsync_every_n=self.fsync_every_n,
+                flush_mode=self.flush_mode,
+                max_unflushed_records=self.max_unflushed_records,
+                max_flush_delay_ms=self.max_flush_delay_ms)
 
     # ---- ingest path ----
 
@@ -422,19 +453,7 @@ class ServingRuntime:
                     f"journal append failed for batch {batch.seq}: {e} "
                     f"— serving state can no longer be made durable; "
                     f"restart and recover from {self.dir}") from e
-            if (self._fault is not None
-                    and self._fault.mode == "torn_journal"
-                    and int(batch.seq) == self._fault.batch):
-                # Crash DURING this append: the record went out torn and
-                # the process died before the commit/snapshot below —
-                # the batch was never acknowledged, so the journal and
-                # snapshots stay mutually consistent at seq N-1 and the
-                # source will retransmit N.  Tear the line we just
-                # wrote, then die without cleanup.
-                from .journal import tear_tail
-
-                tear_tail(self._journal.path)
-                os._exit(19)
+            self._post_append_faults(int(batch.seq))
         self._state = new_state
         self._last_decision = decision
         latency = (self._clock() - submitted_at
@@ -444,24 +463,155 @@ class ServingRuntime:
         if self.dir is not None and \
                 self._since_snapshot >= self.snapshot_every:
             self.snapshot()
-        if (self._fault is not None
-                and self._fault.mode == "crash_after_apply"
-                and int(batch.seq) == self._fault.batch):
+        self._post_commit_faults(int(batch.seq))
+        return decision
+
+    def _post_append_faults(self, seq: int) -> None:
+        """Ingest faults that fire right after seq's journal append
+        (shared by the per-batch and coalesced paths — a coalesced group
+        is pre-split so the addressed batch always ENDS its record)."""
+        f = self._fault
+        if f is None or f.batch != seq:
+            return
+        if f.mode == "torn_journal":
+            # Crash DURING this append: the record went out torn and
+            # the process died before the commit/snapshot — the batch
+            # was never acknowledged, so the journal and snapshots stay
+            # mutually consistent at the previous seq and the source
+            # will retransmit.  Tear the line we just wrote, then die
+            # without cleanup.
+            from .journal import tear_tail
+
+            tear_tail(self._journal.path)
+            os._exit(19)
+        if f.mode == "crash_in_window":
+            # The POWER-LOSS shape: the append was acked but its fsync
+            # had not landed — drop every byte past the durability
+            # watermark (what a machine crash provably keeps), then die.
+            # Under flush_mode="sync"/fsync_every_n=1 the watermark IS
+            # the last append and this degenerates to a plain crash;
+            # under group commit it consumes the documented loss window.
+            self._journal.power_loss()
+            os._exit(23)
+
+    def _post_commit_faults(self, seq: int) -> None:
+        f = self._fault
+        if (f is not None and f.mode == "crash_after_apply"
+                and f.batch == seq):
             # The kill -9 shape: no atexit, no flush beyond the fsyncs
             # already landed — the acceptance test's mid-stream SIGKILL.
+            # (Flushed-but-unfsynced group-commit bytes survive a
+            # process kill in the page cache, so this stays lossless
+            # under async group commit too.)
             os._exit(17)
-        return decision
+
+    # The ingest fault modes that must END a coalesced group at their
+    # addressed batch (so they fire at the exact seq, like the
+    # per-batch path).
+    _SPLIT_FAULTS = ("torn_journal", "crash_after_apply",
+                     "crash_in_window")
+
+    def _apply_group(self, group) -> List[Decision]:
+        """Apply one coalesced group — ONE jitted dispatch, ONE
+        device→host transfer, ONE journal record for up to ``coalesce``
+        queued batches.  Bitwise identical to applying them one at a
+        time (``state.make_coalesced_apply_fn``), so recovery and the
+        chaos acceptance digests are grouping-independent."""
+        import jax
+
+        K, E = self.coalesce, self.max_batch_events
+        k = len(group)
+        times = np.zeros((K, E), np.float32)
+        feeds = np.zeros((K, E), np.int32)
+        nvalid = np.zeros((K,), np.int32)
+        seqs = np.zeros((K,), np.int32)
+        for j, (b, _at) in enumerate(group):
+            t, f, n = _pad_events(b.times, b.feeds, E)
+            times[j], feeds[j], nvalid[j], seqs[j] = t, f, n, int(b.seq)
+        new_state, (posted, t_new, lam) = self._apply_many(
+            self._state, times, feeds, nvalid, seqs, np.int32(k),
+            self._s_sink, self._q)
+        # The ONE deliberate device→host boundary of the coalesced apply
+        # path: one transfer per poll ROUND (amortized over the group),
+        # not per batch.
+        posted, t_new, lam = jax.device_get((posted, t_new, lam))  # rqlint: disable=RQ702 per-round decision boundary
+        stale = self.pending
+        decisions = [
+            Decision(seq=int(b.seq), post=bool(posted[j]),
+                     post_time=float(t_new[j]), intensity=float(lam[j]),
+                     stale_batches=stale)
+            for j, (b, _at) in enumerate(group)]
+        if self._journal is not None:
+            rec = {
+                "seqs": [int(b.seq) for b, _ in group],
+                "counts": [int(b.n_events) for b, _ in group],
+                "times": [float(t) for b, _ in group for t in b.times],
+                "feeds": [int(f) for b, _ in group for f in b.feeds],
+                "decisions": [{"post": d.post, "post_time": d.post_time,
+                               "intensity": d.intensity}
+                              for d in decisions],
+                "state_digest": state_digest(new_state),
+            }
+            try:
+                self._journal.append(rec, seq=int(group[-1][0].seq))
+            except OSError as e:
+                raise RuntimeError(
+                    f"journal append failed for batches "
+                    f"{rec['seqs'][0]}..{rec['seqs'][-1]}: {e} — serving "
+                    f"state can no longer be made durable; restart and "
+                    f"recover from {self.dir}") from e
+            self._post_append_faults(int(group[-1][0].seq))
+        self._state = new_state
+        self._last_decision = decisions[-1]
+        now = self._clock()
+        for (b, at), d in zip(group, decisions):
+            self.metrics.observe_apply(
+                b.n_events, d.post, None if at is None else now - at)
+        self._since_snapshot += k
+        if self.dir is not None and \
+                self._since_snapshot >= self.snapshot_every:
+            self.snapshot()
+        self._post_commit_faults(int(group[-1][0].seq))
+        return decisions
+
+    def _take_group(self, limit: int):
+        """Pop up to ``limit`` queued batches, cutting the group so an
+        armed split-fault batch lands LAST in its record."""
+        f = self._fault
+        split_at = (f.batch if f is not None
+                    and f.mode in self._SPLIT_FAULTS else None)
+        group = []
+        while self._queue and len(group) < limit:
+            b, at = self._queue.popleft()
+            group.append((b, at))
+            if split_at is not None and int(b.seq) == split_at:
+                break
+        return group
 
     def poll(self, max_batches: Optional[int] = None) -> List[Decision]:
         """Apply up to ``max_batches`` queued batches (all, by default);
-        returns their decisions.  Bounding the per-poll work is the
+        returns their decisions.  With ``coalesce > 1`` the batches are
+        applied in groups of up to ``coalesce`` — one jitted dispatch,
+        one device→host transfer, and one journal record per group (the
+        wire-speed ingest path).  Bounding the per-poll work is the
         overload throttle: a slow consumer polls small, the queue fills,
         and submit() starts shedding — bounded memory, no deadlock."""
         out: List[Decision] = []
+        if self.coalesce == 1:
+            while self._queue and (max_batches is None
+                                   or len(out) < max_batches):
+                batch, submitted_at = self._queue.popleft()
+                out.append(self._apply_one(batch, submitted_at))
+            return out
         while self._queue and (max_batches is None
                                or len(out) < max_batches):
-            batch, submitted_at = self._queue.popleft()
-            out.append(self._apply_one(batch, submitted_at))
+            limit = self.coalesce
+            if max_batches is not None:
+                limit = min(limit, max_batches - len(out))
+            group = self._take_group(limit)
+            if not group:
+                break
+            out.extend(self._apply_group(group))
         return out
 
     # ---- decision path (never blocks on the backlog) ----
@@ -506,9 +656,24 @@ class ServingRuntime:
             steps = [int(n) for n in os.listdir(snap_dir) if n.isdigit()]
             if steps:
                 _journal_mod.prune_segments(path, min(steps))
-            self._journal = Journal(path,
-                                    fsync_every_n=self.fsync_every_n)
+            self._journal = Journal(
+                path, fsync_every_n=self.fsync_every_n,
+                flush_mode=self.flush_mode,
+                max_unflushed_records=self.max_unflushed_records,
+                max_flush_delay_ms=self.max_flush_delay_ms)
         return seq
+
+    def durability(self) -> Dict[str, Any]:
+        """The configured durability window — what an ack MEANS under
+        this runtime's flush mode (committed beside every throughput
+        number so bench results are never quoted without their
+        durability cost; ``journal.durability_info`` is the one
+        definition)."""
+        from .journal import durability_info
+
+        return durability_info(self.flush_mode, self.fsync_every_n,
+                               self.max_unflushed_records,
+                               self.max_flush_delay_ms, self.coalesce)
 
     def write_metrics(self, path: Optional[str] = None) -> Dict[str, Any]:
         """The ``rq.serving.metrics/1`` artifact (defaults into the
@@ -521,6 +686,7 @@ class ServingRuntime:
             path, pending=self.pending,
             extra={"n_feeds": self.n_feeds, "q": self.q,
                    "applied_seq": self.applied_seq,
+                   "durability": self.durability(),
                    "health_sick_edges": int(np.count_nonzero(
                        np.asarray(self._state.health)))})
 
@@ -557,7 +723,32 @@ class ServingRuntime:
 # Recovery: snapshot + journal replay -> bit-identical carry
 # ---------------------------------------------------------------------------
 
-def recover(dir: str, clock=time.monotonic
+def _record_batches(rec: Dict[str, Any]
+                    ) -> List[Tuple[int, list, list, Dict[str, Any]]]:
+    """One journal record → its ``(seq, times, feeds, decision)`` batch
+    tuples, for BOTH record shapes: a /1 record is one batch, a /2 group
+    record (flat concatenated events + per-batch ``counts``) is several.
+    The single flat-record parser every journal reader shares."""
+    if "seqs" not in rec:
+        return [(int(rec["seq"]), rec["times"], rec["feeds"],
+                 rec["decision"])]
+    out = []
+    at = 0
+    for seq, n, d in zip(rec["seqs"], rec["counts"], rec["decisions"]):
+        n = int(n)
+        out.append((int(seq), rec["times"][at:at + n],
+                    rec["feeds"][at:at + n], d))
+        at += n
+    if at != len(rec["times"]):
+        raise ValueError(
+            f"group record {rec['seqs'][0]}..{rec['seqs'][-1]} counts "
+            f"sum to {at} but carries {len(rec['times'])} events — "
+            f"corrupt group structure")
+    return out
+
+
+def recover(dir: str, clock=time.monotonic,
+            acked_seq: Optional[int] = None
             ) -> Tuple[ServingRuntime, RecoveryInfo]:
     """Rebuild a runtime from its serving directory after a crash.
 
@@ -566,10 +757,19 @@ def recover(dir: str, clock=time.monotonic
     torn steps are quarantined, never trusted); verify-and-replay the
     journal (torn tail quarantined by ``serving.journal.replay``),
     re-applying every record past the snapshot through the same pure
-    apply step.  Each replayed record's recomputed carry digest must
-    equal the journaled one — the bit-identity witness; divergence
-    raises ``RuntimeError`` rather than serving reconstructed-but-wrong
-    state."""
+    apply step — per-batch records through :func:`make_apply_fn`, group
+    records through the coalesced fn (grouping-invariant bitwise, so
+    both paths reconstruct the same carry).  Each replayed record's
+    recomputed carry digest must equal the journaled one — the
+    bit-identity witness; divergence raises ``RuntimeError`` rather than
+    serving reconstructed-but-wrong state.
+
+    ``acked_seq`` is the caller's ack high-water mark (what the source /
+    router saw acknowledged before the crash): when the journal kept
+    less — the async-group-commit loss window a power-style crash
+    consumed — the exact lost seqs come back in
+    ``RecoveryInfo.lost_acked_seqs`` so the caller can retransmit them
+    deliberately instead of discovering the gap by timeout."""
     import jax
     import jax.numpy as jnp
 
@@ -585,39 +785,77 @@ def recover(dir: str, clock=time.monotonic
              else _checkpoint.restore(snap_dir, step=step, like=like))
     records, torn = journal_replay(os.path.join(dir, _JOURNAL))
     apply_fn = make_apply_fn()
+    co_fn = None
     s_sink = jnp.asarray(np.asarray(cfg["s_sink"], np.float64),
                          jnp.float32)
     qv = jnp.asarray(float(cfg["q"]), jnp.float32)
     E = int(cfg["max_batch_events"])
+    K_cfg = int(cfg.get("coalesce", 1))
     replayed = skipped = 0
     last_decision: Optional[Decision] = None
     start_seq_state = int(jax.device_get(state.seq))
     for rec in records:
-        seq = int(rec["seq"])
-        if seq <= start_seq_state:
-            skipped += 1
-            d = rec["decision"]
+        batches = _record_batches(rec)
+        last_seq = batches[-1][0]
+        if last_seq <= start_seq_state:
+            skipped += len(batches)
+            seq, _, _, d = batches[-1]
             last_decision = Decision(seq=seq, post=bool(d["post"]),
                                      post_time=float(d["post_time"]),
                                      intensity=float(d["intensity"]))
             continue
-        times, feeds, n = _pad_events(rec["times"], rec["feeds"], E)
-        state, (posted, t_new, lam) = apply_fn(
-            state, times, feeds, n, np.int32(seq), s_sink, qv)
-        posted, t_new, lam = jax.device_get((posted, t_new, lam))  # rqlint: disable=RQ702 replay decision boundary
+        if batches[0][0] <= start_seq_state:
+            # Snapshots land only at record boundaries, so a record
+            # straddling the restored seq cannot come from this
+            # directory's own history.
+            raise RuntimeError(
+                f"journal record {batches[0][0]}..{last_seq} straddles "
+                f"the restored snapshot seq {start_seq_state} — mixed "
+                f"directories or a foreign journal; refusing to replay")
+        if len(batches) == 1 and "seqs" not in rec:
+            seq, r_times, r_feeds, _ = batches[0]
+            times, feeds, n = _pad_events(r_times, r_feeds, E)
+            state, (posted, t_new, lam) = apply_fn(
+                state, times, feeds, n, np.int32(seq), s_sink, qv)
+            posted, t_new, lam = jax.device_get((posted, t_new, lam))  # rqlint: disable=RQ702 replay decision boundary
+            posted_l, t_l, lam_l = [posted], [t_new], [lam]
+        else:
+            # Group record: replay through the coalesced fn — the bulk
+            # path recovery shares with live serving (one dispatch per
+            # journal record, so replaying a wire-speed journal is as
+            # amortized as writing it was).
+            if co_fn is None:
+                co_fn = make_coalesced_apply_fn()
+            k = len(batches)
+            K = max(K_cfg, k)  # an over-wide group still replays
+            g_times = np.zeros((K, E), np.float32)
+            g_feeds = np.zeros((K, E), np.int32)
+            g_nvalid = np.zeros((K,), np.int32)
+            g_seqs = np.zeros((K,), np.int32)
+            for j, (seq, r_times, r_feeds, _) in enumerate(batches):
+                t, f, n = _pad_events(r_times, r_feeds, E)
+                g_times[j], g_feeds[j] = t, f
+                g_nvalid[j], g_seqs[j] = n, seq
+            state, (posted, t_new, lam) = co_fn(
+                state, g_times, g_feeds, g_nvalid, g_seqs, np.int32(k),
+                s_sink, qv)
+            posted, t_new, lam = jax.device_get((posted, t_new, lam))  # rqlint: disable=RQ702 replay decision boundary
+            posted_l = [posted[j] for j in range(k)]
+            t_l = [t_new[j] for j in range(k)]
+            lam_l = [lam[j] for j in range(k)]
         got = state_digest(state)
         if got != rec["state_digest"]:
             raise RuntimeError(
-                f"journal replay diverged at seq {seq}: recomputed carry "
-                f"digest {got[:12]}.. != journaled "
+                f"journal replay diverged at seq {last_seq}: recomputed "
+                f"carry digest {got[:12]}.. != journaled "
                 f"{str(rec['state_digest'])[:12]}.. — the journal and the "
                 f"snapshot disagree (mixed directories? code drift across "
                 f"the restart?); refusing to serve reconstructed state")
-        last_decision = Decision(seq=seq, post=bool(posted),
-                                 post_time=float(t_new),
-                                 intensity=float(lam))
-        replayed += 1
-        start_seq_state = seq
+        last_decision = Decision(
+            seq=last_seq, post=bool(posted_l[-1]),
+            post_time=float(t_l[-1]), intensity=float(lam_l[-1]))
+        replayed += len(batches)
+        start_seq_state = last_seq
     rt = ServingRuntime(
         n_feeds=int(cfg["n_feeds"]), q=float(cfg["q"]),
         s_sink=np.asarray(cfg["s_sink"], np.float64),
@@ -628,11 +866,20 @@ def recover(dir: str, clock=time.monotonic
         queue_capacity=int(cfg["queue_capacity"]),
         max_batch_events=E,
         fsync_every_n=int(cfg.get("fsync_every_n", 1)),
-        clock=clock, _state=state)
+        flush_mode=str(cfg.get("flush_mode", "sync")),
+        max_unflushed_records=int(cfg.get("max_unflushed_records", 64)),
+        max_flush_delay_ms=float(cfg.get("max_flush_delay_ms", 50.0)),
+        coalesce=K_cfg, clock=clock, _state=state)
     rt._last_decision = last_decision
+    recovered_seq = int(jax.device_get(state.seq))
+    lost: Tuple[int, ...] = ()
+    if acked_seq is not None and int(acked_seq) > recovered_seq:
+        # Seqs are consecutive by the stream contract, so the lost
+        # window is exactly the integer gap.
+        lost = tuple(range(recovered_seq + 1, int(acked_seq) + 1))
     info = RecoveryInfo(
         snapshot_seq=step, replayed=replayed, skipped=skipped, torn=torn,
-        recovered_seq=int(jax.device_get(state.seq)))
+        recovered_seq=recovered_seq, lost_acked_seqs=lost)
     return rt, info
 
 
@@ -640,13 +887,13 @@ def journal_decisions(dir: str) -> List[Decision]:
     """The full decision history a serving directory's journal records —
     what the crash-recovery acceptance test compares against the
     uninterrupted run (read-only: the torn tail, if any, is skipped, not
-    quarantined)."""
+    quarantined).  Group records contribute one decision per batch."""
     records, _ = journal_replay(os.path.join(dir, _JOURNAL),
                                 quarantine_torn_tail=False)
     out = []
     for rec in records:
-        d = rec["decision"]
-        out.append(Decision(seq=int(rec["seq"]), post=bool(d["post"]),
-                            post_time=float(d["post_time"]),
-                            intensity=float(d["intensity"])))
+        for seq, _times, _feeds, d in _record_batches(rec):
+            out.append(Decision(seq=seq, post=bool(d["post"]),
+                                post_time=float(d["post_time"]),
+                                intensity=float(d["intensity"])))
     return out

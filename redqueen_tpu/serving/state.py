@@ -44,7 +44,7 @@ from ..ops.threefry import threefry2x32, uniform_from_bits
 from ..runtime import numerics
 
 __all__ = ["FeedState", "Decision", "init_feed_state", "make_apply_fn",
-           "state_digest", "poison_edge"]
+           "make_coalesced_apply_fn", "state_digest", "poison_edge"]
 
 
 class FeedState(struct.PyTreeNode):
@@ -162,6 +162,51 @@ def make_apply_fn():
     """The jitted apply step, carry-donated where the backend supports it
     (CPU ignores donation and would warn on every call)."""
     return _apply_fn_cached(jax.default_backend() != "cpu")
+
+
+def _apply_many(state: FeedState, times, feeds, n_valid, seqs, k_valid,
+                s_sink, q):
+    """Coalesced apply: ``lax.scan`` of :func:`_apply` over a stacked
+    group of up to K micro-batches — ONE XLA dispatch amortized over the
+    whole poll round instead of one per batch (the serving-path
+    throughput lever; see ROADMAP item 2).
+
+    Slots ``>= k_valid`` are padding: their step runs but every carry
+    field is passed through with a bitwise-exact ``jnp.where`` select,
+    so the result is IDENTICAL — bit for bit — to applying the valid
+    batches one at a time with :func:`_apply`.  That invariance (to the
+    grouping AND to the pad width K) is load-bearing: a faulted run and
+    an uninterrupted run coalesce differently, yet the chaos acceptance
+    tests compare their carry digests bitwise.  Asserted empirically in
+    ``tests/test_serving.py`` (grouping/K sweep vs the sequential
+    path)."""
+    def step(st, xs):
+        t, f, n, s, i = xs
+        new, (posted, t_new, lam) = _apply(st, t, f, n, s, s_sink, q)
+        ok = i < k_valid
+        merged = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(ok, a, b), new, st)
+        return merged, (posted & ok,
+                        jnp.where(ok, t_new, st.t),
+                        jnp.where(ok, lam, jnp.zeros_like(lam)))
+    idx = jnp.arange(times.shape[0], dtype=jnp.int32)
+    return jax.lax.scan(step, state, (times, feeds, n_valid, seqs, idx))
+
+
+@functools.lru_cache(maxsize=None)
+def _apply_many_cached(donate: bool):
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(_apply_many, donate_argnums=donate_argnums)
+
+
+def make_coalesced_apply_fn():
+    """The jitted coalesced apply (see :func:`_apply_many`): signature
+    ``(state, times[K,E], feeds[K,E], n_valid[K], seqs[K], k_valid,
+    s_sink, q) -> (state', (posted[K], t[K], intensity[K]))``.  One
+    compilation per (K, E) shape — the runtime pads every poll round to
+    its configured coalesce width so steady-state serving never
+    recompiles."""
+    return _apply_many_cached(jax.default_backend() != "cpu")
 
 
 def state_digest(state: FeedState) -> str:

@@ -46,7 +46,8 @@ digest, per-shard journal decision histories, the ``/2`` metrics
 report) — everything the acceptance tests compare bitwise between an
 uninterrupted run and a faulted/killed+recovered one.  Exit codes: 0
 clean (incl. survived shard faults); 17 crash_after_apply (runtime); 19
-torn_journal (runtime driver).
+torn_journal (runtime driver); 23 crash_in_window (runtime — the
+power-loss shape consuming the async group-commit durability window).
 """
 
 from __future__ import annotations
@@ -189,9 +190,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "worker) — real crash domains, parallel "
                          "journal fsyncs; worker:* faults apply here "
                          "(--in-process is the default placement)")
+    ap.add_argument("--sockets", action="store_true",
+                    help="with --shards: worker subprocesses over "
+                         "authenticated TCP (serving.transport) — the "
+                         "cross-host placement with reconnect; net:* "
+                         "faults apply here")
     ap.add_argument("--in-process", dest="workers", action="store_false",
                     help="keep all shards in this process (default; "
                          "the PR 7 placement)")
+    ap.add_argument("--coalesce", type=int, default=1,
+                    help="max micro-batches per jitted dispatch / "
+                         "journal record (the wire-speed apply path; "
+                         "1 = the per-batch PR 6 path)")
+    ap.add_argument("--flush-mode", choices=("sync", "group"),
+                    default="sync",
+                    help="journal durability mode: sync = fsync before "
+                         "ack; group = async group commit with the "
+                         "bounded loss window below")
+    ap.add_argument("--max-unflushed-records", type=int, default=64,
+                    help="group mode: hard record bound of the "
+                         "durability window")
+    ap.add_argument("--max-flush-delay-ms", type=float, default=50.0,
+                    help="group mode: time bound of the durability "
+                         "window (background fsync cadence)")
     ap.add_argument("--resume", action="store_true",
                     help="recover from --dir (snapshot + journal "
                          "replay) instead of starting fresh, then "
@@ -203,11 +224,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     batches = synthetic_stream(args.seed, args.batches, args.feeds,
                                events_per_batch=args.events_per_batch)
 
-    if args.workers and not args.shards:
-        ap.error("--workers needs --shards N (worker placement is a "
-                 "cluster mode)")
+    if (args.workers or args.sockets) and not args.shards:
+        ap.error("--workers/--sockets need --shards N (worker "
+                 "placement is a cluster mode)")
+    if args.workers and args.sockets:
+        ap.error("--workers and --sockets are exclusive placements")
     if args.shards:
-        placement = "workers" if args.workers else "in-process"
+        placement = ("sockets" if args.sockets
+                     else "workers" if args.workers else "in-process")
         if args.resume:
             cl, infos = ServingCluster.recover(args.dir,
                                                placement=placement)
@@ -225,6 +249,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 snapshot_every=args.snapshot_every,
                 reorder_window=args.window,
                 queue_capacity=args.queue_capacity,
+                coalesce=args.coalesce, flush_mode=args.flush_mode,
+                max_unflushed_records=args.max_unflushed_records,
+                max_flush_delay_ms=args.max_flush_delay_ms,
                 placement=placement)
         with cl:
             drive(cl, batches, fault=fault)
@@ -246,7 +273,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             n_feeds=args.feeds, q=args.q, seed=args.seed, dir=args.dir,
             snapshot_every=args.snapshot_every,
             reorder_window=args.window,
-            queue_capacity=args.queue_capacity)
+            queue_capacity=args.queue_capacity,
+            coalesce=args.coalesce, flush_mode=args.flush_mode,
+            max_unflushed_records=args.max_unflushed_records,
+            max_flush_delay_ms=args.max_flush_delay_ms)
     with rt:
         drive(rt, batches, fault=fault)
         rt.write_metrics()

@@ -1,5 +1,5 @@
 """Length-prefixed, checksummed frame protocol between the shard router
-and its out-of-process workers.
+and its out-of-process workers — over pipes or TCP sockets.
 
 One frame = ``MAGIC(4) | length(u32 BE) | crc32(u32 BE) | payload`` with
 a UTF-8 JSON payload.  The checksum covers the payload bytes, so a
@@ -11,6 +11,22 @@ owns one pipe pair per process, which is exactly the fault-domain
 boundary: a SIGKILLed worker is an EOF, a wedged one is a timeout, a
 corrupted one is a checksum mismatch, and each maps to its own typed
 error so the router can degrade that one shard instead of guessing.
+
+**TCP mode** (the cross-host placement): the router owns one
+:class:`Listener` per shard; a worker launched with ``--connect
+HOST:PORT`` dials it and authenticates with a **hello frame** carrying
+its shard index and the per-cluster token (read from the
+``RQ_WORKER_TOKEN`` environment, never argv — ``ps`` must not leak it).
+The byte protocol is IDENTICAL to the pipe mode — a connected socket's
+fd plugs straight into :class:`FrameReader`/:func:`write_frame` — so
+every corruption/EOF/timeout shape classifies the same way; what TCP
+adds is RECONNECTION: a worker that loses its link redials under
+``runtime.supervisor.RetryPolicy`` backoff and re-hellos, and the
+router re-accepts the SAME live process (hello pid must match) instead
+of declaring it dead — a network partition degrades and heals without
+journal replay.  Plain loopback/LAN framing with checksums, not
+transport encryption: the token gates accidental cross-talk, not a
+hostile network (run cross-host deployments over a trusted link).
 
 Error taxonomy (all subclass :class:`TransportError`):
 
@@ -35,7 +51,7 @@ import select
 import struct
 import time
 import zlib
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 __all__ = [
     "MAGIC",
@@ -48,7 +64,16 @@ __all__ = [
     "encode_frame",
     "write_frame",
     "FrameReader",
+    "Listener",
+    "connect_worker",
+    "HELLO_KIND",
+    "ENV_WORKER_TOKEN",
 ]
+
+HELLO_KIND = "hello"
+# The cluster token travels by environment, never argv: a secret on the
+# command line is visible to every local `ps`.
+ENV_WORKER_TOKEN = "RQ_WORKER_TOKEN"
 
 MAGIC = b"RQF1"
 _HEADER = struct.Struct(">4sII")  # magic, payload length, crc32(payload)
@@ -131,10 +156,20 @@ class FrameReader:
             # heartbeat-drain contract, and frames already delivered to
             # the pipe must be readable without waiting.
             remaining = max(0.0, deadline - self._clock())
-            r, _, _ = select.select([self._fd], [], [], remaining)
+            try:
+                r, _, _ = select.select([self._fd], [], [], remaining)
+            except (OSError, ValueError):
+                self._eof = True  # fd torn down under us: peer is gone
+                return True
             if not r:
                 return False
-        chunk = os.read(self._fd, 1 << 16)
+        try:
+            chunk = os.read(self._fd, 1 << 16)
+        except OSError:
+            # A reset/closed socket (ECONNRESET, EBADF after a hard
+            # teardown) is the same fact as EOF for the caller: the
+            # peer is gone mid-stream.
+            chunk = b""
         if not chunk:
             self._eof = True
         else:
@@ -196,3 +231,114 @@ class FrameReader:
                 f"frame payload must be an object, got "
                 f"{type(payload).__name__}")
         return payload
+
+
+# ---------------------------------------------------------------------------
+# TCP mode: router-side listener + worker-side dialer
+# ---------------------------------------------------------------------------
+
+
+class Listener:
+    """The router's accept point for ONE socket-placed shard.
+
+    Per-shard on purpose: accept routing is unambiguous (whatever dials
+    this port claims this shard, and the hello proves it), and a
+    replacement or reconnecting worker re-uses the same address — the
+    remote-spawn contract is just "run the printed command on any host
+    that can reach this port".
+
+    :meth:`accept` validates the hello frame (kind/shard/token, and
+    optionally the pid for reattach-after-partition: only the SAME live
+    process may resume its shard); connections failing validation are
+    closed and the wait continues until the deadline — a port-scanner or
+    a mis-wired worker cannot occupy the slot."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 clock=time.monotonic):
+        import socket as _socket
+
+        self._clock = clock
+        self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(8)
+        self.host, self.port = self._sock.getsockname()[:2]
+
+    @property
+    def address(self) -> str:
+        """``host:port`` — what the worker's ``--connect`` takes."""
+        return f"{self.host}:{self.port}"
+
+    def accept(self, token: str, expect_shard: int,
+               timeout_s: float = 30.0,
+               expect_pid: Optional[int] = None
+               ) -> Tuple[Any, Dict[str, Any], "FrameReader"]:
+        """Wait for a worker to dial + hello; returns ``(socket, hello,
+        reader)``.  The returned reader already owns any bytes buffered
+        past the hello — callers MUST keep it (constructing a fresh
+        reader would drop them)."""
+        import socket as _socket
+
+        deadline = self._clock() + float(timeout_s)
+        while True:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                raise TransportTimeout(
+                    f"no worker for shard {expect_shard} dialed "
+                    f"{self.address} within {timeout_s}s")
+            r, _, _ = select.select([self._sock], [], [], remaining)
+            if not r:
+                continue
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                continue
+            conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            reader = FrameReader(conn.fileno(), clock=self._clock)
+            try:
+                hello = reader.read_frame(timeout_s=min(5.0, remaining))
+            except TransportError:
+                conn.close()
+                continue
+            if (hello.get("kind") != HELLO_KIND
+                    or hello.get("token") != token
+                    or int(hello.get("shard", -1)) != int(expect_shard)
+                    or (expect_pid is not None
+                        and int(hello.get("pid", -1)) != int(expect_pid))):
+                # Wrong credentials or a stranger process: refuse the
+                # connection, keep the slot open for the real worker.
+                conn.close()
+                continue
+            return conn, hello, reader
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def connect_worker(address: str, shard: int, token: str,
+                   timeout_s: float = 10.0):
+    """Worker-side dial: connect to the router's per-shard listener and
+    send the hello frame.  Returns the connected socket (blocking, with
+    TCP_NODELAY — request/response frames must not sit in Nagle's
+    buffer).  Raises ``OSError`` on connection failure — the caller owns
+    the RetryPolicy redial loop."""
+    import socket as _socket
+
+    host, _, port = address.rpartition(":")
+    sock = _socket.create_connection((host or "127.0.0.1", int(port)),
+                                     timeout=float(timeout_s))
+    sock.settimeout(None)
+    sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    write_frame(sock.fileno(), {"kind": HELLO_KIND, "shard": int(shard),
+                                "token": str(token),
+                                "pid": os.getpid()})
+    return sock

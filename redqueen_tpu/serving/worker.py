@@ -34,6 +34,21 @@ ITSELF at exact sub-batch sequence numbers, so SIGKILL-a-real-process,
 wedged-worker-timeout, torn-frame, and protocol-garbage paths all run
 deterministically on CPU in CI.
 
+**Socket mode** (``--connect HOST:PORT``, the cross-host placement):
+instead of stdin/stdout pipes the worker dials the router's per-shard
+:class:`~redqueen_tpu.serving.transport.Listener`, authenticates with a
+hello frame (token via the ``RQ_WORKER_TOKEN`` env), and serves the
+SAME frame protocol over TCP.  What sockets add is link-failure
+tolerance: on EOF/reset the worker REDIALS under a deterministic
+``runtime.supervisor.RetryPolicy`` backoff and resumes serving with its
+runtime (journal, carry, queue) fully intact — the router reattaches
+the same live process and resyncs the decisions whose response frames
+the dead link ate (``replay_decisions``, backed by a bounded ring
+buffer).  Network faults (``RQ_FAULT=net:drop|delay|partition|
+reconnect@shardK[,batchN]``) are applied by the worker itself around
+the response that carries sub-batch N, so every link failure runs
+deterministically on CPU in CI.
+
 Module-level imports are stdlib + numpy + the jax-free serving pieces
 only; everything that pulls jax loads lazily when a shard does.
 """
@@ -41,6 +56,7 @@ only; everything that pulls jax loads lazily when a shard does.
 from __future__ import annotations
 
 import argparse
+import collections
 import os
 import signal
 import subprocess
@@ -51,15 +67,20 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..runtime import faultinject as _faultinject
+from ..runtime.supervisor import RetryPolicy as _RetryPolicy
 from .events import EventBatch
-from .transport import (FrameError, FrameReader, TransportEOF,
-                        TransportError, TransportTimeout, encode_frame,
+from .transport import (ENV_WORKER_TOKEN, FrameError, FrameReader,
+                        Listener, TransportEOF, TransportError,
+                        TransportTimeout, connect_worker, encode_frame,
                         write_frame)
 
-__all__ = ["WorkerHandle", "WorkerOpError", "main",
+__all__ = ["WorkerHandle", "SocketWorkerHandle", "WorkerOpError", "main",
            "HANG_FIRES", "ENV_HANG_FIRES",
            "DEFAULT_REQUEST_TIMEOUT_S", "DEFAULT_OPEN_TIMEOUT_S",
-           "DEFAULT_HEARTBEAT_EVERY_S", "DEFAULT_READ_TIMEOUT_S"]
+           "DEFAULT_HEARTBEAT_EVERY_S", "DEFAULT_READ_TIMEOUT_S",
+           "RECONNECT_POLICY", "RECENT_DECISIONS",
+           "NET_DELAY_S", "NET_PARTITION_S",
+           "ENV_NET_DELAY_S", "ENV_NET_PARTITION_S"]
 
 # An injected hang drops (never answers) this many requests targeting
 # its batch, then the worker serves normally — bounded like the
@@ -79,6 +100,34 @@ DEFAULT_HEARTBEAT_EVERY_S = 1.0
 # worker must cost a read milliseconds-to-seconds, not the full apply
 # budget.
 DEFAULT_READ_TIMEOUT_S = 5.0
+
+# Socket-mode link recovery: a worker that loses its connection redials
+# under this schedule (seed=0: the redial timeline — and with it the
+# whole net-chaos acceptance — is deterministic in CI), then gives up
+# and exits (the router's crash path takes over: respawn + journal
+# recovery).
+RECONNECT_POLICY = _RetryPolicy(max_attempts=6, base_delay_s=0.2,
+                                multiplier=2.0, max_delay_s=5.0,
+                                jitter=0.1, seed=0)
+
+# Bounded ring of recently-applied decisions kept for the router's
+# ``replay_decisions`` resync (a lost response frame must not lose
+# journaled facts from the router's ledger).  Far above any poll
+# round's batch count; memory stays bounded per worker.
+RECENT_DECISIONS = 8192
+
+# net:delay sleeps this long before answering (must exceed the router's
+# request deadline in the chaos tests — they shrink the deadline, not
+# this); net:partition holds the link down this long before redialing.
+NET_DELAY_S = 2.0
+NET_PARTITION_S = 0.75
+ENV_NET_DELAY_S = "RQ_NET_DELAY_S"
+ENV_NET_PARTITION_S = "RQ_NET_PARTITION_S"
+
+
+class _LinkDown(Exception):
+    """Socket-mode internal: the connection died mid-serve (read EOF or
+    write failure) — the serve loop must redial, not exit."""
 
 
 class WorkerOpError(TransportError):
@@ -104,28 +153,119 @@ def _decision_dict(d) -> Dict[str, Any]:
             "intensity": float(d.intensity)}
 
 
+def _close_quietly(sock) -> None:
+    """Best-effort socket close — link teardown must never raise (both
+    the worker child and the router handle share this)."""
+    if sock is not None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _spawn_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """The worker child's environment: the minimal-import flag plus the
+    package root on PYTHONPATH — the child runs ``python -m
+    redqueen_tpu...`` and must find THIS package even when the parent
+    imported it through a ``sys.path`` insert from another working
+    directory (plain library usage, not just repo-cwd tests)."""
+    env = dict(os.environ)
+    env["RQ_SERVING_WORKER"] = "1"
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    prev = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = (pkg_root if not prev
+                         else pkg_root + os.pathsep + prev)
+    if extra:
+        env.update(extra)
+    return env
+
+
 class _Worker:
     """One shard's serving loop behind the frame protocol.  Owns the
-    runtime from ``open``/``recover`` on; one request at a time."""
+    runtime from ``open``/``recover`` on; one request at a time.
 
-    def __init__(self, dir: str, shard: int, proto_fd: int,
-                 heartbeat_every_s: float):
+    Pipe mode: ``in_fd``/``out_fd`` are stdin / the dup'd stdout.
+    Socket mode: both are the connected socket's fd, ``connect_to`` is
+    set, and a dead link redials + re-hellos instead of exiting — the
+    runtime (journal, carry, queue) survives the partition."""
+
+    def __init__(self, dir: str, shard: int, in_fd: int, out_fd: int,
+                 heartbeat_every_s: float,
+                 connect_to: Optional[str] = None,
+                 token: Optional[str] = None, sock=None):
         self.dir = dir
         self.shard = int(shard)
-        self.proto_fd = proto_fd
+        self.in_fd = in_fd
+        self.out_fd = out_fd
         self.hb_every = float(heartbeat_every_s)
+        self.connect_to = connect_to
+        self.token = token
+        self._sock = sock  # keeps the socket object (and its fd) alive
         self.rt = None
-        self._reader = FrameReader(sys.stdin.fileno())
+        self._reader = FrameReader(in_fd)
         fault = _faultinject.worker_fault()
         self._fault = (fault if fault is not None
                        and fault.shard == self.shard else None)
+        nf = _faultinject.net_fault()
+        self._net_fault = (nf if nf is not None and connect_to is not None
+                           and nf.shard == self.shard else None)
+        self._net_armed: Optional[str] = None
+        self._net_delay_s = float(os.environ.get(ENV_NET_DELAY_S,
+                                                 NET_DELAY_S))
+        self._net_partition_s = float(os.environ.get(
+            ENV_NET_PARTITION_S, NET_PARTITION_S))
         self._hang_left = int(os.environ.get(ENV_HANG_FIRES, HANG_FIRES))
         self._poison_response = False  # garbage fault armed this reply
+        # Recently applied decisions for the router's resync after a
+        # lost response frame (replay_decisions).
+        self._recent: collections.deque = collections.deque(
+            maxlen=RECENT_DECISIONS)
+
+    # -- link management (socket mode) --
+
+    def _drop_link(self) -> None:
+        _close_quietly(self._sock)
+        self._sock = None
+
+    def _redial(self) -> bool:
+        """Reconnect under the deterministic RetryPolicy; True on a new
+        live link, False when the budget is spent (the caller exits and
+        the router's crash path takes over)."""
+        if self.connect_to is None:
+            return False
+        self._drop_link()
+        rng = RECONNECT_POLICY.rng()
+        for attempt in range(1, RECONNECT_POLICY.max_attempts + 1):
+            try:
+                sock = connect_worker(self.connect_to, self.shard,
+                                      self.token or "")
+            except OSError as e:
+                print(f"worker {self.shard}: redial attempt {attempt} "
+                      f"failed: {e}", file=sys.stderr, flush=True)
+                time.sleep(RECONNECT_POLICY.delay(attempt, rng))
+                continue
+            self._sock = sock
+            self.in_fd = self.out_fd = sock.fileno()
+            self._reader = FrameReader(self.in_fd)
+            print(f"worker {self.shard}: reconnected to "
+                  f"{self.connect_to} (attempt {attempt})",
+                  file=sys.stderr, flush=True)
+            return True
+        return False
 
     # -- protocol plumbing --
 
+    def _write(self, frame: Dict[str, Any]) -> None:
+        try:
+            write_frame(self.out_fd, frame)
+        except OSError as e:
+            if self.connect_to is not None:
+                raise _LinkDown(str(e)) from e
+            raise
+
     def _beat(self) -> None:
-        write_frame(self.proto_fd, {"kind": "beat", "pid": os.getpid()})
+        self._write({"kind": "beat", "pid": os.getpid()})
 
     def _respond(self, req_id: int, value: Any, op: str) -> None:
         # ``op`` is echoed so the router can salvage a STALE poll
@@ -138,12 +278,41 @@ class _Worker:
             # response — no magic, no checksum; the router's FrameReader
             # must refuse them and kill this (still running) process.
             self._poison_response = False
-            os.write(self.proto_fd, b"\x00\xffGARBAGE-NOT-A-FRAME" * 16)
+            os.write(self.out_fd, b"\x00\xffGARBAGE-NOT-A-FRAME" * 16)
             return
-        write_frame(self.proto_fd, frame)
+        armed, self._net_armed = self._net_armed, None
+        if armed == "drop":
+            # One response frame eaten by the network: the router's
+            # deadline expires; the applied decisions resync later.
+            print(f"worker {self.shard}: net:drop ate response "
+                  f"{req_id}", file=sys.stderr, flush=True)
+            return
+        if armed == "delay":
+            # Late past the router's deadline but salvageable by id.
+            time.sleep(self._net_delay_s)
+        elif armed == "partition":
+            # Hard link loss with the response UNSENT, a dead interval,
+            # then a redial: the router must reattach this same live
+            # process and resync the decisions the link ate.
+            print(f"worker {self.shard}: net:partition dropping link "
+                  f"for {self._net_partition_s}s", file=sys.stderr,
+                  flush=True)
+            self._drop_link()
+            time.sleep(self._net_partition_s)
+            raise _LinkDown("injected net:partition")
+        elif armed == "reconnect":
+            # Clean link flap: redial immediately, answer on the new
+            # connection.
+            print(f"worker {self.shard}: net:reconnect flapping link",
+                  file=sys.stderr, flush=True)
+            self._drop_link()
+            if not self._redial():
+                raise _LinkDown("injected net:reconnect could not "
+                                "redial")
+        self._write(frame)
 
     def _fail(self, req_id: int, op: str, e: BaseException) -> None:
-        write_frame(self.proto_fd, {
+        self._write({
             "kind": "resp", "id": req_id, "op": op, "ok": False,
             "error": type(e).__name__, "message": str(e)})
 
@@ -168,28 +337,68 @@ class _Worker:
             reorder_window=int(cfg["reorder_window"]),
             queue_capacity=int(cfg["queue_capacity"]),
             max_batch_events=int(cfg["max_batch_events"]),
-            fsync_every_n=int(cfg.get("fsync_every_n", 1)))
+            fsync_every_n=int(cfg.get("fsync_every_n", 1)),
+            flush_mode=str(cfg.get("flush_mode", "sync")),
+            max_unflushed_records=int(
+                cfg.get("max_unflushed_records", 64)),
+            max_flush_delay_ms=float(
+                cfg.get("max_flush_delay_ms", 50.0)),
+            coalesce=int(cfg.get("coalesce", 1)))
         return {"applied_seq": self.rt.applied_seq, "pid": os.getpid()}
 
     def _handle_recover(self, req: Dict[str, Any]) -> Dict[str, Any]:
         from .service import recover
 
-        self.rt, info = recover(self.dir)
+        acked = req.get("acked_seq")
+        self.rt, info = recover(
+            self.dir, acked_seq=None if acked is None else int(acked))
         return {"applied_seq": self.rt.applied_seq, "pid": os.getpid(),
                 "info": {"snapshot_seq": info.snapshot_seq,
                          "replayed": info.replayed,
                          "skipped": info.skipped,
                          "torn": info.torn,
-                         "recovered_seq": info.recovered_seq}}
+                         "recovered_seq": info.recovered_seq,
+                         "lost_acked_seqs":
+                             list(info.lost_acked_seqs)}}
+
+    def _adm_dict(self, adm) -> Dict[str, Any]:
+        return {"status": adm.status, "seq": adm.seq,
+                "backpressure": adm.backpressure, "reason": adm.reason,
+                "missing": list(adm.missing)}
 
     def _handle_submit(self, req: Dict[str, Any]) -> Dict[str, Any]:
         batch = EventBatch(int(req["seq"]),
                            np.asarray(req["times"], np.float64),
                            np.asarray(req["feeds"], np.int32))
-        adm = self.rt.submit(batch, _validated=True)
-        return {"status": adm.status, "seq": adm.seq,
-                "backpressure": adm.backpressure, "reason": adm.reason,
-                "missing": list(adm.missing)}
+        return self._adm_dict(self.rt.submit(batch, _validated=True))
+
+    def _handle_submit_many(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """One frame per ROUND: a whole list of sub-batches admitted in
+        one request/response — the frame-protocol half of the wire-speed
+        ingest path (the per-request round-trip was the measured
+        overhead, not the admission work)."""
+        admissions = []
+        for b in req["batches"]:
+            batch = EventBatch(int(b["seq"]),
+                               np.asarray(b["times"], np.float64),
+                               np.asarray(b["feeds"], np.int32))
+            admissions.append(
+                self._adm_dict(self.rt.submit(batch, _validated=True)))
+        return {"admissions": admissions}
+
+    def _handle_replay_decisions(self, req: Dict[str, Any]
+                                 ) -> Dict[str, Any]:
+        """The router's post-reattach resync: decisions with seq >
+        ``after_seq`` from the bounded recent-ring.  ``complete`` is the
+        no-gap witness (per-shard seqs are consecutive, so the expected
+        count is exact); an incomplete answer sends the router to the
+        journal-recovery path instead of trusting a hole."""
+        after = int(req.get("after_seq", -1))
+        ds = [d for d in self._recent if int(d["seq"]) > after]
+        applied = self.rt.applied_seq
+        expected = max(applied - after, 0)
+        return {"decisions": ds, "applied_seq": applied,
+                "complete": len(ds) == expected}
 
     def _handle_poll(self, req: Dict[str, Any]
                      ) -> Optional[Dict[str, Any]]:
@@ -198,6 +407,14 @@ class _Worker:
         be DROPPED (the injected hang: the router's deadline expires)."""
         max_b = req.get("max_batches")
         decisions: List[Dict[str, Any]] = []
+        if self._fault is None and self._net_fault is None:
+            # No fault armed: drain in COALESCED groups (one dispatch +
+            # one journal record per group — the wire-speed path).  The
+            # per-batch stepping below exists only to land injected
+            # faults at exact sub-batch seqs.
+            ds = self.rt.poll(
+                max_batches=None if max_b is None else int(max_b))
+            return self._poll_value([_decision_dict(d) for d in ds])
         while max_b is None or len(decisions) < int(max_b):
             nq = self.rt.next_queued_seq()
             if nq is None:
@@ -234,15 +451,25 @@ class _Worker:
                         "kind": "resp", "id": int(req["id"]),
                         "op": "poll", "ok": True,
                         "value": self._poll_value(decisions)})
-                    os.write(self.proto_fd, torn[:len(torn) // 2])
+                    os.write(self.out_fd, torn[:len(torn) // 2])
                     os._exit(0)
                 elif f.mode == "garbage":
                     self._fault = None
                     self._poison_response = True
+        nf = self._net_fault
+        if nf is not None and decisions and (
+                nf.batch is None
+                or any(int(d["seq"]) == nf.batch for d in decisions)):
+            # Arm the link fault on THIS response — it carries the
+            # addressed sub-batch's decision, so the chaos timeline is
+            # pinned to an exact stream position.
+            self._net_fault = None
+            self._net_armed = nf.mode
         return self._poll_value(decisions)
 
     def _poll_value(self, decisions: List[Dict[str, Any]]
                     ) -> Dict[str, Any]:
+        self._recent.extend(decisions)
         return {"decisions": decisions, "pending": self.rt.pending,
                 "applied_seq": self.rt.applied_seq}
 
@@ -255,6 +482,10 @@ class _Worker:
             return True, self._handle_recover(req)
         if op == "submit":
             return True, self._handle_submit(req)
+        if op == "submit_many":
+            return True, self._handle_submit_many(req)
+        if op == "replay_decisions":
+            return True, self._handle_replay_decisions(req)
         if op == "poll":
             value = self._handle_poll(req)
             return value is not None, value
@@ -282,6 +513,31 @@ class _Worker:
         raise ValueError(f"unknown worker op {op!r}")
 
     def serve(self) -> int:
+        """The outer loop: serve the link until it dies; in socket mode
+        a dead link redials (runtime intact) instead of exiting — the
+        partition-tolerance contract."""
+        while True:
+            try:
+                return self._serve_link()
+            except (_LinkDown, TransportEOF) as e:
+                if self.connect_to is None:
+                    # Pipe mode: the router went away — release the
+                    # journal and exit clean.
+                    if self.rt is not None:
+                        self.rt.close()
+                    return 0
+                print(f"worker {self.shard}: link down ({e}); "
+                      f"redialing", file=sys.stderr, flush=True)
+                if not self._redial():
+                    # Redial budget spent: the router is really gone (or
+                    # unreachable past the policy horizon).  Exit with
+                    # the journal synced — the respawn/recovery path
+                    # owns what happens next.
+                    if self.rt is not None:
+                        self.rt.close()
+                    return 3
+
+    def _serve_link(self) -> int:
         """The main loop: requests in lockstep, heartbeats when idle."""
         while True:
             try:
@@ -289,11 +545,6 @@ class _Worker:
             except TransportTimeout:
                 self._beat()
                 continue
-            except TransportEOF:
-                # Router went away: release the journal and exit clean.
-                if self.rt is not None:
-                    self.rt.close()
-                return 0
             req_id = int(req.get("id", -1))
             op = str(req.get("op"))
             if op == "shutdown":
@@ -324,18 +575,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--heartbeat-every", type=float,
                     default=DEFAULT_HEARTBEAT_EVERY_S,
                     help="idle heartbeat-frame interval, seconds")
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="SOCKET mode: dial the router's per-shard "
+                         "listener instead of speaking frames on "
+                         "stdin/stdout — the cross-host placement "
+                         "(token via the RQ_WORKER_TOKEN env; a lost "
+                         "link redials under RetryPolicy backoff)")
     args = ap.parse_args(argv)
 
-    # Claim fd 1 for the frame protocol and point everything that
-    # thinks it is printing to stdout at stderr instead — one stray
-    # print() (jax, a library, a debug line) must not poison the frame
-    # stream.
+    # Point everything that thinks it is printing to stdout at stderr —
+    # one stray print() (jax, a library, a debug line) must not poison
+    # the frame stream (socket mode keeps the discipline: logs belong
+    # on stderr either way).
     proto_fd = os.dup(1)
     os.dup2(2, 1)
     sys.stdout = sys.stderr
 
-    worker = _Worker(args.dir, args.shard, proto_fd,
-                     args.heartbeat_every)
+    if args.connect:
+        token = os.environ.get(ENV_WORKER_TOKEN, "")
+        try:
+            sock = connect_worker(args.connect, args.shard, token)
+        except OSError as e:
+            print(f"worker {args.shard}: cannot reach router at "
+                  f"{args.connect}: {e}", file=sys.stderr, flush=True)
+            return 2
+        worker = _Worker(args.dir, args.shard, sock.fileno(),
+                         sock.fileno(), args.heartbeat_every,
+                         connect_to=args.connect, token=token, sock=sock)
+    else:
+        worker = _Worker(args.dir, args.shard, sys.stdin.fileno(),
+                         proto_fd, args.heartbeat_every)
     worker._beat()  # birth announcement: the router's first liveness
     return worker.serve()
 
@@ -365,9 +634,17 @@ class WorkerHandle:
         self.open_timeout_s = float(open_timeout_s)
         self.read_timeout_s = float(read_timeout_s)
         self._clock = clock
-        self._reader = FrameReader(proc.stdout.fileno(), clock=clock)
+        if proc is not None and proc.stdout is not None:
+            # Pipe placement; the socket subclass installs its own
+            # reader/write-fd over the accepted connection.
+            self._reader = FrameReader(proc.stdout.fileno(), clock=clock)
+            self._wfd = proc.stdin.fileno()
         self._next_id = 0
         self._last_frame_t = clock()
+        # applied_seq the worker reported on its latest poll response —
+        # the router's resync trigger (outstanding seqs at or below it
+        # were applied but their response frame never arrived).
+        self.last_polled_seq: Optional[int] = None
         # Salvaged values of poll responses that answered a request the
         # router already timed out on — their decisions were APPLIED and
         # JOURNALED by the worker, so dropping them would desync the
@@ -390,17 +667,14 @@ class WorkerHandle:
         cmd = [sys.executable, "-m", "redqueen_tpu.serving.worker",
                "--dir", str(dir), "--shard", str(int(shard)),
                "--heartbeat-every", str(float(heartbeat_every_s))]
-        child_env = dict(os.environ)
-        # The minimal-import flag: the child's package imports skip the
-        # eager jax-pulling re-exports (PEP 562 lazy fallbacks keep the
-        # surface whole), so a worker spawns cheap and stays jax-free
-        # until open/recover loads its shard — the watchdog-process
-        # import discipline, proven by the subprocess test.
-        child_env["RQ_SERVING_WORKER"] = "1"
-        if env:
-            child_env.update(env)
+        # RQ_SERVING_WORKER=1 (the minimal-import flag: the child's
+        # package imports skip the eager jax-pulling re-exports, PEP 562
+        # lazy fallbacks keep the surface whole, so a worker spawns
+        # cheap and stays jax-free until open/recover loads its shard)
+        # + the package root on PYTHONPATH (library usage).
         proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
-                                stdout=subprocess.PIPE, env=child_env)
+                                stdout=subprocess.PIPE,
+                                env=_spawn_env(env))
         return cls(proc, shard, request_timeout_s=request_timeout_s,
                    open_timeout_s=open_timeout_s,
                    read_timeout_s=read_timeout_s, clock=clock)
@@ -412,10 +686,10 @@ class WorkerHandle:
         req_id = self._next_id
         frame = {"kind": "req", "id": req_id, "op": op, **fields}
         try:
-            write_frame(self.proc.stdin.fileno(), frame)
+            write_frame(self._wfd, frame)
         except (OSError, ValueError) as e:
             raise TransportEOF(
-                f"worker {self.shard} pipe closed on send: {e}") from e
+                f"worker {self.shard} link closed on send: {e}") from e
         return req_id
 
     def _note_stale(self, frame: Dict[str, Any]) -> None:
@@ -513,6 +787,8 @@ class WorkerHandle:
         except (subprocess.TimeoutExpired, OSError):
             pass
         for f in (self.proc.stdin, self.proc.stdout):
+            if f is None:
+                continue  # socket placement: no pipe pair to close
             try:
                 f.close()
             except OSError:
@@ -536,8 +812,8 @@ class WorkerHandle:
         return int(self._wait(req_id, self.open_timeout_s,
                               "open")["applied_seq"])
 
-    def start_recover(self) -> int:
-        return self._send("recover")
+    def start_recover(self, acked_seq: Optional[int] = None) -> int:
+        return self._send("recover", acked_seq=acked_seq)
 
     def finish_recover(self, req_id: int):
         from .service import RecoveryInfo
@@ -547,7 +823,9 @@ class WorkerHandle:
         return RecoveryInfo(
             snapshot_seq=i["snapshot_seq"], replayed=int(i["replayed"]),
             skipped=int(i["skipped"]), torn=i["torn"],
-            recovered_seq=int(i["recovered_seq"]))
+            recovered_seq=int(i["recovered_seq"]),
+            lost_acked_seqs=tuple(
+                int(s) for s in i.get("lost_acked_seqs", ())))
 
     def start_submit(self, batch: EventBatch) -> int:
         return self._send("submit", seq=int(batch.seq),
@@ -566,12 +844,50 @@ class WorkerHandle:
     def submit(self, batch: EventBatch, _validated: bool = False):
         return self.finish_submit(self.start_submit(batch))
 
+    def start_submit_many(self, batches: List[EventBatch]) -> int:
+        """One frame for a whole ROUND of sub-batches (the batched frame
+        protocol: admission round-trips were the measured ingest tax,
+        not the admission work)."""
+        return self._send("submit_many", batches=[
+            {"seq": int(b.seq),
+             "times": [float(t) for t in b.times],
+             "feeds": [int(f) for f in b.feeds]} for b in batches])
+
+    def finish_submit_many(self, req_id: int) -> List[Any]:
+        from .service import Admission
+
+        value = self._wait(req_id, self.request_timeout_s,
+                           "submit_many")
+        return [Admission(status=v["status"], seq=v["seq"],
+                          backpressure=bool(v["backpressure"]),
+                          reason=v["reason"],
+                          missing=tuple(v["missing"]))
+                for v in value["admissions"]]
+
     def start_poll(self, max_batches: Optional[int] = None) -> int:
         return self._send("poll", max_batches=max_batches)
 
-    def finish_poll(self, req_id: int) -> List[Any]:
-        value = self._wait(req_id, self.request_timeout_s, "poll")
+    def finish_poll(self, req_id: int,
+                    timeout_s: Optional[float] = None) -> List[Any]:
+        """``timeout_s`` overrides the request deadline — the cluster's
+        post-reattach retry passes a SHORT one (the response usually
+        died with the link; resync heals that case, so the retry must
+        not stall the whole round on the full apply budget)."""
+        value = self._wait(req_id,
+                           self.request_timeout_s if timeout_s is None
+                           else float(timeout_s), "poll")
+        self.last_polled_seq = int(value["applied_seq"])
         return [self._decision(d) for d in value["decisions"]]
+
+    def replay_decisions(self, after_seq: int
+                         ) -> Tuple[List[Any], bool]:
+        """Resync: the worker's applied decisions with seq >
+        ``after_seq`` from its recent-ring, plus the no-gap witness.
+        Used after a lost response frame (net drop / partition /
+        reconnect) so journaled facts re-enter the router's ledger."""
+        value = self.request("replay_decisions", after_seq=int(after_seq))
+        return ([self._decision(d) for d in value["decisions"]],
+                bool(value["complete"]))
 
     def poll(self, max_batches: Optional[int] = None) -> List[Any]:
         return self.finish_poll(self.start_poll(max_batches))
@@ -629,11 +945,233 @@ class WorkerHandle:
     def journal_path(self) -> Optional[str]:
         return None  # the journal lives in the worker process
 
+    def try_reattach(self, grace_s: float = 5.0) -> bool:
+        """Pipe transports cannot reattach — a dead pipe is a dead
+        worker.  The socket handle overrides this with the real
+        re-accept protocol."""
+        return False
+
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         self.close()
+
+
+class SocketWorkerHandle(WorkerHandle):
+    """A :class:`WorkerHandle` whose frames ride a TCP connection —
+    the cross-host placement.  The router owns one
+    :class:`~redqueen_tpu.serving.transport.Listener` per shard; the
+    worker child dials it (``--connect``) and authenticates with the
+    cluster token.  Two things differ from the pipe handle:
+
+    - **Spawn is detachable from locality.**  :meth:`spawn_socket`
+      starts the child locally; :meth:`remote_command` returns the
+      exact argv + env to start the SAME worker on any host that can
+      reach the listener, and :meth:`await_external` just waits for it
+      to dial in — `placement="sockets"` spans hosts by running one
+      printed command per shard.
+    - **A dead link is not a dead worker.**  :meth:`try_reattach`
+      re-accepts a redialing worker (hello must carry the same shard,
+      token, AND pid — only the same live process may resume), after
+      which the router resyncs the decisions the dead link ate
+      (``replay_decisions``) instead of paying a journal recovery."""
+
+    def __init__(self, proc: Optional[subprocess.Popen], shard: int,
+                 listener: Listener, token: str, sock, reader,
+                 request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+                 open_timeout_s: float = DEFAULT_OPEN_TIMEOUT_S,
+                 read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                 clock=time.monotonic):
+        super().__init__(proc, shard,
+                         request_timeout_s=request_timeout_s,
+                         open_timeout_s=open_timeout_s,
+                         read_timeout_s=read_timeout_s, clock=clock)
+        self.listener = listener
+        self.token = token
+        self._sock = sock
+        self._reader = reader  # owns bytes buffered past the hello
+        self._wfd = sock.fileno()
+        self.worker_pid: Optional[int] = (
+            None if proc is None else proc.pid)
+
+    # -- spawn / remote spawn --
+
+    @staticmethod
+    def worker_argv(dir: str, shard: int, address: str,
+                    heartbeat_every_s: float = DEFAULT_HEARTBEAT_EVERY_S
+                    ) -> List[str]:
+        """The worker command line for ``--connect`` mode — what a
+        remote host runs (plus ``RQ_WORKER_TOKEN`` in its env) to serve
+        this shard across the network."""
+        return [sys.executable, "-m", "redqueen_tpu.serving.worker",
+                "--dir", str(dir), "--shard", str(int(shard)),
+                "--heartbeat-every", str(float(heartbeat_every_s)),
+                "--connect", str(address)]
+
+    @classmethod
+    def remote_command(cls, dir: str, shard: int, address: str,
+                       heartbeat_every_s: float =
+                       DEFAULT_HEARTBEAT_EVERY_S) -> Dict[str, Any]:
+        """The remote-spawn recipe: ``{"argv": [...], "env":
+        {"RQ_WORKER_TOKEN": ...}}`` minus the token value (the operator
+        supplies it out of band).  ``dir`` must name the shard
+        directory AS THE REMOTE HOST SEES IT (shared filesystem or a
+        synced copy — the journal lives with the worker)."""
+        return {"argv": cls.worker_argv(dir, shard, address,
+                                        heartbeat_every_s),
+                "env": [ENV_WORKER_TOKEN]}
+
+    @classmethod
+    def launch(cls, dir: str, shard: int, listener: Listener,
+               token: str,
+               heartbeat_every_s: float = DEFAULT_HEARTBEAT_EVERY_S,
+               env: Optional[Dict[str, str]] = None
+               ) -> subprocess.Popen:
+        """Start the child WITHOUT waiting for its dial-in — the
+        cluster launches all N children first and then accepts each
+        hello, so interpreter start + package import + dial overlap
+        across shards instead of serializing."""
+        cmd = cls.worker_argv(dir, shard, listener.address,
+                              heartbeat_every_s)
+        child_env = _spawn_env(env)
+        child_env[ENV_WORKER_TOKEN] = token
+        return subprocess.Popen(cmd, stdin=subprocess.DEVNULL,
+                                env=child_env)
+
+    @classmethod
+    def from_child(cls, proc: subprocess.Popen, shard: int,
+                   listener: Listener, token: str,
+                   request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+                   open_timeout_s: float = DEFAULT_OPEN_TIMEOUT_S,
+                   read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                   accept_timeout_s: float = 30.0,
+                   clock=time.monotonic) -> "SocketWorkerHandle":
+        """Accept a :meth:`launch`-ed child's hello (pid-matched) into
+        a handle; SIGKILLs the child when nothing authentic dials in."""
+        try:
+            sock, hello, reader = listener.accept(
+                token, shard, timeout_s=accept_timeout_s,
+                expect_pid=proc.pid)
+        except TransportError:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            raise
+        return cls(proc, shard, listener, token, sock, reader,
+                   request_timeout_s=request_timeout_s,
+                   open_timeout_s=open_timeout_s,
+                   read_timeout_s=read_timeout_s, clock=clock)
+
+    @classmethod
+    def spawn_socket(cls, dir: str, shard: int, listener: Listener,
+                     token: str,
+                     heartbeat_every_s: float = DEFAULT_HEARTBEAT_EVERY_S,
+                     request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+                     open_timeout_s: float = DEFAULT_OPEN_TIMEOUT_S,
+                     read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                     accept_timeout_s: float = 30.0,
+                     env: Optional[Dict[str, str]] = None,
+                     clock=time.monotonic) -> "SocketWorkerHandle":
+        """Start the child locally and wait for it to dial the
+        listener (:meth:`launch` + :meth:`from_child`).  (For a REMOTE
+        worker, run :meth:`worker_argv`'s command on the other host and
+        use :meth:`await_external` /
+        ``ServingCluster.adopt_external_worker``.)"""
+        proc = cls.launch(dir, shard, listener, token,
+                          heartbeat_every_s=heartbeat_every_s, env=env)
+        return cls.from_child(proc, shard, listener, token,
+                              request_timeout_s=request_timeout_s,
+                              open_timeout_s=open_timeout_s,
+                              read_timeout_s=read_timeout_s,
+                              accept_timeout_s=accept_timeout_s,
+                              clock=clock)
+
+    @classmethod
+    def await_external(cls, shard: int, listener: Listener, token: str,
+                       accept_timeout_s: float = 300.0,
+                       request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+                       open_timeout_s: float = DEFAULT_OPEN_TIMEOUT_S,
+                       read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                       clock=time.monotonic) -> "SocketWorkerHandle":
+        """Adopt a worker someone ELSE spawned (another host, a
+        container scheduler): wait for its authenticated hello.  The
+        handle has no child process to SIGKILL — ``kill()`` degrades to
+        closing the link (the remote supervisor owns the process)."""
+        sock, hello, reader = listener.accept(
+            token, shard, timeout_s=accept_timeout_s)
+        h = cls(None, shard, listener, token, sock, reader,
+                request_timeout_s=request_timeout_s,
+                open_timeout_s=open_timeout_s,
+                read_timeout_s=read_timeout_s, clock=clock)
+        h.worker_pid = int(hello.get("pid", -1))
+        return h
+
+    # -- liveness / link management --
+
+    def alive(self) -> bool:
+        if self.proc is None:
+            return self._sock is not None  # external: the link is all
+        return self.proc.poll() is None    # we can observe
+
+    def _drop_link(self) -> None:
+        _close_quietly(self._sock)
+        self._sock = None
+
+    def sever_link(self) -> None:
+        """CHAOS HOOK (the router side of a network partition): shut the
+        connection down abruptly — the worker process stays alive and
+        will redial; the router heals through :meth:`try_reattach` +
+        resync.  What ``ServingCluster.partition_shard`` drives."""
+        if self._sock is not None:
+            import socket as _socket
+
+            try:
+                self._sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def try_reattach(self, grace_s: float = 5.0) -> bool:
+        """Accept the SAME worker's redial (hello pid must match) and
+        swap the link in; False when nothing authentic dials back
+        within ``grace_s`` (then the worker really is gone — crash
+        path).  In-flight requests on the old link are lost: the caller
+        must resync (``replay_decisions``) before trusting its ledger."""
+        self._drop_link()
+        # Externally-adopted workers (proc is None) pin the pid learned
+        # from the FIRST hello — only the same live process may resume,
+        # never a second worker racing the journal's single writer.
+        expect = (self.worker_pid if self.proc is None
+                  else self.proc.pid)
+        try:
+            sock, hello, reader = self.listener.accept(
+                self.token, self.shard, timeout_s=grace_s,
+                expect_pid=expect)
+        except TransportError:
+            return False
+        self._sock = sock
+        self._reader = reader
+        self._wfd = sock.fileno()
+        self._last_frame_t = self._clock()
+        if self.proc is None:
+            self.worker_pid = int(hello.get("pid", -1))
+        return True
+
+    def kill(self) -> None:
+        """SIGKILL (when the process is ours) + close the link.  The
+        per-shard listener is NOT closed — it belongs to the cluster
+        slot and a replacement worker reuses the address."""
+        if self.proc is not None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+            try:
+                self.proc.wait(timeout=10.0)
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+        self._drop_link()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,471 @@
+"""Wire-speed serving with bounded durability (ISSUE 11).
+
+Three contracts, all deterministic on CPU:
+
+- **Coalesced applies are bitwise grouping-invariant**: one jitted
+  dispatch over a masked group of K micro-batches produces the same
+  carry — bit for bit — as K per-batch applies, for every grouping and
+  pad width.  This is what lets a faulted run (different grouping) and
+  a clean run compare digests.
+- **Async group commit has an explicit, bounded durability window**:
+  acks may precede the fsync by at most ``max_unflushed_records``
+  records / ``max_flush_delay_ms``; a power-style crash
+  (``ingest:crash_in_window``) loses AT MOST the window, recovery
+  reports exactly which acked seqs were lost, and retransmit +
+  duplicate-drop heal bit-identically.
+- **The artifact carries its durability cost**: every metrics payload
+  embeds the flush mode + window, and latency percentiles come in raw,
+  trimmed, and windowed views so IO-stall waves stop making p99
+  incomparable run-to-run.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from redqueen_tpu import serving
+from redqueen_tpu.runtime import faultinject, integrity
+from redqueen_tpu.serving.journal import Journal
+from redqueen_tpu.serving.metrics import _latency_percentiles
+from redqueen_tpu.serving.service import recover
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_FEEDS = 12
+N_BATCHES = 20
+
+
+def _batches(n=N_BATCHES):
+    return serving.synthetic_stream(0, n, N_FEEDS, events_per_batch=5)
+
+
+def _runtime(dir=None, **kw):
+    kw.setdefault("n_feeds", N_FEEDS)
+    kw.setdefault("seed", 0)
+    kw.setdefault("snapshot_every", 10 ** 9)
+    return serving.ServingRuntime(dir=None if dir is None else str(dir),
+                                  **kw)
+
+
+# ---------------------------------------------------------------------------
+# Coalesced applies: bitwise grouping invariance
+# ---------------------------------------------------------------------------
+
+
+class TestCoalescedApply:
+    def test_coalesce_is_bit_identical_across_widths(self, tmp_path):
+        """Same stream through coalesce widths 1/4/32 (and grouping
+        broken up by interleaved polls): identical carry digests and
+        identical decisions — the invariance every chaos digest
+        comparison rests on."""
+        outs = []
+        for j, k in enumerate((1, 4, 32)):
+            rt = _runtime(tmp_path / f"c{k}", coalesce=k)
+            with rt:
+                decs = []
+                for i, b in enumerate(_batches()):
+                    rt.submit(b)
+                    if i % 7 == j:  # different grouping per width
+                        decs += rt.poll()
+                decs += rt.poll()
+                outs.append((rt.state_digest(), decs))
+        d0, dec0 = outs[0]
+        # stale_batches reports the live backlog at decision time — a
+        # function of the poll interleave, not of the stream — so it is
+        # normalized out of the bit-identity comparison.
+        norm = lambda ds: [d._replace(stale_batches=0) for d in ds]  # noqa: E731
+        for d, dec in outs[1:]:
+            assert d == d0
+            assert norm(dec) == norm(dec0)
+
+    def test_fn_level_invariance_vs_sequential(self):
+        """make_coalesced_apply_fn == sequential make_apply_fn,
+        bitwise, including pad-slot passthrough."""
+        import jax
+
+        from redqueen_tpu.serving.state import (init_feed_state,
+                                                make_apply_fn,
+                                                make_coalesced_apply_fn,
+                                                state_digest)
+
+        F, E, K = 8, 8, 5
+        ap = make_apply_fn()
+        co = make_coalesced_apply_fn()
+        s_sink = np.ones(F, np.float32)
+        rng = np.random.RandomState(1)
+        seq_state = init_feed_state(F, 0)
+        times = np.sort(rng.uniform(0, 1, (K, E)).astype(np.float32), 1)
+        feeds = rng.randint(0, F, (K, E)).astype(np.int32)
+        nv = rng.randint(1, E, K).astype(np.int32)
+        seqs = np.arange(K, dtype=np.int32)
+        for j in range(3):  # only 3 of the 5 slots are valid
+            seq_state, _ = ap(seq_state, times[j], feeds[j], nv[j],
+                              seqs[j], s_sink, np.float32(1.0))
+        co_state, (posted, t, lam) = co(
+            init_feed_state(F, 0), times, feeds, nv, seqs, np.int32(3),
+            s_sink, np.float32(1.0))
+        assert state_digest(co_state) == state_digest(seq_state)
+        posted, lam = jax.device_get((posted, lam))
+        assert not posted[3:].any() and (lam[3:] == 0).all()
+
+    def test_group_journal_records_and_replay(self, tmp_path):
+        """coalesce > 1 journals ONE group record per poll round;
+        recovery replays groups through the coalesced fn with the
+        digest re-asserted per record; journal_decisions flattens them
+        back to per-batch decisions."""
+        from redqueen_tpu.serving.journal import (JOURNAL_FILENAME,
+                                                  replay)
+
+        d = tmp_path / "grp"
+        rt = _runtime(d, coalesce=8)
+        with rt:
+            for b in _batches():
+                rt.submit(b)
+            rt.poll()
+            digest = rt.state_digest()
+        records, torn = replay(os.path.join(str(d), JOURNAL_FILENAME))
+        assert torn is None
+        assert all("seqs" in r for r in records)
+        assert sum(len(r["seqs"]) for r in records) == N_BATCHES
+        decs = serving.journal_decisions(str(d))
+        assert [dd.seq for dd in decs] == list(range(N_BATCHES))
+        rt2, info = recover(str(d))
+        with rt2:
+            assert rt2.state_digest() == digest
+            assert info.replayed == N_BATCHES
+            assert rt2.coalesce == 8  # stored config is reused
+
+    def test_learn_ingest_reads_group_records(self, tmp_path):
+        """The journal consumer contract: learn.ingest.from_journal
+        reads group records through the same flat times/feeds keys."""
+        pytest.importorskip("jax")
+        from redqueen_tpu.learn.ingest import from_journal
+
+        d = tmp_path / "lrn"
+        rt = _runtime(d, coalesce=8)
+        with rt:
+            for b in _batches():
+                rt.submit(b)
+            rt.poll()
+        stream = from_journal(str(d))
+        assert stream.n_events == sum(b.n_events for b in _batches())
+
+
+# ---------------------------------------------------------------------------
+# Async group commit: the journal's durability window
+# ---------------------------------------------------------------------------
+
+
+class TestGroupCommitJournal:
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="flush_mode"):
+            Journal(str(tmp_path / "j"), flush_mode="lazy")
+        with pytest.raises(ValueError, match="max_unflushed_records"):
+            Journal(str(tmp_path / "j"), flush_mode="group",
+                    max_unflushed_records=0)
+        with pytest.raises(ValueError, match="max_flush_delay_ms"):
+            Journal(str(tmp_path / "j"), flush_mode="group",
+                    max_flush_delay_ms=0)
+        with pytest.raises(ValueError, match="flush_mode"):
+            _runtime(flush_mode="lazy")
+
+    def test_record_bound_forces_inline_fsync(self, tmp_path):
+        """The hard window bound: the moment max_unflushed_records acks
+        are un-forced, append() fsyncs inline — the window can never
+        silently widen."""
+        j = Journal(str(tmp_path / "j.jsonl"), flush_mode="group",
+                    max_unflushed_records=3, max_flush_delay_ms=60000.0)
+        with j:
+            j.append({"seq": 0}, seq=0)
+            j.append({"seq": 1}, seq=1)
+            assert j.durable_seq is None and j.unsynced == 2
+            j.append({"seq": 2}, seq=2)  # window full -> inline fsync
+            assert j.durable_seq == 2 and j.unsynced == 0
+
+    def test_time_bound_background_flush(self, tmp_path):
+        """The time bound: with the record window far away, the
+        background flusher forces the tail within max_flush_delay_ms."""
+        import time
+
+        j = Journal(str(tmp_path / "j.jsonl"), flush_mode="group",
+                    max_unflushed_records=10 ** 6,
+                    max_flush_delay_ms=20.0)
+        with j:
+            j.append({"seq": 7}, seq=7)
+            deadline = time.monotonic() + 5.0
+            while j.durable_seq != 7 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert j.durable_seq == 7 and j.unsynced == 0
+
+    def test_power_loss_drops_exactly_past_watermark(self, tmp_path):
+        """power_loss() truncates to the durability watermark: replay
+        afterwards returns the durable prefix, nothing more, nothing
+        torn."""
+        from redqueen_tpu.serving.journal import replay
+
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path, flush_mode="group",
+                    max_unflushed_records=4, max_flush_delay_ms=60000.0)
+        for s in range(6):  # inline fsync at the 4th append
+            j.append({"seq": s}, seq=s)
+        info = j.power_loss()
+        assert info["durable_seq"] == 3
+        assert info["dropped_records"] == 2
+        records, torn = replay(path)
+        assert torn is None
+        assert [r["seq"] for r in records] == [0, 1, 2, 3]
+
+    def test_sync_mode_close_keeps_everything(self, tmp_path):
+        """Group mode still syncs on close/rotation: a clean shutdown
+        never loses acked records regardless of flush mode."""
+        from redqueen_tpu.serving.journal import replay
+
+        path = str(tmp_path / "j.jsonl")
+        with Journal(path, flush_mode="group",
+                     max_unflushed_records=10 ** 6,
+                     max_flush_delay_ms=60000.0) as j:
+            for s in range(5):
+                j.append({"seq": s}, seq=s)
+        records, _ = replay(path)
+        assert len(records) == 5
+
+
+# ---------------------------------------------------------------------------
+# THE group-commit crash window acceptance (satellite): power-loss kill
+# between append and background flush -> bounded loss, reported lost
+# seqs, retransmit heals bit-identically, accounting reconciles.
+# ---------------------------------------------------------------------------
+
+
+def _stream_cli(dir, fault=None, resume=False, extra=(), timeout=240):
+    env = {k: v for k, v in os.environ.items()
+           if k not in (faultinject.ENV_FAULT, faultinject.ENV_FAULT_POINT)}
+    env["JAX_PLATFORMS"] = "cpu"
+    if fault:
+        env[faultinject.ENV_FAULT] = fault
+    cmd = [sys.executable, "-m", "redqueen_tpu.serving.stream",
+           "--dir", str(dir), "--batches", str(N_BATCHES),
+           "--feeds", str(N_FEEDS), "--events-per-batch", "5", *extra]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+WIRESPEED_FLAGS = ("--coalesce", "4", "--flush-mode", "group",
+                   "--max-unflushed-records", "1000",
+                   "--max-flush-delay-ms", "60000", "--snapshot-every",
+                   "6")
+
+
+@pytest.mark.slow
+def test_crash_in_window_bounded_loss_and_heal(tmp_path):
+    """SIGKILL with the fsync still pending (simulated power loss,
+    ``Journal.power_loss``): the journal keeps at most the durability
+    window less than what was acked; ``recover(acked_seq=...)`` reports
+    EXACTLY the lost acked seqs; full retransmit + duplicate drop heal
+    to a carry bit-identical to an uninterrupted run; the accounting
+    identity reconciles after the heal."""
+    ref_dir = tmp_path / "ref"
+    r = _stream_cli(ref_dir, extra=WIRESPEED_FLAGS)
+    assert r.returncode == 0, r.stderr[-2000:]
+    ref = integrity.read_json(os.path.join(str(ref_dir), "final.json"),
+                              schema="rq.serving.final/1")
+
+    d = tmp_path / "crash"
+    fault_at = 13
+    r = _stream_cli(d, fault=f"ingest:crash_in_window@batch{fault_at}",
+                    extra=WIRESPEED_FLAGS)
+    assert r.returncode == 23, (r.returncode, r.stderr[-2000:])
+
+    rt, info = recover(str(d), acked_seq=fault_at)
+    with rt:
+        # Bounded loss: everything acked past the durability watermark,
+        # and nothing before it, is reported lost.
+        assert info.recovered_seq < fault_at
+        assert info.lost_acked_seqs == tuple(
+            range(info.recovered_seq + 1, fault_at + 1))
+        # The run snapshotted at seq 5 (snapshot-every 6) — the
+        # snapshot is durable, so the window cannot reach below it.
+        assert info.recovered_seq >= 5
+        rt.reset_metrics()
+        # Retransmit the full stream: duplicates drop, the lost window
+        # re-applies, the tail extends.
+        for b in _batches():
+            rt.submit(b)
+        rt.poll()
+        assert rt.applied_seq == N_BATCHES - 1
+        assert rt.state_digest() == ref["state_digest"]
+        m = rt.metrics.report(pending=rt.pending)
+        assert m["reconciles"]
+        assert m["duplicates"] == info.recovered_seq + 1
+
+
+@pytest.mark.slow
+def test_crash_in_window_resume_cli_heals(tmp_path):
+    """The same scenario end-to-end through the CLI driver: crash (rc
+    23), --resume recovers + retransmits, the final artifact matches a
+    clean run bitwise."""
+    ref_dir = tmp_path / "ref"
+    r = _stream_cli(ref_dir, extra=WIRESPEED_FLAGS)
+    assert r.returncode == 0, r.stderr[-2000:]
+    ref = integrity.read_json(os.path.join(str(ref_dir), "final.json"),
+                              schema="rq.serving.final/1")
+    d = tmp_path / "crash"
+    r = _stream_cli(d, fault="ingest:crash_in_window@batch13",
+                    extra=WIRESPEED_FLAGS)
+    assert r.returncode == 23, (r.returncode, r.stderr[-2000:])
+    r2 = _stream_cli(d, resume=True, extra=WIRESPEED_FLAGS)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    got = integrity.read_json(os.path.join(str(d), "final.json"),
+                              schema="rq.serving.final/1")
+    assert got["state_digest"] == ref["state_digest"]
+    assert got["applied_seq"] == ref["applied_seq"] == N_BATCHES - 1
+
+
+@pytest.mark.slow
+def test_cluster_workers_crash_in_window_heals_and_reconciles(tmp_path):
+    """The satellite at CLUSTER scope: every worker power-loses at its
+    sub-batch (crash_in_window fires in each worker's runtime), the
+    router restarts them under the RetryPolicy, recovery reports the
+    per-shard lost acked seqs (``lost_in_window`` in the /2 ledger),
+    retransmit + duplicate drop heal, the final cluster digest equals a
+    clean run's, and the accounting identity reconciles THROUGH the
+    loss."""
+    batches = _batches()
+    clean = serving.ServingCluster(
+        n_feeds=N_FEEDS, n_shards=2, dir=str(tmp_path / "clean"),
+        snapshot_every=6, coalesce=4, flush_mode="group",
+        max_unflushed_records=1000, max_flush_delay_ms=60000.0)
+    with clean:
+        serving.drive(clean, batches)
+        ref_digest = clean.cluster_digest()
+
+    env_fault = "ingest:crash_in_window@batch13"
+    os.environ[faultinject.ENV_FAULT] = env_fault
+    try:
+        cl = serving.ServingCluster(
+            n_feeds=N_FEEDS, n_shards=2, dir=str(tmp_path / "chaos"),
+            snapshot_every=6, coalesce=4, flush_mode="group",
+            max_unflushed_records=1000, max_flush_delay_ms=60000.0,
+            placement="workers", token=None)
+    finally:
+        # The fault must reach the WORKER children (via the inherited
+        # env), not the router's own validation for shard kinds.
+        del os.environ[faultinject.ENV_FAULT]
+    with cl:
+        serving.drive(cl, batches, max_retransmit_rounds=8)
+        assert cl.applied_seq == N_BATCHES - 1
+        rep = cl.metrics.report(cl.pending_by_shard, cl.health_by_shard)
+        assert rep["reconciles"]
+        assert rep["crashes"] >= 1 and rep["recoveries"] >= 1
+        # The loss window was consumed and REPORTED, never silent.
+        assert rep["lost_in_window"] >= 1
+        lost = [s for sh in rep["shards"] for s in sh["lost_window_seqs"]]
+        assert lost and all(s <= 13 for s in lost)
+        assert cl.cluster_digest() == ref_digest
+
+
+# ---------------------------------------------------------------------------
+# Durability + latency reporting (satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestDurabilityReporting:
+    def test_metrics_carry_durability_block(self, tmp_path):
+        rt = _runtime(tmp_path / "d", coalesce=4, flush_mode="group",
+                      max_unflushed_records=16, max_flush_delay_ms=10.0)
+        with rt:
+            for b in _batches():
+                rt.submit(b)
+            rt.poll()
+            payload = rt.write_metrics()
+        dur = payload["durability"]
+        assert dur["flush_mode"] == "group"
+        assert dur["ack_is_durable"] is False
+        assert dur["loss_window_records"] == 15
+        assert dur["loss_window_batches"] == 60
+        assert dur["max_flush_delay_ms"] == 10.0
+
+    def test_sync_mode_ack_is_durable(self, tmp_path):
+        rt = _runtime(tmp_path / "s")
+        with rt:
+            for b in _batches():
+                rt.submit(b)
+            rt.poll()
+            payload = rt.write_metrics()
+        dur = payload["durability"]
+        assert dur["flush_mode"] == "sync"
+        assert dur["ack_is_durable"] is True
+        assert dur["loss_window_records"] == 0
+
+    def test_cluster_metrics_carry_durability_block(self, tmp_path):
+        cl = serving.ServingCluster(
+            n_feeds=N_FEEDS, n_shards=2, dir=str(tmp_path / "c"),
+            snapshot_every=10 ** 9, coalesce=4, flush_mode="group",
+            max_unflushed_records=8, max_flush_delay_ms=15.0)
+        with cl:
+            for b in _batches():
+                cl.submit(b)
+            cl.poll()
+            payload = cl.write_metrics()
+        dur = payload["durability"]
+        assert dur["flush_mode"] == "group"
+        assert dur["loss_window_records"] == 7
+        assert dur["loss_window_batches"] == 28
+
+    def test_durability_knobs_are_not_directory_identity(self, tmp_path):
+        """Reopening a directory with different flush/coalesce knobs is
+        LEGAL (they are durability/throughput, not replay identity) —
+        unlike seed/q/max_batch_events which still refuse."""
+        d = tmp_path / "dir"
+        with _runtime(d, coalesce=4, flush_mode="group"):
+            pass
+        with _runtime(d, coalesce=1, flush_mode="sync"):
+            pass  # no refusal
+        with pytest.raises(ValueError, match="replay would diverge"):
+            _runtime(d, seed=1)
+
+
+class TestLatencyPercentiles:
+    def test_empty(self):
+        p = _latency_percentiles([])
+        assert p["p99_trimmed_ms"] is None
+        assert p["p99_window_median_ms"] is None
+        assert p["windows"] == 0
+
+    def test_trimmed_excludes_stall_spike(self):
+        """One IO-stall outlier in 1000 samples: raw p99 and max see
+        it; the trimmed view (top 0.5% excluded) does not."""
+        lat = [0.001] * 999 + [5.0]
+        p = _latency_percentiles(lat)
+        assert p["max_ms"] == 5000.0
+        assert p["p99_trimmed_ms"] == 1.0
+        assert p["p99_trimmed_ms"] < p["p99_ms"] or p["p99_ms"] == 1.0
+
+    def test_windowed_median_is_stall_stable(self):
+        """An IO-stall WAVE confined to one window moves the global p99
+        but not the median of per-window p99s — the run-to-run
+        comparable statistic."""
+        wave = [0.001] * 512 * 3 + [0.2] * 512
+        p = _latency_percentiles(wave)
+        assert p["windows"] == 4
+        assert p["p99_window_median_ms"] == 1.0
+        assert p["p99_ms"] > 10.0  # the raw tail still shows the wave
+
+    def test_views_agree_on_clean_data(self):
+        p = _latency_percentiles([0.002] * 2048)
+        assert (p["p50_ms"] == p["p99_ms"] == p["p99_trimmed_ms"]
+                == p["p99_window_median_ms"] == 2.0)
+
+    def test_single_window_remainder_is_not_dropped(self):
+        """With fewer than two full windows the windowed view covers
+        EVERY sample — a stall in the trailing remainder must not be
+        invisible in the comparison statistic."""
+        lat = [0.001] * 512 + [0.5] * 88  # 600 samples, stall at tail
+        p = _latency_percentiles(lat)
+        assert p["windows"] == 1
+        assert p["p99_window_median_ms"] > 100.0

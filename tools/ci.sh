@@ -49,13 +49,22 @@ echo "== integrity / self-healing / numerics / serving fault-injection pass =="
 # kind plus the digest-asserted reshard path; test_serving_workers.py
 # re-runs that scenario at the PROCESS level (SIGKILL 1 of 4 real
 # subprocess workers; worker:* kinds, frame-protocol fuzzing, the
-# jax-free worker-child import proof, journal group commit).  This pass
+# jax-free worker-child import proof, journal group commit);
+# test_serving_wirespeed.py carries the wire-speed durability contracts
+# (coalesced-apply bitwise grouping invariance, the async-group-commit
+# crash window: power-loss kill -> bounded loss, reported lost acked
+# seqs, retransmit heals bit-identically, accounting reconciles);
+# test_serving_sockets.py carries the socket/net-chaos suite (TCP
+# placement bit-identity, hello-token auth, every net:drop|delay|
+# partition|reconnect kind healing without journal replay, the
+# kill+partition compound scenario, the remote-spawn proof).  This pass
 # runs UNFILTERED — the @pytest.mark.slow process-tree scenarios that
 # tier-1 skips (to hold its 870s bound) gate every CI run right here.
 env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
     tests/test_watchdog.py tests/test_watcher.py tests/test_numerics.py \
     tests/test_numerics_properties.py tests/test_serving.py \
     tests/test_serving_cluster.py tests/test_serving_workers.py \
+    tests/test_serving_wirespeed.py tests/test_serving_sockets.py \
     tests/test_rqlint.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
