@@ -286,6 +286,13 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     extras = roofline_fields(
         n_steps, secs, scan_step_traffic_bytes(cfg, params, adj),
         jax.devices()[0].platform, jax.devices()[0].device_kind)
+    # Kernel-launch count of one rep, summed over slabs (both engines
+    # report it on the EventLog): the denominator of the superchunk
+    # dispatch-amortization story — the scan engine pays ~one dispatch
+    # per sync_every chunks, the pallas megakernel one per k chunks.
+    disp = sum(lg.dispatches or 0 for lg in logs)
+    if disp:
+        extras["dispatches"] = disp
     if _profile_cb is not None:
         extras["_profile_cb"] = _profile_cb  # popped by child_main pre-print
 
@@ -322,10 +329,13 @@ def _sync_every() -> int:
 def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
                    wall_rate: float, capacity: int, deadline_abs=None,
                    profile_dir=None):
-    """Headline graph on the Pallas event-scan engine: the whole chunk is one
-    fused kernel with state resident in VMEM (ops/pallas_chunk.py). TPU
-    only — interpret mode exists for tests, not timing."""
-    from redqueen_tpu.ops.pallas_chunk import simulate_pallas
+    """Headline graph on the Pallas megakernel engine: k chunks per fused
+    superchunk launch with state resident in VMEM (ops/pallas_engine.py).
+    Timing claims are TPU-only; ``--interpret`` runs the same kernel
+    under the CPU interpreter for correctness/dispatch accounting (the
+    BENCH_r06 correctness slot), marked ``interpret: true`` in the
+    result line so it can never be mistaken for a timing number."""
+    from redqueen_tpu.ops.pallas_engine import simulate_pallas
 
     mc = _max_chunks(n_followers, T, wall_rate, capacity)
     sync = _sync_every()
@@ -504,6 +514,10 @@ def child_main(args) -> None:
         ev, secs, top1, top1_std, posts, extras = run_jax_pallas(
             B, args.followers, T, args.q, args.wall_rate, capacity,
             deadline_abs=deadline_abs, profile_dir=args.profile)
+        if jax.devices()[0].platform != "tpu":
+            # CPU interpreter correctness run (--interpret): the numbers
+            # are semantics + dispatch evidence, NEVER a timing claim.
+            extras["interpret"] = True
     else:
         raise SystemExit(f"unknown engine {args.as_engine!r}")
     profile_cb = extras.pop("_profile_cb", None)
@@ -557,6 +571,8 @@ def _run_child(args, engine: str, backend: str, timeout_s: float):
         cmd += ["--config", str(args.config)]
     if args.profile:
         cmd += ["--profile", args.profile]
+    if getattr(args, "interpret", False):
+        cmd.append("--interpret")
     from redqueen_tpu.runtime import RetryPolicy, Supervisor
     from redqueen_tpu.utils.backend import parse_last_json_line
 
@@ -658,11 +674,13 @@ def parent_main(args) -> None:
         backend = "cpu"
     log(f"backend: {backend}; total deadline {args.deadline:.0f}s "
         f"({_remaining(args):.0f}s remaining)")
-    if engines == ["pallas"] and backend == "cpu":
+    if (engines == ["pallas"] and backend == "cpu"
+            and not getattr(args, "interpret", False)):
         raise RuntimeError(
             "--engine pallas requires the TPU backend (Mosaic lowering); "
             "interpret mode exists for tests, not timing — run with --tpu "
-            "and a live tunnel, or pick --engine scan/star"
+            "and a live tunnel, pick --engine scan/star, or pass "
+            "--interpret for an explicit CPU correctness run"
         )
 
     # One flag, one policy: an explicit --tpu run is a TPU-EVIDENCE capture
@@ -782,8 +800,11 @@ def parent_main(args) -> None:
         }
         # Utilization block (the MFU analogue; see utils/roofline.py) —
         # present for the scan/pallas engines, absent for star/config.
+        # `dispatches` is the per-rep kernel-launch count (superchunk
+        # amortization evidence); `interpret` marks a pallas CPU
+        # correctness run so it can never pass for a timing claim.
         for k in ("steps", "step_ns", "bytes_per_step", "hbm_gbps",
-                  "hbm_peak_gbps", "hbm_frac"):
+                  "hbm_peak_gbps", "hbm_frac", "dispatches", "interpret"):
             if k in res:
                 line[k] = res[k]
         line.update(gate_fields(res))
@@ -798,7 +819,8 @@ def parent_main(args) -> None:
         nonlocal best
         any_ok = False
         for name in engines:
-            if name == "pallas" and bk == "cpu":
+            if (name == "pallas" and bk == "cpu"
+                    and not getattr(args, "interpret", False)):
                 continue  # interpret mode exists for tests, not timing
             rem = _remaining(args)
             if rem < 45.0:
@@ -923,6 +945,12 @@ def main():
                     help="write one redqueen_tpu.runtime RunReport JSON "
                          "per supervised engine child into DIR (attempts, "
                          "deadlines, disposition) — off by default")
+    ap.add_argument("--interpret", action="store_true",
+                    help="allow the pallas megakernel on the CPU backend "
+                         "via the Pallas interpreter — a CORRECTNESS + "
+                         "dispatch-count run (the BENCH_r06 interpreter "
+                         "slot), never a timing claim; the result line "
+                         "carries interpret:true")
     ap.add_argument("--no-oracle", action="store_true",
                     help="skip the NumPy-oracle denominator (engine-vs-"
                          "engine comparisons; O(sources)-per-event makes it "

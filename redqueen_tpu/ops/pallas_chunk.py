@@ -1,325 +1,14 @@
-"""Pallas event-scan chunk: the batch engine's hot loop as ONE fused TPU
-kernel with all simulation state resident in VMEM.
-
-Motivation (docs/DESIGN.md "Pallas status"): under XLA, every step of the
-event scan streams the [B, S] state arrays HBM->VMEM->HBM; a chunk of
-``capacity`` steps therefore moves ~capacity x state-size of HBM traffic.
-This kernel runs the whole chunk inside one ``pallas_call`` — state loads
-once, lives in registers/VMEM across all steps, and only the event log
-(one (time, src) pair per step) is written out. The batch axis rides the
-128-wide lane dimension; sources ride sublanes.
-
-Scope: components whose policy mix is {Poisson walls, Opt broadcasters}
-(the headline BASELINE shape — configs 1 and 3). Other mixes fall back to
-the XLA engine (``supports`` reports False and callers dispatch there);
-reference semantics are identical: argmin event selection with
-lowest-index tie-break, absorbing steps past the horizon, per-source
-(key, counter) PRNG streams (SURVEY.md sections 3.1-3.2).
-
-Randomness: in-kernel threefry-2x32 (ops/threefry.py — bit-identical to
-JAX's generator, pure 32-bit ops, so the SAME kernel runs compiled on TPU
-and under ``interpret=True`` on CPU for tests). Streams differ from the
-XLA engine's ``jax.random`` call pattern (documented in PARITY.md — parity
-is statistical, pinned by tests/test_pallas_chunk.py).
+"""Back-compat shim: the seed per-chunk Pallas engine grew into the
+full-mix megakernel (``ops/pallas_engine.py`` — superchunk launches,
+Hawkes/RealData/piecewise coverage, in-kernel lane health, per-shape
+VMEM planning via ``ops/pallas_vmem.py``).  Import from those modules;
+this one only preserves the seed entry points for existing callers.
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
-from jax.experimental import pallas as pl
-
-from ..config import SimConfig, SourceParams
-from ..models.base import KIND_OPT, KIND_POISSON
-from .threefry import exponential_from_bits, threefry2x32
+from .pallas_engine import PallasState, simulate_pallas, supports  # noqa: F401
+from .pallas_vmem import DEFAULT_VMEM_BUDGET as _VMEM_BUDGET  # noqa: F401
+from .pallas_vmem import plan_vmem, vmem_bytes  # noqa: F401
 
 __all__ = ["supports", "simulate_pallas"]
-
-_TILE = 128
-
-
-def supports(cfg: SimConfig) -> bool:
-    """True iff this kernel covers the config's policy mix."""
-    kinds = set(cfg.present_kinds)
-    return bool(kinds) and kinds <= {KIND_POISSON, KIND_OPT}
-
-
-def vmem_bytes(cfg: SimConfig, S: int, F: int) -> int:
-    """Per-grid-step VMEM footprint estimate of the kernel's blocks (4-byte
-    words x 128 lanes): the [S, F, T] adjacency cube dominates, plus the
-    [S, T] state/param rows, [F, T] rows, and the [capacity, T] event log
-    pair."""
-    rows_S = 7       # rate, q, is_opt, k0, k1, t_next, ctr
-    rows_F = 2       # ssink, feeds_hit scratch
-    return 4 * _TILE * (S * F + rows_S * S + rows_F * F + 2 * cfg.capacity + 4)
-
-
-# v5e VMEM is 16 MiB/core; leave headroom for Mosaic's own scratch.
-_VMEM_BUDGET = 12 * 2**20
-
-
-def _check_vmem(cfg: SimConfig, S: int, F: int):
-    """Host-side shape guard: the state-resident design bounds S*F and
-    capacity; fail with a clear message instead of a Mosaic OOM deep in
-    compilation (the scan/star engines cover larger shapes)."""
-    need = vmem_bytes(cfg, S, F)
-    if need > _VMEM_BUDGET:
-        raise ValueError(
-            f"pallas engine VMEM estimate {need / 2**20:.1f} MiB exceeds the "
-            f"{_VMEM_BUDGET / 2**20:.0f} MiB budget (S={S}, F={F}, "
-            f"capacity={cfg.capacity}; the [S, F, 128] adjacency block "
-            f"dominates) — use the scan engine (sim.simulate_batch) or the "
-            f"star engine (parallel.bigf) for this shape"
-        )
-
-
-def _kernel_body(cfg: SimConfig, opt_rows, rate_ref, q_ref, is_opt_ref,
-                 adj_ref, ssink_ref, k0_ref, k1_ref, tnext_ref, ctr_ref,
-                 t_ref, nev_ref, tnext_out, ctr_out, t_out, nev_out,
-                 times_ref, srcs_ref):
-    S = rate_ref.shape[0]
-    T = rate_ref.shape[1]
-    # Python scalars, not jnp constants: pallas kernels may not capture
-    # traced constant arrays.
-    end = float(cfg.end_time)
-    inf = float(np.inf)
-
-    rate = rate_ref[:]          # [S, T]
-    is_opt = is_opt_ref[:]      # [S, T] f32 mask
-    adj = adj_ref[:]            # [S, F, T] f32 mask
-    ssink = ssink_ref[:]        # [F, T]
-    q = q_ref[:]                # [S, T]
-    k0 = k0_ref[:]              # [S, T] uint32
-    k1 = k1_ref[:]
-    iota_s = lax.broadcasted_iota(jnp.int32, (S, T), 0)
-    # sqrt(s_f / q_r) panel per opt row, hoisted out of the loop.
-    opt_rates = {
-        r: jnp.sqrt(ssink / jnp.maximum(q[r][None, :], 1e-30))  # [F, T]
-        for r in opt_rows
-    }
-
-    def step(i, carry):
-        t_next, ctr, t, nev = carry
-
-        tmin = jnp.min(t_next, axis=0)                       # [T]
-        prio = jnp.where(t_next == tmin[None, :], iota_s, S)
-        s_star = jnp.min(prio, axis=0)                       # [T] lowest idx
-        ff = (iota_s == s_star[None, :]).astype(jnp.float32)  # [S, T] onehot
-        valid = (tmin <= end) & (s_star < S)                 # [T]
-
-        # ---- fired source resamples (Poisson -> new Exp; Opt -> inf) ----
-        # int32 detours: Mosaic lowers f32->i32, bool->i32 and i32->u32 but
-        # not f32->u32 / bool->u32 directly.
-        ffu = ff.astype(jnp.int32).astype(jnp.uint32)
-        k0f = jnp.sum(k0 * ffu, axis=0)                      # [T] fired key
-        k1f = jnp.sum(k1 * ffu, axis=0)
-        ctrf = jnp.sum(ctr * ffu, axis=0)
-        bits0, _ = threefry2x32(k0f, k1f, ctrf, jnp.zeros_like(ctrf))
-        e = exponential_from_bits(bits0)                     # [T]
-        ratef = jnp.sum(rate * ff, axis=0)
-        optf = jnp.sum(is_opt * ff, axis=0) > 0.5
-        t_new = jnp.where(
-            optf | (ratef <= 0), inf, tmin + e / jnp.maximum(ratef, 1e-30)
-        )
-        sel = (ff > 0.5) & valid[None, :]
-        t_next = jnp.where(sel, t_new[None, :], t_next)
-        ctr = ctr + (ffu * valid.astype(jnp.int32).astype(jnp.uint32))
-
-        # ---- react: each Opt row spawns a superposition clock ----
-        feeds_hit = jnp.sum(adj * ff[:, None, :], axis=0)    # [F, T]
-        for r in opt_rows:
-            aff = adj[r] * feeds_hit                         # [F, T]
-            rs = jnp.sum(aff * opt_rates[r], axis=0)         # [T]
-            react = (rs > 0) & (s_star != r) & valid
-            bits_r, _ = threefry2x32(
-                k0[r], k1[r], ctr[r], jnp.ones((T,), jnp.uint32)
-            )
-            cand = tmin + exponential_from_bits(bits_r) / jnp.maximum(rs, 1e-30)
-            t_next = t_next.at[r].set(
-                jnp.where(react, jnp.minimum(t_next[r], cand), t_next[r])
-            )
-            ctr = ctr.at[r].set(ctr[r] + react.astype(jnp.int32).astype(jnp.uint32))
-
-        # ---- emit event, advance clock (absorbing past horizon) ----
-        times_ref[i, :] = jnp.where(valid, tmin, inf)
-        srcs_ref[i, :] = jnp.where(valid, s_star, -1)
-        t = jnp.where(valid, tmin, t)
-        nev = nev + valid.astype(jnp.int32)
-        return t_next, ctr, t, nev
-
-    t_next, ctr, t, nev = lax.fori_loop(
-        0, cfg.capacity, step,
-        (tnext_ref[:], ctr_ref[:], t_ref[:], nev_ref[:]),
-    )
-    tnext_out[:] = t_next
-    ctr_out[:] = ctr
-    t_out[:] = t
-    nev_out[:] = nev
-
-
-class PallasState:
-    """Host-side carry of the pallas engine (batch-first layout [B, ...])."""
-
-    def __init__(self, t_next, ctr, t, n_events, k0, k1):
-        self.t_next = t_next    # [B, S]
-        self.ctr = ctr          # [B, S] uint32
-        self.t = t              # [B]
-        self.n_events = n_events  # [B] int32
-        self.k0 = k0            # [B, S] uint32 (constant across chunks)
-        self.k1 = k1
-
-
-def _source_keys(seeds, S):
-    """Per-(component, source) base keys with the engine's own discipline:
-    (k0, k1) = threefry(seed, 0; source, 0) — layout-independent."""
-    seeds = jnp.asarray(seeds, jnp.uint32)          # [B]
-    src = jnp.arange(S, dtype=jnp.uint32)
-    k0, k1 = threefry2x32(
-        seeds[:, None], jnp.zeros_like(seeds)[:, None],
-        src[None, :], jnp.zeros((1, S), jnp.uint32),
-    )
-    return k0, k1                                    # [B, S]
-
-
-def _init_state(cfg: SimConfig, params: SourceParams, seeds) -> PallasState:
-    B = params.kind.shape[0]
-    S = cfg.n_sources
-    k0, k1 = _source_keys(seeds, S)
-    bits0, _ = threefry2x32(k0, k1, jnp.zeros_like(k0),
-                            jnp.full_like(k0, 2))   # x1=2: the init stream
-    e = exponential_from_bits(bits0)                # [B, S]
-    rate = params.rate
-    is_poisson = params.kind == KIND_POISSON
-    t_next = jnp.where(
-        is_poisson & (rate > 0),
-        jnp.float32(cfg.start_time) + e / jnp.maximum(rate, 1e-30),
-        jnp.inf,
-    ).astype(jnp.float32)
-    return PallasState(
-        t_next=t_next,
-        ctr=jnp.zeros((B, S), jnp.uint32),
-        t=jnp.full((B,), cfg.start_time, jnp.float32),
-        n_events=jnp.zeros((B,), jnp.int32),
-        k0=k0, k1=k1,
-    )
-
-
-@functools.lru_cache(maxsize=None)
-def _chunk_call(cfg: SimConfig, S: int, F: int, interpret: bool):
-    kernel = functools.partial(_kernel_body, cfg, cfg.opt_rows)
-    T = _TILE
-    grid = lambda B: (B // T,)  # noqa: E731
-
-    def call(rate, q, is_opt, adj, ssink, k0, k1, t_next, ctr, t, nev):
-        B = rate.shape[-1]
-        row = pl.BlockSpec((S, T), lambda i: (0, i))
-        rowF = pl.BlockSpec((F, T), lambda i: (0, i))
-        cube = pl.BlockSpec((S, F, T), lambda i: (0, 0, i))
-        vec = pl.BlockSpec((T,), lambda i: (i,))
-        log = pl.BlockSpec((cfg.capacity, T), lambda i: (0, i))
-        f32, u32, i32 = jnp.float32, jnp.uint32, jnp.int32
-        out_shape = (
-            jax.ShapeDtypeStruct((S, B), f32),     # t_next
-            jax.ShapeDtypeStruct((S, B), u32),     # ctr
-            jax.ShapeDtypeStruct((B,), f32),       # t
-            jax.ShapeDtypeStruct((B,), i32),       # n_events
-            jax.ShapeDtypeStruct((cfg.capacity, B), f32),   # times
-            jax.ShapeDtypeStruct((cfg.capacity, B), i32),   # srcs
-        )
-        return pl.pallas_call(
-            kernel,
-            grid=grid(B),
-            in_specs=[row, row, row, cube, rowF, row, row, row, row, vec, vec],
-            out_specs=(row, row, vec, vec, log, log),
-            out_shape=out_shape,
-            interpret=interpret,
-        )(rate, q, is_opt, adj, ssink, k0, k1, t_next, ctr, t, nev)
-
-    return jax.jit(call)
-
-
-def _pad(x, B_pad, fill):
-    B = x.shape[-1]
-    if B == B_pad:
-        return x
-    pad = [(0, 0)] * (x.ndim - 1) + [(0, B_pad - B)]
-    return jnp.pad(x, pad, constant_values=fill)
-
-
-def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
-                    max_chunks: int = 100, interpret: Optional[bool] = None,
-                    sync_every: Optional[int] = None):
-    """Run a batch of components on the Pallas engine; returns an
-    ``EventLog`` (same contract as ``sim.simulate_batch``, different PRNG
-    streams — see module docstring). ``params``/``adj`` carry a leading [B]
-    dim; ``seeds`` is an int array [B].
-
-    ``interpret`` defaults to True off-TPU (tests) and False on TPU.
-    ``sync_every`` is the liveness-check cadence of the chunk loop: the
-    device->host `any(alive)` round-trip runs every that many chunks
-    (default 1 off-TPU — tests see per-chunk buffers — and 8 on TPU, where
-    each sync is a tunnel RTT that dwarfs an absorbed chunk's compute;
-    results are identical either way, later-trimmed padding aside).
-    """
-    from ..sim import EventLog  # local: avoid import cycle
-
-    if not supports(cfg):
-        raise ValueError(
-            f"pallas engine supports only Poisson+Opt components, got "
-            f"present_kinds={cfg.present_kinds}"
-        )
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    if sync_every is None:
-        sync_every = 1 if interpret else 8
-    B, S = params.kind.shape
-    F = adj.shape[-1]
-    _check_vmem(cfg, S, F)
-    B_pad = -(-B // _TILE) * _TILE
-
-    state = _init_state(cfg, params, jnp.asarray(seeds))
-    # Lane layout: batch last. Padded lanes: rate 0 / t_next inf => absorb.
-    to_lanes = lambda x, fill=0: _pad(  # noqa: E731
-        jnp.moveaxis(jnp.asarray(x), 0, -1), B_pad, fill
-    )
-    rate = to_lanes(params.rate.astype(jnp.float32))
-    q = to_lanes(params.q.astype(jnp.float32), 1.0)
-    is_opt = to_lanes((params.kind == KIND_OPT).astype(jnp.float32))
-    adj_l = to_lanes(jnp.asarray(adj).astype(jnp.float32))
-    ssink = to_lanes(params.s_sink.astype(jnp.float32))
-    k0 = to_lanes(state.k0)
-    k1 = to_lanes(state.k1)
-    t_next = to_lanes(state.t_next, jnp.inf)
-    ctr = to_lanes(state.ctr)
-    t = _pad(state.t, B_pad, 0.0)
-    nev = _pad(state.n_events, B_pad, 0)
-
-    call = _chunk_call(cfg, S, F, bool(interpret))
-    times_chunks, srcs_chunks = [], []
-    for i in range(max_chunks):
-        t_next, ctr, t, nev, times_c, srcs_c = call(
-            rate, q, is_opt, adj_l, ssink, k0, k1, t_next, ctr, t, nev
-        )
-        times_chunks.append(times_c[:, :B])
-        srcs_chunks.append(srcs_c[:, :B])
-        check = (i % sync_every == sync_every - 1) or (i == max_chunks - 1)
-        # The docstring's cadence-controlled liveness round-trip: ONE
-        # scalar sync every `sync_every` chunks, never per event.
-        if check and not bool(  # rqlint: disable=RQ702 cadence-gated sync
-            jnp.any(jnp.min(t_next, axis=0) <= cfg.end_time)
-        ):
-            break
-    else:
-        raise RuntimeError(
-            f"simulation still active after {max_chunks} chunks of "
-            f"{cfg.capacity} events — raise capacity or max_chunks "
-            f"(refusing to truncate silently)"
-        )
-    times = jnp.concatenate(times_chunks, axis=0).T   # [B, E]
-    srcs = jnp.concatenate(srcs_chunks, axis=0).T
-    return EventLog(times, srcs, jax.device_get(nev[:B]), cfg)

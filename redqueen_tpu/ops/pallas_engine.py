@@ -1,0 +1,474 @@
+"""The Pallas event megakernel: the batch engine's full per-step pipeline
+fused into ONE kernel, run k chunks per launch ("superchunks"), with all
+simulation state resident in VMEM across every step of every chunk.
+
+Grown from the seed chunk engine (``ops/pallas_chunk.py``, Poisson+Opt
+only, one ``pallas_call`` + one host round-trip per chunk) into the
+repo's primary fused batch engine:
+
+- **Full covered policy mix** — Poisson walls, Opt broadcasters, Hawkes
+  excitation state, RealData replay cursors, and piecewise-constant
+  rates all run inside the fused step (``ops/pallas_step.py``); only the
+  RMTPP neural policy falls back to the scan engine
+  (:func:`coverage` reports why, ``sim.select_engine`` dispatches).
+- **Superchunk launches** — the grid is ``(lanes/128, k)``: the second,
+  innermost axis runs k chunks back-to-back in ONE launch, carrying the
+  state through revisited output blocks (fetched once per lane tile,
+  written back once) while the per-chunk event-log blocks stream out
+  double-buffered by the Pallas pipeline.  The host's liveness check is
+  ONE replicated scalar per launch, so a bench run that used to cost
+  ~one dispatch per chunk now costs ``chunks / k`` dispatches
+  (``EventLog.dispatches`` records the count).
+- **In-kernel lane health (PR 3 semantics)** — the per-lane uint32
+  bitmask rides the kernel carry and freezes sick lanes exactly like
+  the scan engine, so ``EventLog.health`` is populated by this path and
+  the sweep-level quarantine/heal machinery is engine-agnostic.
+- **Per-shape VMEM plan** — ``ops/pallas_vmem.plan_vmem`` prices every
+  block and picks (capacity, k, tile) per config, degrading to the scan
+  engine with a recorded reason instead of a Mosaic OOM.
+
+Randomness: in-kernel threefry-2x32 (``ops/threefry.py``), bit-identical
+to JAX's generator, so the SAME kernel runs compiled on TPU and under
+``interpret=True`` on CPU for tests.  Streams differ from the XLA
+engine's call pattern (PARITY.md): parity is statistical for the random
+policies and BIT-IDENTICAL for replay-only mixes (no randomness), pinned
+by tests/test_pallas_engine.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..config import SimConfig, SourceParams
+from ..models.base import (
+    KIND_HAWKES,
+    KIND_OPT,
+    KIND_PIECEWISE,
+    KIND_POISSON,
+    KIND_REALDATA,
+    get_registry,
+)
+from ..runtime import faultinject as _faultinject
+from ..runtime import numerics as _numerics
+from .pallas_step import KernelSpec, make_step, prepare_consts
+from .pallas_vmem import TILE as _TILE
+from .pallas_vmem import VmemPlan, plan_vmem
+from .sampling import piecewise_next_from_target
+from .threefry import exponential_from_bits, threefry2x32
+
+__all__ = ["supports", "coverage", "simulate_pallas", "PallasState",
+           "COVERED_KINDS", "CHUNK_CALL_CACHE"]
+
+
+#: Policy kinds the fused step implements; everything else (RMTPP)
+#: dispatches to the scan engine.
+COVERED_KINDS = frozenset(
+    (KIND_POISSON, KIND_OPT, KIND_HAWKES, KIND_REALDATA, KIND_PIECEWISE))
+
+
+def coverage(cfg: SimConfig):
+    """``(covered, reason)`` for a config's policy mix: ``reason`` is
+    ``None`` when the megakernel covers it, else the recorded degrade
+    provenance (``sim.select_engine`` surfaces it on the fallback)."""
+    kinds = set(cfg.present_kinds)
+    if not kinds:
+        return False, (
+            "config carries no present_kinds (hand-built SimConfig) — "
+            "build through GraphBuilder so the kernel can specialize")
+    extra = kinds - COVERED_KINDS
+    if extra:
+        reg = get_registry()
+        names = ", ".join(sorted(
+            reg[k].name if k in reg else f"kind{k}" for k in extra))
+        return False, (
+            f"policy kind(s) {{{names}}} have no fused-kernel "
+            f"implementation (the RMTPP recurrence needs per-step hidden "
+            f"state the megakernel does not carry) — the scan engine "
+            f"covers them")
+    return True, None
+
+
+def supports(cfg: SimConfig) -> bool:
+    """True iff the megakernel covers the config's policy mix."""
+    return coverage(cfg)[0]
+
+
+class PallasState(struct.PyTreeNode):
+    """Host-side carry of the pallas engine, batch-first layout [B, ...]
+    (``runtime.numerics.poison_lane`` operates on it like a SimState)."""
+
+    t_next: jnp.ndarray    # [B, S]
+    ctr: jnp.ndarray       # [B, S] uint32
+    t: jnp.ndarray         # [B]
+    n_events: jnp.ndarray  # [B] int32
+    health: jnp.ndarray    # [B] uint32
+    exc: jnp.ndarray       # [B, S] Hawkes excitation
+    exc_t: jnp.ndarray     # [B, S] excitation fold time
+    rd_ptr: jnp.ndarray    # [B, S] int32 replay cursors
+    k0: jnp.ndarray        # [B, S] uint32 (constant across chunks)
+    k1: jnp.ndarray
+
+
+def _source_keys(seeds, S):
+    """Per-(component, source) base keys with the engine's own discipline:
+    (k0, k1) = threefry(seed, 0; source, 0) — layout-independent."""
+    seeds = jnp.asarray(seeds, jnp.uint32)          # [B]
+    src = jnp.arange(S, dtype=jnp.uint32)
+    k0, k1 = threefry2x32(
+        seeds[:, None], jnp.zeros_like(seeds)[:, None],
+        src[None, :], jnp.zeros((1, S), jnp.uint32),
+    )
+    return k0, k1                                    # [B, S]
+
+
+def _init_state(cfg: SimConfig, params: SourceParams, seeds) -> PallasState:
+    """First draws for every covered kind, all from the engine's init
+    stream (counter word x1=2, one Exp(1) per source): Poisson inverts
+    Exp(rate); Hawkes from an empty history is exactly Exp(l0); RealData
+    seeks the first replay timestamp at/after the start; piecewise
+    inverts its cumulative hazard from the start time."""
+    B, S = params.kind.shape
+    k0, k1 = _source_keys(seeds, S)
+    bits0, _ = threefry2x32(k0, k1, jnp.zeros_like(k0),
+                            jnp.full_like(k0, 2))   # x1=2: the init stream
+    e = exponential_from_bits(bits0)                # [B, S]
+    kind = params.kind
+    kinds = set(cfg.present_kinds)
+    t0 = jnp.float32(cfg.start_time)
+    # Poisson and empty-history Hawkes share the Exp(rate-like) inversion.
+    rate_like = jnp.where(kind == KIND_HAWKES, params.l0, params.rate)
+    t_exp = jnp.where(rate_like > 0,
+                      t0 + e / jnp.maximum(rate_like, 1e-30), jnp.inf)
+    t_next = jnp.where(
+        (kind == KIND_POISSON) | (kind == KIND_HAWKES), t_exp, jnp.inf)
+    rd_ptr = jnp.zeros((B, S), jnp.int32)
+    if KIND_REALDATA in kinds:
+        rd = params.rd_times
+        Kr = rd.shape[-1]
+        # First replay timestamp >= t0 (searchsorted 'left' over the
+        # sorted trace, as a rank count so it vmaps freely).
+        rd_ptr = jnp.sum(rd < t0, axis=-1).astype(jnp.int32)
+        peek = jnp.take_along_axis(
+            rd, jnp.minimum(rd_ptr, Kr - 1)[..., None], axis=-1)[..., 0]
+        t_rd = jnp.where(rd_ptr < Kr, peek, jnp.inf)
+        t_next = jnp.where(kind == KIND_REALDATA, t_rd, t_next)
+    if KIND_PIECEWISE in kinds:
+        t_pw = piecewise_next_from_target(
+            e, t0, params.pw_times, params.pw_rates)
+        t_next = jnp.where(kind == KIND_PIECEWISE, t_pw, t_next)
+    return PallasState(
+        t_next=t_next.astype(jnp.float32),
+        ctr=jnp.zeros((B, S), jnp.uint32),
+        t=jnp.full((B,), cfg.start_time, jnp.float32),
+        n_events=jnp.zeros((B,), jnp.int32),
+        health=jnp.zeros((B,), jnp.uint32),
+        exc=jnp.zeros((B, S), jnp.float32),
+        exc_t=jnp.full((B, S), cfg.start_time, jnp.float32),
+        rd_ptr=rd_ptr,
+        k0=k0, k1=k1,
+    )
+
+
+def _spec_for(cfg: SimConfig, S, F, Kr, Kp, k, capacity) -> KernelSpec:
+    kinds = set(cfg.present_kinds)
+    end_time = float(cfg.end_time)  # rqlint: disable=RQ701 host float
+    return KernelSpec(
+        S=S, F=F, Kr=Kr, Kp=Kp, tile=_TILE, capacity=capacity, k=k,
+        end_time=end_time, opt_rows=cfg.opt_rows,
+        has_opt=KIND_OPT in kinds, has_hawkes=KIND_HAWKES in kinds,
+        has_rd=KIND_REALDATA in kinds, has_pw=KIND_PIECEWISE in kinds,
+    )
+
+
+def _io_names(spec: KernelSpec):
+    """(param names, carry names) in kernel argument order — only the
+    blocks the policy mix compiles exist at all."""
+    ins = ["kind", "rate", "k0", "k1"]
+    if spec.has_opt:
+        ins += ["q", "ssink", "adj"]
+    if spec.has_hawkes:
+        ins += ["l0", "alpha", "beta"]
+    if spec.has_rd:
+        ins += ["rd_times"]
+    if spec.has_pw:
+        ins += ["pw_times", "pw_rates"]
+    carry = ["t_next", "ctr", "t", "nev", "health"]
+    if spec.has_hawkes:
+        carry += ["exc", "exc_t"]
+    if spec.has_rd:
+        carry += ["rd_ptr"]
+    return ins, carry
+
+
+# Every carry slot the step function threads, in its fixed order; absent
+# slots ride as None (an empty pytree node under fori_loop).
+_CARRY_SLOTS = ("t_next", "ctr", "t", "nev", "health", "exc", "exc_t",
+                "rd_ptr")
+
+_CARRY_DTYPES = dict(t_next=jnp.float32, ctr=jnp.uint32, t=jnp.float32,
+                     nev=jnp.int32, health=jnp.uint32, exc=jnp.float32,
+                     exc_t=jnp.float32, rd_ptr=jnp.int32)
+
+
+def _block_spec(name: str, spec: KernelSpec):
+    """BlockSpec per logical input/carry block.  Carry/param blocks are
+    constant along the chunk axis j — fetched once per lane tile and, for
+    outputs, written back once when the tile advances (the revisited-
+    block carry that keeps state on-chip across all k chunks)."""
+    T = spec.tile
+    if name in ("t", "nev", "health"):
+        return pl.BlockSpec((T,), lambda i, j: (i,))
+    if name == "ssink":
+        return pl.BlockSpec((spec.F, T), lambda i, j: (0, i))
+    if name == "adj":
+        return pl.BlockSpec((spec.S, spec.F, T), lambda i, j: (0, 0, i))
+    if name == "rd_times":
+        return pl.BlockSpec((spec.S, spec.Kr, T), lambda i, j: (0, 0, i))
+    if name in ("pw_times", "pw_rates"):
+        return pl.BlockSpec((spec.S, spec.Kp, T), lambda i, j: (0, 0, i))
+    return pl.BlockSpec((spec.S, T), lambda i, j: (0, i))
+
+
+def _build_kernel(spec: KernelSpec):
+    in_names, carry_names = _io_names(spec)
+    n_params = len(in_names)
+    n_in = n_params + len(carry_names)
+
+    def kernel(*refs):
+        params = dict(zip(in_names, refs[:n_params]))
+        cin = refs[n_params:n_in]
+        cout = refs[n_in:n_in + len(carry_names)]
+        times_ref, srcs_ref = refs[n_in + len(carry_names):]
+        j = pl.program_id(1)
+
+        # First chunk of the superchunk: seed the carry-out blocks from
+        # the carry-in blocks.  For j > 0 the out blocks are REVISITED
+        # (same block index), so they still hold the previous chunk's
+        # final state — the on-chip carry across all k chunks.
+        @pl.when(j == 0)
+        def _seed_carry():
+            # Static unroll over the ref TUPLE (its length is a compile-
+            # time fact of the policy mix), not a traced operand.
+            for a, b in zip(cin, cout):  # rqlint: disable=RQ401 static refs
+                b[:] = a[:]
+
+        c = prepare_consts(spec, {nm: params[nm][:] for nm in in_names})
+        carried = dict(zip(carry_names, (r[:] for r in cout)))
+        carry0 = tuple(carried.get(nm) for nm in _CARRY_SLOTS)
+        step = make_step(spec, c, times_ref, srcs_ref)
+        out = lax.fori_loop(0, spec.capacity, step, carry0)
+        final = dict(zip(_CARRY_SLOTS, out))
+        # Static unroll over the carry-name list, not a traced operand.
+        for nm, r in zip(carry_names, cout):  # rqlint: disable=RQ401 static
+            r[:] = final[nm]
+
+    return kernel
+
+
+#: Bound on the compiled-callable cache (seed bug: ``lru_cache(None)``
+#: leaked one compiled superchunk per (cfg, shape) forever — a sweep over
+#: many configs grew without bound).  32 comfortably covers every live
+#: shape a bench/sweep run cycles through; colder entries recompile.
+CHUNK_CALL_CACHE = 32
+
+
+@functools.lru_cache(maxsize=CHUNK_CALL_CACHE)
+def _chunk_call(cfg: SimConfig, S: int, F: int, Kr: int, Kp: int, k: int,
+                capacity: int, interpret: bool):
+    spec = _spec_for(cfg, S, F, Kr, Kp, k, capacity)
+    kernel = _build_kernel(spec)
+    in_names, carry_names = _io_names(spec)
+    T = _TILE
+    end = float(cfg.end_time)
+
+    def call(*args):
+        # args: params then carry, lane-last, B_pad lanes (multiple of T).
+        B = args[0].shape[-1]
+        grid = (B // T, k)
+        in_specs = [_block_spec(nm, spec) for nm in in_names + carry_names]
+        out_specs = tuple(
+            [_block_spec(nm, spec) for nm in carry_names]
+            + [pl.BlockSpec((capacity, T), lambda i, j: (j, i))] * 2)
+
+        def shp(nm):
+            if nm in ("t", "nev", "health"):
+                return jax.ShapeDtypeStruct((B,), _CARRY_DTYPES[nm])
+            return jax.ShapeDtypeStruct((S, B), _CARRY_DTYPES[nm])
+
+        out_shape = tuple(
+            [shp(nm) for nm in carry_names]
+            + [jax.ShapeDtypeStruct((k * capacity, B), jnp.float32),
+               jax.ShapeDtypeStruct((k * capacity, B), jnp.int32)])
+        outs = pl.pallas_call(
+            kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape, interpret=interpret,
+        )(*args)
+        carry_out = outs[:len(carry_names)]
+        times, srcs = outs[len(carry_names):]
+        m = dict(zip(carry_names, carry_out))
+        # The launch's ONE liveness scalar: any lane both unfinished and
+        # healthy (a frozen sick lane must count as done, or it would
+        # spin the superchunk loop to max_chunks).
+        alive = jnp.any((jnp.min(m["t_next"], axis=0) <= end)
+                        & (m["health"] == 0))
+        return carry_out + (times, srcs, alive)
+
+    return jax.jit(call)
+
+
+def _pad(x, B_pad, fill):
+    B = x.shape[-1]
+    if B == B_pad:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, B_pad - B)]
+    return jnp.pad(x, pad, constant_values=fill)
+
+
+def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
+                    max_chunks: int = 100, interpret: Optional[bool] = None,
+                    sync_every: Optional[int] = None,
+                    plan: Optional[VmemPlan] = None):
+    """Run a batch of components on the megakernel; returns an
+    ``EventLog`` (same contract as ``sim.simulate_batch``, different PRNG
+    streams — see module docstring).  ``params``/``adj`` carry a leading
+    [B] dim; ``seeds`` is an int array [B].
+
+    ``interpret`` defaults to True off-TPU (tests) and False on TPU.
+    ``sync_every`` is the superchunk length k: chunks per LAUNCH, with
+    the liveness round-trip amortized to one replicated scalar per
+    launch (default 1 off-TPU — tests see per-chunk buffers — and 8 on
+    TPU, where each sync is a tunnel RTT that dwarfs an absorbed chunk's
+    compute; results are identical either way, later-trimmed padding
+    aside).  ``EventLog.dispatches`` records the launch count; ``plan``
+    overrides the per-shape VMEM plan (tests)."""
+    from ..sim import EventLog  # local: avoid import cycle
+
+    ok, why = coverage(cfg)
+    if not ok:
+        raise ValueError(
+            f"pallas engine supports only "
+            f"{{poisson, opt, hawkes, realdata, piecewise}} policy mixes "
+            f"— {why}")
+    seeds = jnp.asarray(seeds)
+    if seeds.ndim != 1:
+        raise ValueError(
+            f"pallas engine takes integer seeds [B] (its per-source "
+            f"threefry streams derive from them) — got shape "
+            f"{tuple(seeds.shape)}; key-array seeds are a scan-engine "
+            f"contract (sim.simulate_batch)")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if sync_every is None:
+        sync_every = 1 if interpret else 8
+    B, S = params.kind.shape
+    F = adj.shape[-1]
+    kinds = set(cfg.present_kinds)
+    Kr = params.rd_times.shape[-1] if KIND_REALDATA in kinds else 0
+    Kp = params.pw_times.shape[-1] if KIND_PIECEWISE in kinds else 0
+    if plan is None:
+        # int()/bool() below normalize HOST call options for the plan /
+        # compile-cache key — no traced value is ever concretized here.
+        plan = plan_vmem(cfg, S, F, Kr, Kp, k=int(sync_every))  # rqlint: disable=RQ701 host ints
+    if not plan.fits:
+        raise ValueError(plan.reason)
+    k, cap = plan.k, plan.capacity
+    B_pad = -(-B // _TILE) * _TILE
+
+    state = _init_state(cfg, params, seeds)
+    # The env-configured ``numeric`` fault (RQ_FAULT=numeric:mode@laneN):
+    # the same deterministic poisoning the scan driver applies, so the
+    # detection/quarantine/heal paths run engine-agnostically in CI.
+    hit = _faultinject.active_numeric_lane(B)
+    if hit is not None:
+        state = _numerics.poison_lane(state, hit[0], hit[1])
+
+    # Lane layout: batch last.  Padded lanes: rate 0 / t_next inf =>
+    # absorb from step 0 and never touch the health mask.
+    to_lanes = lambda x, fill=0: _pad(  # noqa: E731
+        jnp.moveaxis(jnp.asarray(x), 0, -1), B_pad, fill)
+    args = {
+        "kind": to_lanes(params.kind),
+        "rate": to_lanes(params.rate.astype(jnp.float32)),
+        "k0": to_lanes(state.k0),
+        "k1": to_lanes(state.k1),
+    }
+    if KIND_OPT in kinds:
+        args["q"] = to_lanes(params.q.astype(jnp.float32), 1.0)
+        args["ssink"] = to_lanes(params.s_sink.astype(jnp.float32))
+        args["adj"] = to_lanes(jnp.asarray(adj).astype(jnp.float32))
+    if KIND_HAWKES in kinds:
+        args["l0"] = to_lanes(params.l0.astype(jnp.float32))
+        args["alpha"] = to_lanes(params.alpha.astype(jnp.float32))
+        args["beta"] = to_lanes(params.beta.astype(jnp.float32), 1.0)
+    if KIND_REALDATA in kinds:
+        args["rd_times"] = to_lanes(
+            params.rd_times.astype(jnp.float32), jnp.inf)
+    if KIND_PIECEWISE in kinds:
+        args["pw_times"] = to_lanes(
+            params.pw_times.astype(jnp.float32), jnp.inf)
+        args["pw_rates"] = to_lanes(params.pw_rates.astype(jnp.float32))
+    carry = {
+        "t_next": to_lanes(state.t_next, jnp.inf),
+        "ctr": to_lanes(state.ctr),
+        "t": _pad(state.t, B_pad, 0.0),
+        "nev": _pad(state.n_events, B_pad, 0),
+        "health": _pad(state.health, B_pad, 0),
+    }
+    if KIND_HAWKES in kinds:
+        carry["exc"] = to_lanes(state.exc)
+        carry["exc_t"] = to_lanes(state.exc_t)
+    if KIND_REALDATA in kinds:
+        carry["rd_ptr"] = to_lanes(state.rd_ptr)
+
+    call = _chunk_call(cfg, S, F, Kr, Kp, k, cap, bool(interpret))  # rqlint: disable=RQ701 host bool
+    spec = _spec_for(cfg, S, F, Kr, Kp, k, cap)
+    in_names, carry_names = _io_names(spec)
+    carry_vals = tuple(carry[nm] for nm in carry_names)
+    param_vals = tuple(args[nm] for nm in in_names)
+
+    # The overflow contract counts chunks of ``cfg.capacity`` events; a
+    # VMEM-shrunk kernel capacity scales the allowance so the permitted
+    # EVENT budget is unchanged.
+    max_kernel_chunks = max_chunks * (-(-cfg.capacity // cap))
+    n_launches = -(-max_kernel_chunks // k)
+    times_chunks, srcs_chunks = [], []
+    dispatches = 0
+    for _ in range(n_launches):
+        *carry_vals, times_sc, srcs_sc, alive = call(
+            *param_vals, *carry_vals)
+        carry_vals = tuple(carry_vals)
+        dispatches += 1
+        times_chunks.append(times_sc[:, :B])
+        srcs_chunks.append(srcs_sc[:, :B])
+        # THE one liveness sync per superchunk launch: a single
+        # replicated scalar, never per chunk, never per event.
+        if not bool(alive):  # rqlint: disable=RQ702 one sync per superchunk
+            break
+    else:
+        raise RuntimeError(
+            f"simulation still active after {max_kernel_chunks} chunks of "
+            f"{cap} events ({dispatches} superchunk launches) — raise "
+            f"capacity or max_chunks (refusing to truncate silently)")
+
+    out = dict(zip(carry_names, carry_vals))
+    # The run's ONE results boundary (mirrors sim._drive's): the [B]
+    # health mask and event counts cross to host once, after the last
+    # launch — never per chunk, never per event.
+    health = jax.device_get(out["health"][:B])  # rqlint: disable=RQ701 results boundary
+    if health.size and np.all(health != 0):
+        raise _numerics.NumericalHealthError(
+            health, context=f"pallas simulation of {health.size} lane(s)")
+    times = jnp.concatenate(times_chunks, axis=0).T   # [B, E]
+    srcs = jnp.concatenate(srcs_chunks, axis=0).T
+    nev = jax.device_get(out["nev"][:B])  # rqlint: disable=RQ701 results boundary
+    return EventLog(times, srcs, nev, cfg,
+                    health=jnp.asarray(health), dispatches=dispatches,
+                    engine="pallas")

@@ -1,0 +1,283 @@
+"""The megakernel's fused event step: rank update -> intensity -> threefry
+sampling -> argmin commit -> health mask, for the FULL covered policy mix
+(Poisson, Opt, Hawkes, RealData replay, piecewise-constant rates), all on
+the lane-last ``[..., 128]`` layout with every value resident in
+VMEM/registers across the whole chunk.
+
+Semantics mirror ``ops/scan_core.step`` exactly where the policies are
+deterministic, and distributionally where they draw randomness (the
+engines share per-source (key, counter) threefry streams but not call
+patterns — PARITY.md "known intentional differences"):
+
+- **argmin commit** — lowest-index tie-break via the iota/priority trick
+  (no argmin primitive in Mosaic), absorbing steps past the horizon.
+- **Poisson** — one Exp(rate) per own fire from the per-source stream.
+- **Opt** — own fire cancels all candidate clocks (t_next -> +inf); the
+  react pass below spawns the superposition clock per affected Opt row,
+  identical to the seed chunk kernel.
+- **Hawkes** — excitation folds to the fire time and jumps by alpha, then
+  the next event comes from EXACT inversion of the exponential-kernel
+  compensator (Newton on the concave increasing hazard — a fixed,
+  branch-free iteration count, unlike the scan engine's Ogata thinning
+  whose rejection loop cannot live on the 128-lane vector unit).  Same
+  law; different sampler; statistical parity gates in
+  tests/test_pallas_engine.py.
+- **RealData** — the replay cursor advances on own fires only; the padded
+  ``[S, Kr]`` trace cube is gathered with one-hot ``where`` sums (never
+  ``0 * inf`` multiplies).  No randomness at all, so a replay-only mix is
+  BIT-IDENTICAL to the scan engine — the one golden the threefry
+  discipline allows, pinned in tests.
+- **Piecewise** — exact cumulative-hazard inversion unrolled over the
+  static ``Kp`` segments (the branch-free twin of
+  ``ops.sampling.piecewise_next_time``).
+- **health mask (PR 3 in-kernel)** — the per-lane uint32 bitmask rides
+  the carry: a NaN/regressed event time, a NaN resample, or a non-finite
+  folded excitation ORs the matching ``runtime.numerics`` BIT_* and
+  freezes the lane (``valid`` is gated on ``health == 0``), so sickness
+  can never cross lanes through the argmin and never emits a NaN event.
+  ``BIT_SAMPLER_FAILURE`` cannot arise here — the closed-form inverters
+  have no rejection loop to exhaust; their failure shape is a NaN, which
+  the TIME/STATE bits catch on the step it appears.
+
+Mosaic lowering discipline (audited against the TPU kernel guide, same
+rules as the seed chunk kernel): Python-float constants, int32 detours
+for bool/f32 -> uint32 casts, ``broadcasted_iota``, static unrolls over
+Opt rows and piecewise segments, ``fori_loop`` for the Newton iteration,
+NaN checks as ``x != x`` / ``(x - x) == 0`` arithmetic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.base import (
+    KIND_HAWKES,
+    KIND_PIECEWISE,
+    KIND_POISSON,
+    KIND_REALDATA,
+)
+from ..runtime import numerics
+from ..runtime.numerics import safe_exp
+from .threefry import exponential_from_bits, threefry2x32
+
+__all__ = ["KernelSpec", "prepare_consts", "make_step",
+           "hawkes_invert", "NEWTON_ITERS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """Static shape/specialization info one compiled megakernel closes
+    over (the hashable core of the engine's compile-cache key)."""
+
+    S: int
+    F: int
+    Kr: int
+    Kp: int
+    tile: int
+    capacity: int
+    k: int
+    end_time: float
+    opt_rows: tuple
+    has_opt: bool
+    has_hawkes: bool
+    has_rd: bool
+    has_pw: bool
+
+
+#: Fixed Newton iteration count for the Hawkes compensator inversion.
+#: The map is concave increasing, so iterates climb monotonically from
+#: below and converge quadratically; 24 rounds reach f32 precision for
+#: every subcritical parameter set the domain validation admits.
+NEWTON_ITERS = 24
+
+
+def hawkes_invert(e, l0, exc, beta, iters: int = NEWTON_ITERS):
+    """Exact inversion of the exponential-kernel Hawkes compensator:
+    solve ``l0*tau + (exc/beta)*(1 - exp(-beta*tau)) = e`` for the
+    inter-event time ``tau`` (Newton, fixed ``iters`` rounds).  When
+    ``l0 == 0`` the total remaining hazard is finite (``exc/beta``) and
+    draws beyond it never fire (+inf) — the closed-form twin of
+    ``ops.sampling.rmtpp_next_delta``'s w<0 branch."""
+    c = exc / jnp.maximum(beta, 1e-30)
+    never = (l0 <= 0) & (e >= c)
+    tau = e / jnp.maximum(l0 + exc, 1e-30)  # tangent-at-0 step: a lower bound
+
+    def newton(_, tau):
+        em = safe_exp(-beta * tau)
+        g = l0 * tau + c * (1.0 - em) - e
+        return tau - g / jnp.maximum(l0 + exc * em, 1e-30)
+
+    tau = lax.fori_loop(0, iters, newton, tau)
+    tau = jnp.maximum(tau, 0.0)  # guard rounding below the t=0 tangent
+    return jnp.where(never, jnp.asarray(np.inf, tau.dtype), tau)
+
+
+def prepare_consts(spec: KernelSpec, vals: dict) -> SimpleNamespace:
+    """Hoist everything loop-invariant out of the per-event step: the
+    source iota, the replay-cursor iota, and each Opt row's
+    ``sqrt(s_f / q_r)`` rate panel."""
+    c = SimpleNamespace(**vals)
+    c.iota_s = lax.broadcasted_iota(jnp.int32, (spec.S, spec.tile), 0)
+    if spec.has_rd:
+        c.iota_kr = lax.broadcasted_iota(jnp.int32, (spec.Kr, spec.tile), 0)
+    if spec.opt_rows:
+        c.opt_rates = {
+            r: jnp.sqrt(c.ssink / jnp.maximum(c.q[r][None, :], 1e-30))
+            for r in spec.opt_rows
+        }
+    return c
+
+
+def _piecewise_invert_panel(e, t_from, knots, rates, Kp: int):
+    """Branch-free hazard inversion for the FIRED source's piecewise
+    profile, unrolled over the static segment count: first segment whose
+    cumulative hazard reaches the Exp(1) target ``e`` wins.  ``knots``
+    [Kp, lanes] carries the +inf padding convention of
+    ``config.GraphBuilder`` (the inf-inf span's NaN is masked by the
+    rate/span guards exactly as in ``ops.sampling.piecewise_next_time``)."""
+    inf = float(np.inf)
+    out = jnp.full(e.shape, inf, e.dtype)
+    found = jnp.zeros(e.shape, bool)
+    cum = jnp.zeros(e.shape, e.dtype)
+    for kseg in range(Kp):
+        t0k = knots[kseg]
+        t1k = knots[kseg + 1] if kseg + 1 < Kp else jnp.full(
+            e.shape, inf, e.dtype)
+        rk = rates[kseg]
+        lo = jnp.maximum(t0k, t_from)
+        span = t1k - lo
+        hz = jnp.where((rk > 0) & (span > 0), rk * span, 0.0)
+        cum_next = cum + hz
+        hit = jnp.logical_not(found) & (cum_next >= e)
+        t_hit = lo + (e - cum) / jnp.maximum(rk, 1e-30)
+        out = jnp.where(hit, t_hit, out)
+        found = found | hit
+        cum = cum_next
+    return out
+
+
+def make_step(spec: KernelSpec, c: SimpleNamespace, times_ref, srcs_ref):
+    """Build the fused per-event step for ``lax.fori_loop`` over one
+    chunk.  ``c`` holds the loaded loop-invariant values
+    (:func:`prepare_consts`); the carry is the 8-slot tuple
+    ``(t_next, ctr, t, nev, health, exc, exc_t, rd_ptr)`` with ``None``
+    for slots the policy mix does not compile."""
+    S, Tl = spec.S, spec.tile
+    end = float(spec.end_time)
+    inf = float(np.inf)
+    f32, i32, u32 = jnp.float32, jnp.int32, jnp.uint32
+    BIT_TIME = u32(numerics.BIT_NONFINITE_TIME)
+    BIT_STATE = u32(numerics.BIT_NONFINITE_STATE)
+    U0 = u32(0)
+
+    def step(i, carry):
+        t_next, ctr, t, nev, health, exc, exc_t, rd_ptr = carry
+
+        # ---- argmin commit (lowest-index tie-break) + lane gating ----
+        tmin = jnp.min(t_next, axis=0)                         # [T]
+        prio = jnp.where(t_next == tmin[None, :], c.iota_s, S)
+        s_star = jnp.min(prio, axis=0)                         # [T]
+        ff = (c.iota_s == s_star[None, :]).astype(f32)         # [S, T]
+        healthy = health == U0
+        nan_t = tmin != tmin
+        regressed = tmin < t
+        valid = ((tmin <= end) & (s_star < S) & healthy
+                 & jnp.logical_not(nan_t) & jnp.logical_not(regressed))
+        bits = jnp.where(healthy & (nan_t | regressed), BIT_TIME, U0)
+
+        # ---- fired source's draw from its (key, ctr) stream ----
+        ffi = ff.astype(i32)
+        ffu = ffi.astype(u32)
+        k0f = jnp.sum(c.k0 * ffu, axis=0)
+        k1f = jnp.sum(c.k1 * ffu, axis=0)
+        ctrf = jnp.sum(ctr * ffu, axis=0)
+        bits0, _ = threefry2x32(k0f, k1f, ctrf, jnp.zeros_like(ctrf))
+        e = exponential_from_bits(bits0)                       # Exp(1) [T]
+        kindf = jnp.sum(c.kind * ffi, axis=0)                  # [T] i32
+
+        # ---- per-kind resample (Opt and unmatched kinds stay +inf) ----
+        t_new = jnp.full((Tl,), inf, f32)
+        ratef = jnp.sum(c.rate * ff, axis=0)
+        t_new = jnp.where(
+            kindf == KIND_POISSON,
+            jnp.where(ratef > 0, tmin + e / jnp.maximum(ratef, 1e-30), inf),
+            t_new)
+        exc_new = None
+        if spec.has_hawkes:
+            l0f = jnp.sum(c.l0 * ff, axis=0)
+            alphaf = jnp.sum(c.alpha * ff, axis=0)
+            betaf = jnp.sum(c.beta * ff, axis=0)
+            # where-gathers: carried state may hold a poisoned inf, and
+            # 0 * inf would smear NaN across the whole lane tile.
+            excf = jnp.sum(jnp.where(ff > 0.5, exc, 0.0), axis=0)
+            exctf = jnp.sum(jnp.where(ff > 0.5, exc_t, 0.0), axis=0)
+            exc_new = excf * safe_exp(-betaf * (tmin - exctf)) + alphaf
+            tau = hawkes_invert(e, l0f, exc_new, betaf)
+            t_new = jnp.where(kindf == KIND_HAWKES, tmin + tau, t_new)
+        if spec.has_rd:
+            ptrf = jnp.sum(rd_ptr * ffi, axis=0)
+            ptr1 = ptrf + 1
+            rdf = jnp.sum(jnp.where(ff[:, None, :] > 0.5, c.rd_times, 0.0),
+                          axis=0)                              # [Kr, T]
+            hit = c.iota_kr == ptr1[None, :]
+            t_rd = jnp.sum(jnp.where(hit, rdf, 0.0), axis=0)
+            t_rd = jnp.where(ptr1 < spec.Kr, t_rd, inf)
+            t_new = jnp.where(kindf == KIND_REALDATA, t_rd, t_new)
+        if spec.has_pw:
+            pwtf = jnp.sum(jnp.where(ff[:, None, :] > 0.5, c.pw_times, 0.0),
+                           axis=0)                             # [Kp, T]
+            pwrf = jnp.sum(c.pw_rates * ff[:, None, :], axis=0)
+            t_pw = _piecewise_invert_panel(e, tmin, pwtf, pwrf, spec.Kp)
+            t_new = jnp.where(kindf == KIND_PIECEWISE, t_pw, t_new)
+
+        # ---- write-back checks: never store a NaN time, flag the lane ----
+        t_nan = t_new != t_new
+        bits = bits | jnp.where(valid & t_nan, BIT_TIME, U0)
+        t_new = jnp.where(t_nan, jnp.full((Tl,), inf, f32), t_new)
+        sel = (ff > 0.5) & valid[None, :]
+        t_next = jnp.where(sel, t_new[None, :], t_next)
+        ctr = ctr + ffu * valid.astype(i32).astype(u32)
+        if spec.has_hawkes:
+            exc_bad = jnp.logical_not((exc_new - exc_new) == 0)  # inf or NaN
+            bits = bits | jnp.where(
+                valid & (kindf == KIND_HAWKES) & exc_bad, BIT_STATE, U0)
+            sel_h = sel & (c.kind == KIND_HAWKES)
+            exc = jnp.where(sel_h, exc_new[None, :], exc)
+            exc_t = jnp.where(sel_h, tmin[None, :], exc_t)
+        if spec.has_rd:
+            rd_ptr = rd_ptr + (ffi * (c.kind == KIND_REALDATA).astype(i32)
+                               * valid.astype(i32))
+
+        # ---- react: each Opt row spawns a superposition clock ----
+        if spec.opt_rows:
+            feeds_hit = jnp.sum(c.adj * ff[:, None, :], axis=0)  # [F, T]
+            for r in spec.opt_rows:
+                aff = c.adj[r] * feeds_hit
+                rs = jnp.sum(aff * c.opt_rates[r], axis=0)       # [T]
+                react = (rs > 0) & (s_star != r) & valid
+                bits_r, _ = threefry2x32(
+                    c.k0[r], c.k1[r], ctr[r], jnp.ones((Tl,), u32))
+                cand = tmin + (exponential_from_bits(bits_r)
+                               / jnp.maximum(rs, 1e-30))
+                t_next = t_next.at[r].set(
+                    jnp.where(react, jnp.minimum(t_next[r], cand),
+                              t_next[r]))
+                ctr = ctr.at[r].set(
+                    ctr[r] + react.astype(i32).astype(u32))
+
+        # ---- emit event, advance clock (absorbing past horizon) ----
+        times_ref[i, :] = jnp.where(valid, tmin, inf)
+        srcs_ref[i, :] = jnp.where(valid, s_star, -1)
+        t = jnp.where(valid, tmin, t)
+        nev = nev + valid.astype(i32)
+        # Ungated: sickness is recorded on the very step it appears; for
+        # healthy lanes bits == 0 so this is a value-identical no-op.
+        health = health | bits
+        return (t_next, ctr, t, nev, health, exc, exc_t, rd_ptr)
+
+    return step

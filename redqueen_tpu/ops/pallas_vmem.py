@@ -1,0 +1,164 @@
+"""Per-shape VMEM planning for the Pallas event megakernel.
+
+Replaces the seed chunk engine's flat ``_VMEM_BUDGET`` guess with an
+itemized per-shape plan: every block the megakernel asks Pallas to keep
+resident — the policy-parameter rows/cubes for exactly the kinds the
+config compiles, the carry in/out rows, and the double-buffered event-log
+stream — is priced from its BlockSpec shape, the pipeline's
+double-buffering is modeled explicitly (factor ``PIPELINE_BUFFERS`` on
+every block, since Mosaic prefetches the next grid step's blocks while
+the current one computes), and :func:`plan_vmem` picks the largest kernel
+chunk capacity that fits the budget.  When even the minimum capacity does
+not fit (the ``[S, F, lane]`` adjacency cube or a corpus-scale replay
+cube dominates), the plan records WHY in ``VmemPlan.reason`` so the
+engine dispatch (``sim.select_engine``) can degrade to the scan engine
+with provenance instead of a Mosaic OOM deep in compilation.
+
+The superchunk length ``k`` costs no VMEM at all — it is a grid
+dimension, and only two log blocks are ever resident regardless of how
+many chunks one launch runs — so ``k`` is a latency knob (host syncs per
+run), never a memory knob.
+
+These numbers are an exact accounting of the blocks the engine declares,
+not a device measurement: VMEM occupancy is unobservable under interpret
+mode, so the staged TPU watcher banks a Mosaic compile confirmation when
+the tunnel next comes alive.  The budget default leaves headroom for
+Mosaic's own scratch below the 16 MiB/core v5e figure.  Boundary
+behavior is pinned by tests: a plan exactly at budget fits, one byte
+over refuses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from ..models.base import KIND_HAWKES, KIND_OPT, KIND_PIECEWISE, KIND_REALDATA
+
+__all__ = [
+    "VmemPlan",
+    "plan_vmem",
+    "vmem_blocks",
+    "vmem_bytes",
+    "DEFAULT_VMEM_BUDGET",
+    "MIN_CAPACITY",
+    "TILE",
+    "PIPELINE_BUFFERS",
+]
+
+#: Lane tile: the batch axis rides the TPU's 128-wide lane dimension.
+TILE = 128
+
+#: v5e VMEM is 16 MiB/core; leave headroom for Mosaic's own scratch.
+DEFAULT_VMEM_BUDGET = 12 * 2**20
+
+#: Smallest kernel chunk capacity the planner will shrink to before
+#: declaring the shape unfittable (chunks below this absorb too much
+#: launch overhead to ever win against the scan engine).
+MIN_CAPACITY = 32
+
+#: Pallas pipelines grid steps: while step g computes, step g+1's blocks
+#: are being fetched and step g-1's outputs drained, so every declared
+#: block costs two VMEM residencies.
+PIPELINE_BUFFERS = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class VmemPlan:
+    """The (capacity, k, tile) choice for one config shape, or the
+    recorded reason it must run on the scan engine instead."""
+
+    fits: bool
+    reason: Optional[str]       # None when fits; the degrade provenance otherwise
+    capacity: int               # kernel chunk capacity (events per chunk)
+    k: int                      # chunks per launch (superchunk grid length)
+    tile: int                   # lane tile (batch lanes per grid step)
+    total_bytes: int            # modeled VMEM at the chosen capacity
+    budget: int
+    blocks: Tuple[Tuple[str, int], ...]  # itemized (name, bytes) accounting
+
+
+def _kind_flags(cfg):
+    kinds = set(cfg.present_kinds)
+    return (KIND_OPT in kinds, KIND_HAWKES in kinds,
+            KIND_REALDATA in kinds, KIND_PIECEWISE in kinds)
+
+
+def vmem_blocks(cfg, S: int, F: int, Kr: int = 0, Kp: int = 0,
+                capacity: Optional[int] = None,
+                tile: int = TILE) -> Tuple[Tuple[str, int], ...]:
+    """Itemized (name, bytes) VMEM accounting of the megakernel's blocks
+    for one config shape — every dtype in the kernel is a 4-byte word and
+    the lane axis is always ``tile`` wide.  Only the blocks the config's
+    policy mix actually compiles are listed (a mix without Opt rows never
+    pays the adjacency cube; one without replay rows never pays the
+    ``[S, Kr, lane]`` trace cube)."""
+    if capacity is None:
+        capacity = cfg.capacity
+    has_opt, has_hawkes, has_rd, has_pw = _kind_flags(cfg)
+    w = 4 * tile
+    blocks = [("params.base", 4 * S * w)]  # kind, rate, k0, k1 rows
+    if has_opt:
+        blocks.append(("params.opt", (S + F + S * F) * w))  # q + ssink + adj
+    if has_hawkes:
+        blocks.append(("params.hawkes", 3 * S * w))         # l0, alpha, beta
+    if has_rd:
+        blocks.append(("params.realdata", S * Kr * w))      # replay cube
+    if has_pw:
+        blocks.append(("params.piecewise", 2 * S * Kp * w))  # knots + rates
+    carry_rows = 2 + (2 if has_hawkes else 0) + (1 if has_rd else 0)
+    carry = (carry_rows * S + 3) * w  # rows + (t, nev, health) vectors
+    blocks.append(("carry.in", carry))
+    blocks.append(("carry.out", carry))
+    blocks.append(("log.stream", 2 * capacity * w))  # (times, srcs) blocks
+    return tuple(blocks)
+
+
+def vmem_bytes(cfg, S: int, F: int, Kr: int = 0, Kp: int = 0,
+               capacity: Optional[int] = None, tile: int = TILE) -> int:
+    """Total modeled VMEM for one config shape at the given chunk
+    capacity, pipeline double-buffering included."""
+    return PIPELINE_BUFFERS * sum(
+        b for _, b in vmem_blocks(cfg, S, F, Kr, Kp, capacity, tile))
+
+
+def plan_vmem(cfg, S: int, F: int, Kr: int = 0, Kp: int = 0, *,
+              k: int = 8, budget: Optional[int] = None,
+              tile: int = TILE) -> VmemPlan:
+    """Pick (capacity, k, tile) for one config shape, or record why the
+    shape degrades to the scan engine.
+
+    Starts from ``cfg.capacity`` and halves the kernel chunk capacity —
+    the event-log stream is the only capacity-dependent block — until the
+    itemized total fits ``budget``; a shape whose capacity-independent
+    blocks alone exceed the budget gets ``fits=False`` with the dominant
+    blocks named in ``reason``."""
+    if budget is None:
+        budget = DEFAULT_VMEM_BUDGET
+    # Static plan math on HOST ints (SimConfig fields / call options) —
+    # nothing here ever touches a traced value.
+    k = int(k)  # rqlint: disable=RQ701 host ints
+    cap = int(cfg.capacity)  # rqlint: disable=RQ701 host ints
+    while True:
+        blocks = vmem_blocks(cfg, S, F, Kr, Kp, cap, tile)
+        total = PIPELINE_BUFFERS * sum(b for _, b in blocks)
+        if total <= budget:
+            return VmemPlan(fits=True, reason=None, capacity=cap, k=k,
+                            tile=tile, total_bytes=total, budget=budget,
+                            blocks=blocks)
+        if cap <= MIN_CAPACITY:
+            top = sorted(blocks, key=lambda nb: -nb[1])[:3]
+            named = ", ".join(f"{n}={b / 2**20:.2f} MiB" for n, b in top)
+            return VmemPlan(
+                fits=False,
+                reason=(
+                    f"pallas megakernel VMEM plan: {total / 2**20:.2f} MiB "
+                    f"at the minimum chunk capacity {MIN_CAPACITY} exceeds "
+                    f"the {budget / 2**20:.2f} MiB budget (S={S}, F={F}, "
+                    f"Kr={Kr}, Kp={Kp}; dominant blocks: {named}) — use "
+                    f"the scan engine (sim.simulate_batch) or the star "
+                    f"engine (parallel.bigf) for this shape"
+                ),
+                capacity=cap, k=k, tile=tile, total_bytes=total,
+                budget=budget, blocks=blocks)
+        cap = max(cap // 2, MIN_CAPACITY)

@@ -39,6 +39,7 @@ __all__ = [
     "hawkes_intensity",
     "hawkes_next_time",
     "piecewise_next_time",
+    "piecewise_next_from_target",
     "rmtpp_next_delta",
     "rmtpp_log_intensity",
     "rmtpp_cum_hazard",
@@ -155,6 +156,46 @@ def hawkes_next_time(key, t_from, l0, alpha, beta, exc, exc_t, t_max,
     return t_ret, ok
 
 
+def piecewise_next_from_target(target, t_from, change_times, rates):
+    """Exact cumulative-hazard inversion for a piecewise-constant rate,
+    from a PRE-DRAWN Exp(1) target — the key-free core of
+    :func:`piecewise_next_time`, shared with the Pallas megakernel's
+    counter-addressed init stream (``ops.pallas_engine``), which draws
+    its exponentials from in-kernel threefry rather than ``jax.random``.
+
+    Batched: ``change_times``/``rates`` are [..., K] with the segment
+    axis LAST; ``target`` matches the leading shape and ``t_from``
+    broadcasts against ``change_times``. Value-identical to the original
+    scalar formulation (the segment lookup is ``searchsorted`` rewritten
+    as a rank count so it vectorizes over arbitrary leading axes)."""
+    dtype = jnp.result_type(t_from, change_times, jnp.float32)
+    target = jnp.asarray(target, dtype)
+    K = rates.shape[-1]
+    seg_end = jnp.concatenate(
+        [change_times[..., 1:],
+         jnp.full_like(change_times[..., :1], jnp.inf)], axis=-1)
+    lo = jnp.maximum(change_times, t_from)  # effective start of each segment
+    span = jnp.maximum(seg_end - lo, 0.0)
+    # rate * span with 0 * inf := 0 (zero-rate final/padding segments).
+    hz = jnp.where(rates > 0, rates * jnp.minimum(span, jnp.inf), 0.0)
+    hz = jnp.where(span > 0, hz, 0.0)
+    cum = jnp.cumsum(hz, axis=-1)
+    # searchsorted 'left' as a rank count: first segment reaching E.
+    k = jnp.sum(cum < target[..., None], axis=-1)
+    k_safe = jnp.minimum(k, K - 1)
+    prev_idx = jnp.maximum(k_safe - 1, 0)
+    prev = jnp.where(
+        k_safe > 0,
+        jnp.take_along_axis(cum, prev_idx[..., None], axis=-1)[..., 0],
+        0.0)
+    remaining = target - prev
+    rate_k = jnp.take_along_axis(rates, k_safe[..., None], axis=-1)[..., 0]
+    lo_k = jnp.take_along_axis(lo, k_safe[..., None], axis=-1)[..., 0]
+    t_hit = lo_k + jnp.where(rate_k > 0, safe_div(remaining, rate_k),
+                             jnp.inf)
+    return jnp.where(k < K, t_hit, jnp.inf).astype(dtype)
+
+
 def piecewise_next_time(key, t_from, change_times, rates):
     """Next event of an inhomogeneous Poisson process with piecewise-constant
     rate, by exact inversion of the cumulative hazard (reference:
@@ -170,23 +211,7 @@ def piecewise_next_time(key, t_from, change_times, rates):
     """
     dtype = jnp.result_type(t_from, change_times, jnp.float32)
     target = jr.exponential(key, dtype=dtype)
-    seg_end = jnp.concatenate(
-        [change_times[1:], jnp.array([jnp.inf], dtype=change_times.dtype)]
-    )
-    lo = jnp.maximum(change_times, t_from)  # effective start of each segment
-    span = jnp.maximum(seg_end - lo, 0.0)
-    # rate * span with 0 * inf := 0 (zero-rate final/padding segments).
-    hz = jnp.where(rates > 0, rates * jnp.minimum(span, jnp.inf), 0.0)
-    hz = jnp.where(span > 0, hz, 0.0)
-    cum = jnp.cumsum(hz)
-    k = jnp.searchsorted(cum, target, side="left")  # first segment reaching E
-    k_safe = jnp.minimum(k, rates.shape[0] - 1)
-    prev = jnp.where(k_safe > 0, cum[jnp.maximum(k_safe - 1, 0)], 0.0)
-    remaining = target - prev
-    rate_k = rates[k_safe]
-    t_hit = lo[k_safe] + jnp.where(rate_k > 0, safe_div(remaining, rate_k),
-                                   jnp.inf)
-    return jnp.where(k < rates.shape[0], t_hit, jnp.inf).astype(dtype)
+    return piecewise_next_from_target(target, t_from, change_times, rates)
 
 
 def rmtpp_log_intensity(a, w, tau):

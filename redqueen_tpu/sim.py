@@ -39,7 +39,7 @@ from . import models as _models  # noqa: F401
 from .models import base
 
 __all__ = ["EventLog", "simulate", "simulate_batch", "resume",
-           "NumericalHealthError"]
+           "select_engine", "NumericalHealthError"]
 
 
 class EventLog:
@@ -58,14 +58,26 @@ class EventLog:
     events up to the freeze are valid, nothing after was emitted, and
     ``times`` is NaN-free by construction.  Decode with
     ``runtime.numerics.describe_health``.
+
+    ``engine`` names the kernel that produced the log (``"scan"`` or
+    ``"pallas"``); ``engine_reason`` records why an ``engine="auto"`` /
+    ``engine="pallas"`` dispatch fell back to the scan engine (None when
+    the requested engine ran).  ``dispatches`` counts the device
+    launches the run cost (scan: superchunk dispatches of ``sim._drive``;
+    pallas: megakernel superchunk launches) — the denominator of the
+    dispatch-amortization story the bench artifacts commit.
     """
 
-    def __init__(self, times, srcs, n_events, cfg: SimConfig, health=None):
+    def __init__(self, times, srcs, n_events, cfg: SimConfig, health=None,
+                 dispatches=None, engine="scan", engine_reason=None):
         self.times = times
         self.srcs = srcs
         self.n_events = n_events
         self.cfg = cfg
         self.health = health
+        self.dispatches = dispatches
+        self.engine = engine
+        self.engine_reason = engine_reason
 
     @property
     def batched(self) -> bool:
@@ -330,10 +342,12 @@ def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
     round-trip."""
     times_chunks, srcs_chunks = [], []
     n_chunks = 0
+    n_dispatches = 0
     n_before = state.n_events  # resume(): count only this drive's events
     cap = cfg.capacity
     k = 1
     while True:
+        n_dispatches += 1
         state, t_sc, s_sc, c, alive = chunk_fn_for(k)(
             # np.int32 of two HOST ints (no transfer; keeps the chunk
             # budget weak-type-stable across dispatches)
@@ -381,7 +395,69 @@ def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
             raise NumericalHealthError(
                 h, context=f"simulation of {h.size} lane(s)")
     return EventLog(times, srcs, state.n_events - n_before, cfg,
-                    health=state.health), state
+                    health=state.health, dispatches=n_dispatches,
+                    engine="scan"), state
+
+
+def select_engine(cfg: SimConfig, params: Optional[SourceParams] = None, *,
+                  engine: str = "auto", max_events=None,
+                  return_state: bool = False, platform: Optional[str] = None):
+    """Resolve the batch-engine choice -> ``(name, reason)``.
+
+    ``engine="scan"`` short-circuits; ``engine="pallas"`` FORCES the
+    megakernel (raising ``ValueError`` with the recorded reason when the
+    config cannot run on it); ``engine="auto"`` prefers the megakernel
+    when (a) the policy mix is covered (``ops.pallas_engine.coverage``),
+    (b) the per-shape VMEM plan fits (``ops.pallas_vmem.plan_vmem``;
+    needs ``params`` for the replay/piecewise cube shapes — skipped when
+    ``params`` is None), (c) no scan-only feature is requested
+    (``max_events`` budgets and ``return_state`` carries are scan
+    contracts), and (d) the backend is a TPU (interpret mode exists for
+    tests, not timing) — otherwise it falls back to the scan engine with
+    the degrade provenance in ``reason`` (surfaced as
+    ``EventLog.engine_reason``)."""
+    if engine not in ("scan", "pallas", "auto"):
+        raise ValueError(
+            f"unknown engine {engine!r} (choose scan, pallas, or auto)")
+    if engine == "scan":
+        return "scan", None
+    from .ops import pallas_engine
+    from .ops.pallas_vmem import plan_vmem
+
+    forced = engine == "pallas"
+
+    def fall(reason):
+        if forced:
+            raise ValueError(f"engine='pallas' requested but {reason}")
+        return "scan", reason
+
+    ok, why = pallas_engine.coverage(cfg)
+    if not ok:
+        return fall(why)
+    if max_events is not None:
+        return fall("the pallas megakernel has no run_dynamic budget "
+                    "support — max_events is a scan-engine contract")
+    if return_state:
+        return fall("the pallas carry is engine-internal (no SimState "
+                    "handoff for resume) — return_state is a scan-engine "
+                    "contract")
+    if params is not None:
+        kinds = set(cfg.present_kinds)
+        Kr = (params.rd_times.shape[-1]
+              if base.KIND_REALDATA in kinds else 0)
+        Kp = (params.pw_times.shape[-1]
+              if base.KIND_PIECEWISE in kinds else 0)
+        plan = plan_vmem(cfg, cfg.n_sources, cfg.n_sinks, Kr, Kp)
+        if not plan.fits:
+            return fall(plan.reason)
+    if not forced:
+        if platform is None:
+            platform = jax.devices()[0].platform
+        if platform != "tpu":
+            return "scan", (
+                "pallas interpret mode is test-only off-TPU — auto picks "
+                "the scan engine (pass engine='pallas' to force it)")
+    return "pallas", None
 
 
 def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
@@ -416,17 +492,47 @@ def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
 
 def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
                    max_chunks: int = 100, return_state: bool = False,
-                   max_events: Optional[int] = None, sync_every: int = 8):
+                   max_events: Optional[int] = None, sync_every: int = 8,
+                   engine: str = "scan"):
     """Run B same-shape components in lockstep (params/adj have a leading
     batch axis; ``seeds`` is an int array [B] or a key array [B, 2]).
 
     This is the reference's embarrassingly-parallel sweep loop (SURVEY.md
     section 3.5) turned into a vmap axis: components finish at different
     event counts and simply absorb until the slowest one is done.
-    ``max_events`` (scalar or [B]) applies the per-lane run_dynamic stop."""
+    ``max_events`` (scalar or [B]) applies the per-lane run_dynamic stop.
+
+    ``engine`` selects the batch kernel: ``"scan"`` (default — the
+    general event-scan engine), ``"pallas"`` (the fused megakernel,
+    forced; integer seeds only), or ``"auto"`` (megakernel when
+    :func:`select_engine` says it covers this dispatch, scan otherwise
+    with the fallback reason recorded on ``EventLog.engine_reason``)."""
     _check_kinds(cfg, params)
     _check_weights(cfg, params)
     _check_finite_params(cfg, params)
+    engine_reason = None
+    if engine != "scan":
+        if jnp.asarray(seeds).ndim != 1:
+            # Key-array seeds ([B, 2]) are a scan-engine contract: the
+            # pallas engine derives its per-source threefry streams from
+            # integer seeds.  Catch here with provenance instead of a
+            # block-shape error deep inside pallas_call.
+            reason = ("the pallas engine takes integer seeds [B] — "
+                      "key-array seeds ([B, 2]) are a scan-engine "
+                      "contract")
+            if engine == "pallas":
+                raise ValueError(f"engine='pallas' requested but {reason}")
+            engine_reason = reason
+        else:
+            name, engine_reason = select_engine(
+                cfg, params, engine=engine, max_events=max_events,
+                return_state=return_state)
+            if name == "pallas":
+                from .ops.pallas_engine import simulate_pallas
+
+                return simulate_pallas(cfg, params, adj, seeds,
+                                       max_chunks=max_chunks,
+                                       sync_every=sync_every)
     seeds = jnp.asarray(seeds)
     keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
     state = _init_fn(cfg, True)(params, adj, keys)
@@ -442,6 +548,7 @@ def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
         cfg, params, adj, state, lambda k: _chunk_fn(cfg, True, k),
         max_chunks, True, sync_every
     )
+    log.engine_reason = engine_reason  # why an auto dispatch fell back
     return (log, state) if return_state else log
 
 

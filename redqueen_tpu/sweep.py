@@ -129,7 +129,7 @@ def _reduce_to_grid(m, n_posts, P: int, n_seeds: int,
 def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
               metric_K: int = 1, seed0: int = 0,
               mesh: Optional[Mesh] = None, axis="data",
-              max_chunks: int = 100) -> SweepResult:
+              max_chunks: int = 100, engine: str = "scan") -> SweepResult:
     """Run every sweep point across ``n_seeds`` Monte-Carlo seeds in one
     batch and return per-lane metric summaries.
 
@@ -145,8 +145,20 @@ def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
     grow a Monte-Carlo run by sweeping a fresh ``seed0`` range instead).
     With ``mesh``, the batch shards over ``axis`` (a name or tuple of
     names, e.g. ``("dcn", "data")``) with bit-identical results.
+
+    ``engine`` forwards to :func:`~redqueen_tpu.sim.simulate_batch`
+    (``"scan"`` / ``"pallas"`` / ``"auto"``): the pallas megakernel's
+    in-kernel lane-health mask flows through the same ``SweepResult``
+    grid, so the checkpointed quarantine/heal machinery is
+    engine-agnostic.  Sharded sweeps (``mesh``) are scan-only — the
+    megakernel owns its own lane layout.
     """
     points, cfg0 = _validate_points(points, n_seeds, "SourceParams")
+    if mesh is not None and engine != "scan":
+        raise ValueError(
+            "sharded sweeps (mesh=...) run on the scan engine only — the "
+            "pallas megakernel owns its lane layout; drop mesh or pass "
+            "engine='scan'")
     P = len(points)
     params, adj = stack_components(
         [p for _, p, _ in points for _ in range(n_seeds)],
@@ -154,7 +166,8 @@ def run_sweep(points: Sequence, n_seeds: int, src_index: int = 0,
     )
     seeds = np.arange(P * n_seeds) + seed0
     if mesh is None:
-        log = simulate_batch(cfg0, params, adj, seeds, max_chunks=max_chunks)
+        log = simulate_batch(cfg0, params, adj, seeds, max_chunks=max_chunks,
+                             engine=engine)
     else:
         log = simulate_sharded(cfg0, params, adj, seeds, mesh, axis=axis,
                                max_chunks=max_chunks)

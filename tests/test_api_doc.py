@@ -12,6 +12,7 @@ DOC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 MODULES = [
     "redqueen_tpu",
     "redqueen_tpu.sim", "redqueen_tpu.sweep", "redqueen_tpu.config",
+    "redqueen_tpu.ops.pallas_engine", "redqueen_tpu.ops.pallas_vmem",
     "redqueen_tpu.parallel.comm", "redqueen_tpu.parallel.multihost",
     "redqueen_tpu.parallel.bigf", "redqueen_tpu.parallel.shard",
     "redqueen_tpu.data.traces", "redqueen_tpu.models.rmtpp",

@@ -76,14 +76,23 @@ class TestPallasEngine:
     def test_supports_gating(self):
         cfg, p0, a0, _ = _component()
         assert supports(cfg)
+        # The megakernel now covers Hawkes mixes (the config the seed
+        # chunk engine refused); only the RMTPP neural policy falls back.
         gb = GraphBuilder(n_sinks=2, end_time=10.0)
         gb.add_opt()
         gb.add_hawkes(l0=1.0, alpha=0.5, beta=1.0)
-        hcfg, hp, ha = gb.build(capacity=64)
-        assert not supports(hcfg)
-        hp_b, ha_b = stack_components([hp], [ha])
+        hcfg, _, _ = gb.build(capacity=64)
+        assert supports(hcfg)
+        from redqueen_tpu.models import rmtpp  # noqa: F401  (registers kind)
+
+        gb = GraphBuilder(n_sinks=2, end_time=10.0)
+        gb.add_opt()
+        gb.add_rmtpp()
+        rcfg, rp, ra = gb.build(capacity=64)
+        assert not supports(rcfg)
+        rp_b, ra_b = stack_components([rp], [ra])
         with pytest.raises(ValueError, match="supports only"):
-            simulate_pallas(hcfg, hp_b, ha_b, np.array([0]))
+            simulate_pallas(rcfg, rp_b, ra_b, np.array([0]))
 
     def test_log_invariants_and_determinism(self):
         cfg, p0, a0, me = _component()

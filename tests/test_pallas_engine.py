@@ -1,0 +1,591 @@
+"""Pallas event megakernel (ops/pallas_engine.py + pallas_step.py +
+pallas_vmem.py) — the interpreter golden + parity suite, all on CPU.
+
+Pinning strategy per the PR's acceptance contract:
+
+- every COVERED policy mix runs on the megakernel and matches the scan
+  engine: BIT-IDENTICAL where the threefry discipline allows (RealData
+  replay draws no randomness at all), PARITY.md 4-sigma statistical
+  gates for the random policies (the engines share per-source streams
+  but not call patterns);
+- a Hawkes-containing config — which the seed per-chunk engine refused
+  outright — simulates and statistically matches scan;
+- the PR 3 lane-health protocol runs IN-KERNEL: ``EventLog.health`` is
+  populated by the pallas path, poisoned lanes freeze without touching
+  siblings, and the existing checkpointed-sweep quarantine/heal
+  machinery heals pallas lanes bit-identically;
+- superchunk launches: k chunks per dispatch, results identical at any
+  cadence (padding aside), ``EventLog.dispatches`` recording the >= k-x
+  amortization;
+- the VMEM plan's exact boundary (at-budget passes, one byte over
+  refuses with the documented message) and the bounded compile cache.
+"""
+
+import os
+
+import jax  # noqa: F401  (platform selection happens in conftest)
+import numpy as np
+import pytest
+
+from redqueen_tpu.config import GraphBuilder, stack_components
+from redqueen_tpu.ops import pallas_engine
+from redqueen_tpu.ops.pallas_engine import (
+    CHUNK_CALL_CACHE,
+    coverage,
+    simulate_pallas,
+    supports,
+)
+from redqueen_tpu.ops.pallas_step import hawkes_invert
+from redqueen_tpu.ops.pallas_vmem import (
+    DEFAULT_VMEM_BUDGET,
+    MIN_CAPACITY,
+    plan_vmem,
+    vmem_bytes,
+)
+from redqueen_tpu.runtime import faultinject, numerics
+from redqueen_tpu.sim import (
+    NumericalHealthError,
+    select_engine,
+    simulate_batch,
+)
+from redqueen_tpu.sweep import run_sweep, run_sweep_checkpointed
+
+
+def _valid_events(log):
+    """Per-lane (times, srcs) of the VALID entries — cadence/padding
+    independent, the unit every cross-engine comparison uses."""
+    t, s = np.asarray(log.times), np.asarray(log.srcs)
+    return [(t[lane][s[lane] >= 0], s[lane][s[lane] >= 0])
+            for lane in range(t.shape[0])]
+
+
+def _assert_log_invariants(log, end_time):
+    for tv, sv in _valid_events(log):
+        assert np.isfinite(tv).all()
+        assert np.all(np.diff(tv) >= 0), "event times must be non-decreasing"
+        if len(tv):
+            assert tv.max() <= end_time
+        assert (sv >= 0).all() and (sv < log.cfg.n_sources).all()
+    assert not np.isnan(np.asarray(log.times)).any()
+
+
+def _count_parity(log_a, log_b, label):
+    """4-sigma event-count parity across lanes (PARITY.md gate)."""
+    na = np.asarray(log_a.n_events, np.float64)
+    nb = np.asarray(log_b.n_events, np.float64)
+    se = np.sqrt(na.var() / len(na) + nb.var() / len(nb))
+    assert abs(na.mean() - nb.mean()) < 4 * max(se, 1e-9), (
+        label, na.mean(), nb.mean(), 4 * se)
+
+
+# ---------------------------------------------------------------------------
+# Coverage gating
+# ---------------------------------------------------------------------------
+
+class TestCoverage:
+    def test_all_covered_mixes(self):
+        gb = GraphBuilder(n_sinks=4, end_time=10.0)
+        gb.add_opt(q=1.0)
+        gb.add_poisson(rate=1.0, sinks=[0])
+        gb.add_hawkes(l0=0.5, alpha=0.2, beta=1.0, sinks=[1])
+        gb.add_piecewise([0.0, 5.0], [1.0, 0.5], sinks=[2])
+        gb.add_realdata([1.0, 2.0], sinks=[3])
+        cfg, *_ = gb.build(capacity=64)
+        ok, why = coverage(cfg)
+        assert ok and why is None
+        assert supports(cfg)
+
+    def test_rmtpp_excluded_with_reason(self):
+        from redqueen_tpu.models import rmtpp  # noqa: F401
+
+        gb = GraphBuilder(n_sinks=2, end_time=10.0)
+        gb.add_opt()
+        gb.add_rmtpp()
+        cfg, *_ = gb.build(capacity=64)
+        ok, why = coverage(cfg)
+        assert not ok
+        assert "rmtpp" in why and "scan engine" in why
+
+    def test_handbuilt_config_excluded(self):
+        from redqueen_tpu.config import SimConfig
+
+        cfg = SimConfig(n_sources=2, n_sinks=2, end_time=1.0)
+        ok, why = coverage(cfg)
+        assert not ok and "present_kinds" in why
+
+
+# ---------------------------------------------------------------------------
+# Hawkes: the mix the seed engine refused
+# ---------------------------------------------------------------------------
+
+class TestHawkesMix:
+    def test_hawkes_walls_parity_with_scan(self):
+        gb = GraphBuilder(n_sinks=3, end_time=20.0)
+        gb.add_opt(q=1.0)
+        for i in range(3):
+            gb.add_hawkes(l0=0.8, alpha=0.4, beta=1.0, sinks=[i])
+        cfg, p0, a0 = gb.build(capacity=512)
+        B = 32
+        params, adj = stack_components([p0] * B, [a0] * B)
+        lp = simulate_pallas(cfg, params, adj, np.arange(B))
+        _assert_log_invariants(lp, 20.0)
+        assert np.asarray(lp.health).max() == 0
+        lx = simulate_batch(cfg, params, adj, np.arange(B) + 500)
+        _count_parity(lp, lx, "hawkes+opt events")
+        # deterministic replay: same seeds, bit-identical log
+        lp2 = simulate_pallas(cfg, params, adj, np.arange(B))
+        np.testing.assert_array_equal(np.asarray(lp.times),
+                                      np.asarray(lp2.times))
+
+    def test_hawkes_stationary_count_anchor(self):
+        # Subcritical closed form: the stationary rate is l0/(1 - a/b);
+        # over a long horizon the mean count approaches T * that rate
+        # (from below — the process warms up from an empty history).
+        l0, a, b, T = 1.0, 0.5, 2.0, 200.0
+        gb = GraphBuilder(n_sinks=1, end_time=T)
+        gb.add_hawkes(l0=l0, alpha=a, beta=b, sinks=[0])
+        cfg, p0, a0 = gb.build(capacity=512)
+        B = 32
+        params, adj = stack_components([p0] * B, [a0] * B)
+        log = simulate_pallas(cfg, params, adj, np.arange(B))
+        n = np.asarray(log.n_events, np.float64)
+        stationary = T * l0 / (1 - a / b)
+        se = n.std() / np.sqrt(B)
+        assert n.mean() < stationary + 4 * se
+        # warm-up deficit is O(1/(b - a)) events — tiny against T=200
+        assert n.mean() > 0.95 * stationary - 4 * se
+
+    def test_hawkes_invert_matches_brentq(self):
+        # The in-kernel Newton inversion solves the compensator to f32
+        # precision across the parameter box the validation admits.
+        rng = np.random.RandomState(0)
+        for _ in range(200):
+            l0 = float(rng.uniform(0.0, 3.0))
+            beta = float(rng.uniform(0.1, 4.0))
+            exc = float(rng.uniform(0.0, 5.0))
+            e = float(rng.exponential())
+            c = exc / beta
+            tau = float(hawkes_invert(np.float32(e), np.float32(l0),
+                                      np.float32(exc), np.float32(beta)))
+            if l0 <= 0 and e >= c:
+                assert np.isinf(tau)
+                continue
+            got = l0 * tau + c * (1 - np.exp(-beta * tau))
+            assert abs(got - e) < 1e-4 * max(1.0, e), (l0, beta, exc, e, tau)
+
+
+# ---------------------------------------------------------------------------
+# RealData replay: no randomness => bit-identical golden vs scan
+# ---------------------------------------------------------------------------
+
+class TestRealDataGolden:
+    def test_replay_bit_identical_to_scan(self):
+        gb = GraphBuilder(n_sinks=2, end_time=10.0)
+        gb.add_realdata([0.5, 1.25, 2.0, 3.75, 9.5, 11.0], sinks=[0])
+        gb.add_realdata([0.1, 4.2, 8.8], sinks=[1])
+        cfg, p0, a0 = gb.build(capacity=16)
+        B = 3
+        params, adj = stack_components([p0] * B, [a0] * B)
+        lp = simulate_pallas(cfg, params, adj, np.arange(B))
+        lx = simulate_batch(cfg, params, adj, np.arange(B))
+        for (tp, sp), (tx, sx) in zip(_valid_events(lp), _valid_events(lx)):
+            np.testing.assert_array_equal(tp, tx)
+            np.testing.assert_array_equal(sp, sx)
+        np.testing.assert_array_equal(np.asarray(lp.n_events),
+                                      np.asarray(lx.n_events))
+
+    def test_replay_start_time_cursor(self):
+        # start_time > 0: the cursor must seek past earlier timestamps,
+        # exactly like the scan engine's searchsorted init.
+        gb = GraphBuilder(n_sinks=1, end_time=10.0, start_time=2.0)
+        gb.add_realdata([0.5, 1.0, 3.0, 4.5, 12.0], sinks=[0])
+        cfg, p0, a0 = gb.build(capacity=8)
+        params, adj = stack_components([p0], [a0])
+        lp = simulate_pallas(cfg, params, adj, np.array([0]))
+        lx = simulate_batch(cfg, params, adj, np.array([0]))
+        (tp, _), (tx, _) = _valid_events(lp)[0], _valid_events(lx)[0]
+        np.testing.assert_array_equal(tp, tx)
+        np.testing.assert_array_equal(tp, np.float32([3.0, 4.5]))
+
+
+# ---------------------------------------------------------------------------
+# Piecewise-constant rates
+# ---------------------------------------------------------------------------
+
+class TestPiecewiseMix:
+    def test_piecewise_parity_with_scan(self):
+        gb = GraphBuilder(n_sinks=3, end_time=20.0)
+        gb.add_opt(q=1.0)
+        gb.add_piecewise([0.0, 5.0, 10.0], [2.0, 0.2, 1.0], sinks=[0])
+        gb.add_piecewise([2.0, 8.0], [1.5, 0.5], sinks=[1])
+        gb.add_poisson(rate=1.0, sinks=[2])
+        cfg, p0, a0 = gb.build(capacity=512)
+        B = 32
+        params, adj = stack_components([p0] * B, [a0] * B)
+        lp = simulate_pallas(cfg, params, adj, np.arange(B))
+        _assert_log_invariants(lp, 20.0)
+        lx = simulate_batch(cfg, params, adj, np.arange(B) + 500)
+        _count_parity(lp, lx, "piecewise events")
+
+    def test_segment_counts_match_profile(self):
+        # Expected counts per segment are rate * length; a wrong hazard
+        # inversion shifts mass between segments even when totals agree.
+        gb = GraphBuilder(n_sinks=1, end_time=30.0)
+        gb.add_piecewise([0.0, 10.0, 20.0], [2.0, 0.0, 1.0], sinks=[0])
+        cfg, p0, a0 = gb.build(capacity=256)
+        B = 48
+        params, adj = stack_components([p0] * B, [a0] * B)
+        log = simulate_pallas(cfg, params, adj, np.arange(B))
+        t = np.asarray(log.times)
+        t = t[np.isfinite(t)]
+        seg1 = ((t >= 0) & (t < 10)).sum() / B
+        seg2 = ((t >= 10) & (t < 20)).sum() / B
+        seg3 = ((t >= 20) & (t < 30)).sum() / B
+        assert abs(seg1 - 20.0) < 4 * np.sqrt(20.0 / B)
+        assert seg2 == 0.0
+        assert abs(seg3 - 10.0) < 4 * np.sqrt(10.0 / B)
+
+
+# ---------------------------------------------------------------------------
+# The full covered mix in one component
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestFullMix:
+    def test_full_mix_parity_and_invariants(self):
+        gb = GraphBuilder(n_sinks=5, end_time=15.0)
+        gb.add_opt(q=1.0)
+        gb.add_poisson(rate=1.0, sinks=[0])
+        gb.add_hawkes(l0=0.5, alpha=0.3, beta=1.0, sinks=[1])
+        gb.add_piecewise([0.0, 7.0], [1.0, 0.3], sinks=[2])
+        gb.add_realdata([1.0, 2.5, 6.0, 14.0], sinks=[3])
+        gb.add_poisson(rate=0.7, sinks=[4])
+        cfg, p0, a0 = gb.build(capacity=512)
+        B = 48
+        params, adj = stack_components([p0] * B, [a0] * B)
+        lp = simulate_pallas(cfg, params, adj, np.arange(B))
+        _assert_log_invariants(lp, 15.0)
+        assert np.asarray(lp.health).max() == 0
+        lx = simulate_batch(cfg, params, adj, np.arange(B) + 500)
+        _count_parity(lp, lx, "full-mix events")
+        # The replay rows are deterministic even inside a random mix:
+        # every lane must emit exactly the in-horizon replay timestamps.
+        rd_row = 4
+        for tv, sv in _valid_events(lp):
+            np.testing.assert_array_equal(
+                tv[sv == rd_row], np.float32([1.0, 2.5, 6.0, 14.0]))
+
+
+# ---------------------------------------------------------------------------
+# PR 3 health semantics, in-kernel
+# ---------------------------------------------------------------------------
+
+class TestHealthInKernel:
+    def _mix(self, capacity=256):
+        gb = GraphBuilder(n_sinks=2, end_time=30.0)
+        gb.add_opt(q=1.0)
+        gb.add_poisson(rate=1.0, sinks=[0])
+        gb.add_hawkes(l0=0.5, alpha=0.3, beta=1.0, sinks=[1])
+        return gb.build(capacity=capacity)
+
+    def test_healthy_run_reports_all_clear(self):
+        cfg, p, a = self._mix()
+        pb, ab = stack_components([p] * 2, [a] * 2)
+        log = simulate_batch(cfg, pb, ab, np.arange(2), engine="pallas")
+        assert log.engine == "pallas"
+        assert np.asarray(log.health).shape == (2,)
+        assert not np.asarray(log.health).any()
+
+    def test_injected_nan_freezes_lane_and_spares_siblings(self, monkeypatch):
+        cfg, p, a = self._mix()
+        pb, ab = stack_components([p] * 4, [a] * 4)
+        ref = simulate_batch(cfg, pb, ab, np.arange(4), engine="pallas")
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane2")
+        inj = simulate_batch(cfg, pb, ab, np.arange(4), engine="pallas")
+        health = np.asarray(inj.health)
+        assert health[2] == numerics.BIT_NONFINITE_TIME
+        assert (health[[0, 1, 3]] == 0).all()
+        assert int(np.asarray(inj.n_events)[2]) == 0
+        assert not np.isnan(np.asarray(inj.times)).any()
+        w = min(np.asarray(ref.times).shape[1], np.asarray(inj.times).shape[1])
+        for lane in (0, 1, 3):
+            np.testing.assert_array_equal(
+                np.asarray(ref.times)[lane, :w],
+                np.asarray(inj.times)[lane, :w])
+
+    def test_injected_inf_excitation_detected_on_fire(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:inf@lane1")
+        gb = GraphBuilder(n_sinks=2, end_time=30.0)
+        gb.add_hawkes(l0=0.8, alpha=0.3, beta=1.0, sinks=[0])
+        gb.add_poisson(rate=1.0, sinks=[1])
+        cfg, p, a = gb.build(capacity=256)
+        pb, ab = stack_components([p] * 3, [a] * 3)
+        inj = simulate_batch(cfg, pb, ab, np.arange(3), engine="pallas")
+        health = np.asarray(inj.health)
+        assert health[1] & numerics.BIT_NONFINITE_STATE
+        assert (health[[0, 2]] == 0).all()
+        assert not np.isnan(np.asarray(inj.times)).any()
+
+    def test_all_lanes_dead_raises_typed_error(self, monkeypatch):
+        cfg, p, a = self._mix()
+        pb, ab = stack_components([p], [a])
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane0")
+        with pytest.raises(NumericalHealthError) as ei:
+            simulate_batch(cfg, pb, ab, np.arange(1), engine="pallas")
+        assert ei.value.reasons == {0: ["non-finite event time"]}
+
+    def test_sick_lane_does_not_spin_superchunk_loop(self, monkeypatch):
+        cfg, p, a = self._mix(capacity=32)
+        pb, ab = stack_components([p] * 2, [a] * 2)
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane0")
+        log = simulate_batch(cfg, pb, ab, np.arange(2), max_chunks=50,
+                             engine="pallas")
+        assert np.asarray(log.health)[0] != 0
+
+    def test_checkpointed_sweep_quarantines_and_heals(self, monkeypatch,
+                                                      tmp_path):
+        """EventLog.health flows from the pallas path through the EXISTING
+        quarantine machinery: the injected lane is recorded in the chunk
+        artifact, and the resume (fault cleared) re-runs exactly that
+        lane, healing the grid bit-identically to an uninjected sweep."""
+        def pt(q):
+            gb = GraphBuilder(n_sinks=2, end_time=20.0)
+            gb.add_opt(q=q)
+            gb.add_poisson(rate=1.0, sinks=[0])
+            gb.add_hawkes(l0=0.5, alpha=0.3, beta=1.0, sinks=[1])
+            return gb.build(capacity=128)
+
+        points = [pt(0.5), pt(2.0)]
+        d = str(tmp_path / "ckpt")
+        monkeypatch.setenv(faultinject.ENV_FAULT, "numeric:nan@lane1,chunk0")
+        got1 = run_sweep_checkpointed(points, n_seeds=2, ckpt_dir=d,
+                                      engine="pallas")
+        assert got1.health.reshape(-1)[1] != 0
+        monkeypatch.delenv(faultinject.ENV_FAULT)
+        got2 = run_sweep_checkpointed(points, n_seeds=2, ckpt_dir=d,
+                                      engine="pallas")
+        assert not got2.health.any()
+        want = run_sweep(points, n_seeds=2, engine="pallas")
+        for f in ("time_in_top_k", "average_rank", "n_posts", "int_rank2"):
+            np.testing.assert_array_equal(getattr(got2, f), getattr(want, f))
+
+
+# ---------------------------------------------------------------------------
+# Superchunk launches: cadence equivalence + dispatch amortization
+# ---------------------------------------------------------------------------
+
+class TestSuperchunk:
+    def _multi_chunk(self):
+        gb = GraphBuilder(n_sinks=4, end_time=30.0)
+        gb.add_opt(q=1.0)
+        for i in range(4):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        return gb.build(capacity=64)
+
+    def test_sync_cadence_preserves_events(self):
+        """sync_every is the superchunk length k — it changes only HOW
+        MANY chunks one launch runs; the valid event stream and counts
+        must be identical at cadence 1 vs 8 (absorbed-chunk +inf/-1
+        padding aside)."""
+        cfg, p0, a0 = self._multi_chunk()
+        B = 3
+        params, adj = stack_components([p0] * B, [a0] * B)
+        a = simulate_pallas(cfg, params, adj, np.arange(B), sync_every=1)
+        b = simulate_pallas(cfg, params, adj, np.arange(B), sync_every=8)
+        np.testing.assert_array_equal(np.asarray(a.n_events),
+                                      np.asarray(b.n_events))
+        for (ta, sa), (tb, sb) in zip(_valid_events(a), _valid_events(b)):
+            np.testing.assert_array_equal(ta, tb)
+            np.testing.assert_array_equal(sa, sb)
+
+    def test_dispatch_count_amortized_k_fold(self):
+        cfg, p0, a0 = self._multi_chunk()
+        B = 3
+        params, adj = stack_components([p0] * B, [a0] * B)
+        per_chunk = simulate_pallas(cfg, params, adj, np.arange(B),
+                                    sync_every=1)
+        sc = simulate_pallas(cfg, params, adj, np.arange(B), sync_every=4)
+        assert per_chunk.dispatches >= 3  # the shape really is multi-chunk
+        assert sc.dispatches <= -(-per_chunk.dispatches // 4)
+        # The scan engine records its superchunk dispatches too (the
+        # bench artifact's shared `dispatches` field).
+        lx = simulate_batch(cfg, params, adj, np.arange(B))
+        assert lx.dispatches >= 1
+
+
+# ---------------------------------------------------------------------------
+# VMEM plan: exact boundary + degrade provenance
+# ---------------------------------------------------------------------------
+
+class TestVmemPlan:
+    def _cfg(self, capacity=64):
+        gb = GraphBuilder(n_sinks=4, end_time=10.0)
+        gb.add_opt(q=1.0)
+        for i in range(4):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        return gb.build(capacity=capacity)[0]
+
+    def test_exact_budget_boundary(self):
+        """Exactly-at-budget passes; one byte over refuses with the
+        documented message."""
+        cfg = self._cfg(capacity=MIN_CAPACITY)
+        need = vmem_bytes(cfg, 5, 4, capacity=MIN_CAPACITY)
+        at = plan_vmem(cfg, 5, 4, budget=need)
+        assert at.fits and at.capacity == MIN_CAPACITY
+        assert at.total_bytes == need
+        over = plan_vmem(cfg, 5, 4, budget=need - 1)
+        assert not over.fits
+        assert "VMEM plan" in over.reason
+        assert "scan engine" in over.reason
+        assert "dominant blocks" in over.reason
+
+    def test_capacity_shrinks_to_fit(self):
+        """When the log stream is the binding block, the plan halves the
+        kernel chunk capacity instead of refusing."""
+        cfg = self._cfg(capacity=2048)
+        full = vmem_bytes(cfg, 5, 4, capacity=2048)
+        plan = plan_vmem(cfg, 5, 4, budget=full - 1)
+        assert plan.fits and plan.capacity < 2048
+        assert plan.total_bytes <= full - 1
+
+    def test_headline_shape_fits_at_full_capacity(self):
+        gb = GraphBuilder(n_sinks=10, end_time=1.0)
+        gb.add_opt(q=1.0)
+        for i in range(10):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        cfg, *_ = gb.build(capacity=2048)
+        plan = plan_vmem(cfg, 11, 10)
+        assert plan.fits and plan.capacity == 2048
+        assert plan.total_bytes < DEFAULT_VMEM_BUDGET
+
+    def test_engine_refuses_unfittable_shape_host_side(self):
+        F = 1000
+        gb = GraphBuilder(n_sinks=F, end_time=1.0)
+        gb.add_opt(q=1.0)
+        for _ in range(29):
+            gb.add_poisson(rate=0.1)
+        cfg, p0, a0 = gb.build(capacity=64)
+        params, adj = stack_components([p0], [a0])
+        with pytest.raises(ValueError, match="VMEM"):
+            simulate_pallas(cfg, params, adj, np.array([0]))
+
+    def test_policy_blocks_only_when_present(self):
+        """A mix without Opt rows never pays the adjacency cube; one
+        without replay never pays the trace cube."""
+        gb = GraphBuilder(n_sinks=1000, end_time=1.0)
+        gb.add_hawkes(l0=1.0, alpha=0.1, beta=1.0, sinks=[0])
+        cfg, *_ = gb.build(capacity=64)
+        names = [n for n, _ in plan_vmem(cfg, 1, 1000).blocks]
+        assert "params.opt" not in names
+        assert "params.realdata" not in names
+        assert "params.hawkes" in names
+
+
+# ---------------------------------------------------------------------------
+# Bounded compile cache (seed bug: lru_cache(maxsize=None) leaked forever)
+# ---------------------------------------------------------------------------
+
+class TestChunkCallCache:
+    def test_cache_is_bounded_and_evicts(self):
+        from redqueen_tpu.ops.pallas_engine import _chunk_call
+
+        info0 = _chunk_call.cache_info()
+        assert info0.maxsize == CHUNK_CALL_CACHE, \
+            "the compiled-callable cache must be bounded"
+        # Cycle through more distinct shapes than the bound: the cache
+        # must stay <= maxsize (building the callable is lazy — nothing
+        # compiles until it is called, so this probes eviction cheaply).
+        cfgs = []
+        for i in range(CHUNK_CALL_CACHE + 8):
+            gb = GraphBuilder(n_sinks=2, end_time=float(10 + i))
+            gb.add_opt(q=1.0)
+            gb.add_poisson(rate=1.0, sinks=[0])
+            cfgs.append(gb.build(capacity=64)[0])
+        for cfg in cfgs:
+            _chunk_call(cfg, 2, 2, 0, 0, 1, 64, True)
+        info = _chunk_call.cache_info()
+        assert info.currsize <= CHUNK_CALL_CACHE
+        # The earliest entry was evicted: re-requesting it misses.
+        misses_before = _chunk_call.cache_info().misses
+        _chunk_call(cfgs[0], 2, 2, 0, 0, 1, 64, True)
+        assert _chunk_call.cache_info().misses == misses_before + 1
+
+
+# ---------------------------------------------------------------------------
+# Engine dispatch (sim.select_engine / simulate_batch(engine=...))
+# ---------------------------------------------------------------------------
+
+class TestEngineDispatch:
+    def _mix(self):
+        gb = GraphBuilder(n_sinks=2, end_time=10.0)
+        gb.add_opt(q=1.0)
+        gb.add_poisson(rate=1.0, sinks=[0])
+        gb.add_hawkes(l0=0.5, alpha=0.2, beta=1.0, sinks=[1])
+        return gb.build(capacity=64)
+
+    def test_forced_pallas_matches_direct_call(self):
+        cfg, p, a = self._mix()
+        pb, ab = stack_components([p] * 2, [a] * 2)
+        via_sim = simulate_batch(cfg, pb, ab, np.arange(2), engine="pallas")
+        direct = simulate_pallas(cfg, pb, ab, np.arange(2), sync_every=8)
+        for (ta, sa), (tb, sb) in zip(_valid_events(via_sim),
+                                      _valid_events(direct)):
+            np.testing.assert_array_equal(ta, tb)
+            np.testing.assert_array_equal(sa, sb)
+        assert via_sim.engine == "pallas"
+        assert via_sim.engine_reason is None
+
+    def test_auto_falls_back_off_tpu_with_reason(self):
+        cfg, p, a = self._mix()
+        pb, ab = stack_components([p] * 2, [a] * 2)
+        log = simulate_batch(cfg, pb, ab, np.arange(2), engine="auto")
+        assert log.engine == "scan"
+        assert "interpret mode" in log.engine_reason
+
+    def test_auto_prefers_pallas_on_tpu_platform(self):
+        cfg, _, _ = self._mix()
+        name, reason = select_engine(cfg, engine="auto", platform="tpu")
+        assert name == "pallas" and reason is None
+
+    def test_scan_only_contracts_rejected_or_degraded(self):
+        cfg, p, a = self._mix()
+        with pytest.raises(ValueError, match="max_events"):
+            select_engine(cfg, engine="pallas", max_events=10)
+        name, reason = select_engine(cfg, engine="auto", max_events=10,
+                                     platform="tpu")
+        assert name == "scan" and "max_events" in reason
+        name, reason = select_engine(cfg, engine="auto", return_state=True,
+                                     platform="tpu")
+        assert name == "scan" and "return_state" in reason
+
+    def test_key_array_seeds_rejected_or_degraded(self):
+        """Key-array seeds ([B, 2]) are a scan-engine contract: forcing
+        pallas raises with provenance, auto degrades to scan with the
+        reason recorded — never a block-shape crash inside pallas_call."""
+        from jax import random as jr
+
+        cfg, p, a = self._mix()
+        pb, ab = stack_components([p] * 2, [a] * 2)
+        keys = jax.vmap(jr.PRNGKey)(np.arange(2))
+        with pytest.raises(ValueError, match="integer seeds"):
+            simulate_batch(cfg, pb, ab, keys, engine="pallas")
+        with pytest.raises(ValueError, match="integer seeds"):
+            simulate_pallas(cfg, pb, ab, keys)
+        log = simulate_batch(cfg, pb, ab, keys, engine="auto")
+        assert log.engine == "scan" and "integer seeds" in log.engine_reason
+
+    def test_unknown_engine_rejected(self):
+        cfg, p, a = self._mix()
+        pb, ab = stack_components([p], [a])
+        with pytest.raises(ValueError, match="unknown engine"):
+            simulate_batch(cfg, pb, ab, np.arange(1), engine="warp")
+
+    def test_vmem_degrade_reason_recorded(self):
+        F = 1000
+        gb = GraphBuilder(n_sinks=F, end_time=1.0)
+        gb.add_opt(q=1.0)
+        for _ in range(29):
+            gb.add_poisson(rate=0.1)
+        cfg, p0, a0 = gb.build(capacity=64)
+        name, reason = select_engine(cfg, p0, engine="auto", platform="tpu")
+        assert name == "scan" and "VMEM plan" in reason

@@ -79,6 +79,21 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_learn.py \
     tests/test_learn_properties.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== pallas megakernel interpreter golden + parity suite =="
+# The fused-engine acceptance gates, UNFILTERED (tier-1 below re-runs
+# the fast subset under -m 'not slow'; the @slow full-mix parity shapes
+# gate every CI run right here): per-policy-mix parity against
+# scan_core (bit-identical for replay-only mixes — the one golden the
+# threefry discipline allows — 4-sigma PARITY.md gates for the random
+# policies, including the Hawkes-containing config the seed pallas
+# engine refused), in-kernel lane-health + checkpointed-sweep
+# quarantine/heal through the pallas path, superchunk cadence
+# equivalence + dispatch amortization, the VMEM plan's exact budget
+# boundary, and the bounded compile cache.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_engine.py \
+    tests/test_pallas_chunk.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log
 # || rc=$? keeps `set -e` from aborting before the pass-count summary:

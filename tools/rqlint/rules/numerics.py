@@ -17,6 +17,17 @@ raw set: its remaining ops/ call sites consume panel/threefry uniforms
 that are < 1 by construction, while the sampler sites with
 model-dependent domains route through ``safe_log1p`` voluntarily.
 
+Scope note (Pallas megakernel modules): the ``ops/*.py`` glob covers
+the fused-kernel stack — ``pallas_step.py`` (the in-kernel per-event
+pipeline), ``pallas_engine.py`` (superchunk driver), ``pallas_vmem.py``
+(the VMEM planner) — with the SAME rules as the scan samplers, and the
+guarded primitives hold inside ``pallas_call`` bodies too: ``safe_exp``
+et al. are pure jnp ops, so the identical guard code lowers under
+Mosaic and the interpreter (kernel divisions use the inline
+``maximum(...)``-clamp form, which this rule recognizes as statically
+safe).  The kernels' NaN probes (``x != x``, ``(x - x) == 0``) are
+arithmetic, not exp/log/division, and need no exemption.
+
 Migrated verbatim from the third pass of the pre-rqlint
 ``tools/check_resilience.py`` — the shim reuses :func:`numeric_sites`.
 """

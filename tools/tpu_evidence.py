@@ -9,6 +9,11 @@ goes so a mid-sequence wedge keeps everything captured so far:
   2. FULL headline bench on TPU       -> BENCH_tpu_full_<tag>.json
   6. QUICK-shape Pallas on the chip   -> BENCH_tpu_pallas_quick_<tag>.json
      (cheap Mosaic compile: banks "Pallas ran on real Mosaic" fast)
+  9. full-shape Pallas MEGAKERNEL     -> BENCH_tpu_megakernel_<tag>.json
+     (THE round-6 capture: the superchunk engine on the headline shape;
+     target >= 5x over the 15.1M ev/s r05 CPU scan record = 75.65M
+     ev/s on-chip, with the `dispatches` field proving the launch
+     amortization — tools/tpu_watcher.py runs this stage FIRST)
   7. profiled quick-shape scan        -> BENCH_tpu_profile_<tag>.json
      (+ a jax.profiler trace in benchmarks/profiles/<tag>/)
   3. full-shape Pallas engine         -> BENCH_tpu_pallas_<tag>.json
@@ -20,7 +25,7 @@ goes so a mid-sequence wedge keeps everything captured so far:
 Pallas evidence runs BEFORE the expensive full-shape/sweep stages, since
 alive windows have been ~10 minutes and first compiles dominate.)
 
-``<tag>`` is the round tag (``--tag``, default r04): bump it each round
+``<tag>`` is the round tag (``--tag``, default r06): bump it each round
 so a new round's capture never overwrites banked evidence. Stages that
 fail/time out are recorded as such and the sequence continues.
 
@@ -44,7 +49,7 @@ if REPO not in sys.path:  # redqueen_tpu.runtime when loaded by path
 
 # The one authoritative stage-number set; tools/tpu_watcher.py imports it
 # for its own --stages validation so the two lists cannot drift.
-STAGE_CHOICES = (1, 2, 3, 4, 5, 6, 7, 8)
+STAGE_CHOICES = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 
 def run_stage(name, cmd, out_json, deadline_s, log_path):
@@ -82,10 +87,10 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", type=int, action="append", default=None,
                     choices=list(STAGE_CHOICES),
-                    help="run only the given stage(s) (1-8; repeatable, "
+                    help="run only the given stage(s) (1-9; repeatable, "
                          "in the listed order)")
     ap.add_argument("--deadline", type=float, default=1500.0)
-    ap.add_argument("--tag", default="r04",
+    ap.add_argument("--tag", default="r06",
                     help="round tag baked into artifact/log names "
                          "(BENCH_tpu_*_<tag>.json); bump per round so a "
                          "new round never overwrites banked evidence")
@@ -119,6 +124,19 @@ def main() -> int:
                              "--engine", "pallas"],
          os.path.join(REPO, f"BENCH_tpu_pallas_quick_{tag}.json"),
          os.path.join(REPO, "benchmarks", f"tpu_pallas_quick_{tag}.log"),
+         args.deadline),
+        # The round-6 headline capture: the full-mix MEGAKERNEL engine
+        # (superchunk launches, k=32 on TPU) on the headline 10k x 10
+        # shape.  The round's acceptance encodes the target here: beat
+        # the 15.1M ev/s r05 CPU scan record by >= 5x on-chip (75.65M
+        # ev/s), with the result line's `dispatches` field recording the
+        # >= 10x launch amortization over the per-chunk seed engine.
+        # Run FIRST by the watcher (DEFAULT_STAGES) — the quick-shape
+        # stage 6 compile warms the Mosaic cache for it in short windows.
+        (9, "megakernel", [py, bench, "--tpu", "--engine", "pallas",
+                           "--deadline", str(args.deadline - 60)],
+         os.path.join(REPO, f"BENCH_tpu_megakernel_{tag}.json"),
+         os.path.join(REPO, "benchmarks", f"tpu_megakernel_{tag}.log"),
          args.deadline),
         # Quick-shape scan with the jax.profiler trace (round-4 verdict
         # "missing 4": no on-chip profile has ever been captured). Listed

@@ -73,7 +73,11 @@ def append_log(line: str) -> None:
         f.write(line + "\n")
 
 
-DEFAULT_STAGES = (2, 6, 7, 3, 4, 1, 5, 8)
+# Stage 9 (the full-shape Pallas MEGAKERNEL, round 6's >= 5x-over-15.1M
+# ev/s acceptance target) leads: it is the one number this round cannot
+# bank without the chip.  Stage 6's quick-shape compile precedes it to
+# warm the Mosaic cache inside short alive windows.
+DEFAULT_STAGES = (6, 9, 2, 7, 3, 4, 1, 5, 8)
 
 
 def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES,
