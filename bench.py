@@ -245,6 +245,16 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     params, adj = stack_components([p0] * slab, [a0] * slab)
     adj_b = jax.numpy.broadcast_to(a0, (slab,) + a0.shape)
 
+    # --trace arms telemetry via the env for the WHOLE child, but the
+    # committed throughput must stay untraced: hold tracing off through
+    # warm-up and the timed reps, and enable it only around the one
+    # extra traced pass below.
+    from redqueen_tpu.runtime import telemetry as _telemetry
+
+    _tel = _telemetry.get()
+    want_trace = _tel.enabled
+    _tel.configure(enabled=False)
+
     warm = simulate_fn(cfg, params, adj, np.arange(slab))
     jax.block_until_ready(warm.times)
     secs = np.inf
@@ -280,6 +290,21 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     else:
         _profile_cb = None
 
+    # Per-phase spans (RQ_TRACE / --trace): ONE extra engine pass under
+    # a root telemetry span, AFTER the timed reps — the committed
+    # throughput stays untraced while the result line carries the
+    # per-stage `stage_breakdown` (engine superchunk/launch/sync spans
+    # aggregated by runtime.telemetry.summarize — the same definition
+    # tools/rqtrace.py renders), ending the hand-reconstructed
+    # bottleneck analyses.
+    stage_breakdown = None
+    if want_trace:
+        _tel.configure(enabled=True, reset=True)
+        with _tel.trace("bench.rep"):
+            lg_t = simulate_fn(cfg, params, adj, np.arange(slab) + 10_000)
+            jax.block_until_ready(lg_t.times)
+        stage_breakdown = _telemetry.summarize(_tel.drain_spans())
+
     # Sequential scan steps executed = emitted buffer length per dispatch
     # (chunks_run * capacity), summed over the slab dispatches of one rep.
     n_steps = sum(lg.times.shape[-1] for lg in logs)
@@ -293,6 +318,8 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     disp = sum(lg.dispatches or 0 for lg in logs)
     if disp:
         extras["dispatches"] = disp
+    if stage_breakdown is not None:
+        extras["stage_breakdown"] = stage_breakdown
     if _profile_cb is not None:
         extras["_profile_cb"] = _profile_cb  # popped by child_main pre-print
 
@@ -804,7 +831,8 @@ def parent_main(args) -> None:
         # amortization evidence); `interpret` marks a pallas CPU
         # correctness run so it can never pass for a timing claim.
         for k in ("steps", "step_ns", "bytes_per_step", "hbm_gbps",
-                  "hbm_peak_gbps", "hbm_frac", "dispatches", "interpret"):
+                  "hbm_peak_gbps", "hbm_frac", "dispatches", "interpret",
+                  "stage_breakdown"):
             if k in res:
                 line[k] = res[k]
         line.update(gate_fields(res))
@@ -956,6 +984,12 @@ def main():
                          "engine comparisons; O(sources)-per-event makes it "
                          "infeasible at big follower counts) — "
                          "vs_baseline is reported null")
+    ap.add_argument("--trace", action="store_true",
+                    help="after the timed reps, run ONE extra traced "
+                         "engine pass (runtime.telemetry spans) and "
+                         "attach its per-stage `stage_breakdown` to the "
+                         "result line — the timed numbers themselves "
+                         "stay untraced; render with tools/rqtrace.py")
     # Internal: child-process protocol (see child_main).
     ap.add_argument("--as-engine",
                     choices=["scan", "star", "pallas", "oracle", "config"],
@@ -963,6 +997,14 @@ def main():
     ap.add_argument("--backend", choices=["cpu", "default"], default="cpu",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if getattr(args, "trace", False):
+        # Children inherit the env (Supervisor spawns with os.environ),
+        # so one flag traces the whole engine-child tree; the traced
+        # pass runs AFTER the timed reps (see _run_event_log_engine).
+        from redqueen_tpu.runtime.telemetry import ENV_TRACE
+
+        os.environ[ENV_TRACE] = "1"
 
     if args.as_engine is not None:
         child_main(args)

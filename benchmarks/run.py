@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -394,13 +395,20 @@ SERVING_COALESCE = 32
 SERVING_MAX_UNFLUSHED = 64
 SERVING_FLUSH_DELAY_MS = 25.0
 
+# Whole serve-rounds exported into the committed SERVING_TRACE.json
+# (round-aligned so coverage/critical-path stay well-defined; the full
+# traced run still feeds the artifact's stage_breakdown block — the
+# subset bounds the committed file, the summary covers everything).
+TRACE_EXPORT_ROUNDS = 16
+
 
 def _round_chunks(batches, size):
     for i in range(0, len(batches), size):
         yield batches[i:i + size]
 
 
-def bench_serving(quick: bool = False, out_path: str = None, log=log):
+def bench_serving(quick: bool = False, out_path: str = None,
+                  trace_out_path: str = None, log=log):
     """Steady-state serving micro-bench (CPU, small graph): drive a
     deterministic synthetic ingest stream through a journaled
     ``ServingRuntime`` on the WIRE-SPEED path — coalesced applies (one
@@ -417,10 +425,25 @@ def bench_serving(quick: bool = False, out_path: str = None, log=log):
     :data:`SERVING_WARMUP_BATCHES` batches warm the measured runtime
     and are excluded from the artifact (see the constant's comment for
     why a separate warm-up runtime is not enough).
+
+    **Telemetry:** the committed throughput is measured UNTRACED, then
+    the identical workload re-runs with ``runtime.telemetry`` enabled —
+    every round under one root span, the serving span chain (admit →
+    coalesce → dispatch → sync → journal append → fsync → ack) beneath
+    it.  Three things land beside the throughput number: the
+    ``stage_breakdown`` (full traced run, ``telemetry.summarize`` —
+    the same aggregation ``tools/rqtrace.py`` renders), the ``tracing``
+    overhead comparison (traced events/s vs untraced, the <= 5%
+    contract the CI smoke enforces), and the enveloped
+    ``rq.telemetry.trace/1`` artifact (``trace_out_path``, default
+    ``SERVING_TRACE.json`` — round-aligned span subset, flagged when
+    truncated).
     """
     import tempfile
 
     from redqueen_tpu import serving
+    from redqueen_tpu.runtime import integrity as _integrity
+    from redqueen_tpu.runtime import telemetry as _telemetry
 
     n_feeds = 256 if quick else 2048
     n_batches = 256 if quick else 2048
@@ -429,9 +452,11 @@ def bench_serving(quick: bool = False, out_path: str = None, log=log):
     batches = serving.synthetic_stream(0, n_batches + warm, n_feeds,
                                        events_per_batch=epb)
     mbe = 4 * epb
+    tel = _telemetry.get()
 
-    def run(flush_mode):
+    def run(flush_mode, traced=False):
         tmpdir = tempfile.mkdtemp(prefix="rq-serving-bench-")
+        tel.configure(enabled=traced, reset=True)
         try:
             rt = serving.ServingRuntime(
                 n_feeds=n_feeds, dir=tmpdir, snapshot_every=10 ** 9,
@@ -445,30 +470,129 @@ def bench_serving(quick: bool = False, out_path: str = None, log=log):
                     rt.submit(b)
                     rt.poll()
                 rt.reset_metrics()  # steady state starts here
+                tel.configure(reset=True)  # warm-up spans excluded too
                 # One poll round per coalesce-width chunk: the round IS
                 # the dispatch/journal unit the wire-speed path
-                # amortizes over.
+                # amortizes over.  The root span per round is a no-op
+                # singleton when tracing is off (the zero-cost
+                # contract), so traced and untraced runs share this
+                # exact loop.
                 for chunk in _round_chunks(batches[warm:],
                                            SERVING_COALESCE):
-                    for b in chunk:
-                        rt.submit(b)
-                    rt.poll()
-                if flush_mode == "group":
-                    # default the artifact OUTSIDE tmpdir (removed below)
-                    return rt.write_metrics(
-                        out_path or "SERVING_BENCH.json")
+                    with tel.trace("serve.round"):
+                        for b in chunk:
+                            rt.submit(b)
+                        rt.poll()
+                # Report only — the artifact lands exactly ONCE at the
+                # end, from the BEST group rep plus the breakdown/
+                # tracing blocks (per-rep writes would land a non-best,
+                # breakdown-less payload three times for nothing).
+                health = rt.gather()[1]
                 return rt.metrics.report(
                     pending=rt.pending,
-                    extra={"durability": rt.durability()})
+                    extra={"n_feeds": rt.n_feeds, "q": rt.q,
+                           "applied_seq": rt.applied_seq,
+                           "durability": rt.durability(),
+                           "health_sick_edges": int(
+                               (health != 0).sum())})
         finally:
             import shutil
 
+            tel.configure(enabled=False)
             # the journal scratch dir has no value past the report —
             # don't leave thousands of records in /tmp per invocation
             shutil.rmtree(tmpdir, ignore_errors=True)
 
     sync_rep = run("sync")
-    payload = run("group")
+    # INTERLEAVED pairs (the telemetry_overhead.py methodology): this
+    # sandbox's IO-stall waves move a single run by ~10%, far above the
+    # ~1-3% true tracing overhead being compared (measured: 8-pair
+    # median 1.15%, best-of even negative) — sequential best-of runs
+    # let one wave eat a whole mode's reps, so the modes alternate.
+    # The best TRACED run's spans feed the breakdown + artifact; same
+    # workload, same durability window throughout.
+    payload = None
+    traced_rep, trace_payload = None, None
+    off_all, on_all = [], []
+    for _ in range(7):
+        rep = run("group")
+        off_all.append(float(rep["events_per_sec"]))
+        if payload is None or rep["events_per_sec"] > \
+                payload["events_per_sec"]:
+            payload = rep
+        trep = run("group", traced=True)
+        # Whole payload per rep (spans AND the counters/histograms the
+        # same rep recorded — run() resets telemetry at entry), so the
+        # exported artifact is internally consistent: its counters
+        # describe the same rep its spans do.
+        pay_i = tel.payload()
+        tel.configure(reset=True)
+        on_all.append(float(trep["events_per_sec"]))
+        if traced_rep is None or trep["events_per_sec"] > \
+                traced_rep["events_per_sec"]:
+            traced_rep, trace_payload = trep, pay_i
+    trace_spans = trace_payload["spans"]
+    breakdown = _telemetry.summarize(trace_spans)
+
+    def _median(xs):
+        s = sorted(xs)
+        return s[len(s) // 2]
+
+    # The overhead estimate compares MEDIANS of the interleaved runs —
+    # max-of-N is itself a noisy statistic under ~10% IO waves (a lucky
+    # untraced max against an unlucky traced max reads as phantom
+    # overhead), while the median difference converges on the real
+    # ~3% span cost.  The headline throughput stays best-of (the bench
+    # discipline for the NUMBER); both views are committed.
+    off_eps = float(payload["events_per_sec"])
+    on_eps = float(traced_rep["events_per_sec"])
+    off_med, on_med = _median(off_all), _median(on_all)
+    overhead_pct = (round(100.0 * (off_med - on_med) / off_med, 2)
+                    if off_med > 0 else None)
+    trace_path = trace_out_path or os.path.join(
+        os.path.dirname(out_path or "SERVING_BENCH.json") or ".",
+        "SERVING_TRACE.json")
+    # Round-aligned span subset: whole traces only (coverage and the
+    # critical path stay well-defined), size bounded, truncation
+    # flagged — never a silently partial round.
+    root_tids = [s["tid"] for s in trace_spans if "parent" not in s]
+    keep = set(root_tids[:TRACE_EXPORT_ROUNDS])
+    sub = [s for s in trace_spans if s["tid"] in keep]
+    trace_payload.update({
+        "spans": sub, "n_spans": len(sub),
+        "rounds_total": len(root_tids),
+        "rounds_exported": min(TRACE_EXPORT_ROUNDS, len(root_tids)),
+        "spans_truncated": len(sub) < len(trace_spans),
+        "workload": {"n_feeds": n_feeds, "n_batches": n_batches,
+                     "events_per_batch": epb,
+                     "coalesce": SERVING_COALESCE},
+        "stage_breakdown": breakdown,
+        "events_per_sec_traced": on_eps,
+        "events_per_sec_untraced": off_eps,
+        "durability": traced_rep["durability"],
+    })
+    _integrity.write_json(trace_path, trace_payload,
+                          schema=_telemetry.TRACE_SCHEMA)
+    # Land the metrics artifact (the ONE write) WITH the breakdown +
+    # overhead blocks beside its throughput number — no more
+    # hand-reconstructed bottleneck analyses next to a bare events/s.
+    from redqueen_tpu.serving.metrics import METRICS_SCHEMA
+
+    payload["stage_breakdown"] = breakdown
+    payload["tracing"] = {
+        "events_per_sec_traced": on_eps,
+        "events_per_sec_untraced": off_eps,
+        "events_per_sec_traced_median": on_med,
+        "events_per_sec_untraced_median": off_med,
+        "interleaved_reps": len(off_all),
+        "overhead_pct": overhead_pct,
+        "within_5pct": (overhead_pct is not None
+                        and overhead_pct <= 5.0),
+        "coverage": breakdown["coverage"],
+        "trace_artifact": trace_path,
+    }
+    _integrity.write_json(out_path or "SERVING_BENCH.json", payload,
+                          schema=METRICS_SCHEMA)
     lat = payload["decision_latency"]
     log(f"serving [group commit, coalesce={SERVING_COALESCE}]: "
         f"{payload['events_applied']} events in "
@@ -479,6 +603,11 @@ def bench_serving(quick: bool = False, out_path: str = None, log=log):
         f"(trimmed {lat['p99_trimmed_ms']}ms, windowed "
         f"{lat['p99_window_median_ms']}ms) max {lat['max_ms']}ms; "
         f"sync-ack comparison {sync_rep['events_per_sec']:,.0f} ev/s")
+    log(f"serving telemetry: traced median {on_med:,.0f} ev/s vs "
+        f"untraced median {off_med:,.0f} ev/s (overhead "
+        f"{overhead_pct}%; bests {on_eps:,.0f} / {off_eps:,.0f}); "
+        f"stage coverage {breakdown['coverage']}; "
+        f"trace -> {trace_path}")
     return {
         "metric": f"serving events/sec ({n_feeds} feeds, journaled "
                   f"group-commit, coalesce={SERVING_COALESCE}, "
@@ -500,6 +629,8 @@ def bench_serving(quick: bool = False, out_path: str = None, log=log):
                 sync_rep["decision_latency"]["p99_ms"],
             "durability": sync_rep["durability"],
         },
+        "tracing": payload["tracing"],
+        "stage_breakdown": breakdown,
         "reconciles": payload["reconciles"],
     }
 
@@ -831,6 +962,11 @@ def main():
     ap.add_argument("--serving-out", default="SERVING_BENCH.json",
                     help="artifact path for --serving "
                          "(default: SERVING_BENCH.json)")
+    ap.add_argument("--serving-trace-out", default=None,
+                    help="with --serving (no --shards): path of the "
+                         "rq.telemetry.trace/1 artifact from the traced "
+                         "re-run (default: SERVING_TRACE.json beside "
+                         "--serving-out); render with tools/rqtrace.py")
     ap.add_argument("--learn", action="store_true",
                     help="run the Hawkes-estimation micro-bench "
                          "(redqueen_tpu.learn): simulate->fit->recover "
@@ -898,7 +1034,8 @@ def main():
                            else "in-process"))
         else:
             res = bench_serving(quick=args.quick,
-                                out_path=args.serving_out)
+                                out_path=args.serving_out,
+                                trace_out_path=args.serving_trace_out)
         res["platform"] = platform
         print(json.dumps(res))
         log(f"wrote {args.serving_out}")

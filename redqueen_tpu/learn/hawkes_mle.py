@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..runtime import preempt as _preempt
+from ..runtime import telemetry as _telemetry
 from ..runtime.numerics import (
     BIT_NONFINITE_STATE,
     describe_health,
@@ -335,17 +336,23 @@ def fit_hawkes(data, solver: str = "em", max_iters: int = 200,
         _heartbeat()
         _preempt.check_preempt(f"fit_hawkes[{solver}] iteration {it}")
 
-    if solver == "em":
-        fit_arrays, n_iter, converged = _run_em(
-            dt, dims, mask, tail, counts, counts64, span, D, params,
-            start_it, max_iters, tol, beta_floor, beta_cap, sync_every,
-            ckpt_every, curve, bits, save)
-    else:
-        fit_arrays, n_iter, converged = _run_fw(
-            dt, dims, mask, tail, counts, counts64, span, D,
-            params, start_it, max_iters, tol, beta_floor, beta_cap, rho,
-            mu_max_scale, fw_beta_warmup, sync_every, ckpt_every, curve,
-            bits, save)
+    # The fit's root span: every per-iteration / sync-boundary span
+    # below chains under it, so `rqtrace` answers "where did this
+    # EM/FW fit spend its time" without a hand-inserted timer.
+    with _telemetry.span("learn.fit", solver=solver, n_dims=int(D),
+                         n_events=int(data.n_events)) as fit_sp:
+        if solver == "em":
+            fit_arrays, n_iter, converged = _run_em(
+                dt, dims, mask, tail, counts, counts64, span, D, params,
+                start_it, max_iters, tol, beta_floor, beta_cap,
+                sync_every, ckpt_every, curve, bits, save)
+        else:
+            fit_arrays, n_iter, converged = _run_fw(
+                dt, dims, mask, tail, counts, counts64, span, D,
+                params, start_it, max_iters, tol, beta_floor, beta_cap,
+                rho, mu_max_scale, fw_beta_warmup, sync_every,
+                ckpt_every, curve, bits, save)
+        fit_sp.set(n_iter=int(n_iter), converged=bool(converged))
     mu_f, alpha_f, beta_f = fit_arrays
 
     def _score(mu_s, alpha_s, beta_s):
@@ -397,18 +404,26 @@ def _run_em(dt, dims, mask, tail, counts, counts64, span, D, params,
     converged = False
     it = start_it
     while it < max_iters and not converged:
-        mu, alpha, beta, ll, health = _em_iter(
-            dt, dims, mask, tail, mu, alpha, beta, counts,
-            jnp.float32(span), jnp.float32(beta_floor),
-            jnp.float32(beta_cap), n_dims=D)
+        # Per-iteration span = the jitted EM sweep's ENQUEUE; the
+        # blocked device wait is the sync span at the window boundary
+        # below — the sync-boundary split the learn arc's breakdowns
+        # need (iterations between syncs cost host-dispatch only).
+        with _telemetry.span("learn.em.iter") as isp:
+            isp.set(it=it)
+            mu, alpha, beta, ll, health = _em_iter(
+                dt, dims, mask, tail, mu, alpha, beta, counts,
+                jnp.float32(span), jnp.float32(beta_floor),
+                jnp.float32(beta_cap), n_dims=D)
         pending.append((ll, health))
         it += 1
         if len(pending) >= sync_every or it >= max_iters:
             # ONE blocked transfer per sync window (never per step): the
             # trajectory tail the convergence check needs, the scan's
             # per-dimension health words, and the tiny parameter carry.
-            vals, mu_h, alpha_h, beta_h = jax.device_get(  # rqlint: disable=RQ701,RQ702 one blocked sync per sync_every iterations
-                (pending, mu, alpha, beta))
+            with _telemetry.span("learn.em.sync") as ssp:
+                ssp.set(iters=len(pending))
+                vals, mu_h, alpha_h, beta_h = jax.device_get(  # rqlint: disable=RQ701,RQ702 one blocked sync per sync_every iterations
+                    (pending, mu, alpha, beta))
             curve.extend(float(v) for v, _h in vals)
             scan_bits = np.zeros_like(bits)
             for _v, h in vals:
@@ -446,14 +461,16 @@ def _run_fw(dt, dims, mask, tail, counts, counts64, span, D,
         mu = jnp.asarray(mu_np, jnp.float32)
         alpha = jnp.asarray(alpha_np, jnp.float32)
         beta = jnp.asarray(beta_np, jnp.float32)
-        for _ in range(int(fw_beta_warmup)):
-            mu, alpha, beta, _ll, _h = _em_iter(
-                dt, dims, mask, tail, mu, alpha, beta,
-                counts, jnp.float32(span), jnp.float32(beta_floor),
-                jnp.float32(beta_cap), n_dims=D)
-        mu_np, alpha_np, beta_np = (
-            np.asarray(leaf, np.float64)
-            for leaf in jax.device_get((mu, alpha, beta)))  # rqlint: disable=RQ701 one blocked transfer: the warm-started decay crosses to host exactly once
+        with _telemetry.span("learn.fw.warmup") as wsp:
+            wsp.set(iters=int(fw_beta_warmup))
+            for _ in range(int(fw_beta_warmup)):
+                mu, alpha, beta, _ll, _h = _em_iter(
+                    dt, dims, mask, tail, mu, alpha, beta,
+                    counts, jnp.float32(span), jnp.float32(beta_floor),
+                    jnp.float32(beta_cap), n_dims=D)
+            mu_np, alpha_np, beta_np = (
+                np.asarray(leaf, np.float64)
+                for leaf in jax.device_get((mu, alpha, beta)))  # rqlint: disable=RQ701 one blocked transfer: the warm-started decay crosses to host exactly once
         mu_np, alpha_np, beta_np, bits[:] = _sanitize(
             mu_np, alpha_np, beta_np, counts64, span, bits)
     beta = jnp.asarray(beta_np, jnp.float32)
@@ -476,13 +493,18 @@ def _run_fw(dt, dims, mask, tail, counts, counts64, span, D,
     converged = False
     it = start_it
     while it < max_iters and not converged:
-        mu, a, nll, gap = _fw_iter(
-            dt, dims, mask, G, mu_max, jnp.float32(it), mu, a, beta,
-            jnp.float32(span), jnp.float32(rho), n_dims=D)
+        # Same enqueue/sync split as the EM loop (see _run_em).
+        with _telemetry.span("learn.fw.iter") as isp:
+            isp.set(it=it)
+            mu, a, nll, gap = _fw_iter(
+                dt, dims, mask, G, mu_max, jnp.float32(it), mu, a, beta,
+                jnp.float32(span), jnp.float32(rho), n_dims=D)
         pending.append((nll, gap))
         it += 1
         if len(pending) >= sync_every or it >= max_iters:
-            vals, mu_h, a_h = jax.device_get((pending, mu, a))  # rqlint: disable=RQ701,RQ702 one blocked sync per sync_every iterations
+            with _telemetry.span("learn.fw.sync") as ssp:
+                ssp.set(iters=len(pending))
+                vals, mu_h, a_h = jax.device_get((pending, mu, a))  # rqlint: disable=RQ701,RQ702 one blocked sync per sync_every iterations
             last_gap = float(vals[-1][1])
             last_nll = float(vals[-1][0])
             curve.extend(-float(v[0]) for v in vals)

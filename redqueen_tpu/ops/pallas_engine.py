@@ -58,6 +58,7 @@ from ..models.base import (
 )
 from ..runtime import faultinject as _faultinject
 from ..runtime import numerics as _numerics
+from ..runtime import telemetry as _telemetry
 from .pallas_step import KernelSpec, make_step, prepare_consts
 from .pallas_vmem import TILE as _TILE
 from .pallas_vmem import VmemPlan, plan_vmem
@@ -377,6 +378,11 @@ def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
         # int()/bool() below normalize HOST call options for the plan /
         # compile-cache key — no traced value is ever concretized here.
         plan = plan_vmem(cfg, S, F, Kr, Kp, k=int(sync_every))  # rqlint: disable=RQ701 host ints
+    # The VMEM plan as a span event: what the planner picked (or why it
+    # degraded) rides the trace next to the launches it shaped.
+    _telemetry.event("engine.pallas.vmem_plan", fits=plan.fits,
+                     k=plan.k, capacity=plan.capacity,
+                     reason=plan.reason)
     if not plan.fits:
         raise ValueError(plan.reason)
     k, cap = plan.k, plan.capacity
@@ -441,22 +447,39 @@ def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
     n_launches = -(-max_kernel_chunks // k)
     times_chunks, srcs_chunks = [], []
     dispatches = 0
-    for _ in range(n_launches):
-        *carry_vals, times_sc, srcs_sc, alive = call(
-            *param_vals, *carry_vals)
-        carry_vals = tuple(carry_vals)
-        dispatches += 1
-        times_chunks.append(times_sc[:, :B])
-        srcs_chunks.append(srcs_sc[:, :B])
-        # THE one liveness sync per superchunk launch: a single
-        # replicated scalar, never per chunk, never per event.
-        if not bool(alive):  # rqlint: disable=RQ702 one sync per superchunk
-            break
-    else:
-        raise RuntimeError(
-            f"simulation still active after {max_kernel_chunks} chunks of "
-            f"{cap} events ({dispatches} superchunk launches) — raise "
-            f"capacity or max_chunks (refusing to truncate silently)")
+    # The with-statement (not a manual __enter__/__exit__) so a raising
+    # run stamps its error attribute on the span; the inner finally
+    # records the launch count on BOTH exits.
+    with _telemetry.span("engine.pallas.run", k=k, capacity=cap,
+                         interpret=bool(interpret)) as run_span:
+        try:
+            for _ in range(n_launches):
+                # The launch span measures the superchunk ENQUEUE; the
+                # device wait surfaces in the sync span at the liveness
+                # scalar below (async-dispatch honesty, same split as
+                # the scan driver).
+                with _telemetry.span("engine.pallas.launch"):
+                    *carry_vals, times_sc, srcs_sc, alive = call(
+                        *param_vals, *carry_vals)
+                    carry_vals = tuple(carry_vals)
+                dispatches += 1
+                times_chunks.append(times_sc[:, :B])
+                srcs_chunks.append(srcs_sc[:, :B])
+                # THE one liveness sync per superchunk launch: a single
+                # replicated scalar, never per chunk, never per event.
+                with _telemetry.span("engine.pallas.sync"):
+                    done = not bool(alive)  # rqlint: disable=RQ702 one sync per superchunk
+                if done:
+                    break
+            else:
+                raise RuntimeError(
+                    f"simulation still active after {max_kernel_chunks} "
+                    f"chunks of {cap} events ({dispatches} superchunk "
+                    f"launches) — raise capacity or max_chunks (refusing "
+                    f"to truncate silently)")
+        finally:
+            run_span.set(dispatches=dispatches)
+    _telemetry.counter("engine.pallas.launches", dispatches)
 
     out = dict(zip(carry_names, carry_vals))
     # The run's ONE results boundary (mirrors sim._drive's): the [B]

@@ -37,7 +37,7 @@ The one API behind which the stack's tunnel-hang defenses live (see
 
 from __future__ import annotations
 
-from . import artifacts, faultinject, integrity, preempt, watchdog  # noqa: F401
+from . import artifacts, faultinject, integrity, preempt, telemetry, watchdog  # noqa: F401
 from .artifacts import (
     atomic_savez,
     atomic_write_json,
@@ -45,6 +45,7 @@ from .artifacts import (
     atomic_write_text,
 )
 from .integrity import CorruptArtifactError
+from .telemetry import FlightRecorder, Telemetry
 from .watchdog import Lease, LeaseHeldError, Watchdog
 from .preempt import (
     PreemptedError,
@@ -134,11 +135,15 @@ __all__ = [
     "Watchdog",
     "Lease",
     "LeaseHeldError",
+    # telemetry (spans / counters / flight recorder)
+    "Telemetry",
+    "FlightRecorder",
     # submodules
     "artifacts",
     "faultinject",
     "integrity",
     "numerics",
     "preempt",
+    "telemetry",
     "watchdog",
 ]

@@ -149,6 +149,10 @@ class Attempt:
     backoff_s: Optional[float] = None  # sleep applied AFTER this attempt
     stdout: str = dataclasses.field(default="", repr=False)
     stderr: str = dataclasses.field(default="", repr=False)
+    # Flight-recorder salvage (Supervisor(flight_path=...)): the failed
+    # child's last spans, read from its on-disk ring — evidence a
+    # SIGKILL/timeout cannot erase.  Bounded like the stream tails.
+    flight: list = dataclasses.field(default_factory=list, repr=False)
 
     def to_dict(self) -> dict:
         d = {k: getattr(self, k) for k in
@@ -156,6 +160,8 @@ class Attempt:
               "wall_s", "detail", "backoff_s")}
         d["stdout_tail"] = self.stdout[-_STREAM_TAIL:]
         d["stderr_tail"] = self.stderr[-_STREAM_TAIL:]
+        if self.flight:
+            d["flight_spans"] = list(self.flight)
         return d
 
 
@@ -393,6 +399,7 @@ class Supervisor:
                  cwd: Optional[str] = None,
                  probe_first: bool = False,
                  raise_on_failure: bool = False,
+                 flight_path: Optional[str] = None,
                  log: Callable = _stderr_log):
         if backend not in ("default", "cpu"):
             raise ValueError(f"backend must be 'default' or 'cpu', "
@@ -411,6 +418,16 @@ class Supervisor:
         self.cwd = cwd
         self.probe_first = probe_first
         self.raise_on_failure = raise_on_failure
+        # Flight-recorder salvage (runtime.telemetry): when set, the
+        # child's telemetry mirrors its spans into this ring file
+        # (RQ_TRACE_FLIGHT in the attempt env — setting it implies
+        # tracing on), and every FAILED attempt's last ~N spans are
+        # salvaged into the RunReport — a SIGKILL'd/timed-out child
+        # still testifies about where it spent its final moments.
+        # Absolute-ized: a relative path under a cwd= override would
+        # have the child write one file and the parent salvage another.
+        self.flight_path = (None if flight_path is None
+                            else os.path.abspath(flight_path))
         self.log = log or (lambda *a: None)
 
     # -- helpers -----------------------------------------------------------
@@ -420,6 +437,10 @@ class Supervisor:
         env.update(self.env)
         env[ENV_SUPERVISED] = "1"
         env[ENV_HEARTBEAT] = hb_path
+        if self.flight_path:
+            from . import telemetry as _telemetry
+
+            env[_telemetry.ENV_TRACE_FLIGHT] = self.flight_path
         if backend == "cpu":
             env[ENV_BACKEND] = "cpu"
             env["JAX_PLATFORMS"] = "cpu"
@@ -518,6 +539,20 @@ class Supervisor:
             finally:
                 if os.path.exists(hb_path):
                     os.remove(hb_path)
+
+            if self.flight_path and att.outcome != OK:
+                # Salvage the dead/timed-out child's flight ring into
+                # the report (read_flight never raises; the ring is
+                # consumed so the NEXT attempt's ring starts clean —
+                # stale evidence never attributes to a later attempt).
+                from . import telemetry as _telemetry
+
+                att.flight = _telemetry.read_flight(
+                    self.flight_path)[-_telemetry.FLIGHT_SALVAGE_SPANS:]
+                try:
+                    os.remove(self.flight_path)
+                except OSError:
+                    pass
 
             if att.outcome == OK:
                 report.ok = True

@@ -131,6 +131,7 @@ import numpy as np
 
 from ..runtime import faultinject as _faultinject
 from ..runtime import integrity as _integrity
+from ..runtime import telemetry as _telemetry
 from ..runtime.supervisor import RetryPolicy
 from .events import EventBatch, IngestError, validate_batch
 from .metrics import ClusterMetrics
@@ -766,6 +767,12 @@ class ServingCluster:
         raises on bad input; a quarantined shard's slice is shed with
         its seq recorded (``shed_unavailable``) so the source
         retransmits it after recovery."""
+        with _telemetry.span("cluster.submit") as tsp:
+            adm = self._submit(batch)
+            tsp.set(status=adm.status)
+            return adm
+
+    def _submit(self, batch: EventBatch) -> ClusterAdmission:
         try:
             batch = validate_batch(batch, self.n_feeds,
                                    max_events=self.max_batch_events)
@@ -873,6 +880,12 @@ class ServingCluster:
         loops (there is no frame to batch)."""
         if not batches:
             return []
+        with _telemetry.span("cluster.submit_round") as tsp:
+            tsp.set(n=len(batches))
+            return self._submit_many(batches)
+
+    def _submit_many(self, batches: List[EventBatch]
+                     ) -> List[ClusterAdmission]:
         if not self._worker_mode:
             return [self.submit(b) for b in batches]
         prepared = []  # (batch|None, subs|None, admission-or-None)
@@ -1005,6 +1018,13 @@ class ServingCluster:
         they were already drained by the time recovery runs, and their
         admissions never depend on the dead shard).  Returns the
         per-shard decision lists."""
+        with _telemetry.span("cluster.poll") as tsp:
+            out = self._poll(max_batches_per_shard)
+            tsp.set(applied=sum(len(v) for v in out.values()))
+            return out
+
+    def _poll(self, max_batches_per_shard: Optional[int] = None
+              ) -> Dict[int, List[Any]]:
         if self._worker_mode:
             return self._poll_workers(max_batches_per_shard)
         out: Dict[int, List[Any]] = {}
@@ -1381,6 +1401,32 @@ class ServingCluster:
             self.metrics.observe_lost_on_crash(slot.k, seq)
         slot.outstanding.clear()
         self.metrics.observe_crash(slot.k, reason)
+        self._salvage_flight(slot)
+
+    def _salvage_flight(self, slot: _ShardSlot) -> None:
+        """Pull the dead fault domain's flight-recorder ring (the last
+        ~N spans the process completed before it died — a SIGKILL'd
+        worker's only testimony) into the crash report: the span dicts
+        land on the shard's metrics block AND in this router's telemetry
+        buffer, so an exported trace stitches the child's final moments
+        under their original trace ids.  Best-effort by design: a
+        missing or torn ring is an empty salvage, never an error in the
+        crash path.  The ring file is consumed (removed) so a later,
+        unrelated crash cannot re-report stale evidence."""
+        if slot.dir is None:
+            return
+        ring = os.path.join(slot.dir, _telemetry.FLIGHT_FILENAME)
+        spans = _telemetry.read_flight(ring)
+        if not spans:
+            return
+        self.metrics.observe_flight_salvage(slot.k, spans)
+        tel = _telemetry.get()
+        if tel.enabled:
+            tel.adopt_spans(spans)
+        try:
+            os.remove(ring)
+        except OSError:
+            pass
 
     def _corrupt_newest_snapshot(self, slot: _ShardSlot) -> None:
         """The ``corrupt_snapshot`` fault body: scribble every file of

@@ -38,6 +38,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..runtime import integrity as _integrity
+from ..runtime import telemetry as _telemetry
 
 __all__ = ["Journal", "JournalError", "replay", "tear_tail",
            "rotate", "prune_segments", "segment_paths",
@@ -255,6 +256,9 @@ class Journal:
                 fd = self._f.fileno()
             try:
                 os.fsync(fd)
+                # Counter, not a span: this thread has no trace context
+                # (a span here would start orphan root traces per tick).
+                _telemetry.counter("serving.journal.bg_fsync")
             except ValueError:
                 return  # fd closed under us: clean shutdown race
             except OSError:
@@ -281,31 +285,34 @@ class Journal:
         """Append one record.  ``seq`` tags the record's LAST applied
         sequence number for the durability watermark (group records pass
         their trailing seq)."""
-        env = _integrity.make_envelope(
-            payload, schema=(JOURNAL_GROUP_SCHEMA if "seqs" in payload
-                             else JOURNAL_SCHEMA))
-        line = json.dumps(env, separators=(",", ":")) + "\n"
-        with self._lock:
-            self._f.write(line)
-            self._f.flush()
-            self._written_offset = self._f.tell()
-            self._written_records += 1
-            if seq is not None:
-                self._written_seq = int(seq)
-            elif "seq" in payload:
-                self._written_seq = int(payload["seq"])
-            self._unsynced += 1
-            if self.flush_mode == "group":
-                # The record bound: the ack below may precede the fsync
-                # by at most max_unflushed_records records — when the
-                # window is full the append BLOCKS on the fsync (the
-                # hard bound; the background thread normally keeps the
-                # window far from full).
-                if (self._written_records - self._durable_records
-                        >= self.max_unflushed_records):
-                    self._fsync_locked()
-            elif self._unsynced >= self.fsync_every_n:
-                self._fsync_locked()
+        with _telemetry.span("serving.journal.append"):
+            env = _integrity.make_envelope(
+                payload, schema=(JOURNAL_GROUP_SCHEMA if "seqs" in payload
+                                 else JOURNAL_SCHEMA))
+            line = json.dumps(env, separators=(",", ":")) + "\n"
+            with self._lock:
+                self._f.write(line)
+                self._f.flush()
+                self._written_offset = self._f.tell()
+                self._written_records += 1
+                if seq is not None:
+                    self._written_seq = int(seq)
+                elif "seq" in payload:
+                    self._written_seq = int(payload["seq"])
+                self._unsynced += 1
+                if self.flush_mode == "group":
+                    # The record bound: the ack below may precede the
+                    # fsync by at most max_unflushed_records records —
+                    # when the window is full the append BLOCKS on the
+                    # fsync (the hard bound; the background thread
+                    # normally keeps the window far from full).
+                    if (self._written_records - self._durable_records
+                            >= self.max_unflushed_records):
+                        with _telemetry.span("serving.journal.fsync"):
+                            self._fsync_locked()
+                elif self._unsynced >= self.fsync_every_n:
+                    with _telemetry.span("serving.journal.fsync"):
+                        self._fsync_locked()
 
     def sync(self) -> None:
         """Force any group-commit tail to media now (a no-op at

@@ -36,13 +36,12 @@ import collections
 import time
 from typing import Any, Dict, List, Optional
 
-import numpy as np
-
 from ..runtime import integrity as _integrity
+from ..runtime import telemetry as _telemetry
 
 __all__ = ["ServingMetrics", "ClusterMetrics", "METRICS_SCHEMA",
            "CLUSTER_METRICS_SCHEMA", "MAX_SHED_SEQS", "LATENCY_WINDOW",
-           "MAX_SEQS_PER_SHARD"]
+           "MAX_SEQS_PER_SHARD", "MAX_FLIGHT_SPANS"]
 
 METRICS_SCHEMA = "rq.serving.metrics/1"
 CLUSTER_METRICS_SCHEMA = "rq.serving.metrics/2"
@@ -61,60 +60,25 @@ LATENCY_WINDOW = 8192
 MAX_SEQS_PER_SHARD = 256
 
 
-# Trimmed/windowed percentile parameters (see _latency_percentiles).
-# TRIM_FRACTION of the slowest samples is excluded from the *_trimmed
-# view; the windowed view takes the MEDIAN of per-window p99s over
-# windows of PCTL_WINDOW samples.
-TRIM_FRACTION = 0.005
-PCTL_WINDOW = 512
+# Trimmed/windowed percentile parameters — re-exported from
+# runtime.telemetry, which owns THE histogram/percentile implementation
+# (this module is a consumer, not a second definition: the /1 and /2
+# `decision_latency` blocks, every telemetry histogram, and the rqtrace
+# breakdowns all share one percentile function).
+TRIM_FRACTION = _telemetry.TRIM_FRACTION
+PCTL_WINDOW = _telemetry.PCTL_WINDOW
 
+#: The one percentile definition (see runtime.telemetry
+#: .latency_percentiles) — kept under its historical name because the
+#: serving tests and the cluster artifact builders address it here.
+_latency_percentiles = _telemetry.latency_percentiles
 
-def _latency_percentiles(latencies) -> Dict[str, Optional[float]]:
-    """One percentile definition for BOTH artifact versions — the /1
-    and /2 `decision_latency` blocks must never drift apart.
-
-    Three views of the same samples, all committed so none can be
-    quoted without the others:
-
-    - **raw** p50/p99/max — the honest tail, IO-stall waves included;
-    - **trimmed** p99 over the fastest ``1 - TRIM_FRACTION`` of samples
-      — the tail with the top 0.5% outliers excluded;
-    - **windowed** p99: the MEDIAN of per-window p99s (windows of
-      ``PCTL_WINDOW`` samples).  This sandbox's IO-stall waves (PR 7)
-      land in a few windows and move a single global p99 by 10×
-      run-to-run; the median-of-windows statistic is stable across
-      runs while still a genuine 99th percentile within each window —
-      the number to COMPARE across runs, never the number to hide the
-      raw tail behind."""
-    if not latencies:
-        return {"p50_ms": None, "p99_ms": None, "max_ms": None,
-                "p99_trimmed_ms": None, "p99_window_median_ms": None,
-                "windows": 0}
-    lat = np.asarray(latencies, np.float64)
-    out = {
-        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
-        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
-        "max_ms": round(float(lat.max()) * 1e3, 3),
-    }
-    keep = max(1, int(np.ceil(len(lat) * (1.0 - TRIM_FRACTION))))
-    trimmed = np.sort(lat)[:keep]
-    out["p99_trimmed_ms"] = round(
-        float(np.percentile(trimmed, 99)) * 1e3, 3)
-    n_win = max(1, len(lat) // PCTL_WINDOW)
-    if n_win == 1:
-        wins = [lat]  # fewer than two full windows: use every sample
-    else:
-        wins = [lat[i * PCTL_WINDOW:(i + 1) * PCTL_WINDOW]
-                for i in range(n_win)]
-        if len(lat) % PCTL_WINDOW:
-            # the remainder merges into the last window — every sample
-            # is in exactly one window, none silently dropped
-            wins[-1] = lat[(n_win - 1) * PCTL_WINDOW:]
-    p99s = [float(np.percentile(w, 99)) for w in wins if len(w)]
-    out["p99_window_median_ms"] = round(
-        float(np.median(p99s)) * 1e3, 3)
-    out["windows"] = len(p99s)
-    return out
+#: Cap on salvaged flight-recorder spans retained per shard (the crash
+#: forensics the router pulls from a dead worker's ring — bounded like
+#: every other per-shard ledger; the count stays exact).  ONE policy,
+#: owned by runtime.telemetry: the supervisor's RunReport salvage uses
+#: the same constant, so the two crash-evidence paths never drift.
+MAX_FLIGHT_SPANS = _telemetry.FLIGHT_SALVAGE_SPANS
 
 
 class ServingMetrics:
@@ -150,6 +114,10 @@ class ServingMetrics:
         self.posts += int(bool(posted))
         if latency_s is not None:
             self._latencies.append(float(latency_s))
+            # One observation, two consumers: the report's percentile
+            # window here, the exported telemetry histogram there (a
+            # no-op branch when tracing is disabled).
+            _telemetry.observe("serving.decision_latency_s", latency_s)
 
     def observe_shed(self, seq: int, n_events: int) -> None:
         self.shed += 1
@@ -222,7 +190,8 @@ class _ShardStats:
                  "crashes", "recoveries", "replayed", "recovery_ms",
                  "shed_seqs", "lost_seqs", "last_crash_reason",
                  "lost_in_window", "lost_window_seqs", "resyncs",
-                 "resynced_decisions", "reattaches")
+                 "resynced_decisions", "reattaches",
+                 "flight_salvaged", "flight_spans")
 
     def __init__(self):
         self.submitted = 0
@@ -256,6 +225,12 @@ class _ShardStats:
         self.resyncs = 0
         self.resynced_decisions = 0
         self.reattaches = 0
+        # Flight-recorder salvage: the dead worker's last spans, read
+        # from its on-disk ring after a crash (count exact, retained
+        # spans capped at MAX_FLIGHT_SPANS — the evidence a SIGKILL'd
+        # process leaves behind).
+        self.flight_salvaged = 0
+        self.flight_spans: List[Dict[str, Any]] = []
 
     @property
     def shed_total(self) -> int:
@@ -294,6 +269,8 @@ class _ShardStats:
             "reattaches": self.reattaches,
             "resyncs": self.resyncs,
             "resynced_decisions": self.resynced_decisions,
+            "flight_salvaged": self.flight_salvaged,
+            "flight_spans": list(self.flight_spans),
             "seqs_truncated": (
                 self.shed_queue + self.shed_unavailable
                 > len(self.shed_seqs)
@@ -339,6 +316,12 @@ class ClusterMetrics:
         s.posts += int(bool(posted))
         if latency_s is not None:
             self._latencies.append(float(latency_s))
+            # Distinct histogram from ServingMetrics' on purpose: under
+            # IN-PROCESS placement both ledgers observe the same
+            # decision (runtime- and router-level latency are different
+            # definitions), and one shared name would double-count and
+            # blend them.
+            _telemetry.observe("cluster.decision_latency_s", latency_s)
 
     def observe_shed_queue(self, shard: int, seq: int) -> None:
         s = self.shards[shard]
@@ -370,6 +353,16 @@ class ClusterMetrics:
         s = self.shards[shard]
         s.resyncs += 1
         s.resynced_decisions += int(n_decisions)
+
+    def observe_flight_salvage(self, shard: int,
+                               spans: List[Dict[str, Any]]) -> None:
+        """The dead worker's flight-recorder ring, salvaged by the
+        router after a crash: the count is exact, the retained spans
+        are the most recent ``MAX_FLIGHT_SPANS`` (newest evidence
+        matters most after a SIGKILL)."""
+        s = self.shards[shard]
+        s.flight_salvaged += len(spans)
+        s.flight_spans = [dict(sp) for sp in spans[-MAX_FLIGHT_SPANS:]]
 
     def observe_rejected(self, shard: int) -> None:
         self.shards[shard].rejected += 1

@@ -49,6 +49,7 @@ import numpy as np
 
 from ..runtime import faultinject as _faultinject
 from ..runtime import integrity as _integrity
+from ..runtime import telemetry as _telemetry
 from .events import EventBatch, IngestError, validate_batch
 from .ingest import Sequencer
 from .journal import (FLUSH_MODES, JOURNAL_FILENAME, Journal,
@@ -345,6 +346,13 @@ class ServingRuntime:
         (coerced dtypes, non-decreasing times, in-range local feeds by
         construction), so re-validating every slice would double the
         O(events) host work on the measured ingest path."""
+        with _telemetry.span("serving.admit") as tsp:
+            adm = self._submit(batch, _validated)
+            tsp.set(status=adm.status)
+            return adm
+
+    def _submit(self, batch: EventBatch,
+                _validated: bool = False) -> Admission:
         self.metrics.ingested += 1
         backpressure = self.pending >= max(self.queue_capacity * 3 // 4, 1)
         if not _validated:
@@ -425,15 +433,24 @@ class ServingRuntime:
     def _apply_one(self, batch: EventBatch, submitted_at: float) -> Decision:
         import jax
 
-        times, feeds, n = self._pad(batch)
-        new_state, (posted, t_new, lam) = self._apply(
-            self._state, times, feeds, n, np.int32(batch.seq),
-            self._s_sink, self._q)
+        # Stage spans under the current trace (the poll round / the
+        # worker request): coalesce = host-side packing, dispatch = the
+        # jitted enqueue, sync = the device→host wait (async dispatch
+        # means the device time surfaces HERE, not in dispatch — the
+        # same honesty split the benches use), then journal (its own
+        # span inside Journal.append) and ack.
+        with _telemetry.span("serving.coalesce"):
+            times, feeds, n = self._pad(batch)
+        with _telemetry.span("serving.dispatch"):
+            new_state, (posted, t_new, lam) = self._apply(
+                self._state, times, feeds, n, np.int32(batch.seq),
+                self._s_sink, self._q)
         # The ONE deliberate device→host boundary of the apply path: the
         # decision must reach the caller and the journal this batch, so
         # the transfer is per-batch by CONTRACT (serving, not batch sim);
         # it is explicit and batched into a single device_get.
-        posted, t_new, lam = jax.device_get((posted, t_new, lam))  # rqlint: disable=RQ702 per-batch decision boundary
+        with _telemetry.span("serving.sync"):
+            posted, t_new, lam = jax.device_get((posted, t_new, lam))  # rqlint: disable=RQ702 per-batch decision boundary
         decision = Decision(
             seq=batch.seq, post=bool(posted), post_time=float(t_new),
             intensity=float(lam), stale_batches=self.pending)
@@ -454,12 +471,14 @@ class ServingRuntime:
                     f"— serving state can no longer be made durable; "
                     f"restart and recover from {self.dir}") from e
             self._post_append_faults(int(batch.seq))
-        self._state = new_state
-        self._last_decision = decision
-        latency = (self._clock() - submitted_at
-                   if submitted_at is not None else None)
-        self.metrics.observe_apply(batch.n_events, decision.post, latency)
-        self._since_snapshot += 1
+        with _telemetry.span("serving.ack"):
+            self._state = new_state
+            self._last_decision = decision
+            latency = (self._clock() - submitted_at
+                       if submitted_at is not None else None)
+            self.metrics.observe_apply(batch.n_events, decision.post,
+                                       latency)
+            self._since_snapshot += 1
         if self.dir is not None and \
                 self._since_snapshot >= self.snapshot_every:
             self.snapshot()
@@ -521,20 +540,25 @@ class ServingRuntime:
 
         K, E = self.coalesce, self.max_batch_events
         k = len(group)
-        times = np.zeros((K, E), np.float32)
-        feeds = np.zeros((K, E), np.int32)
-        nvalid = np.zeros((K,), np.int32)
-        seqs = np.zeros((K,), np.int32)
-        for j, (b, _at) in enumerate(group):
-            t, f, n = _pad_events(b.times, b.feeds, E)
-            times[j], feeds[j], nvalid[j], seqs[j] = t, f, n, int(b.seq)
-        new_state, (posted, t_new, lam) = self._apply_many(
-            self._state, times, feeds, nvalid, seqs, np.int32(k),
-            self._s_sink, self._q)
+        with _telemetry.span("serving.coalesce") as csp:
+            csp.set(k=k)
+            times = np.zeros((K, E), np.float32)
+            feeds = np.zeros((K, E), np.int32)
+            nvalid = np.zeros((K,), np.int32)
+            seqs = np.zeros((K,), np.int32)
+            for j, (b, _at) in enumerate(group):
+                t, f, n = _pad_events(b.times, b.feeds, E)
+                times[j], feeds[j], nvalid[j], seqs[j] = \
+                    t, f, n, int(b.seq)
+        with _telemetry.span("serving.dispatch"):
+            new_state, (posted, t_new, lam) = self._apply_many(
+                self._state, times, feeds, nvalid, seqs, np.int32(k),
+                self._s_sink, self._q)
         # The ONE deliberate device→host boundary of the coalesced apply
         # path: one transfer per poll ROUND (amortized over the group),
         # not per batch.
-        posted, t_new, lam = jax.device_get((posted, t_new, lam))  # rqlint: disable=RQ702 per-round decision boundary
+        with _telemetry.span("serving.sync"):
+            posted, t_new, lam = jax.device_get((posted, t_new, lam))  # rqlint: disable=RQ702 per-round decision boundary
         stale = self.pending
         decisions = [
             Decision(seq=int(b.seq), post=bool(posted[j]),
@@ -561,13 +585,14 @@ class ServingRuntime:
                     f"state can no longer be made durable; restart and "
                     f"recover from {self.dir}") from e
             self._post_append_faults(int(group[-1][0].seq))
-        self._state = new_state
-        self._last_decision = decisions[-1]
-        now = self._clock()
-        for (b, at), d in zip(group, decisions):
-            self.metrics.observe_apply(
-                b.n_events, d.post, None if at is None else now - at)
-        self._since_snapshot += k
+        with _telemetry.span("serving.ack"):
+            self._state = new_state
+            self._last_decision = decisions[-1]
+            now = self._clock()
+            for (b, at), d in zip(group, decisions):
+                self.metrics.observe_apply(
+                    b.n_events, d.post, None if at is None else now - at)
+            self._since_snapshot += k
         if self.dir is not None and \
                 self._since_snapshot >= self.snapshot_every:
             self.snapshot()
@@ -596,23 +621,26 @@ class ServingRuntime:
         wire-speed ingest path).  Bounding the per-poll work is the
         overload throttle: a slow consumer polls small, the queue fills,
         and submit() starts shedding — bounded memory, no deadlock."""
-        out: List[Decision] = []
-        if self.coalesce == 1:
+        with _telemetry.span("serving.poll") as tsp:
+            out: List[Decision] = []
+            if self.coalesce == 1:
+                while self._queue and (max_batches is None
+                                       or len(out) < max_batches):
+                    batch, submitted_at = self._queue.popleft()
+                    out.append(self._apply_one(batch, submitted_at))
+                tsp.set(applied=len(out))
+                return out
             while self._queue and (max_batches is None
                                    or len(out) < max_batches):
-                batch, submitted_at = self._queue.popleft()
-                out.append(self._apply_one(batch, submitted_at))
+                limit = self.coalesce
+                if max_batches is not None:
+                    limit = min(limit, max_batches - len(out))
+                group = self._take_group(limit)
+                if not group:
+                    break
+                out.extend(self._apply_group(group))
+            tsp.set(applied=len(out))
             return out
-        while self._queue and (max_batches is None
-                               or len(out) < max_batches):
-            limit = self.coalesce
-            if max_batches is not None:
-                limit = min(limit, max_batches - len(out))
-            group = self._take_group(limit)
-            if not group:
-                break
-            out.extend(self._apply_group(group))
-        return out
 
     # ---- decision path (never blocks on the backlog) ----
 
@@ -643,24 +671,30 @@ class ServingRuntime:
         seq = self.applied_seq
         if seq < 0:
             return None
-        from ..utils import checkpoint as _checkpoint
-        from . import journal as _journal_mod
-
-        snap_dir = os.path.join(self.dir, _SNAPSHOTS)
-        _checkpoint.save(snap_dir, seq, self._state)
-        self._since_snapshot = 0
-        if self._journal is not None:
-            path = self._journal.path
-            self._journal.close()
-            _journal_mod.rotate(path, seq)
-            steps = [int(n) for n in os.listdir(snap_dir) if n.isdigit()]
-            if steps:
-                _journal_mod.prune_segments(path, min(steps))
-            self._journal = Journal(
-                path, fsync_every_n=self.fsync_every_n,
-                flush_mode=self.flush_mode,
-                max_unflushed_records=self.max_unflushed_records,
-                max_flush_delay_ms=self.max_flush_delay_ms)
+        with _telemetry.span("serving.snapshot") as tsp:
+            tsp.set(seq=seq)
+            # Inside the span on purpose: the FIRST snapshot pays the
+            # orbax import (~1s) right here, and unattributed it reads
+            # as mystery poll self-time in every breakdown (found by
+            # this subsystem's own rqtrace output).
+            from ..utils import checkpoint as _checkpoint
+            from . import journal as _journal_mod
+            snap_dir = os.path.join(self.dir, _SNAPSHOTS)
+            _checkpoint.save(snap_dir, seq, self._state)
+            self._since_snapshot = 0
+            if self._journal is not None:
+                path = self._journal.path
+                self._journal.close()
+                _journal_mod.rotate(path, seq)
+                steps = [int(n) for n in os.listdir(snap_dir)
+                         if n.isdigit()]
+                if steps:
+                    _journal_mod.prune_segments(path, min(steps))
+                self._journal = Journal(
+                    path, fsync_every_n=self.fsync_every_n,
+                    flush_mode=self.flush_mode,
+                    max_unflushed_records=self.max_unflushed_records,
+                    max_flush_delay_ms=self.max_flush_delay_ms)
         return seq
 
     def durability(self) -> Dict[str, Any]:

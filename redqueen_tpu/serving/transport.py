@@ -68,12 +68,46 @@ __all__ = [
     "connect_worker",
     "HELLO_KIND",
     "ENV_WORKER_TOKEN",
+    "TRACE_KEY",
+    "attach_trace",
+    "extract_trace",
 ]
 
 HELLO_KIND = "hello"
 # The cluster token travels by environment, never argv: a secret on the
 # command line is visible to every local `ps`.
 ENV_WORKER_TOKEN = "RQ_WORKER_TOKEN"
+
+#: Reserved frame field carrying the telemetry trace context
+#: (``{"tid", "sid"}``) across the worker protocol — pipes AND sockets
+#: ride the same frames, so one request's spans stitch across processes
+#: and hosts with no second mechanism.  Absent when tracing is off (the
+#: wire cost of disabled telemetry is zero bytes).
+TRACE_KEY = "trace"
+
+
+def attach_trace(frame: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp the CURRENT telemetry wire context onto an outgoing
+    request frame (mutates + returns it): the live ``{"tid", "sid"}``,
+    or the explicit ``{"drop": 1}`` marker inside a sampled-OUT trace
+    (the receiver must drop the subtree too — sampling is trace-global,
+    never per-process).  No-op when tracing is disabled or no span is
+    open."""
+    from ..runtime import telemetry as _telemetry
+
+    ctx = _telemetry.wire_context()
+    if ctx is not None:
+        frame[TRACE_KEY] = ctx
+    return frame
+
+
+def extract_trace(frame: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The trace context a received frame carries, or None.  Feed it to
+    ``runtime.telemetry.attach`` so the handler's spans chain under the
+    remote sender's span."""
+    ctx = frame.get(TRACE_KEY)
+    return ctx if isinstance(ctx, dict) else None
+
 
 MAGIC = b"RQF1"
 _HEADER = struct.Struct(">4sII")  # magic, payload length, crc32(payload)

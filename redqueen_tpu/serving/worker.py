@@ -67,12 +67,13 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..runtime import faultinject as _faultinject
+from ..runtime import telemetry as _telemetry
 from ..runtime.supervisor import RetryPolicy as _RetryPolicy
 from .events import EventBatch
 from .transport import (ENV_WORKER_TOKEN, FrameError, FrameReader,
                         Listener, TransportEOF, TransportError,
-                        TransportTimeout, connect_worker, encode_frame,
-                        write_frame)
+                        TransportTimeout, attach_trace, connect_worker,
+                        encode_frame, extract_trace, write_frame)
 
 __all__ = ["WorkerHandle", "SocketWorkerHandle", "WorkerOpError", "main",
            "HANG_FIRES", "ENV_HANG_FIRES",
@@ -221,6 +222,15 @@ class _Worker:
         # lost response frame (replay_decisions).
         self._recent: collections.deque = collections.deque(
             maxlen=RECENT_DECISIONS)
+        # Flight recorder: when tracing is on (RQ_TRACE / RQ_TRACE_FLIGHT
+        # inherited through the spawn env), this process mirrors its
+        # spans into a fixed-size ring INSIDE the shard directory — the
+        # evidence a SIGKILL leaves behind, salvaged by the router's
+        # crash path (cluster._crash_slot) and readable by any operator.
+        tel = _telemetry.get()
+        if tel.enabled and tel.flight_path is None and dir:
+            tel.configure(
+                flight=os.path.join(dir, _telemetry.FLIGHT_FILENAME))
 
     # -- link management (socket mode) --
 
@@ -510,6 +520,15 @@ class _Worker:
         if op == "reset_metrics":
             self.rt.reset_metrics()
             return True, {}
+        if op == "telemetry":
+            # The router's live-forensics read: this process's recent
+            # spans + counters (the crash path reads the on-disk ring
+            # instead — a dead process answers no ops).
+            tel = _telemetry.get()
+            return True, {"spans": tel.recent_spans(
+                              int(req.get("limit", 512))),
+                          "counters": dict(tel.counters),
+                          "pid": os.getpid()}
         raise ValueError(f"unknown worker op {op!r}")
 
     def serve(self) -> int:
@@ -552,11 +571,19 @@ class _Worker:
                     self.rt.close()
                 self._respond(req_id, {}, op)
                 return 0
-            try:
-                respond, value = self._handle(req)
-            except Exception as e:  # noqa: BLE001 — classified router-side
-                self._fail(req_id, op, e)
-                continue
+            # Adopt the request's trace context (when the frame carries
+            # one): this worker's spans chain under the router's span,
+            # so one request's timeline stitches across the process —
+            # and across hosts in socket mode (same frames).
+            with _telemetry.attach(extract_trace(req)):
+                with _telemetry.span("worker." + op) as tsp:
+                    tsp.set(shard=self.shard)
+                    try:
+                        respond, value = self._handle(req)
+                    except Exception as e:  # noqa: BLE001 — classified
+                        # router-side
+                        self._fail(req_id, op, e)
+                        continue
             if respond:
                 self._respond(req_id, value, op)
 
@@ -684,7 +711,11 @@ class WorkerHandle:
     def _send(self, op: str, **fields) -> int:
         self._next_id += 1
         req_id = self._next_id
-        frame = {"kind": "req", "id": req_id, "op": op, **fields}
+        # attach_trace stamps the current telemetry context (when
+        # tracing is on) so the worker's spans chain under this
+        # request's span — the cross-process half of one trace.
+        frame = attach_trace(
+            {"kind": "req", "id": req_id, "op": op, **fields})
         try:
             write_frame(self._wfd, frame)
         except (OSError, ValueError) as e:
@@ -929,6 +960,13 @@ class WorkerHandle:
 
     def reset_metrics(self) -> None:
         self.request("reset_metrics")
+
+    def telemetry(self, limit: int = 512) -> Dict[str, Any]:
+        """The worker process's recent telemetry: ``{"spans": [...],
+        "counters": {...}, "pid": ...}`` (empty when tracing is off in
+        the child).  The live counterpart of the crash path's on-disk
+        flight-ring salvage."""
+        return self.request("telemetry", limit=int(limit))
 
     def gather(self) -> Tuple[np.ndarray, np.ndarray, int, float, int]:
         """The shard's per-edge carry for the cluster's edge-digest /

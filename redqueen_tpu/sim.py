@@ -31,6 +31,7 @@ from .config import SimConfig, SimState, SourceParams
 from .ops.scan_core import init_state, make_run_chunk
 from .runtime import faultinject as _faultinject
 from .runtime import numerics as _numerics
+from .runtime import telemetry as _telemetry
 from .runtime.numerics import NumericalHealthError
 
 # Importing the models package registers the built-in policies (the
@@ -346,41 +347,61 @@ def _drive(cfg, params, adj, state, chunk_fn_for, max_chunks, batched,
     n_before = state.n_events  # resume(): count only this drive's events
     cap = cfg.capacity
     k = 1
-    while True:
-        n_dispatches += 1
-        state, t_sc, s_sc, c, alive = chunk_fn_for(k)(
-            # np.int32 of two HOST ints (no transfer; keeps the chunk
-            # budget weak-type-stable across dispatches)
-            params, adj, state,
-            np.int32(max_chunks - n_chunks),  # rqlint: disable=RQ701 host ints
-        )
-        k = sync_every
-        # The ONE host sync per superchunk: chunks executed + liveness.
-        # Reduced to REPLICATED scalars on-device first: a fully-replicated
-        # value is readable on every process, so the same driver serves
-        # multihost runs (where the [B] lanes span processes and could not
-        # be fetched whole) — and only two scalars cross to the host.
-        c_max_dev, alive_dev = _sync_reduce(c, alive)
-        # rqlint: RQ702 pragmas — this IS the deliberate, cadence-
-        # controlled sync the comment above documents (two replicated
-        # scalars per superchunk, not per event); sanctioning it here
-        # keeps every simulate()/sweep caller's summary clean.
-        c_max = int(c_max_dev)  # rqlint: disable=RQ702 the one sync/superchunk
-        alive_any = bool(alive_dev)  # rqlint: disable=RQ702 same sync point
-        # Trim unused chunk slots so the returned buffers are bit-identical
-        # to the per-chunk driver's (goldens/parity unchanged).
-        times_chunks.append(t_sc[..., : c_max * cap])
-        srcs_chunks.append(s_sc[..., : c_max * cap])
-        n_chunks += c_max
-        if not alive_any:
-            break
-        if n_chunks >= max_chunks:
-            done = _host_view(state.n_events)
-            raise RuntimeError(
-                f"simulation still active after {n_chunks} chunks of "
-                f"{cfg.capacity} events (events so far: {done}); raise "
-                f"capacity or max_chunks — refusing to truncate silently"
-            )
+    # The with-statement (not a manual __enter__/__exit__) so a raising
+    # drive stamps its error attribute on the span; the inner finally
+    # records the progress attrs on BOTH exits.
+    with _telemetry.span("engine.scan.drive", batched=batched) as dsp:
+        try:
+            while True:
+                n_dispatches += 1
+                with _telemetry.span("engine.scan.superchunk") as ssp:
+                    ssp.set(k=k)
+                    state, t_sc, s_sc, c, alive = chunk_fn_for(k)(
+                        # np.int32 of two HOST ints (no transfer; keeps
+                        # the chunk budget weak-type-stable across
+                        # dispatches)
+                        params, adj, state,
+                        np.int32(max_chunks - n_chunks),  # rqlint: disable=RQ701 host ints
+                    )
+                k = sync_every
+                # The ONE host sync per superchunk: chunks executed +
+                # liveness.  Reduced to REPLICATED scalars on-device
+                # first: a fully-replicated value is readable on every
+                # process, so the same driver serves multihost runs
+                # (where the [B] lanes span processes and could not be
+                # fetched whole) — and only two scalars cross to the
+                # host.  The superchunk span above measured the ENQUEUE
+                # (async dispatch); the device wait surfaces in this
+                # sync span — the per-stage split the breakdowns rely
+                # on.
+                with _telemetry.span("engine.scan.sync"):
+                    c_max_dev, alive_dev = _sync_reduce(c, alive)
+                    # rqlint: RQ702 pragmas — this IS the deliberate,
+                    # cadence-controlled sync the comment above
+                    # documents (two replicated scalars per superchunk,
+                    # not per event); sanctioning it here keeps every
+                    # simulate()/sweep caller's summary clean.
+                    c_max = int(c_max_dev)  # rqlint: disable=RQ702 the one sync/superchunk
+                    alive_any = bool(alive_dev)  # rqlint: disable=RQ702 same sync point
+                # Trim unused chunk slots so the returned buffers are
+                # bit-identical to the per-chunk driver's (goldens/
+                # parity unchanged).
+                times_chunks.append(t_sc[..., : c_max * cap])
+                srcs_chunks.append(s_sc[..., : c_max * cap])
+                n_chunks += c_max
+                if not alive_any:
+                    break
+                if n_chunks >= max_chunks:
+                    done = _host_view(state.n_events)
+                    raise RuntimeError(
+                        f"simulation still active after {n_chunks} "
+                        f"chunks of {cfg.capacity} events (events so "
+                        f"far: {done}); raise capacity or max_chunks — "
+                        f"refusing to truncate silently"
+                    )
+        finally:
+            dsp.set(dispatches=n_dispatches, chunks=n_chunks)
+    _telemetry.counter("engine.scan.dispatches", n_dispatches)
     axis = 1 if batched else 0
     times = jnp.concatenate(times_chunks, axis=axis)
     srcs = jnp.concatenate(srcs_chunks, axis=axis)
@@ -530,9 +551,19 @@ def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
             if name == "pallas":
                 from .ops.pallas_engine import simulate_pallas
 
+                _telemetry.event("engine.dispatch", engine="pallas",
+                                 requested=engine)
+                _telemetry.counter("engine.dispatch.pallas")
                 return simulate_pallas(cfg, params, adj, seeds,
                                        max_chunks=max_chunks,
                                        sync_every=sync_every)
+    # The dispatch-choice provenance, telemetry-side: which engine ran
+    # and (for auto/pallas requests that fell back) why — the same fact
+    # EventLog.engine_reason carries, folded into the one trace so
+    # rqtrace breakdowns never need the ad-hoc field.
+    _telemetry.event("engine.dispatch", engine="scan", requested=engine,
+                     reason=engine_reason)
+    _telemetry.counter("engine.dispatch.scan")
     seeds = jnp.asarray(seeds)
     keys = jax.vmap(jr.PRNGKey)(seeds) if seeds.ndim == 1 else seeds
     state = _init_fn(cfg, True)(params, adj, keys)

@@ -29,7 +29,7 @@ MODULES = [
     "redqueen_tpu.runtime", "redqueen_tpu.runtime.faultinject",
     "redqueen_tpu.runtime.preempt", "redqueen_tpu.runtime.artifacts",
     "redqueen_tpu.runtime.integrity", "redqueen_tpu.runtime.watchdog",
-    "redqueen_tpu.runtime.numerics",
+    "redqueen_tpu.runtime.numerics", "redqueen_tpu.runtime.telemetry",
     "redqueen_tpu.learn", "redqueen_tpu.learn.ingest",
     "redqueen_tpu.learn.loglik", "redqueen_tpu.learn.hawkes_mle",
     "redqueen_tpu.learn.control", "redqueen_tpu.learn.ckpt",

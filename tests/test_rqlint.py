@@ -444,6 +444,73 @@ class TestRQ601:
 
 
 # ---------------------------------------------------------------------------
+# RQ901 — telemetry discipline (raw timer pairs in instrumented trees)
+# ---------------------------------------------------------------------------
+
+RAW_TIMER_PAIR = """\
+    import time
+    def apply(batch, fn):
+        t0 = time.perf_counter()
+        out = fn(batch)
+        lat = time.perf_counter() - t0
+        return out, lat
+"""
+
+
+class TestRQ901:
+    def test_fires_in_serving_tree(self):
+        fs = lint(RAW_TIMER_PAIR, "redqueen_tpu/serving/service.py",
+                  ["RQ901"])
+        assert ids(fs) == ["RQ901"] and fs[0].line == 3
+
+    def test_fires_in_ops_tree_even_when_synchronized(self):
+        # RQ601's block_until_ready escape does NOT apply: the pair
+        # itself is the finding — the measurement bypasses telemetry.
+        src = """\
+            import time
+            import jax
+            def launch(fn):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                return time.perf_counter() - t0
+        """
+        assert ids(lint(src, "redqueen_tpu/ops/pallas_engine.py",
+                        ["RQ901"])) == ["RQ901"]
+
+    def test_out_of_scope_trees_are_not_checked(self):
+        for path in ("bench.py", "redqueen_tpu/learn/hawkes_mle.py",
+                     "redqueen_tpu/runtime/telemetry.py",
+                     "tools/telemetry_overhead.py"):
+            assert lint(RAW_TIMER_PAIR, path, ["RQ901"]) == []
+
+    def test_injected_clock_callables_do_not_match(self):
+        # serving.metrics' determinism-for-tests pattern: clock() via an
+        # injected callable is not a raw perf-counter pair.
+        src = """\
+            import time
+            class M:
+                def __init__(self, clock=time.monotonic):
+                    self._clock = clock
+                    self.t0 = self._clock()
+                def busy(self):
+                    return self._clock() - self.t0
+        """
+        assert lint(src, "redqueen_tpu/serving/metrics.py",
+                    ["RQ901"]) == []
+
+    def test_pragma_suppresses_with_justification(self):
+        src = """\
+            import time
+            def audit(fn):
+                t0 = time.perf_counter()  # rqlint: disable=RQ901 measuring telemetry itself
+                fn()
+                return time.perf_counter() - t0
+        """
+        fs = lint(src, "redqueen_tpu/serving/service.py", ["RQ901"])
+        assert [f.rule for f in fs if not f.suppressed] == []
+
+
+# ---------------------------------------------------------------------------
 # Engine: RQ000, crash isolation, single parse
 # ---------------------------------------------------------------------------
 
@@ -490,7 +557,7 @@ class TestEngine:
 
     def test_registry_covers_every_band(self):
         bands = {r.id[:3] for r in (cls() for cls in REGISTRY)}
-        assert {"RQ1", "RQ2", "RQ3", "RQ4", "RQ5", "RQ6"} <= bands
+        assert {"RQ1", "RQ2", "RQ3", "RQ4", "RQ5", "RQ6", "RQ9"} <= bands
         assert len(REGISTRY) >= 6
 
 

@@ -1,0 +1,760 @@
+"""Unified telemetry (ISSUE 13): the span/counter subsystem, the
+flight-recorder ring, cross-process trace propagation, and the rqtrace
+breakdowns.
+
+Fast tests cover the span model (parents, attrs, events, sampling,
+remote-context adoption), the disabled-mode cost contract (shared
+no-op singleton, ZERO surviving allocations), the on-disk ring
+(wraparound, torn-slot salvage, detail degradation), the one-histogram
+contract with serving.metrics, the summarize/rqtrace aggregation, and
+the serving span chain end to end in process.
+
+The ``@pytest.mark.slow`` scenarios pay real worker processes — THE
+acceptance cases:
+
+- **SIGKILL + restart**: a worker kills itself mid-stream
+  (``worker:kill``); the router salvages its flight ring into the
+  crash report (spans carrying the live trace id), the replacement
+  process serves the SAME trace id, and the stream converges.
+- **net:partition**: the socket link dies with a response unsent; the
+  healed link's spans still carry the router's trace id (the context
+  rides the frames, so a reattach needs no re-negotiation).
+
+tier-1 (``-m 'not slow'``) skips the process trees; tools/ci.sh runs
+this file UNFILTERED in the telemetry pass before tier-1.
+"""
+
+import gc
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from redqueen_tpu.runtime import telemetry as T
+from redqueen_tpu.runtime import integrity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """The module-level default instance is process-global state: every
+    test starts and ends disabled, unsampled, empty, ring-less."""
+    tel = T.get()
+    tel.close()
+    tel.configure(enabled=False, sample=1.0, reset=True)
+    yield tel
+    tel.close()
+    tel.configure(enabled=False, sample=1.0, reset=True)
+
+
+# ---------------------------------------------------------------------------
+# Span model
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_parent_links_and_trace_id(self):
+        tel = T.Telemetry(enabled=True)
+        with tel.trace("root") as r:
+            with tel.span("a") as a:
+                with tel.span("a.1"):
+                    pass
+            with tel.span("b"):
+                pass
+        spans = {s["name"]: s for s in tel.drain_spans()}
+        assert spans["root"].get("parent") is None
+        assert spans["a"]["parent"] == spans["root"]["sid"]
+        assert spans["a.1"]["parent"] == spans["a"]["sid"]
+        assert spans["b"]["parent"] == spans["root"]["sid"]
+        assert len({s["tid"] for s in spans.values()}) == 1
+        assert all(s["dur"] >= 0 for s in spans.values())
+        assert r.tid == a.tid
+
+    def test_attrs_events_and_error_capture(self):
+        tel = T.Telemetry(enabled=True)
+        with pytest.raises(ValueError):
+            with tel.trace("r", kind="test") as sp:
+                sp.set(extra=1)
+                sp.event("hit", at="mid")
+                raise ValueError("boom")
+        (s,) = tel.drain_spans()
+        assert s["attrs"]["kind"] == "test"
+        assert s["attrs"]["extra"] == 1
+        assert s["attrs"]["error"] == "ValueError"
+        name, off, attrs = s["events"][0]
+        assert name == "hit" and off >= 0 and attrs == {"at": "mid"}
+
+    def test_span_without_open_trace_becomes_root(self):
+        tel = T.Telemetry(enabled=True)
+        with tel.span("orphan"):
+            pass
+        (s,) = tel.drain_spans()
+        assert "parent" not in s
+
+    def test_event_without_span_records_a_zero_duration_root(self):
+        # provenance events (engine dispatch choice, VMEM plan) must
+        # reach the trace even with no enclosing span
+        tel = T.Telemetry(enabled=True)
+        tel.event("engine.dispatch", engine="scan")
+        (s,) = tel.drain_spans()
+        assert s["dur"] == 0.0 and "parent" not in s
+        assert s["attrs"] == {"engine": "scan"}
+
+    def test_context_and_attach_stitch_processes(self):
+        a = T.Telemetry(enabled=True)
+        b = T.Telemetry(enabled=True)
+        with a.trace("req"):
+            ctx = a.context()
+            assert set(ctx) == {"tid", "sid"}
+        with b.attach(ctx):
+            with b.span("remote.child"):
+                pass
+        (s,) = b.drain_spans()
+        assert s["tid"] == ctx["tid"] and s["parent"] == ctx["sid"]
+
+    def test_attach_rejects_garbage_quietly(self):
+        tel = T.Telemetry(enabled=True)
+        for bad in (None, {}, {"tid": "x"}, {"tid": "x", "sid": "nope"},
+                    "not-a-dict"):
+            scope = tel.attach(bad)
+            with scope:
+                pass
+        assert tel.drain_spans() == []
+
+    def test_unsampled_trace_suppresses_whole_subtree(self):
+        tel = T.Telemetry(enabled=True, sample=0.0)
+        with tel.trace("r"):
+            assert tel.context() is None  # receiver records nothing too
+            with tel.span("child"):
+                with tel.span("grandchild"):
+                    pass
+        assert tel.drain_spans() == []
+        assert tel.counters == {}
+
+    def test_sampled_out_trace_propagates_the_drop(self):
+        """Sampling is trace-GLOBAL: a sampled-out sender exports an
+        explicit drop marker on the wire (not a missing context), and
+        the receiver suppresses the subtree instead of minting orphan
+        root traces of its own."""
+        from redqueen_tpu.serving.transport import (attach_trace,
+                                                    extract_trace)
+
+        sender = T.Telemetry(enabled=True, sample=0.0)
+        with sender.trace("r"):
+            assert sender.wire_context() == {"drop": 1}
+        receiver = T.Telemetry(enabled=True)
+        with receiver.attach({"drop": 1}):
+            with receiver.span("worker.op"):
+                pass
+        assert receiver.drain_spans() == []
+        # and with NO trace open, the frame carries nothing — the
+        # receiver's own tracing policy applies
+        T.configure(enabled=True, reset=True)
+        frame = attach_trace({"kind": "req"})
+        assert extract_trace(frame) is None
+
+    def test_sampling_is_deterministic_per_trace_id(self):
+        a = T.Telemetry(enabled=True, sample=0.5)
+        b = T.Telemetry(enabled=True, sample=0.5)
+        tids = [f"trace-{i}" for i in range(64)]
+        da = [a._sampled(t) for t in tids]
+        db = [b._sampled(t) for t in tids]
+        assert da == db  # every process in a trace agrees
+        assert any(da) and not all(da)
+
+    def test_counters_and_histograms(self):
+        tel = T.Telemetry(enabled=True)
+        tel.counter("x")
+        tel.counter("x", 2)
+        tel.observe("lat", 0.001)
+        tel.observe("lat", None)  # dropped, not an error
+        assert tel.counters == {"x": 3}
+        assert tel.histograms["lat"].count == 1
+
+    def test_buffer_bound_counts_drops(self):
+        tel = T.Telemetry(enabled=True, max_spans=3)
+        for i in range(5):
+            with tel.trace(f"s{i}"):
+                pass
+        assert len(tel.spans) == 3 and tel.spans_dropped == 2
+        assert tel.payload()["spans_dropped"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Disabled-mode cost contract
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledCost:
+    def test_every_disabled_call_returns_the_shared_singleton(self):
+        tel = T.Telemetry(enabled=False)
+        assert tel.span("a") is tel.span("b") is T.NULL_SPAN
+        assert tel.trace("c") is T.NULL_SPAN
+        assert tel.attach({"tid": "t", "sid": 1}) is T.NULL_SPAN
+        assert tel.context() is None
+        # the singleton absorbs the whole span surface
+        with T.NULL_SPAN as s:
+            assert s.set(a=1) is s and s.event("e") is s
+
+    def test_disabled_mode_zero_surviving_allocations(self):
+        tel = T.get()
+        assert not tel.enabled
+
+        def loop(n):
+            for _ in range(n):
+                with T.span("hot"):
+                    pass
+                T.counter("c")
+                T.observe("h", 0.1)
+                T.event("e")
+
+        loop(1000)  # warm every code path / cache
+        gc.collect()
+        before = sys.getallocatedblocks()
+        loop(5000)
+        gc.collect()
+        after = sys.getallocatedblocks()
+        # Interpreter background noise moves the block count by O(10);
+        # a real per-call retention would move it by O(5000) — the
+        # bound catches the regression class, not allocator jitter.
+        assert after - before <= 64, (
+            f"disabled telemetry retained {after - before} allocation "
+            f"blocks over 5000 iterations — the hot path must not "
+            f"keep anything when tracing is off")
+        assert tel.spans == [] and tel.counters == {}
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder ring
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_wraparound_keeps_the_newest(self, tmp_path):
+        p = str(tmp_path / "flight.ring")
+        tel = T.Telemetry(enabled=True, flight=p, flight_capacity=4)
+        for i in range(11):
+            with tel.trace(f"s{i}"):
+                pass
+        got = T.read_flight(p)
+        assert [g["name"] for g in got] == ["s7", "s8", "s9", "s10"]
+        assert [g["n"] for g in got] == [8, 9, 10, 11]
+        tel.close()
+
+    def test_missing_and_empty_rings_salvage_empty(self, tmp_path):
+        assert T.read_flight(str(tmp_path / "nope.ring")) == []
+        p = tmp_path / "empty.ring"
+        p.write_bytes(b"")
+        assert T.read_flight(str(p)) == []
+
+    def test_torn_slot_is_skipped_not_fatal(self, tmp_path):
+        p = str(tmp_path / "flight.ring")
+        tel = T.Telemetry(enabled=True, flight=p, flight_capacity=8)
+        for i in range(4):
+            with tel.trace(f"s{i}"):
+                pass
+        tel.close()
+        # scribble over slot 2 (span s1) — a torn concurrent pwrite
+        with open(p, "r+b") as f:
+            f.seek(2 * T.FLIGHT_SLOT_BYTES + 10)
+            f.write(b"\x00\xffGARBAGE")
+        names = [g["name"] for g in T.read_flight(p)]
+        assert names == ["s0", "s2", "s3"]
+
+    def test_oversized_span_degrades_detail_not_presence(self, tmp_path):
+        p = str(tmp_path / "flight.ring")
+        tel = T.Telemetry(enabled=True, flight=p, flight_capacity=4)
+        with tel.trace("fat") as sp:
+            sp.set(blob="x" * (2 * T.FLIGHT_SLOT_BYTES))
+            for i in range(30):
+                sp.event(f"e{i}")
+        (got,) = T.read_flight(p)
+        assert got["name"] == "fat"          # still evidence
+        assert "attrs" not in got            # detail shed to fit
+        tel.close()
+
+    def test_salvaged_ring_adopts_into_another_buffer(self, tmp_path):
+        p = str(tmp_path / "flight.ring")
+        child = T.Telemetry(enabled=True, flight=p)
+        with child.trace("child.work"):
+            pass
+        child.close()
+        router = T.Telemetry(enabled=True)
+        n = router.adopt_spans(T.read_flight(p))
+        assert n == 1
+        (s,) = router.drain_spans()
+        assert s["name"] == "child.work" and "n" not in s
+
+    def test_supervisor_salvages_child_ring_into_the_run_report(
+            self, tmp_path):
+        """Supervisor(flight_path=...): the child's telemetry mirrors
+        into the ring (RQ_TRACE_FLIGHT via the attempt env), and a
+        FAILED attempt's last spans land on the RunReport — a crashed
+        child still testifies."""
+        from redqueen_tpu.runtime.supervisor import (RetryPolicy,
+                                                     Supervisor)
+
+        ring = str(tmp_path / "child.flight.ring")
+        code = ("from redqueen_tpu.runtime import telemetry as T\n"
+                "t = T.get()\n"
+                "assert t.enabled and t.flight_path\n"
+                "with t.trace('child.final-moments'):\n"
+                "    pass\n"
+                "raise SystemExit(7)\n")
+        sup = Supervisor(name="flight-test",
+                         retry=RetryPolicy(max_attempts=1,
+                                           base_delay_s=0.0),
+                         deadline_s=60.0, backend="cpu",
+                         allow_degrade=False, flight_path=ring,
+                         cwd=REPO)
+        report = sup.run([sys.executable, "-c", code])
+        assert not report.ok
+        att = report.attempts[-1]
+        assert any(s.get("name") == "child.final-moments"
+                   for s in att.flight)
+        assert att.to_dict()["flight_spans"]
+        assert not os.path.exists(ring)  # consumed, never re-reported
+        # a RELATIVE flight path is absolute-ized at construction —
+        # under a cwd= override the child would otherwise write one
+        # file while the parent salvages another
+        rel = Supervisor(name="x", flight_path="rel.ring")
+        assert os.path.isabs(rel.flight_path)
+
+    def test_env_flight_implies_enabled(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(T.ENV_TRACE_FLIGHT,
+                           str(tmp_path / "flight.ring"))
+        monkeypatch.delenv(T.ENV_TRACE, raising=False)
+        tel = T.Telemetry()
+        tel.configure_from_env()
+        assert tel.enabled and tel.flight_path is not None
+        tel.close()
+
+
+# ---------------------------------------------------------------------------
+# One histogram implementation
+# ---------------------------------------------------------------------------
+
+
+class TestOneHistogram:
+    def test_serving_metrics_is_a_consumer_not_a_second_definition(self):
+        from redqueen_tpu.serving import metrics as smetrics
+
+        assert smetrics._latency_percentiles is T.latency_percentiles
+        assert smetrics.TRIM_FRACTION == T.TRIM_FRACTION
+        assert smetrics.PCTL_WINDOW == T.PCTL_WINDOW
+
+    def test_histogram_report_matches_the_shared_definition(self):
+        h = T.Histogram(window=128)
+        vals = [0.001 * (i % 7 + 1) for i in range(300)]
+        for v in vals:
+            h.observe(v)
+        assert h.count == 300
+        assert h.percentiles() == T.latency_percentiles(vals[-128:])
+
+    def test_metrics_observe_feeds_the_telemetry_histogram(self):
+        from redqueen_tpu.serving.metrics import ServingMetrics
+
+        T.configure(enabled=True, reset=True)
+        m = ServingMetrics()
+        m.observe_apply(4, True, 0.002)
+        m.observe_apply(4, False, None)  # no latency -> no observation
+        h = T.get().histograms["serving.decision_latency_s"]
+        assert h.count == 1
+
+    def test_runtime_and_router_latencies_are_distinct_histograms(self):
+        """In-process cluster placement: the runtime AND the router
+        both observe the same decision — two different latency
+        definitions that must land in two histograms, never blended or
+        double-counted into one."""
+        from redqueen_tpu.serving.metrics import (ClusterMetrics,
+                                                  ServingMetrics)
+
+        T.configure(enabled=True, reset=True)
+        sm, cm = ServingMetrics(), ClusterMetrics(n_shards=1)
+        for _ in range(4):
+            sm.observe_apply(2, True, 0.001)
+            cm.observe_applied(0, 2, True, 0.002)
+        hs = T.get().histograms
+        assert hs["serving.decision_latency_s"].count == 4
+        assert hs["cluster.decision_latency_s"].count == 4
+
+
+# ---------------------------------------------------------------------------
+# summarize / rqtrace
+# ---------------------------------------------------------------------------
+
+
+def _span(tid, sid, name, dur, parent=None):
+    d = {"tid": tid, "sid": sid, "name": name, "t": 0.0, "dur": dur,
+         "pid": 1}
+    if parent is not None:
+        d["parent"] = parent
+    return d
+
+
+class TestSummarize:
+    def test_coverage_self_time_and_critical_path(self):
+        spans = [
+            _span("t", 1, "root", 10.0),
+            _span("t", 2, "a", 6.0, parent=1),
+            _span("t", 3, "b", 3.0, parent=1),
+            _span("t", 4, "a.inner", 4.0, parent=2),
+        ]
+        s = T.summarize(spans)
+        assert s["wall_s"] == 10.0
+        assert s["coverage"] == pytest.approx(0.9)  # a + b over root
+        assert s["stages"]["root"]["self_s"] == pytest.approx(1.0)
+        assert s["stages"]["a"]["self_s"] == pytest.approx(2.0)
+        assert [h["name"] for h in s["critical_path"]] == \
+            ["root", "a", "a.inner"]
+
+    def test_orphan_parents_count_as_roots(self):
+        spans = [_span("t", 7, "salvaged", 2.0, parent=99)]
+        s = T.summarize(spans)
+        assert s["n_roots"] == 1 and s["wall_s"] == 2.0
+
+    def test_cycles_cannot_hang_the_analysis(self):
+        # self-parenting + a 2-cycle (corrupt or pre-unique-sid data):
+        # summarize must terminate and degrade, never spin
+        spans = [
+            _span("t", 1, "self", 1.0, parent=1),
+            _span("t", 2, "a", 1.0, parent=3),
+            _span("t", 3, "b", 1.0, parent=2),
+        ]
+        s = T.summarize(spans)
+        assert s["n_spans"] == 3
+        assert len(s["critical_path"]) <= 3
+
+    def test_sids_are_process_unique_within_a_trace(self):
+        # two instances (stand-ins for two processes) joining one trace
+        # must not collide span ids — the cross-process stitching bug
+        # class the random sid base exists to kill
+        a = T.Telemetry(enabled=True)
+        b = T.Telemetry(enabled=True)
+        with a.trace("r"):
+            ctx = a.context()
+        with b.attach(ctx):
+            with b.span("remote"):
+                pass
+        (ra,) = a.drain_spans()
+        (rb,) = b.drain_spans()
+        assert ra["sid"] != rb["sid"]
+        assert rb["parent"] == ra["sid"]
+
+    def test_empty_set(self):
+        s = T.summarize([])
+        assert s["coverage"] is None and s["critical_path"] == []
+
+
+class TestRqtraceCli:
+    def _export(self, tmp_path, tel):
+        path = str(tmp_path / "trace.json")
+        tel.export(path)
+        return path
+
+    def test_round_trip_render_and_coverage_gate(self, tmp_path, capsys):
+        from tools import rqtrace
+
+        tel = T.Telemetry(enabled=True)
+        with tel.trace("round"):
+            with tel.span("work"):
+                time.sleep(0.01)
+        path = self._export(tmp_path, tel)
+        payload = rqtrace.load_trace(path)
+        assert payload["n_spans"] == 2
+        out = io.StringIO()
+        report = rqtrace.render(rqtrace.merge_traces([payload]), out=out)
+        assert "work" in out.getvalue()
+        assert report["summary"]["coverage"] > 0.9
+        # CLI: pass and fail legs of --min-coverage
+        assert rqtrace.main([path, "--min-coverage", "0.5"]) == 0
+        assert rqtrace.main([path, "--min-coverage", "0.999999"]) == 1
+
+    def test_corrupt_artifact_fails_loudly(self, tmp_path):
+        from tools import rqtrace
+
+        tel = T.Telemetry(enabled=True)
+        with tel.trace("r"):
+            pass
+        path = self._export(tmp_path, tel)
+        blob = open(path).read().replace('"name"', '"nome"', 1)
+        open(path, "w").write(blob)
+        with pytest.raises(integrity.CorruptArtifactError):
+            rqtrace.load_trace(path)
+
+    def test_merge_sums_counters_and_stitches_spans(self, tmp_path):
+        from tools import rqtrace
+
+        a = T.Telemetry(enabled=True)
+        with a.trace("r"):
+            ctx = a.context()
+        a.counter("n", 2)
+        b = T.Telemetry(enabled=True)
+        with b.attach(ctx):
+            with b.span("remote"):
+                pass
+        b.counter("n", 3)
+        pa = str(tmp_path / "a.json")
+        pb = str(tmp_path / "b.json")
+        a.export(pa)
+        b.export(pb)
+        merged = rqtrace.merge_traces(
+            [rqtrace.load_trace(pa), rqtrace.load_trace(pb)])
+        assert merged["counters"] == {"n": 5}
+        s = T.summarize(merged["spans"])
+        # the remote span resolved its cross-process parent
+        assert s["n_roots"] == 1 and "remote" in s["stages"]
+
+
+# ---------------------------------------------------------------------------
+# The serving span chain (in process, fast)
+# ---------------------------------------------------------------------------
+
+
+SERVING_STAGES = {"serving.admit", "serving.poll", "serving.coalesce",
+                  "serving.dispatch", "serving.sync",
+                  "serving.journal.append", "serving.ack"}
+
+
+class TestServingSpanChain:
+    def _run(self, tmp_path, enabled):
+        from redqueen_tpu import serving
+
+        T.configure(enabled=enabled, reset=True)
+        rt = serving.ServingRuntime(
+            n_feeds=8, dir=str(tmp_path / "srv"), coalesce=4,
+            snapshot_every=4, max_batch_events=16)
+        batches = serving.synthetic_stream(0, 8, 8, events_per_batch=4)
+        with rt:
+            with T.trace("serve.round"):
+                for b in batches:
+                    rt.submit(b)
+                rt.poll()
+        return T.get().drain_spans()
+
+    def test_traced_run_emits_the_full_stage_chain(self, tmp_path):
+        spans = self._run(tmp_path, enabled=True)
+        names = {s["name"] for s in spans}
+        assert SERVING_STAGES <= names
+        assert "serving.snapshot" in names  # snapshot_every=4 fired
+        # one trace, fully parent-linked under the round root
+        assert len({s["tid"] for s in spans}) == 1
+        summ = T.summarize(spans)
+        assert summ["n_roots"] == 1
+        assert summ["coverage"] > 0.9
+
+    def test_disabled_run_records_nothing(self, tmp_path):
+        assert self._run(tmp_path, enabled=False) == []
+
+    def test_engine_spans(self):
+        from redqueen_tpu.config import GraphBuilder
+        from redqueen_tpu import sim
+
+        T.configure(enabled=True, reset=True)
+        gb = GraphBuilder(n_sinks=3, end_time=2.0)
+        gb.add_poisson(rate=2.0)
+        gb.add_opt(q=1.0)
+        cfg, params, adj = gb.build(capacity=64)
+        sim.simulate(cfg, params, adj, seed=0)
+        names = {s["name"] for s in T.get().drain_spans()}
+        assert {"engine.scan.drive", "engine.scan.superchunk",
+                "engine.scan.sync"} <= names
+
+    def test_learn_spans_with_sync_boundaries(self):
+        from redqueen_tpu.learn import fit_hawkes
+        from redqueen_tpu.learn.ingest import EventStream
+
+        T.configure(enabled=True, reset=True)
+        rng = np.random.default_rng(0)
+        t = np.sort(rng.uniform(0, 30, 200))
+        d = rng.integers(0, 2, 200).astype(np.int32)
+        fit_hawkes(EventStream(times=t, dims=d, n_dims=2, t_end=30.0),
+                   solver="em", max_iters=6, sync_every=3)
+        spans = T.get().drain_spans()
+        names = {s["name"] for s in spans}
+        assert {"learn.fit", "learn.em.iter", "learn.em.sync"} <= names
+        fit_span = next(s for s in spans if s["name"] == "learn.fit")
+        iters = [s for s in spans if s["name"] == "learn.em.iter"]
+        assert all(s["parent"] == fit_span["sid"] for s in iters)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process propagation + flight salvage (slow: real workers)
+# ---------------------------------------------------------------------------
+
+
+WORKER_PARAMS = dict(n_feeds=12, n_shards=2, snapshot_every=10 ** 9,
+                     reorder_window=4, queue_capacity=64)
+N_BATCHES = 10
+
+
+def _batches(n=N_BATCHES, n_feeds=WORKER_PARAMS["n_feeds"]):
+    from redqueen_tpu import serving
+
+    return serving.synthetic_stream(0, n, n_feeds, events_per_batch=5)
+
+
+def _drain(cl, batches, root, rounds=16, sleep_s=0.2):
+    """Retransmit until convergence, every round inside the SAME root
+    trace (the long-lived stream context the propagation tests pin)."""
+    for _ in range(rounds):
+        cl.poll()
+        missing = [b for b in batches if int(b.seq) > cl.applied_seq]
+        if not missing:
+            return
+        for b in missing:
+            cl.submit(b)
+            cl.poll()
+        time.sleep(sleep_s)
+    raise AssertionError(
+        f"stream did not converge: applied_seq={cl.applied_seq}")
+
+
+@pytest.mark.slow
+class TestWorkerPropagationAndSalvage:
+    def test_trace_id_survives_worker_sigkill_and_restart(
+            self, tmp_path, monkeypatch):
+        """THE acceptance scenario: worker 0 SIGKILLs itself after
+        journaling batch 2 (``worker:kill``).  The salvaged flight ring
+        lands in the crash report carrying the live trace id, the
+        REPLACEMENT process's spans carry the same trace id (the
+        context rides every frame), and the stream converges."""
+        from redqueen_tpu import serving
+        from redqueen_tpu.runtime import faultinject
+        from redqueen_tpu.runtime.supervisor import RetryPolicy
+
+        monkeypatch.setenv(T.ENV_TRACE, "1")  # children inherit
+        monkeypatch.setenv(faultinject.ENV_FAULT,
+                           "worker:kill@shard0,batch2")
+        T.configure(enabled=True, reset=True)
+        fast = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                           multiplier=2.0, max_delay_s=0.0, jitter=0.0,
+                           seed=0)
+        cl = serving.ServingCluster(
+            dir=str(tmp_path / "cl"), placement="workers",
+            restart_policy=fast, **WORKER_PARAMS)
+        batches = _batches()
+        with cl:
+            with T.trace("stream") as root:
+                tid = T.context()["tid"]
+                first_pid = cl._slots[0].runtime.proc.pid
+                for b in batches:
+                    cl.submit(b)
+                _drain(cl, batches, root)
+                assert cl.applied_seq == N_BATCHES - 1
+
+                # (a) the crash was real and the ring was salvaged into
+                # the crash report, spans carrying the live trace id
+                st = cl.metrics.shards[0]
+                assert st.crashes >= 1
+                assert st.flight_salvaged > 0
+                assert any(s.get("tid") == tid
+                           for s in st.flight_spans), \
+                    "salvaged flight spans lost the trace id"
+                rep = cl.metrics.report(cl.pending_by_shard,
+                                        cl.health_by_shard)
+                assert rep["shards"][0]["flight_spans"]
+
+                # (b) the dead worker's spans were adopted into the
+                # router's own buffer under their original ids
+                own_pid = os.getpid()
+                adopted = [s for s in T.get().recent_spans(10_000)
+                           if s.get("pid") not in (own_pid, None)
+                           and s.get("tid") == tid]
+                assert adopted, "no salvaged child span in the router " \
+                                "telemetry buffer"
+
+                # (c) the REPLACEMENT process serves the same trace id
+                new_handle = cl._slots[0].runtime
+                assert new_handle is not None
+                assert new_handle.proc.pid != first_pid
+                wtel = new_handle.telemetry()
+                assert wtel["pid"] == new_handle.proc.pid
+                assert any(s.get("tid") == tid for s in wtel["spans"]), \
+                    "replacement worker spans do not carry the trace id"
+
+    def test_worker_spans_chain_under_router_spans(self, tmp_path,
+                                                   monkeypatch):
+        """Propagation mechanics without chaos: a worker span's parent
+        resolves to a span the ROUTER recorded (the frame carried the
+        context), so one request renders as one stitched timeline."""
+        from redqueen_tpu import serving
+
+        monkeypatch.setenv(T.ENV_TRACE, "1")
+        T.configure(enabled=True, reset=True)
+        cl = serving.ServingCluster(
+            dir=str(tmp_path / "cl"), placement="workers",
+            **WORKER_PARAMS)
+        batches = _batches(4)
+        with cl:
+            with T.trace("stream"):
+                tid = T.context()["tid"]
+                for b in batches:
+                    cl.submit(b)
+                cl.poll()
+                wtel = cl._slots[0].runtime.telemetry()
+            router_spans = T.get().recent_spans(10_000)
+        worker_spans = [s for s in wtel["spans"] if s["tid"] == tid]
+        assert worker_spans
+        router_sids = {s["sid"] for s in router_spans
+                       if s["tid"] == tid}
+        tops = [s for s in worker_spans
+                if s["name"].startswith("worker.")]
+        assert tops and all(s.get("parent") in router_sids
+                            for s in tops)
+        # the worker-side serving chain nests under the worker op spans
+        assert any(s["name"] == "serving.admit" for s in worker_spans)
+        # merged, the whole thing reads as ONE trace
+        merged = router_spans + worker_spans
+        summ = T.summarize([s for s in merged if s["tid"] == tid])
+        assert summ["n_roots"] == 1
+
+
+@pytest.mark.slow
+class TestSocketPartitionPropagation:
+    def test_trace_context_survives_net_partition(self, tmp_path,
+                                                  monkeypatch):
+        """Socket placement under ``net:partition``: the link dies with
+        a response unsent, the worker redials, the router reattaches +
+        resyncs — and spans recorded AFTER the heal still carry the
+        router's trace id (the context rides every frame; a reattach
+        needs no re-negotiation).  No crash, no journal replay."""
+        from redqueen_tpu import serving
+        from redqueen_tpu.runtime import faultinject
+
+        monkeypatch.setenv(T.ENV_TRACE, "1")
+        monkeypatch.setenv(faultinject.ENV_FAULT,
+                           "net:partition@shard1,batch3")
+        T.configure(enabled=True, reset=True)
+        cl = serving.ServingCluster(
+            dir=str(tmp_path / "cl"), placement="sockets",
+            token="telemetry-test-token",
+            worker_request_timeout_s=1.5,
+            worker_reattach_grace_s=10.0, **WORKER_PARAMS)
+        batches = _batches()
+        with cl:
+            with T.trace("stream"):
+                tid = T.context()["tid"]
+                serving.drive(cl, batches, max_retransmit_rounds=8,
+                              retry_delay_s=0.4)
+                assert cl.applied_seq == N_BATCHES - 1
+                rep = cl.metrics.report(cl.pending_by_shard,
+                                        cl.health_by_shard)
+                assert rep["reconciles"]
+                assert rep["crashes"] == 0
+                assert rep["reattaches"] >= 1
+                # the telemetry op itself rides the HEALED link; the
+                # spans it returns include post-partition work under
+                # the same trace id
+                wtel = cl._slots[1].runtime.telemetry()
+                post = [s for s in wtel["spans"]
+                        if s.get("tid") == tid]
+                assert post, "no worker span carries the trace id " \
+                             "after the partition healed"

@@ -68,6 +68,22 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
     tests/test_rqlint.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== telemetry suite + overhead smoke =="
+# The unified-telemetry contracts, UNFILTERED (tier-1 runs the fast
+# subset; the @slow process trees gate every CI run here): span model +
+# sampling + disabled-mode zero-allocation, flight-ring wraparound and
+# torn-slot salvage, the one-histogram contract with serving.metrics,
+# rqtrace breakdown/coverage round trips, and THE cross-process
+# acceptance scenarios — trace-id propagation across a worker SIGKILL +
+# restart (the salvaged ring lands in the crash report; the replacement
+# process serves the same trace id) and across a socket net:partition.
+# The overhead smoke then pins the other end of the cost contract:
+# tracing-enabled wire-speed serving throughput within 5% of disabled
+# (interleaved best-of runs; one full retry absorbs an IO-stall wave).
+env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly
+env JAX_PLATFORMS=cpu python tools/telemetry_overhead.py
+
 echo "== learn suite (simulate->fit->control closed loop) =="
 # The learning subsystem's full pass, UNFILTERED: tier-1 runs the fast
 # subset (ingest/likelihood/solver/quarantine/checkpoint tests, incl.

@@ -2,7 +2,8 @@
 
 Stable ID bands: RQ1xx resilience, RQ2xx artifacts, RQ3xx numerics,
 RQ4xx trace-safety, RQ5xx PRNG discipline, RQ6xx benchmark honesty,
-RQ7xx hidden host-sync (tier-2), RQ8xx recompilation hazards (tier-2).
+RQ7xx hidden host-sync (tier-2), RQ8xx recompilation hazards (tier-2),
+RQ9xx telemetry discipline.
 RQ000 (unparseable file) is emitted by the engine itself, not a rule.
 Tier-2 rules carry ``needs_project`` and are skipped under
 ``--no-project`` (which therefore reproduces the tier-1 rule set).
@@ -22,6 +23,7 @@ from .numerics import RawNumericsRule
 from .prng import ConstantSeedRule, KeyReuseRule
 from .recompile import RecompilationHazardRule, WeakTypeWideningRule
 from .resilience import BackendGuardRule
+from .telemetry import RawTimerPairRule
 from .trace_safety import TraceSafetyRule
 
 REGISTRY = (
@@ -36,6 +38,7 @@ REGISTRY = (
     HotLoopTransferRule,
     RecompilationHazardRule,
     WeakTypeWideningRule,
+    RawTimerPairRule,
 )
 
 

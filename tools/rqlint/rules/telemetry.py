@@ -1,0 +1,80 @@
+"""RQ901 — raw perf-counter timing in telemetry-instrumented trees.
+
+The serving and ops trees are threaded through ``runtime.telemetry``
+spans: every hot-path stage (admit, coalesce, dispatch, journal, fsync,
+ack; superchunk launches and sync boundaries) reports into ONE
+instrumentation layer that the flight recorder, the exported
+``rq.telemetry.trace/1`` artifacts, and the ``rqtrace`` breakdowns all
+read.  A raw ``t0 = time.perf_counter(); ...; time.perf_counter() - t0``
+pair in those trees is a second, private timing channel — invisible to
+traces, unsampled, uncorrelated with any trace id, and the exact
+ad-hoc pattern the telemetry subsystem exists to replace.
+
+Detection mirrors RQ601's timed-region machinery (one scope, a clock
+assignment paired with a later elapsed-read of the same name), minus
+the ``block_until_ready`` escape — here the PAIR itself is the finding,
+synchronized or not.  Injected ``clock=`` callables (the
+determinism-for-tests pattern ``serving.metrics`` uses) do not match:
+only direct ``time.perf_counter`` / ``time.monotonic`` call pairs do.
+
+A deliberate host-side timing site that must not become a span (e.g. a
+measurement OF the telemetry layer itself) pins itself with
+``# rqlint: disable=RQ901 <why>`` at the clock-assignment line, which
+doubles as documentation that the site was audited — the RQ601
+pragma-justification contract.
+"""
+
+from __future__ import annotations
+
+from ..findings import finding_at
+from .base import Rule
+from .bench import _clock_call, _scope_nodes, _scopes
+
+import ast
+from typing import List, Optional, Tuple
+
+
+class RawTimerPairRule(Rule):
+    id = "RQ901"
+    name = "raw-perf-counter-pair"
+    description = ("raw perf-counter pair in a telemetry-instrumented "
+                   "tree — route the measurement through "
+                   "runtime.telemetry spans so it lands in traces, the "
+                   "flight recorder, and rqtrace breakdowns (pragma "
+                   "with justification for deliberate exceptions)")
+    paths = ("redqueen_tpu/serving/*.py", "redqueen_tpu/ops/*.py")
+
+    def check(self, ctx):
+        for scope in _scopes(ctx.tree):
+            nodes = _scope_nodes(scope, ctx.tree)
+            starts: List[Tuple[str, ast.Assign]] = []
+            reads: List[Tuple[str, ast.AST]] = []
+            for n in nodes:
+                if (isinstance(n, ast.Assign) and _clock_call(n.value)
+                        and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)):
+                    starts.append((n.targets[0].id, n))
+                if (isinstance(n, ast.BinOp)
+                        and isinstance(n.op, ast.Sub)
+                        and _clock_call(n.left)
+                        and isinstance(n.right, ast.Name)):
+                    reads.append((n.right.id, n))
+            for name, start in starts:
+                read = self._first_read_after(name, start, reads)
+                if read is None:
+                    continue
+                yield finding_at(
+                    self.id, ctx, start,
+                    f"raw perf-counter pair `{name}` (lines "
+                    f"{start.lineno}-{read.lineno}) times this region "
+                    f"outside the telemetry layer — wrap it in a "
+                    f"runtime.telemetry span (or counter/histogram) so "
+                    f"the measurement reaches traces and the flight "
+                    f"recorder")
+
+    @staticmethod
+    def _first_read_after(name: str, start: ast.Assign,
+                          reads) -> Optional[ast.AST]:
+        after = [r for n, r in reads
+                 if n == name and r.lineno > start.lineno]
+        return min(after, key=lambda r: r.lineno) if after else None

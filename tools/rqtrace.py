@@ -1,0 +1,172 @@
+"""rqtrace — render where-did-the-time-go breakdowns from telemetry
+trace artifacts.
+
+Reads one or many enveloped ``rq.telemetry.trace/1`` files (written by
+``runtime.telemetry.export`` — the serving bench, the engine benches,
+any traced run) and prints:
+
+- the **per-stage breakdown**: for every span name, count, total time,
+  SELF time (total minus direct children), share of root wall time, and
+  p50/p99 of the individual durations;
+- the **coverage** number: what fraction of root wall time the named
+  child stages account for (the instrumentation-honesty gate — the
+  serving-bench acceptance requires >= 90%);
+- the **critical path**: from the longest root span, the chain of
+  largest-child descents with each hop's share;
+- the exported **counters** and **histograms** (engine dispatch counts,
+  decision-latency percentiles, ...).
+
+Aggregation itself lives in ``runtime.telemetry.summarize`` — ONE
+definition shared with the ``stage_breakdown`` blocks the benches embed
+next to their throughput numbers, so the committed artifact and this
+CLI can never disagree on what a stage cost.
+
+Usage::
+
+    python -m tools.rqtrace SERVING_TRACE.json [MORE.json ...]
+    python -m tools.rqtrace --json REPORT.json --min-coverage 0.9 T.json
+
+``--min-coverage F`` exits non-zero when coverage falls below ``F`` —
+the CI hook that keeps instrumentation from silently rotting.
+Corrupt artifacts fail loudly (the integrity envelope is verified);
+multiple files merge into one span set (cross-process traces stitch by
+trace id, so a router export plus a salvaged worker ring read as one
+timeline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # `python tools/rqtrace.py` and `-m` both work
+    sys.path.insert(0, _REPO)
+
+from redqueen_tpu.runtime import integrity as _integrity  # noqa: E402
+from redqueen_tpu.runtime import telemetry as _telemetry  # noqa: E402
+
+__all__ = ["load_trace", "merge_traces", "render", "main"]
+
+REPORT_SCHEMA = "rq.rqtrace.report/1"
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    """One verified trace payload (checksummed envelope enforced — a
+    bit-rotted trace must fail loudly, not render a wrong breakdown)."""
+    return _integrity.read_json(path, schema=_telemetry.TRACE_SCHEMA)
+
+
+def merge_traces(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge span sets / counters / histogram reports from several
+    exports (router + salvaged workers, or repeated bench runs).
+    Counters sum; histograms keep each source's report under a
+    ``pid``-qualified key when names collide."""
+    spans: List[Dict[str, Any]] = []
+    counters: Dict[str, float] = {}
+    histograms: Dict[str, Any] = {}
+    for p in payloads:
+        spans.extend(s for s in p.get("spans", ())
+                     if isinstance(s, dict))
+        for k, v in (p.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        pid = (p.get("process") or {}).get("pid")
+        for k, v in (p.get("histograms") or {}).items():
+            key = k if k not in histograms else f"{k}@pid{pid}"
+            histograms[key] = v
+    return {"spans": spans, "counters": counters,
+            "histograms": histograms}
+
+
+def _fmt_s(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:8.3f}s "
+    return f"{s * 1e3:8.3f}ms"
+
+
+def render(merged: Dict[str, Any], out=sys.stdout) -> Dict[str, Any]:
+    """Print the human breakdown; returns the structured report (what
+    ``--json`` writes)."""
+    summ = _telemetry.summarize(merged["spans"])
+    w = out.write
+    w(f"spans: {summ['n_spans']}  roots: {summ['n_roots']}  "
+      f"wall: {summ['wall_s']:.3f}s\n")
+    cov = summ["coverage"]
+    w(f"coverage: "
+      + ("n/a (no root spans)\n" if cov is None
+         else f"{100.0 * cov:.1f}% of root wall time is inside named "
+              f"child stages\n"))
+    w("\n-- per-stage breakdown (by total time) --\n")
+    w(f"{'stage':<28} {'count':>7} {'total':>10} {'self':>10} "
+      f"{'%wall':>6} {'p50':>9} {'p99':>9}\n")
+    for name, st in summ["stages"].items():
+        pct = st["pct_of_wall"]
+        w(f"{name:<28} {st['count']:>7} {_fmt_s(st['total_s'])}"
+          f" {_fmt_s(st['self_s'])} "
+          f"{(f'{pct:5.1f}%' if pct is not None else '    --'):>6} "
+          f"{st['p50_ms']:>7.3f}ms {st['p99_ms']:>7.3f}ms\n")
+    if summ["critical_path"]:
+        w("\n-- critical path (largest-child descent from the longest "
+          "root) --\n")
+        for i, hop in enumerate(summ["critical_path"]):
+            w(f"  {'  ' * i}{hop['name']}  {hop['dur_s']:.6f}s  "
+              f"({hop['pct_of_root']:.1f}% of root)\n")
+    if merged["counters"]:
+        w("\n-- counters --\n")
+        for k in sorted(merged["counters"]):
+            w(f"  {k} = {merged['counters'][k]}\n")
+    if merged["histograms"]:
+        w("\n-- histograms --\n")
+        for k in sorted(merged["histograms"]):
+            h = merged["histograms"][k]
+            w(f"  {k}: n={h.get('count')} p50={h.get('p50_ms')}ms "
+              f"p99={h.get('p99_ms')}ms "
+              f"(trimmed {h.get('p99_trimmed_ms')}ms, windowed "
+              f"{h.get('p99_window_median_ms')}ms)\n")
+    return {"summary": summ, "counters": merged["counters"],
+            "histograms": merged["histograms"]}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rqtrace",
+        description="per-stage time breakdown + critical path from "
+                    "rq.telemetry.trace/1 artifacts")
+    ap.add_argument("traces", nargs="+", metavar="TRACE.json",
+                    help="enveloped trace artifact(s); several merge "
+                         "into one span set")
+    ap.add_argument("--json", metavar="OUT.json", default=None,
+                    help="also write the structured report "
+                         "(rq.rqtrace.report/1, atomic + enveloped)")
+    ap.add_argument("--min-coverage", type=float, default=None,
+                    metavar="F",
+                    help="exit 1 when coverage < F (0..1) — the CI "
+                         "instrumentation-honesty gate")
+    args = ap.parse_args(argv)
+
+    payloads = [load_trace(p) for p in args.traces]
+    merged = merge_traces(payloads)
+    report = render(merged)
+    if args.json:
+        _integrity.write_json(args.json, report, schema=REPORT_SCHEMA)
+        print(f"report written to {args.json}")
+    if args.min_coverage is not None:
+        cov = report["summary"]["coverage"]
+        if cov is None or cov < float(args.min_coverage):
+            print(f"FAIL: coverage "
+                  f"{'n/a' if cov is None else f'{cov:.3f}'} < "
+                  f"required {args.min_coverage}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # `rqtrace ... | head` closing the pipe mid-table is normal
+        # terminal usage, not an error.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        raise SystemExit(0)

@@ -1,0 +1,113 @@
+"""Telemetry overhead smoke: tracing-enabled serving throughput must
+stay within RQ_OVERHEAD_TOL (default 5%) of tracing-disabled.
+
+The near-zero-when-disabled contract is pinned by the zero-allocation
+test; THIS gate pins the other end — tracing **enabled at sample=1**
+on the wire-speed serving path (coalesced applies over async group
+commit, journal in the measured path, the exact span chain the
+committed SERVING_TRACE.json carries) may cost at most the tolerance.
+A regression here means someone added a hot-path span that allocates
+too much, took a lock per event, or started exporting mid-loop.
+
+Methodology (this sandbox's IO-stall waves move a single run by ~10%,
+far above the ~3% true overhead being measured):
+
+- interleaved runs, N_REPS per mode (off, on, off, on, ...) over the
+  identical pre-built batch stream and a fresh journal dir per run;
+- best-of per mode compared (the bench.py TIMED_REPS discipline);
+- one full retry of the whole comparison before failing — a wave that
+  eats every "on" run of a pass and no "off" run is possible, twice in
+  a row is a real regression.
+
+Usage:  python tools/telemetry_overhead.py   (exit 0 = within budget)
+Env:    RQ_OVERHEAD_TOL   fractional budget (default 0.05)
+        RQ_OVERHEAD_REPS  runs per mode per pass (default 3)
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+N_FEEDS = 1024
+N_BATCHES = 1024
+EVENTS_PER_BATCH = 32
+WARMUP_BATCHES = 8
+COALESCE = 32
+
+
+def _run_once(batches, traced: bool) -> float:
+    """One steady-state pass; returns sustained events/s."""
+    from redqueen_tpu import serving
+    from redqueen_tpu.runtime import telemetry as _telemetry
+
+    tel = _telemetry.get()
+    tel.configure(enabled=traced, sample=1.0, reset=True)
+    d = tempfile.mkdtemp(prefix="rq-tel-overhead-")
+    try:
+        rt = serving.ServingRuntime(
+            n_feeds=N_FEEDS, dir=d, snapshot_every=10 ** 9,
+            queue_capacity=2 * COALESCE, reorder_window=8,
+            max_batch_events=4 * EVENTS_PER_BATCH, coalesce=COALESCE,
+            flush_mode="group", max_unflushed_records=64,
+            max_flush_delay_ms=25.0)
+        with rt:
+            for b in batches[:WARMUP_BATCHES]:
+                rt.submit(b)
+                rt.poll()
+            rt.reset_metrics()
+            tel.configure(reset=True)
+            for i in range(WARMUP_BATCHES, len(batches), COALESCE):
+                with tel.trace("serve.round"):
+                    for b in batches[i:i + COALESCE]:
+                        rt.submit(b)
+                    rt.poll()
+            return float(rt.metrics.report(
+                pending=rt.pending)["events_per_sec"])
+    finally:
+        tel.configure(enabled=False)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _compare(batches, reps: int):
+    """One interleaved pass; returns (best_off, best_on)."""
+    off, on = 0.0, 0.0
+    for _ in range(reps):
+        off = max(off, _run_once(batches, traced=False))
+        on = max(on, _run_once(batches, traced=True))
+    return off, on
+
+
+def main() -> int:
+    tol = float(os.environ.get("RQ_OVERHEAD_TOL", "0.05"))
+    reps = int(os.environ.get("RQ_OVERHEAD_REPS", "3"))
+    from redqueen_tpu import serving
+
+    batches = serving.synthetic_stream(
+        0, N_BATCHES + WARMUP_BATCHES, N_FEEDS,
+        events_per_batch=EVENTS_PER_BATCH)
+    for attempt in (1, 2):
+        off, on = _compare(batches, reps)
+        overhead = (off - on) / off if off > 0 else 1.0
+        print(f"[attempt {attempt}] traced {on:,.0f} ev/s vs untraced "
+              f"{off:,.0f} ev/s -> overhead {100 * overhead:.2f}% "
+              f"(budget {100 * tol:.0f}%)")
+        if overhead <= tol:
+            print("telemetry overhead smoke: OK")
+            return 0
+        print("over budget; " + ("retrying the whole comparison (one "
+              "IO wave can eat a pass)" if attempt == 1 else ""))
+    print(f"FAIL: tracing-enabled serving throughput dropped more than "
+          f"{100 * tol:.0f}% vs disabled in two independent passes",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
