@@ -151,81 +151,24 @@ def build_component(n_followers: int, T: float, q: float, wall_rate: float,
     return cfg, params, adj, opt
 
 
-def run_jax_star(B: int, n_followers: int, T: float, q: float,
-                 wall_rate: float, wall_cap: int, post_cap: int,
-                 deadline_abs=None):
-    """Headline graph on the loop-free star-batch engine: each broadcaster
-    component is (1 Opt vs n_followers Poisson walls); the 10k-lane batch is
-    one vmap — streams + sort + suffix-min, no per-event loop at all."""
-    import jax
-    import numpy as np
-
-    from redqueen_tpu.parallel.bigf import (
-        StarBuilder,
-        broadcast_star,
-        simulate_star_batch,
-    )
-
-    sb = StarBuilder(n_feeds=n_followers, end_time=T)
-    for f in range(n_followers):
-        sb.wall_poisson(f, wall_rate)
-    sb.ctrl_opt(q=q)
-    cfg, wall, ctrl = sb.build(wall_cap=wall_cap, post_cap=post_cap)
-    wall_b, ctrl_b = broadcast_star(wall, ctrl, B)
-
-    warm = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B))
-    secs = np.inf
-    for _ in range(TIMED_REPS):  # best-of-N: see TIMED_REPS note
-        if not _more_reps_fit(secs, deadline_abs):
-            log("stopping timed reps early: child deadline")
-            break
-        t0 = time.perf_counter()
-        res = simulate_star_batch(cfg, wall_b, ctrl_b, np.arange(B) + 10_000)
-        # simulate_star_batch blocks internally, but the timed region
-        # states its own synchronization rather than leaning on a callee
-        # implementation detail (free here: the arrays are already done).
-        jax.block_until_ready(res.wall_n)
-        secs = min(secs, time.perf_counter() - t0)
-
-    events = int(res.wall_n.sum()) + int(res.n_posts.sum())
-    tops = np.asarray(res.metrics.mean_time_in_top_k()).reshape(-1)
-    posts = float(res.n_posts.mean())
-    # No sequential-step roofline for the star engine: it has no per-event
-    # scan step (streams + sort + suffix-min), so the scan utilization
-    # model does not apply.
-    return events, secs, float(tops.mean()), float(tops.std()), posts, {}
-
-
-# CPU cache-locality optimum for the scan engine's lane count (measured on
-# the headline shape via benchmarks/scaling.py: throughput peaks at
-# B~1000-2500 lanes and falls ~25% by B=10k as the working set outgrows
-# cache). The batch is therefore processed in slabs of ~this many lanes on
-# CPU — identical seeds, so the work is bit-the-same as one big batch. On
-# TPU the full batch runs as one dispatch (the chip wants the parallelism).
-# Re-swept 2026-07-30 after the round-3 driver changes: 2500 beats 2000 by
-# a consistent ~4% (best-of-6: 14.21M vs 13.66M ev/s).
-CPU_SLAB = 2500
-
-
-def _slab_size(B: int, target: int) -> int:
-    """Largest divisor of B in (target/2, target]; B itself (unslabbed)
-    when no divisor lands in that window — equal slabs only, so the timed
-    loop never pays a ragged remainder-slab recompile."""
-    if target >= B:
-        return B
-    for s in range(target, target // 2, -1):
-        if B % s == 0:
-            return s
-    return B
-
-
 def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
                           q: float, wall_rate: float, capacity: int,
-                          deadline_abs=None, profile_dir=None):
+                          deadline_abs=None, profile_dir=None,
+                          engine_name: str = "scan"):
     """Shared harness for engines with the EventLog contract: build the
     component batch, one warm-up run (compilation), timed best-of-N over
     the (possibly slabbed) batch (budget-aware — see _more_reps_fit),
     metrics. ``simulate_fn(cfg, params, adj, seeds)`` -> EventLog.
+
+    CPU batches dispatch in SLABS sized by the measured auto-tuner
+    (redqueen_tpu.parallel.lanes.measured_slab: candidate slab sizes are
+    timed at first use per (backend, shape bucket) and the winner is
+    cached in the rq.lanes.autotune/1 artifact — the hard-coded
+    CPU_SLAB=2500 this replaces was a hand-swept 2026-07-30 number).
+    Slab dispatch is bit-identical to one big batch (identical per-lane
+    seeds); on TPU the full batch runs as one dispatch (the chip wants
+    the parallelism).  The chosen slab and its provenance land on the
+    result line (``slab`` field).
 
     Returns ``(events, secs, top1, top1_std, posts, extras)`` where
     ``extras`` is the utilization block (steps, step_ns, hbm_gbps, ...)
@@ -233,6 +176,7 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     simulator (round-4 verdict item "missing 4")."""
     import jax
     from redqueen_tpu.config import stack_components
+    from redqueen_tpu.parallel import lanes
     from redqueen_tpu.utils.metrics import feed_metrics_batch, num_posts
     from redqueen_tpu.utils.roofline import (
         roofline_fields,
@@ -240,10 +184,9 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     )
 
     on_cpu = jax.devices()[0].platform == "cpu"
-    slab = _slab_size(B, CPU_SLAB) if on_cpu else B
     cfg, p0, a0, opt = build_component(n_followers, T, q, wall_rate, capacity)
-    params, adj = stack_components([p0] * slab, [a0] * slab)
-    adj_b = jax.numpy.broadcast_to(a0, (slab,) + a0.shape)
+    params, adj = stack_components([p0] * B, [a0] * B)
+    adj_b = jax.numpy.broadcast_to(a0, (B,) + a0.shape)
 
     # --trace arms telemetry via the env for the WHOLE child, but the
     # committed throughput must stay untraced: hold tracing off through
@@ -255,21 +198,61 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     want_trace = _tel.enabled
     _tel.configure(enabled=False)
 
-    warm = simulate_fn(cfg, params, adj, np.arange(slab))
-    jax.block_until_ready(warm.times)
+    # --- slab decision: measured, never guessed (ROADMAP item 3) ---
+    slab_info = {"slab": B, "target": B, "source": "unslabbed"}
+    slab = B
+    if on_cpu:
+        def _slab_time_fn(n):
+            # The canonical probe (lanes.probe_slab_cost: one warm pass
+            # pays the compile, one timed pass, seconds per lane) over
+            # a leading slice of the real batch.
+            p_s = jax.tree.map(lambda x: x[:n], params)
+            return lanes.probe_slab_cost(
+                lambda: simulate_fn(cfg, p_s, adj[:n], np.arange(n)), n)
+
+        # Measuring costs ~3 extra compiles + passes; skip to the cached/
+        # fallback choice when the child deadline cannot absorb that.
+        can_measure = (deadline_abs is None
+                       or time.monotonic() + 120.0 <= deadline_abs)
+        choice = lanes.measured_slab(
+            B, backend="cpu",
+            shape_key=(f"{engine_name}/S{cfg.n_sources}F{cfg.n_sinks}"
+                       f"cap{capacity}"),
+            time_fn=_slab_time_fn if can_measure else None)
+        slab = choice.slab
+        slab_info = {"slab": choice.slab, "target": choice.target,
+                     "source": choice.source}
+        log(f"slab autotune: {slab_info}")
+
+    def dispatch_once(seeds):
+        """One pass over the batch as per-slab logs, each blocked as it
+        lands — the timed region measures pure dispatch, exactly the
+        pre-lanes protocol; seed layout matches the unslabbed batch
+        (slabs slice the same per-lane seed array)."""
+        def blocked(c, p, a, s):
+            lg = simulate_fn(c, p, a, s)
+            jax.block_until_ready(lg.times)
+            return lg
+
+        if slab < B:
+            return lanes.dispatch_slabbed(cfg, params, adj, seeds, slab,
+                                          dispatch=blocked)
+        return [blocked(cfg, params, adj, seeds)]
+
+    warm = dispatch_once(np.arange(B))
     secs = np.inf
     for _ in range(TIMED_REPS):  # best-of-N: see TIMED_REPS note
         if not _more_reps_fit(secs, deadline_abs):
             log("stopping timed reps early: child deadline")
             break
-        logs = []
-        t0 = time.perf_counter()
-        for s0 in range(0, B, slab):
-            # Seed layout matches the unslabbed batch exactly.
-            logb = simulate_fn(cfg, params, adj, np.arange(slab) + 10_000 + s0)
-            jax.block_until_ready(logb.times)
-            logs.append(logb)
+        # dispatch_once blocks on every slab's buffers as it lands (the
+        # `blocked` wrapper) — the region is fully synchronized.
+        t0 = time.perf_counter()  # rqlint: disable=RQ601 dispatch_once blocks per slab
+        slab_logs = dispatch_once(np.arange(B) + 10_000)
         secs = min(secs, time.perf_counter() - t0)
+    # The merge (pad + concat to one [B, E] log) happens OFF the clock:
+    # it is metrics plumbing, not engine throughput.
+    logb = lanes.concat_slab_logs(cfg, slab_logs)
 
     if profile_dir:
         # One extra (untimed) pass under the profiler: the on-chip trace
@@ -282,8 +265,8 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
             try:
                 os.makedirs(profile_dir, exist_ok=True)
                 with jax.profiler.trace(profile_dir):
-                    lg = simulate_fn(cfg, params, adj, np.arange(slab) + 10_000)
-                    jax.block_until_ready(lg.times)
+                    for lg in dispatch_once(np.arange(B) + 10_000):
+                        jax.block_until_ready(lg.times)
                 log(f"profiler trace written to {profile_dir}")
             except Exception as e:  # noqa: BLE001 — diagnostics only
                 log(f"profiler trace FAILED (non-fatal): {e!r}")
@@ -301,47 +284,51 @@ def _run_event_log_engine(simulate_fn, B: int, n_followers: int, T: float,
     if want_trace:
         _tel.configure(enabled=True, reset=True)
         with _tel.trace("bench.rep"):
-            lg_t = simulate_fn(cfg, params, adj, np.arange(slab) + 10_000)
-            jax.block_until_ready(lg_t.times)
+            for lg_t in dispatch_once(np.arange(B) + 10_000):
+                jax.block_until_ready(lg_t.times)
         stage_breakdown = _telemetry.summarize(_tel.drain_spans())
 
     # Sequential scan steps executed = emitted buffer length per dispatch
-    # (chunks_run * capacity), summed over the slab dispatches of one rep.
-    n_steps = sum(lg.times.shape[-1] for lg in logs)
+    # (chunks_run * capacity), summed over the slab dispatches of one rep
+    # (lanes.simulate_slabbed preserves the true sum as ``chunk_steps`` —
+    # the concatenated buffer pads short slabs).  Traffic is modeled at
+    # the DISPATCH shape (one slab), matching the per-dispatch step count.
+    n_steps = getattr(logb, "chunk_steps", logb.times.shape[-1])
+    params_d = jax.tree.map(lambda x: x[:slab], params)
     extras = roofline_fields(
-        n_steps, secs, scan_step_traffic_bytes(cfg, params, adj),
+        n_steps, secs, scan_step_traffic_bytes(cfg, params_d, adj[:slab]),
         jax.devices()[0].platform, jax.devices()[0].device_kind)
     # Kernel-launch count of one rep, summed over slabs (both engines
     # report it on the EventLog): the denominator of the superchunk
     # dispatch-amortization story — the scan engine pays ~one dispatch
     # per sync_every chunks, the pallas megakernel one per k chunks.
-    disp = sum(lg.dispatches or 0 for lg in logs)
+    disp = logb.dispatches or 0
     if disp:
         extras["dispatches"] = disp
+    extras["slab"] = slab_info
     if stage_breakdown is not None:
         extras["stage_breakdown"] = stage_breakdown
     if _profile_cb is not None:
         extras["_profile_cb"] = _profile_cb  # popped by child_main pre-print
 
-    events = sum(int(np.asarray(lg.n_events).sum()) for lg in logs)
-    tops, posts_l = [], []
-    for lg in logs:
-        m = feed_metrics_batch(lg.times, lg.srcs, adj_b, opt, T)
-        tops.append(np.asarray(m.mean_time_in_top_k()).reshape(-1))
-        posts_l.append(float(np.asarray(num_posts(lg.srcs, opt)).mean()))
-    tops = np.concatenate(tops)  # per-lane values across all B lanes
-    posts = float(np.mean(posts_l))
+    # The run's results boundary: the timed reps are over, the reduced
+    # per-lane scalars cross to host once.
+    events = int(np.asarray(logb.n_events).sum())  # rqlint: disable=RQ701 results boundary
+    m = feed_metrics_batch(logb.times, logb.srcs, adj_b, opt, T)
+    tops = np.asarray(m.mean_time_in_top_k()).reshape(-1)  # per-lane [B]
+    posts = float(np.asarray(num_posts(logb.srcs, opt)).mean())
     return events, secs, float(tops.mean()), float(tops.std()), posts, extras
 
 
-def _max_chunks(n_followers: int, T: float, wall_rate: float,
-                capacity: int) -> int:
-    """Chunk allowance sized to the workload: ~4x the expected event count
-    (wall mean x 1.25 for posts) over the chunk capacity, floored at 64. A
-    flat 64 silently capped the scan engine at ~130k events/lane, making
-    big-F comparison cells fail on a harness artifact instead of measuring."""
-    mean_ev = T * wall_rate * n_followers * 1.25
-    return max(64, int(4 * mean_ev / capacity) + 1)
+def _shape_budget(n_followers: int, T: float, wall_rate: float, capacity):
+    """(capacity, max_chunks) — ONE definition, owned by the lane layer
+    (redqueen_tpu.parallel.lanes.shape_budget) so the bench and the
+    ragged bucket dispatcher can never diverge on the measured sizing
+    rule.  Called only from engine children (the parent never imports
+    jax, which the lanes import tree pulls)."""
+    from redqueen_tpu.parallel.lanes import shape_budget
+
+    return shape_budget(n_followers, T, wall_rate, capacity)
 
 
 def _sync_every() -> int:
@@ -364,24 +351,26 @@ def run_jax_pallas(B: int, n_followers: int, T: float, q: float,
     result line so it can never be mistaken for a timing number."""
     from redqueen_tpu.ops.pallas_engine import simulate_pallas
 
-    mc = _max_chunks(n_followers, T, wall_rate, capacity)
+    capacity, mc = _shape_budget(n_followers, T, wall_rate, capacity)
     sync = _sync_every()
     fn = lambda cfg, p, a, s: simulate_pallas(cfg, p, a, s, max_chunks=mc,
                                               sync_every=sync)
     return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate,
-                                 capacity, deadline_abs, profile_dir)
+                                 capacity, deadline_abs, profile_dir,
+                                 engine_name="pallas")
 
 
 def run_jax(B: int, n_followers: int, T: float, q: float, wall_rate: float,
             capacity: int, deadline_abs=None, profile_dir=None):
     from redqueen_tpu.sim import simulate_batch
 
-    mc = _max_chunks(n_followers, T, wall_rate, capacity)
+    capacity, mc = _shape_budget(n_followers, T, wall_rate, capacity)
     sync = _sync_every()
     fn = lambda cfg, p, a, s: simulate_batch(cfg, p, a, s, max_chunks=mc,
                                              sync_every=sync)
     return _run_event_log_engine(fn, B, n_followers, T, q, wall_rate,
-                                 capacity, deadline_abs, profile_dir)
+                                 capacity, deadline_abs, profile_dir,
+                                 engine_name="scan")
 
 
 def run_oracle(n_comps: int, n_followers: int, T: float, q: float,
@@ -438,45 +427,31 @@ def _shapes(args):
         B = args.broadcasters or 10_000
         T = args.horizon or 100.0
         oracle_comps = 32  # ~0.75s of oracle wall time: a steady denominator
-    if args.capacity:
-        capacity = args.capacity
-    else:
-        # Chunks much smaller than the run absorb almost no past-horizon
-        # steps (the measured ~40% waste of a run-sized chunk). With the
-        # superchunk driver amortizing host syncs (sim._drive), the re-swept
-        # optimum moved smaller: ~mean_events/16 (cap 64 on the headline
-        # shape, 12.2M vs 11.1M ev/s at mean/8) — per-chunk dispatch is now
-        # cheap enough that absorbing less wins.
-        mean_ev = T * args.wall_rate * args.followers * 1.25
-        capacity = int(min(2048, max(64, 1 << int(np.log2(max(mean_ev / 16, 1)) + 0.5))))
-    return B, T, capacity, oracle_comps
+    # Capacity: None = auto-sized by the measured rule in
+    # redqueen_tpu.parallel.lanes.shape_budget (~mean_events/16, pow2,
+    # clamped [64, 2048] — chunks much smaller than the run absorb
+    # almost no past-horizon steps; see the rule's docstring for the
+    # re-sweep evidence).  Resolved in the engine children via
+    # _shape_budget — the PARENT never imports jax, so the display-only
+    # shape it needs stays (B, T).
+    return B, T, (args.capacity or None), oracle_comps
 
 
-def _star_with_retry(args, B, T, post_cap_mult: int = 1, deadline_abs=None):
-    # Capacity: Poisson(rate*T) wall events per feed; mean + 9 sigma
-    # headroom rounded up so 100k+ streams cannot overflow.
-    mean_w = args.wall_rate * T
-    wall_cap = int(mean_w + 9 * max(mean_w, 1.0) ** 0.5 + 16)
-    # RedQueen's posting volume grows ~ T * sqrt(F * wall_rate / q) (the
-    # intensity sums sqrt(s_f/q) clocks across all F feeds), so the cap
-    # must scale with the follower count — a flat 4x-the-wall-mean cap
-    # always overflowed at the 100k-feed scale. 4x headroom; overflow
-    # still raises loudly and is retried with a doubled cap.
-    est = T * (args.followers * args.wall_rate / max(args.q, 1e-9)) ** 0.5
-    post_cap = max(int(4 * est), 64) * post_cap_mult
-    post_cap = 1 << (post_cap - 1).bit_length()  # round to pow2
-    try:
-        return run_jax_star(
-            B, args.followers, T, args.q, args.wall_rate, wall_cap, post_cap,
-            deadline_abs=deadline_abs,
-        )
-    except RuntimeError as e:
-        if "post_cap" in str(e) and post_cap_mult <= 8:
-            log(f"star engine overflowed post_cap={post_cap}; retrying "
-                f"with a doubled cap")
-            return _star_with_retry(args, B, T, post_cap_mult * 2,
-                                    deadline_abs=deadline_abs)
-        raise
+# The star engine is RETIRED from the headline bench (this PR): at the
+# broadcaster-batch shape it measured 746K ev/s vs the scan engine's
+# 15.1M on the same graph (BENCH_r05 / STAR_VS_SCAN_cpu.json), never won
+# a round, and burned ~88s per sweep — the recorded reason below is what
+# --engine/--engines star now reports.  The star KERNEL is not deleted:
+# it remains the follower-sharded engine for the big-F single-broadcaster
+# presets (configs 2 and 4), where the scan engine's per-event loop is
+# hopeless.  Migration note: docs/MIGRATION.md "Star engine retirement".
+STAR_RETIRED_REASON = (
+    "the star engine is retired from the headline bench: 746K ev/s vs "
+    "scan's 15.1M on the same broadcaster-batch graph (BENCH_r05), never "
+    "the best engine in any round — use --engines oracle,scan[,pallas]; "
+    "the star kernel still serves the follower-sharded presets "
+    "(configs 2/4, parallel.bigf) — see docs/MIGRATION.md"
+)
 
 
 # ---------------------------------------------------------------------------
@@ -530,10 +505,7 @@ def child_main(args) -> None:
     # (measured from process start — build/compile time counts), leaving
     # headroom for the metrics pass + the final print.
     deadline_abs = _START + args.deadline * 0.92
-    if args.as_engine == "star":
-        ev, secs, top1, top1_std, posts, extras = _star_with_retry(
-            args, B, T, deadline_abs=deadline_abs)
-    elif args.as_engine == "scan":
+    if args.as_engine == "scan":
         ev, secs, top1, top1_std, posts, extras = run_jax(
             B, args.followers, T, args.q, args.wall_rate, capacity,
             deadline_abs=deadline_abs, profile_dir=args.profile)
@@ -551,7 +523,7 @@ def child_main(args) -> None:
     out = {"ok": True, "events": ev, "secs": secs, "top1": top1,
            "top1_std": top1_std, "top1_n": B, "posts": posts,
            "platform": jax.devices()[0].platform}
-    out.update(extras)  # utilization block (roofline_fields); {} for star
+    out.update(extras)  # utilization block (roofline_fields)
     print(json.dumps(out), flush=True)
     if profile_cb is not None:
         # After the result print on purpose: a tunnel wedge mid-trace can
@@ -638,23 +610,23 @@ def _run_child(args, engine: str, backend: str, timeout_s: float):
     return None
 
 
-_ENGINE_CHOICES = ("oracle", "scan", "star", "pallas")
+_ENGINE_CHOICES = ("oracle", "scan", "pallas")
 
 
 def _selected_engines(args):
     """The --engines selection: ``(run_oracle, [engine, ...])``.
 
     Default (``--engines`` unset): ``oracle,scan`` plus ``pallas`` —
-    the star engine burns ~88s of every bench run for 746K ev/s on CPU
-    (20x slower than scan, BENCH_r05) and never wins, so it is opt-in
-    (``--engines oracle,scan,star``) until ROADMAP item 4 decides its
-    fate.  pallas stays in the DEFAULT sweep (it is skipped off-TPU
-    anyway, and dropping it would silently degrade the best-TPU-number
+    pallas stays in the DEFAULT sweep (it is skipped off-TPU anyway,
+    and dropping it would silently degrade the best-TPU-number
     contract) but is excluded by any explicit --engines list that omits
     it.  The legacy ``--engine NAME`` (non-auto) still overrides the
-    engine list."""
+    engine list.  ``star`` is RETIRED (see STAR_RETIRED_REASON) and
+    rejected with the recorded reason, never silently dropped."""
     engines_str = getattr(args, "engines", None) or "oracle,scan,pallas"
     sel = [e.strip() for e in engines_str.split(",") if e.strip()]
+    if "star" in sel or getattr(args, "engine", "auto") == "star":
+        raise RuntimeError(STAR_RETIRED_REASON)
     unknown = sorted(set(sel) - set(_ENGINE_CHOICES))
     if unknown:
         raise RuntimeError(
@@ -667,7 +639,7 @@ def _selected_engines(args):
     if not engines:
         raise RuntimeError(
             "--engines selected no simulation engine (oracle alone is a "
-            "denominator, not a benchmark) — add scan/star/pallas")
+            "denominator, not a benchmark) — add scan/pallas")
     return use_oracle, engines
 
 
@@ -706,7 +678,7 @@ def parent_main(args) -> None:
         raise RuntimeError(
             "--engine pallas requires the TPU backend (Mosaic lowering); "
             "interpret mode exists for tests, not timing — run with --tpu "
-            "and a live tunnel, pick --engine scan/star, or pass "
+            "and a live tunnel, pick --engine scan, or pass "
             "--interpret for an explicit CPU correctness run"
         )
 
@@ -826,13 +798,13 @@ def parent_main(args) -> None:
             "engine": engine_name,
         }
         # Utilization block (the MFU analogue; see utils/roofline.py) —
-        # present for the scan/pallas engines, absent for star/config.
+        # present for the scan/pallas engines, absent for config.
         # `dispatches` is the per-rep kernel-launch count (superchunk
         # amortization evidence); `interpret` marks a pallas CPU
         # correctness run so it can never pass for a timing claim.
         for k in ("steps", "step_ns", "bytes_per_step", "hbm_gbps",
                   "hbm_peak_gbps", "hbm_frac", "dispatches", "interpret",
-                  "stage_breakdown"):
+                  "slab", "stage_breakdown"):
             if k in res:
                 line[k] = res[k]
         line.update(gate_fields(res))
@@ -942,20 +914,24 @@ def main():
                     help="benchmark one of the five BASELINE presets instead "
                          "of the headline graph (see redqueen_tpu.presets / "
                          "benchmarks/run.py for the full harness)")
-    ap.add_argument("--engine", choices=["auto", "star", "scan", "pallas"],
+    # "star" stays in the CHOICES so the retirement surfaces as the
+    # recorded reason (_selected_engines raises STAR_RETIRED_REASON with
+    # the MIGRATION.md pointer), not as a bare argparse invalid-choice.
+    ap.add_argument("--engine", choices=["auto", "scan", "pallas", "star"],
                     default="auto",
-                    help="star: loop-free stream/suffix-min batch kernel; "
-                         "scan: the general event-scan kernel (arbitrary "
+                    help="scan: the general event-scan kernel (arbitrary "
                          "graphs/policy mixes); pallas: the VMEM-resident "
                          "fused chunk kernel (TPU only); auto (default): "
                          "run the --engines selection fastest-known-first "
-                         "and report the best")
+                         "and report the best.  (star is RETIRED from the "
+                         "headline bench and refuses with the recorded "
+                         "reason — see docs/MIGRATION.md; the kernel "
+                         "still serves the follower-sharded presets, "
+                         "configs 2/4)")
     ap.add_argument("--engines", default=None,
-                    help="comma list from {oracle,scan,star,pallas} "
+                    help="comma list from {oracle,scan,pallas} "
                          "consulted when --engine is auto (default: "
-                         "oracle,scan + pallas-on-TPU — star costs "
-                         "~88s/run for a result that never wins on CPU "
-                         "[BENCH_r05], so it is opt-in); drop 'oracle' "
+                         "oracle,scan + pallas-on-TPU); drop 'oracle' "
                          "to skip the NumPy denominator like "
                          "--no-oracle")
     ap.add_argument("--deadline", type=float, default=900.0,
@@ -992,7 +968,7 @@ def main():
                          "stay untraced; render with tools/rqtrace.py")
     # Internal: child-process protocol (see child_main).
     ap.add_argument("--as-engine",
-                    choices=["scan", "star", "pallas", "oracle", "config"],
+                    choices=["scan", "pallas", "oracle", "config"],
                     default=None, help=argparse.SUPPRESS)
     ap.add_argument("--backend", choices=["cpu", "default"], default="cpu",
                     help=argparse.SUPPRESS)

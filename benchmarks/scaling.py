@@ -67,8 +67,10 @@ def main():
     import numpy as np
 
     # Shared shape, chunk-allowance formula, and timing protocol with the
-    # headline bench — one source of truth for each.
-    from bench import TIMED_REPS, _max_chunks, build_component
+    # headline bench — one source of truth for each (the sizing rule now
+    # lives in the unified lane layer).
+    from bench import TIMED_REPS, build_component
+    from redqueen_tpu.parallel.lanes import shape_budget
     from redqueen_tpu.config import stack_components
     from redqueen_tpu.sim import simulate_batch
     from redqueen_tpu.utils.roofline import (
@@ -83,7 +85,7 @@ def main():
     rows = []
     for B in args.batches:
         params, adj = stack_components([p0] * B, [a0] * B)
-        mc = _max_chunks(10, args.horizon, 1.0, 64)
+        mc = shape_budget(10, args.horizon, 1.0, 64)[1]
         lg = simulate_batch(cfg, params, adj, np.arange(B), max_chunks=mc)
         jax.block_until_ready(lg.times)  # warm-up compiles this B
         secs = np.inf

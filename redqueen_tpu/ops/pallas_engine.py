@@ -386,7 +386,12 @@ def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
     if not plan.fits:
         raise ValueError(plan.reason)
     k, cap = plan.k, plan.capacity
-    B_pad = -(-B // _TILE) * _TILE
+    # Launch planning through the unified lane layer: the grid's lane
+    # axis is B_pad/_TILE, and pad_to_tile records the occupancy (real
+    # vs padded lanes) on the trace so tile waste is visible per launch.
+    from ..parallel.lanes import pad_to_tile
+
+    B_pad = pad_to_tile(B, _TILE)
 
     state = _init_state(cfg, params, seeds)
     # The env-configured ``numeric`` fault (RQ_FAULT=numeric:mode@laneN):
@@ -451,7 +456,8 @@ def simulate_pallas(cfg: SimConfig, params: SourceParams, adj, seeds,
     # run stamps its error attribute on the span; the inner finally
     # records the launch count on BOTH exits.
     with _telemetry.span("engine.pallas.run", k=k, capacity=cap,
-                         interpret=bool(interpret)) as run_span:
+                         interpret=bool(interpret), lanes=B,
+                         lanes_padded=B_pad) as run_span:
         try:
             for _ in range(n_launches):
                 # The launch span measures the superchunk ENQUEUE; the

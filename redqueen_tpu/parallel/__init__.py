@@ -13,6 +13,12 @@ from .comm import (  # noqa: F401
     shard_leading,
 )
 from .shard import simulate_sharded  # noqa: F401
+from .lanes import (  # noqa: F401
+    measured_slab,
+    plan_buckets,
+    simulate_ragged,
+    simulate_slabbed,
+)
 from .bigf import (  # noqa: F401
     StarBuilder,
     StarConfig,

@@ -1,0 +1,843 @@
+"""Unified lane batching & dispatch (ROADMAP item 3): ONE layer owning how
+a set of simulation lanes becomes dense device dispatches.
+
+Every engine used to reimplement its own slice of this: bench.py carried a
+hard-coded ``CPU_SLAB = 2500`` and a private slab loop, the Pallas engine
+rounded its lane count to 128-wide tiles inline, the serving runtime padded
+every coalesced group to its full ``max_batch_events`` width, and the star
+engine had its own batch stacker.  This module centralizes the three
+mechanisms they all need:
+
+- **Bucketed ragged batching** — a power-law follower graph (the paper's
+  "millions of users" regime) has lane widths spanning 1..10k; padding
+  every lane to the hub width wastes  almost the whole batch.
+  :func:`plan_buckets` groups lanes into a BOUNDED number of
+  geometric width buckets (compile shapes stay few) and
+  :func:`simulate_ragged` dispatches each bucket densely — bit-identical
+  per lane to the dense-padded reference on matched seeds, because every
+  PRNG stream in the kernels depends only on (lane seed, source index,
+  draw counter), never on the padded shape (SURVEY.md section 7).
+
+- **Measured slab auto-tuning** — the CPU cache-locality optimum for the
+  scan engine's lane count is a measured fact of the backend and shape,
+  not a constant: :func:`measured_slab` times a few candidate slab sizes
+  at first use per (backend, shape bucket) and caches the winner in an
+  enveloped ``rq.lanes.autotune/1`` JSON artifact
+  (:func:`autotune_cache_path`), so every later run reuses the
+  measurement instead of a guess.  :func:`simulate_slabbed` (reached via
+  ``sim.simulate_batch(..., slab=...)``) applies the choice with
+  bit-identical results — equal slabs, identical per-lane seeds.
+
+- **Pad-waste / occupancy telemetry** — every padding decision this
+  module makes is recorded (``lanes.pad.real_elems`` /
+  ``lanes.pad.padded_elems`` counters, ``lanes.bucket_plan`` events,
+  ``lanes.*`` spans), so a trace's ``stage_breakdown`` shows the padding
+  fraction per dispatch instead of hiding it inside "compute".
+
+Fault addressing: ``RQ_FAULT=numeric:mode@laneN`` indexes lanes of the
+CALLER'S original lane order — :func:`simulate_ragged` translates the
+spec through its bucket reordering (``runtime.faultinject.numeric_scope``)
+so the same spec hits the same logical lane under any bucket plan, and
+health bits flow back to original lane positions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime import faultinject as _faultinject
+from ..runtime import telemetry as _telemetry
+from ..runtime.artifacts import atomic_write_json
+from ..runtime.numerics import NumericalHealthError as _NumericalHealthError
+
+__all__ = [
+    "BucketPlan", "plan_buckets", "bucket_width", "pad_to_tile",
+    "SlabChoice", "measured_slab", "slab_size", "iter_slabs",
+    "simulate_slabbed", "dispatch_slabbed", "concat_slab_logs",
+    "probe_slab_cost", "shape_budget", "ragged_bucket_component",
+    "RaggedResult",
+    "simulate_ragged", "AUTOTUNE_SCHEMA", "SLAB_CANDIDATES",
+    "autotune_cache_path", "load_autotune_cache",
+]
+
+
+# ---------------------------------------------------------------------------
+# Width rounding & tile padding
+# ---------------------------------------------------------------------------
+
+
+def bucket_width(n: int, floor: int = 1, cap: Optional[int] = None) -> int:
+    """Padded width for a lane/group of true width ``n``: the next power
+    of two at or above ``max(n, floor)``, clamped to ``cap``.  Pow-2
+    ceilings bound the number of DISTINCT padded shapes a workload can
+    produce to log2(range) — the whole point: few compile shapes, bounded
+    pad waste (< 2x per lane)."""
+    n = int(n)
+    if n < 0:
+        raise ValueError(f"width must be >= 0, got {n}")
+    m = max(n, int(floor), 1)
+    w = 1 << (m - 1).bit_length()
+    if cap is not None:
+        if n > int(cap):
+            raise ValueError(
+                f"true width {n} exceeds the cap {cap} — the caller's "
+                f"fixed dispatch budget cannot hold this group")
+        w = min(w, int(cap))
+    return w
+
+
+def pad_to_tile(n: int, tile: int) -> int:
+    """Lanes padded to a whole number of hardware tiles (the Pallas
+    engine's ``(lanes/128, k)`` launch planning).  Emits the occupancy
+    counters so a traced run shows the padded-lane fraction per launch."""
+    if tile < 1:
+        raise ValueError(f"tile must be >= 1, got {tile}")
+    padded = -(-int(n) // int(tile)) * int(tile)
+    _telemetry.counter("lanes.pad.real_lanes", int(n))
+    _telemetry.counter("lanes.pad.padded_lanes", padded - int(n))
+    if padded != n:
+        _telemetry.event("lanes.tile_pad", lanes=int(n), padded=padded,
+                         tile=int(tile),
+                         occupancy=round(int(n) / padded, 4))
+    return padded
+
+
+# ---------------------------------------------------------------------------
+# Bucket planning
+# ---------------------------------------------------------------------------
+
+
+class BucketPlan(NamedTuple):
+    """A bounded bucketing of ragged lane widths.
+
+    ``widths`` are the padded bucket widths (ascending); ``lane_bucket``
+    maps each original lane to its bucket index (the smallest width that
+    holds it).  The pad-accounting fields compare the plan against the
+    dense reference (every lane padded to ``dense_width``): the
+    ``pad_frac_*`` properties are the fraction of PADDED elements that
+    are waste — the headline number ``BENCH_r07.json`` commits."""
+
+    widths: Tuple[int, ...]
+    lane_bucket: np.ndarray      # i64[B] bucket index per original lane
+    counts: np.ndarray           # i64[B] true width per original lane
+    dense_width: int
+
+    def lanes_of(self, b: int) -> np.ndarray:
+        """Original lane indices of bucket ``b``, in original order."""
+        return np.flatnonzero(self.lane_bucket == b)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.widths)
+
+    @property
+    def real_elems(self) -> int:
+        return int(self.counts.sum())
+
+    @property
+    def bucketed_elems(self) -> int:
+        w = np.asarray(self.widths, np.int64)
+        return int(w[self.lane_bucket].sum())
+
+    @property
+    def dense_elems(self) -> int:
+        return int(self.dense_width) * len(self.counts)
+
+    @property
+    def pad_frac_bucketed(self) -> float:
+        b = self.bucketed_elems
+        return (b - self.real_elems) / b if b else 0.0
+
+    @property
+    def pad_frac_dense(self) -> float:
+        d = self.dense_elems
+        return (d - self.real_elems) / d if d else 0.0
+
+    @property
+    def padded_elem_reduction(self) -> float:
+        """Fraction of the dense plan's WASTED elements this plan
+        eliminates — the ">= 60% reduction in padded-element waste"
+        acceptance number."""
+        dw = self.dense_elems - self.real_elems
+        bw = self.bucketed_elems - self.real_elems
+        return (dw - bw) / dw if dw else 0.0
+
+
+#: Smallest bucket width the ragged planner emits.  Width 1 (a 2-source
+#: component) compiles through XLA's tiny-shape scalar math path, whose
+#: log1p/exp rounding can differ by 1 ULP from the vectorized path every
+#: width >= 2 takes — measured: a width-1 bucket's Opt post times drift
+#: one float32 ULP from the dense reference, while widths 2..512 are
+#: bitwise consistent (tests/test_lanes.py pins this).  Padding a
+#: single-follower lane to width 2 costs one dead source row and buys
+#: the bit-identity contract; the bench's identity assertion would
+#: refuse to record a speedup if a future backend moved the boundary.
+MIN_BUCKET_WIDTH = 2
+
+
+def plan_buckets(counts: Sequence[int], max_buckets: int = 8) -> BucketPlan:
+    """Group ragged lane widths into at most ``max_buckets`` pow-2 width
+    buckets (floored at :data:`MIN_BUCKET_WIDTH`), greedily merging the
+    adjacent pair that adds the least padding until the bound holds.
+    ``max_buckets=1`` IS the dense-padded reference plan (every lane
+    padded to one width) — the comparison baseline the bit-identity
+    tests and the bench artifact use."""
+    counts = np.asarray(counts, np.int64)
+    if counts.ndim != 1 or counts.size == 0:
+        raise ValueError(
+            f"counts must be a non-empty 1-D array, got shape "
+            f"{counts.shape}")
+    if (counts < 1).any():
+        i = int(np.flatnonzero(counts < 1)[0])
+        raise ValueError(
+            f"lane widths must be >= 1, got {counts[i]} at lane {i}")
+    if max_buckets < 1:
+        raise ValueError(f"max_buckets must be >= 1, got {max_buckets}")
+    # Pow-2 ceilings -> (width, lane count) histogram, ascending.
+    # Vectorized (a per-lane Python bucket_width() call is ~seconds of
+    # host time at 10^6 lanes — inside the timed bench region): frexp
+    # gives m = mant * 2**e with mant in [0.5, 1), so the ceiling is m
+    # itself at exact powers of two (mant == 0.5) and 2**e otherwise —
+    # exact integer arithmetic, no log2 rounding edge.
+    m = np.maximum(counts, MIN_BUCKET_WIDTH)
+    mant, e = np.frexp(m.astype(np.float64))
+    ceil = np.where(mant == 0.5, m,
+                    np.int64(1) << e.astype(np.int64)).astype(np.int64)
+    widths, n_lanes = np.unique(ceil, return_counts=True)
+    widths = [int(w) for w in widths]
+    n_lanes = [int(n) for n in n_lanes]
+    # Greedy merge: absorbing bucket i into its next-larger neighbour
+    # costs n_lanes[i] * (width[i+1] - width[i]) extra padded elements;
+    # repeatedly take the cheapest merge until the bound holds.
+    while len(widths) > max_buckets:
+        costs = [n_lanes[i] * (widths[i + 1] - widths[i])
+                 for i in range(len(widths) - 1)]
+        i = int(np.argmin(costs))
+        n_lanes[i + 1] += n_lanes[i]
+        del widths[i], n_lanes[i]
+    dense = int(max(widths))
+    lane_bucket = np.searchsorted(np.asarray(widths, np.int64), ceil,
+                                  side="left")
+    plan = BucketPlan(widths=tuple(widths), lane_bucket=lane_bucket,
+                      counts=counts, dense_width=dense)
+    _telemetry.event("lanes.bucket_plan", n_buckets=plan.n_buckets,
+                     lanes=len(counts), dense_width=dense,
+                     pad_frac_bucketed=round(plan.pad_frac_bucketed, 4),
+                     pad_frac_dense=round(plan.pad_frac_dense, 4))
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Measured slab auto-tuning
+# ---------------------------------------------------------------------------
+
+#: Envelope schema of the autotune cache artifact; bump on layout changes
+#: so a stale cache re-measures instead of being misread.
+AUTOTUNE_SCHEMA = "rq.lanes.autotune/1"
+
+#: Candidate slab TARGETS the first-use measurement times.  This tuple is
+#: the autotuner's own search space — the one place a slab number may be
+#: written down (rqlint RQ602 flags hard-coded slab constants everywhere
+#: else).  Spanning 0.5x-2x the last hand-swept optimum keeps the
+#: measurement cheap (<= 3 timed probes) while covering the regime where
+#: the working set crosses the cache boundary.
+SLAB_CANDIDATES = (1250, 2500, 5000)
+
+ENV_AUTOTUNE_PATH = "RQ_LANES_AUTOTUNE"
+
+
+def autotune_cache_path() -> str:
+    """The autotune cache artifact's path: ``$RQ_LANES_AUTOTUNE`` when
+    set (bench children inherit it, so one measurement serves a whole
+    engine sweep), else a per-user cache file."""
+    env = os.environ.get(ENV_AUTOTUNE_PATH)
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "redqueen_tpu",
+                        "lanes_autotune.json")
+
+
+def load_autotune_cache(path: Optional[str] = None) -> Dict[str, dict]:
+    """The cache's ``entries`` dict (``"backend|shape_key" -> entry``).
+    Missing, torn, or wrong-schema files read as empty — the autotuner
+    re-measures rather than trusting an unreadable artifact."""
+    path = path or autotune_cache_path()
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(obj, dict) or obj.get("schema") != AUTOTUNE_SCHEMA:
+        return {}
+    entries = obj.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def _store_autotune(path: str, key: str, entry: dict) -> None:
+    entries = load_autotune_cache(path)
+    entries[key] = entry
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    atomic_write_json(path, {"schema": AUTOTUNE_SCHEMA, "entries": entries})
+
+
+def slab_size(B: int, target: int) -> int:
+    """Largest divisor of ``B`` in (target/2, target]; ``B`` itself
+    (unslabbed) when no divisor lands in that window — equal slabs only,
+    so a timed loop never pays a ragged remainder-slab recompile."""
+    B, target = int(B), int(target)
+    if target >= B:
+        return B
+    for s in range(target, target // 2, -1):
+        if B % s == 0:
+            return s
+    return B
+
+
+def iter_slabs(B: int, slab: int):
+    """``(start, stop)`` half-open lane ranges covering ``[0, B)`` in
+    ``slab``-sized pieces (the last may be short when ``slab`` does not
+    divide ``B`` — callers wanting equal slabs pick via
+    :func:`slab_size`)."""
+    B, slab = int(B), int(slab)
+    if slab < 1:
+        raise ValueError(f"slab must be >= 1, got {slab}")
+    for s0 in range(0, B, slab):
+        yield s0, min(s0 + slab, B)
+
+
+def _choice_from_entries(entries: Dict[str, dict], B: int, *,
+                         backend: str, shape_key: str):
+    """Cache-hit consult against already-loaded entries (so per-bucket
+    callers pay ONE file read per dispatch, never one per bucket);
+    None on a miss."""
+    entry = entries.get(f"{backend}|{shape_key}")
+    if entry and isinstance(entry.get("target"), int):
+        target = int(entry["target"])
+        return SlabChoice(slab_size(int(B), target), target, "cache", {})
+    return None
+
+
+class SlabChoice(NamedTuple):
+    """A slab decision and its provenance: ``source`` is ``"measured"``
+    (timed now), ``"cache"`` (a previous measurement's winner),
+    ``"fallback"`` (no ``time_fn`` and no cache — the median candidate),
+    or ``"unslabbed"`` (batch no bigger than the smallest candidate).
+    ``measurements`` maps candidate target -> the per-lane cost
+    ``time_fn`` reported (empty unless measured this call)."""
+
+    slab: int
+    target: int
+    source: str
+    measurements: Dict[int, float]
+
+
+def measured_slab(B: int, *, backend: str, shape_key: str,
+                  time_fn: Optional[Callable[[int], float]] = None,
+                  candidates: Sequence[int] = SLAB_CANDIDATES,
+                  cache_path: Optional[str] = None,
+                  force: bool = False) -> SlabChoice:
+    """The slab size for a ``B``-lane batch on ``backend``, measured —
+    not guessed.
+
+    First use per ``(backend, shape_key)``: calls ``time_fn(slab)`` for
+    each distinct candidate slab (``time_fn`` returns a comparable cost,
+    canonically seconds per lane for one dispatch of that many lanes),
+    picks the cheapest, and records the winner in the
+    ``rq.lanes.autotune/1`` artifact at ``cache_path`` (default
+    :func:`autotune_cache_path`).  Later calls reuse the cached winner
+    without re-measuring (``force=True`` re-measures).  Without a
+    ``time_fn`` and without a cache entry the median candidate is
+    returned with ``source="fallback"`` — recorded, never silent."""
+    B = int(B)
+    cands = sorted({int(c) for c in candidates})
+    if not cands or any(c < 1 for c in cands):
+        raise ValueError(f"candidates must be positive, got {candidates}")
+    if B <= cands[0]:
+        return SlabChoice(B, B, "unslabbed", {})
+    key = f"{backend}|{shape_key}"
+    path = cache_path or autotune_cache_path()
+    if not force:
+        choice = _choice_from_entries(load_autotune_cache(path), B,
+                                      backend=backend, shape_key=shape_key)
+        if choice is not None:
+            return choice
+    if time_fn is None:
+        target = cands[len(cands) // 2]
+        return SlabChoice(slab_size(B, target), target, "fallback", {})
+    with _telemetry.span("lanes.autotune", backend=backend,
+                         shape_key=shape_key, lanes=B) as sp:
+        measurements: Dict[int, float] = {}
+        by_slab: Dict[int, int] = {}  # distinct slab -> its target
+        for target in cands:
+            by_slab.setdefault(slab_size(B, target), target)
+        for slab, target in by_slab.items():
+            measurements[target] = float(time_fn(slab))
+        best_target = min(measurements, key=measurements.get)
+        sp.set(winner=best_target, measurements=measurements)
+    _store_autotune(path, key, {
+        "target": int(best_target),
+        "per_lane_cost": {str(t): measurements[t] for t in measurements},
+        "lanes": B,
+        "candidates": cands,
+    })
+    return SlabChoice(slab_size(B, best_target), int(best_target),
+                      "measured", dict(measurements))
+
+
+# ---------------------------------------------------------------------------
+# Slab dispatch (the scan driver's batch splitter, library-side)
+# ---------------------------------------------------------------------------
+
+
+def _pad_log_width(times, srcs, width: int):
+    import jax.numpy as jnp
+
+    have = times.shape[-1]
+    if have == width:
+        return times, srcs
+    pad = [(0, 0)] * (times.ndim - 1) + [(0, width - have)]
+    return (jnp.pad(times, pad, constant_values=jnp.inf),
+            jnp.pad(srcs, pad, constant_values=-1))
+
+
+def dispatch_slabbed(cfg, params, adj, seeds, slab: int, *,
+                     max_chunks: int = 100, sync_every: int = 8,
+                     max_events=None, engine: str = "scan",
+                     dispatch: Optional[Callable] = None):
+    """The dispatch half of :func:`simulate_slabbed`: run the [B]-lane
+    batch as consecutive ``slab``-lane dispatches and return the
+    per-slab ``EventLog`` list, WITHOUT the concatenation — so a timed
+    bench region can measure pure dispatch (the old protocol) and pay
+    the merge once, after the clock stops.
+
+    ``dispatch(cfg, params, adj, seeds) -> EventLog`` overrides the
+    per-slab dispatch (bench harnesses close extra options over it);
+    the default is :func:`~redqueen_tpu.sim.simulate_batch` with the
+    keyword options here."""
+    import jax
+
+    if dispatch is None:
+        from ..sim import simulate_batch  # local: sim imports are heavy
+
+        def dispatch(c, p, a, s):
+            return simulate_batch(c, p, a, s, max_chunks=max_chunks,
+                                  sync_every=sync_every,
+                                  max_events=max_events, engine=engine)
+
+    B = int(np.shape(seeds)[0])
+    slab = int(slab)
+    # Seeds are a tiny [B] host list by contract (per-lane integers) —
+    # slicing them host-side is the slab layer's job, not a hidden sync.
+    seeds_np = np.asarray(seeds)  # rqlint: disable=RQ701 host seed list
+    logs = []
+    with _telemetry.span("lanes.slab", lanes=B, slab=slab) as sp:
+        for s0, s1 in iter_slabs(B, slab):
+            part = lambda x: x[s0:s1]  # noqa: E731 — slab slicer
+            log = dispatch(cfg, jax.tree.map(part, params), part(adj),
+                           seeds_np[s0:s1])
+            logs.append(log)
+        sp.set(dispatches=sum(lg.dispatches or 0 for lg in logs))
+    _telemetry.counter("lanes.slab.dispatches", len(logs))
+    return logs
+
+
+def concat_slab_logs(cfg, logs):
+    """Merge per-slab ``EventLog``s (from :func:`dispatch_slabbed`) into
+    one batch log: slabs that ran fewer chunks are padded with the
+    buffer's own (+inf, -1) fill, and ``chunk_steps`` preserves the true
+    summed scan-step count for roofline accounting."""
+    import jax.numpy as jnp
+
+    if len(logs) == 1:
+        out = logs[0]
+        out.chunk_steps = out.times.shape[-1]
+        return out
+    from ..sim import EventLog
+
+    width = max(lg.times.shape[-1] for lg in logs)
+    padded = [_pad_log_width(lg.times, lg.srcs, width) for lg in logs]
+    out = EventLog(
+        jnp.concatenate([t for t, _ in padded], axis=0),  # rqlint: disable=RQ702 host list of per-slab arrays
+        jnp.concatenate([s for _, s in padded], axis=0),  # rqlint: disable=RQ702 host list of per-slab arrays
+        jnp.concatenate([jnp.atleast_1d(jnp.asarray(lg.n_events))
+                         for lg in logs]),
+        cfg,
+        health=jnp.concatenate(
+            [jnp.atleast_1d(jnp.asarray(lg.health)) for lg in logs])
+        if logs[0].health is not None else None,
+        dispatches=sum(lg.dispatches or 0 for lg in logs),
+        engine=logs[0].engine,
+        engine_reason=next(
+            (lg.engine_reason for lg in logs if lg.engine_reason), None),
+    )
+    # True scan-step total across slabs (the concat pads short slabs, so
+    # the buffer width alone would over-count roofline steps).
+    out.chunk_steps = sum(lg.times.shape[-1] for lg in logs)
+    return out
+
+
+def simulate_slabbed(cfg, params, adj, seeds, slab: int, *,
+                     max_chunks: int = 100, sync_every: int = 8,
+                     max_events=None, engine: str = "scan",
+                     dispatch: Optional[Callable] = None):
+    """Dispatch a [B]-lane batch as consecutive ``slab``-lane dispatches
+    with bit-identical per-lane results (identical seeds and streams; the
+    slabs only bound the working set — the CPU cache-locality win the
+    autotuner measures).  Returns one concatenated ``EventLog``
+    (:func:`dispatch_slabbed` + :func:`concat_slab_logs`).
+
+    The all-lanes-sick :class:`~redqueen_tpu.runtime.numerics
+    .NumericalHealthError` contract tightens to slab granularity here (a
+    fully-sick slab raises even if another slab is healthy) — strictly
+    earlier detection, same failure type."""
+    return concat_slab_logs(cfg, dispatch_slabbed(
+        cfg, params, adj, seeds, slab, max_chunks=max_chunks,
+        sync_every=sync_every, max_events=max_events, engine=engine,
+        dispatch=dispatch))
+
+
+def probe_slab_cost(run: Callable[[], object], n: int) -> float:
+    """The canonical ``time_fn`` body for :func:`measured_slab`: one
+    warm pass of ``run()`` (an ``n``-lane dispatch returning an
+    ``EventLog`` — pays the compile), one timed pass, seconds per lane.
+    Single-sourced next to ``SLAB_CANDIDATES`` so every cache entry
+    under ``AUTOTUNE_SCHEMA`` was measured under the same protocol."""
+    import time
+
+    import jax
+
+    lg = run()
+    jax.block_until_ready(lg.times)
+    t0 = time.perf_counter()
+    lg = run()
+    jax.block_until_ready(lg.times)
+    return (time.perf_counter() - t0) / int(n)
+
+
+# ---------------------------------------------------------------------------
+# Bucketed ragged dispatch
+# ---------------------------------------------------------------------------
+
+
+def ragged_bucket_component(counts, width: int, *, end_time: float,
+                            q: float = 1.0, wall_rate: float = 1.0,
+                            capacity: int = 256, start_time: float = 0.0):
+    """One bucket's dense batch, built VECTORIZED (a million-lane plan
+    cannot afford a GraphBuilder per lane): per lane, source 0 is the Opt
+    broadcaster and sources 1..width are Poisson walls feeding sinks
+    0..width-1 — wall j of lane i runs at ``wall_rate`` when j <
+    counts[i] and at rate 0 (never fires, absorbing from step 0)
+    otherwise, which is exactly GraphBuilder's benign-default padding.
+    The Opt row follows only the lane's REAL feeds, so metrics never
+    average over padding.  Returns ``(cfg, params [B_b], adj [B_b])``
+    matching :func:`~redqueen_tpu.config.GraphBuilder.build` semantics
+    lane-for-lane (pinned by tests/test_lanes.py)."""
+    import jax.numpy as jnp
+
+    from ..config import SimConfig, SourceParams
+    from ..models.base import KIND_OPT, KIND_POISSON
+
+    counts = np.asarray(counts, np.int64)
+    width = int(width)
+    if (counts < 1).any() or (counts > width).any():
+        raise ValueError(
+            f"bucket of width {width} holds counts in "
+            f"[{counts.min()}, {counts.max()}] — every lane must satisfy "
+            f"1 <= count <= width")
+    Bb, S, F = len(counts), width + 1, width
+    kind = np.zeros((Bb, S), np.int32)
+    kind[:, 0] = KIND_OPT
+    kind[:, 1:] = KIND_POISSON
+    real = np.arange(F)[None, :] < counts[:, None]       # [Bb, F]
+    rate = np.ones((Bb, S), np.float64)
+    rate[:, 1:] = np.where(real, float(wall_rate), 0.0)
+    q_arr = np.ones((Bb, S), np.float64)
+    q_arr[:, 0] = float(q)
+    adj = np.zeros((Bb, S, F), bool)
+    adj[:, 0, :] = real                                   # Opt: real feeds
+    adj[:, 1:, :] = np.eye(F, dtype=bool)[None]           # wall j -> sink j
+    # GraphBuilder's benign defaults: dummy piecewise row (one segment,
+    # rate 0, +inf tail) and +inf replay padding.
+    pw_t = np.full((Bb, S, 1), np.inf)
+    pw_t[:, :, 0] = 0.0
+    cfg = SimConfig(
+        n_sources=S, n_sinks=F, end_time=float(end_time),
+        start_time=float(start_time), capacity=int(capacity),
+        rmtpp_hidden=1,
+        present_kinds=tuple(sorted({KIND_POISSON, KIND_OPT})),
+        opt_rows=(0,),
+    )
+    f32 = jnp.float32
+    params = SourceParams(
+        kind=jnp.asarray(kind),
+        rate=jnp.asarray(rate, f32),
+        l0=jnp.ones((Bb, S), f32),
+        alpha=jnp.zeros((Bb, S), f32),
+        beta=jnp.ones((Bb, S), f32),
+        pw_times=jnp.asarray(pw_t, f32),
+        pw_rates=jnp.zeros((Bb, S, 1), f32),
+        rd_times=jnp.full((Bb, S, 1), jnp.inf, f32),
+        q=jnp.asarray(q_arr, f32),
+        s_sink=jnp.ones((Bb, F), f32),
+    )
+    return cfg, params, jnp.asarray(adj)
+
+
+def shape_budget(width: int, end_time: float, wall_rate: float,
+                 capacity: Optional[int] = None):
+    """``(capacity, max_chunks)`` for a broadcaster component of
+    ``width`` Poisson-feed followers — THE measured sizing rule, shared
+    by bench.py and the ragged bucket dispatcher so the two can never
+    diverge: chunk capacity ~mean_events/16 (pow2, clamped [64, 2048] —
+    the re-swept optimum between absorbed-step waste and per-chunk
+    dispatch cost under the superchunk driver) unless the caller pins
+    one, with a ~4x event-count chunk allowance floored at 64 (a flat
+    allowance silently capped big-F runs; the overflow contract must
+    fail on real overflow, not a harness artifact)."""
+    mean_ev = end_time * wall_rate * width * 1.25
+    if capacity is None:
+        capacity = int(min(2048, max(
+            64, 1 << int(np.log2(max(mean_ev / 16, 1)) + 0.5))))
+    max_chunks = max(64, int(4 * mean_ev / capacity) + 1)
+    return int(capacity), int(max_chunks)
+
+
+class RaggedResult(NamedTuple):
+    """Per-lane summaries of a bucketed ragged dispatch, in the CALLER'S
+    original lane order (bucket reordering is internal).  ``logs`` is
+    ``None`` unless ``return_logs=True`` (test/debug shapes): per lane,
+    the ``(times, srcs)`` arrays trimmed to its valid events."""
+
+    n_events: np.ndarray       # i64[B]
+    top_k: np.ndarray          # f64[B] mean time-in-top-K over real feeds
+    posts: np.ndarray          # f64[B] broadcaster posts
+    health: np.ndarray         # u32[B] lane-health bitmask
+    plan: BucketPlan
+    dispatches: int
+    engine: str
+    logs: Optional[List[Tuple[np.ndarray, np.ndarray]]]
+
+    @property
+    def events(self) -> int:
+        return int(self.n_events.sum())
+
+
+def _numeric_fault_site(counts_len: int):
+    """(original lane, mode) of the env numeric fault when it addresses
+    this ragged dispatch, else None — evaluated ONCE against the
+    original lane order so bucket reordering cannot change which logical
+    lane gets hit."""
+    return _faultinject.active_numeric_lane(counts_len)
+
+
+def simulate_ragged(counts, seeds, *, end_time: float, q: float = 1.0,
+                    wall_rate: float = 1.0, engine: str = "scan",
+                    max_buckets: int = 8, capacity: Optional[int] = None,
+                    sync_every: int = 8, slab_target: Optional[int] = None,
+                    max_lane_elems: int = 32_000_000, metric_K: int = 1,
+                    cache_path: Optional[str] = None,
+                    return_logs: bool = False) -> RaggedResult:
+    """Simulate ``B`` ragged broadcaster components (1 Opt vs
+    ``counts[i]`` Poisson-feed followers — the headline per-broadcaster
+    component at per-lane width) as at most ``max_buckets`` dense bucket
+    dispatches.
+
+    Per-lane results are BIT-IDENTICAL to the dense-padded reference
+    (``max_buckets=1``) on matched seeds — and to the unpadded
+    single-component ``GraphBuilder`` build — because padding adds only
+    rate-0 sources whose streams nothing consumes (pinned by
+    tests/test_lanes.py for the scan engine and the pallas interpreter).
+
+    ``seeds`` [B] ride with their lanes through the bucket reordering;
+    ``engine`` forwards to :func:`~redqueen_tpu.sim.simulate_batch`.
+    Each bucket dispatches in slabs sized by the autotune cache (
+    ``slab_target`` overrides; a per-slab element ceiling
+    ``max_lane_elems`` bounds host+device memory at big widths).
+    ``RQ_FAULT=numeric:*@laneN`` addresses lane N of the ORIGINAL order.
+    """
+    from ..utils.metrics import feed_metrics_batch, num_posts
+
+    counts = np.asarray(counts, np.int64)
+    seeds = np.asarray(seeds)
+    if seeds.ndim != 1 or len(seeds) != len(counts):
+        raise ValueError(
+            f"seeds must be 1-D with one entry per lane, got "
+            f"{seeds.shape} for {len(counts)} lanes")
+    plan = plan_buckets(counts, max_buckets=max_buckets)
+    B = len(counts)
+    # Evaluate the env numeric fault ONCE against the original lane
+    # order: fault_lane is the addressed ORIGINAL lane (None when the
+    # spec misses this dispatch), abs_lane the spec's absolute index
+    # (what nested scopes must translate against).
+    fault_site = _numeric_fault_site(B)
+    fault_chunk = _faultinject.numeric_scope_ctx()[0]
+    fault_lane = fault_site[0] if fault_site is not None else None
+    abs_lane = (_faultinject.numeric_fault().lane
+                if fault_site is not None else None)
+
+    n_events = np.zeros(B, np.int64)
+    top_k = np.zeros(B, np.float64)
+    posts = np.zeros(B, np.float64)
+    health = np.zeros(B, np.uint32)
+    logs: Optional[list] = [None] * B if return_logs else None
+    dispatches = 0
+    engine_used = engine
+    # The autotune cache is read ONCE per dispatch (not once per bucket):
+    # simulate_ragged runs inside timed bench regions, where a per-bucket
+    # open()+parse would land avoidable file I/O on the clock.
+    if slab_target is None:
+        backend = _backend_name()
+        at_entries = load_autotune_cache(cache_path)
+    else:
+        backend, at_entries = None, {}
+
+    with _telemetry.span("lanes.ragged", lanes=B,
+                         n_buckets=plan.n_buckets,
+                         pad_frac=round(plan.pad_frac_bucketed, 4)):
+        for b, width in enumerate(plan.widths):
+            idx = plan.lanes_of(b)
+            if idx.size == 0:
+                continue
+            cap_b, max_chunks = shape_budget(
+                width, end_time, wall_rate, capacity)
+            real_e = int(counts[idx].sum())
+            _telemetry.counter("lanes.pad.real_elems", real_e)
+            _telemetry.counter("lanes.pad.padded_elems",
+                               width * idx.size - real_e)
+            # Slab sizing: the autotuned target for this backend/width
+            # bucket (cache consult only — ragged callers measure via
+            # bench/tools, not mid-dispatch), clamped by the
+            # per-dispatch element ceiling so hub-width buckets cannot
+            # blow host/device memory.
+            if slab_target is None:
+                choice = _choice_from_entries(
+                    at_entries, int(idx.size), backend=backend,
+                    shape_key=f"ragged/W{width}")
+                if choice is None and backend == "cpu":
+                    # No measured entry: the recorded fallback (median
+                    # candidate), same policy as measured_slab without
+                    # a time_fn.
+                    target = sorted(SLAB_CANDIDATES)[
+                        len(SLAB_CANDIDATES) // 2]
+                    choice = SlabChoice(
+                        slab_size(int(idx.size), target), target,
+                        "fallback", {})
+                elif choice is None:
+                    # The fallback candidates are CPU cache-locality
+                    # numbers; on an accelerator with no MEASURED entry
+                    # they would fragment the dispatch the chip wants
+                    # whole — run the bucket unslabbed (the memory
+                    # ceiling below still bounds it).
+                    choice = SlabChoice(int(idx.size), int(idx.size),
+                                        "unslabbed", {})
+            else:
+                choice = SlabChoice(
+                    slab_size(int(idx.size), int(slab_target)),
+                    int(slab_target), "caller", {})
+            slab = max(1, min(choice.slab,
+                              max_lane_elems // max(width * width, 1)))
+            # Prefer equal slabs (one compiled shape), but NEVER let the
+            # divisor window re-inflate past the memory ceiling:
+            # slab_size returns the bucket size itself when no divisor
+            # lands in (slab/2, slab], which at hub widths would undo
+            # the clamp — a ragged remainder slab (one extra compile)
+            # is the cheaper failure.
+            eq = slab_size(int(idx.size), slab)
+            slab = eq if eq <= slab else slab
+            with _telemetry.span("lanes.ragged.bucket", width=width,
+                                 lanes=int(idx.size), slab=slab) as bsp:
+                for s0, s1 in iter_slabs(idx.size, slab):
+                    oi = idx[s0:s1]
+                    # The slab's arrays are built HERE, slab-sized
+                    # (never the whole bucket): at 10^6 lanes a
+                    # hub-width bucket's full [B_b, S, F] adjacency
+                    # would not fit, and equal slabs share one compiled
+                    # shape per bucket anyway.
+                    cfg, params, adj = ragged_bucket_component(
+                        counts[oi], width, end_time=end_time, q=q,
+                        wall_rate=wall_rate, capacity=cap_b)
+                    try:
+                        log = _dispatch_ragged_slab(
+                            cfg, params, adj, seeds[oi], oi, engine,
+                            max_chunks, sync_every, fault_lane, abs_lane,
+                            fault_chunk)
+                    except _NumericalHealthError as e:
+                        # Every lane of THIS slab died; the ragged layer
+                        # owns lane granularity, so record the per-lane
+                        # bits at their original positions (metrics stay
+                        # zero — garbage is never reported) and keep the
+                        # other buckets' results.  If the WHOLE dispatch
+                        # is sick the caller sees it in
+                        # RaggedResult.health, matching the sweep
+                        # layer's quarantine contract.
+                        health[oi] = e.health.astype(np.uint32)
+                        dispatches += 1
+                        continue
+                    dispatches += log.dispatches or 1
+                    engine_used = log.engine
+                    m = feed_metrics_batch(
+                        log.times, log.srcs, adj, 0, end_time,
+                        K=metric_K)
+                    # The bucket's one results boundary: reduced per-lane
+                    # scalars cross to host here, never per event.
+                    n_events[oi] = np.asarray(
+                        _dg(log.n_events)).reshape(-1)
+                    top_k[oi] = np.asarray(
+                        _dg(m.mean_time_in_top_k())).reshape(-1)
+                    posts[oi] = np.asarray(
+                        _dg(num_posts(log.srcs, 0))).reshape(-1)
+                    if log.health is not None:
+                        health[oi] = np.asarray(
+                            _dg(log.health)).reshape(-1)
+                    if logs is not None:
+                        t_np = np.asarray(_dg(log.times))
+                        s_np = np.asarray(_dg(log.srcs))
+                        for j, lane in enumerate(oi):
+                            ne = int(n_events[lane])
+                            logs[lane] = (t_np[j, :ne].copy(),
+                                          s_np[j, :ne].copy())
+                bsp.set(dispatches=dispatches)
+    return RaggedResult(n_events=n_events, top_k=top_k, posts=posts,
+                        health=health, plan=plan, dispatches=dispatches,
+                        engine=engine_used, logs=logs)
+
+
+def _dg(x):
+    """The ragged dispatch's documented device->host boundary (one
+    reduced per-lane vector per bucket slab)."""
+    import jax
+
+    return jax.device_get(x)  # rqlint: disable=RQ701 results boundary
+
+
+def _backend_name() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _dispatch_ragged_slab(cfg, params, adj, seeds_oi, oi, engine,
+                          max_chunks, sync_every, fault_lane, abs_lane,
+                          fault_chunk):
+    """One bucket-slab dispatch, with the env numeric fault translated
+    into the slab's local lane space (or pushed out of range for slabs
+    that do not contain the addressed original lane)."""
+    from ..sim import simulate_batch
+
+    kwargs = dict(max_chunks=max_chunks, sync_every=sync_every,
+                  engine=engine)
+    if fault_lane is None:
+        return simulate_batch(cfg, params, adj, seeds_oi, **kwargs)
+    pos = np.flatnonzero(oi == fault_lane)
+    # lane_base translates the spec's absolute lane index onto this
+    # slab's local position of the addressed ORIGINAL lane; a slab
+    # without the lane gets a base that pushes the translated index
+    # below 0 (never fires).
+    base = (int(abs_lane) - int(pos[0]) if pos.size
+            else int(abs_lane) + len(oi) + 1)
+    with _faultinject.numeric_scope(chunk=fault_chunk, lane_base=base):
+        return simulate_batch(cfg, params, adj, seeds_oi, **kwargs)

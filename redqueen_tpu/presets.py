@@ -21,7 +21,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["PRESETS", "build_preset", "run_preset"]
+__all__ = ["PRESETS", "build_preset", "run_preset", "power_law_graph"]
 
 
 def _scaled(n: int, scale: float, lo: int = 1) -> int:
@@ -138,6 +138,65 @@ def config5_rmtpp(scale: float = 1.0, end_time: float = 100.0,
     return ("batch", cfg, params, adj, row)
 
 
+def power_law_graph(B, alpha: float = 2.2, seed: int = 0,
+                    min_followers: int = 1, max_followers: int = 1024,
+                    end_time: float = 100.0, q: float = 1.0,
+                    wall_rate: float = 1.0, scale: float = 1.0):
+    """``B`` independent broadcaster components whose follower counts
+    follow a truncated power law ``P(F = k) ∝ k^-alpha`` on
+    ``[min_followers, max_followers]`` — the paper's "millions of users"
+    feed-graph shape, where a handful of hubs have thousands of
+    followers and the long tail has a few.  Returns a ragged bundle
+    ``("ragged", counts, opts)`` for
+    :func:`~redqueen_tpu.parallel.lanes.simulate_ragged` (via
+    :func:`run_preset`), so a 10⁶-lane config is one call.
+
+    Host-side domain validation is typed
+    (:class:`~redqueen_tpu.config.ConfigValidationError`): ``B`` must be
+    a true integer (a float 1e6 would silently truncate), ``alpha``
+    finite and > 0, and ``max_followers >= 2`` — an all-single-follower
+    graph is a degenerate star with no raggedness to bucket (use
+    ``config1_toy``/``config3_bipartite`` for fixed-width graphs)."""
+    from .config import ConfigValidationError
+
+    if isinstance(B, bool) or not isinstance(B, (int, np.integer)):
+        raise ConfigValidationError(
+            f"B must be an integer broadcaster count, got {B!r} "
+            f"({type(B).__name__}) — a float would silently truncate "
+            f"the lane count")
+    if B < 1:
+        raise ConfigValidationError(f"B must be >= 1, got {B}")
+    alpha = float(alpha)
+    if not (np.isfinite(alpha) and alpha > 0):
+        raise ConfigValidationError(
+            f"alpha must be finite and > 0, got {alpha!r} (the tail "
+            f"exponent of P(F=k) ∝ k^-alpha)")
+    min_f, max_f = int(min_followers), int(max_followers)
+    if min_f < 1:
+        raise ConfigValidationError(
+            f"min_followers must be >= 1, got {min_followers!r}")
+    if max_f < min_f:
+        raise ConfigValidationError(
+            f"max_followers ({max_followers!r}) must be >= min_followers "
+            f"({min_followers!r})")
+    if max_f < 2:
+        raise ConfigValidationError(
+            "max_followers < 2 makes every broadcaster a single-follower "
+            "component — a degenerate star with no raggedness to bucket; "
+            "use config1_toy/config3_bipartite for fixed-width graphs")
+    B_s = _scaled(B, scale)
+    max_f = max(_scaled(max_f, scale), 2)
+    min_f = min(min_f, max_f)
+    ks = np.arange(min_f, max_f + 1, dtype=np.float64)
+    p = ks ** -alpha
+    p /= p.sum()
+    rng = np.random.RandomState(seed)
+    counts = rng.choice(np.arange(min_f, max_f + 1), size=B_s, p=p)
+    return ("ragged", counts.astype(np.int64),
+            dict(end_time=float(end_time), q=float(q),
+                 wall_rate=float(wall_rate)))
+
+
 PRESETS = {
     1: config1_toy,
     2: config2_hawkes,
@@ -149,6 +208,7 @@ PRESETS = {
     "bipartite": config3_bipartite,
     "replay": config4_replay,
     "rmtpp": config5_rmtpp,
+    "power_law": power_law_graph,
 }
 
 
@@ -221,6 +281,41 @@ def run_preset(bundle, seeds, mesh=None, max_chunks: int = 256,
             tops = jax.device_get(m.mean_time_in_top_k())
             posts = jax.device_get(num_posts(log.srcs, opt_row))
             events = int(jax.device_get(log.n_events).sum())
+    elif kind == "ragged":
+        # Power-law ragged bundle: bucketed dispatch through the unified
+        # lane layer (parallel.lanes) — per-lane seeds, original order.
+        # Chunk budgets are derived per bucket by the lane layer
+        # (lanes.shape_budget), so ``max_chunks`` does not apply here.
+        if mesh is not None:
+            raise ValueError(
+                "ragged presets dispatch through parallel.lanes."
+                "simulate_ragged, which does not shard over a mesh yet "
+                "(the ROADMAP item 3 remainder) — drop mesh or use a "
+                "batch/star preset")
+        _, counts, opts = bundle
+        from .parallel.lanes import simulate_ragged
+
+        B = len(counts)
+        seeds = np.asarray(seeds)
+        if seeds.ndim == 0:
+            seeds = np.arange(B) + int(seeds)  # base seed -> one per lane
+        elif len(seeds) != B:
+            raise ValueError(
+                f"ragged preset needs {B} seeds (one per lane) or a "
+                f"scalar base seed; got {len(seeds)}"
+            )
+        # RaggedResult fields are host numpy by contract (the ragged
+        # dispatch crosses device->host once per bucket slab, at its
+        # documented _dg boundary) — no hidden sync below.
+        rr = simulate_ragged(counts, seeds, metric_K=metric_K, **opts)
+        return {
+            "events": rr.events,
+            "mean_time_in_top_k": float(rr.top_k.mean()),  # rqlint: disable=RQ701 host numpy
+            "mean_posts": float(rr.posts.mean()),  # rqlint: disable=RQ701 host numpy
+            "per_seed_top_k": rr.top_k.tolist(),  # rqlint: disable=RQ701 host numpy
+            "per_seed_posts": rr.posts.tolist(),  # rqlint: disable=RQ701 host numpy
+            "end_time": opts["end_time"],
+        }
     elif kind == "star":
         _, cfg, wall, ctrl = bundle
         seeds_arr = np.asarray(seeds).ravel()

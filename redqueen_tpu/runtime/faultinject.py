@@ -407,6 +407,15 @@ def numeric_scope(chunk: Optional[int] = None, lane_base: int = 0):
         _NUMERIC_CTX = prev
 
 
+def numeric_scope_ctx() -> Tuple[Optional[int], int]:
+    """The ``(chunk, lane_base)`` numeric-fault addressing context in
+    effect (see :func:`numeric_scope`).  Dispatch layers that REORDER
+    lanes (the bucketed ragged dispatch in ``parallel.lanes``) read the
+    current chunk here so their nested per-dispatch scopes translate the
+    lane index without clobbering the sweep-chunk qualifier."""
+    return _NUMERIC_CTX
+
+
 def active_numeric_lane(batch_size: int) -> Optional[Tuple[int, str]]:
     """``(local_lane, mode)`` if the env-configured numeric fault lands in
     the current dispatch, else None.

@@ -99,12 +99,31 @@ class RecoveryInfo(NamedTuple):
     lost_acked_seqs: Tuple[int, ...] = ()
 
 
-def _pad_events(times, feeds, max_batch_events: int):
-    """Pad one batch to the fixed dispatch shape (ONE compilation of the
-    apply step per runtime).  Shared verbatim by the live apply path and
-    :func:`recover`'s journal replay — the two must pad identically or
-    replay would not be bit-identical."""
-    E = int(max_batch_events)
+#: Smallest padded dispatch width the live apply paths use: pad widths
+#: are pow-2 BUCKETS between this floor and ``max_batch_events`` (the
+#: unified lane layer, ``parallel.lanes.bucket_width``), so a 3-event
+#: micro-batch no longer pads to the full configured width while the
+#: number of compiled apply shapes stays <= log2(E/floor)+1.  The apply
+#: step is bitwise invariant to the pad width (every padded slot is
+#: ``valid``-masked; asserted against the full-width path in
+#: tests/test_serving_wirespeed.py), so replay/recovery — which may pad
+#: at a different width — stays bit-identical.
+_PAD_WIDTH_FLOOR = 16
+
+
+def _pad_width(n_events: int, max_batch_events: int) -> int:
+    """Bucketed pad width for a group of ``n_events`` valid events."""
+    from ..parallel.lanes import bucket_width
+
+    return bucket_width(int(n_events), floor=_PAD_WIDTH_FLOOR,
+                        cap=int(max_batch_events))
+
+
+def _pad_events(times, feeds, width: int):
+    """Pad one batch to the dispatch width (a pow-2 bucket on the live
+    path, the full ``max_batch_events`` on replay — the apply step's
+    bitwise pad-width invariance makes the two interchangeable)."""
+    E = int(width)
     t = np.zeros(E, np.float32)
     f = np.zeros(E, np.int32)
     n = len(times)
@@ -194,6 +213,7 @@ class ServingRuntime:
                                           start_seq=start_seq)
             self._state = self._maybe_poison(self._state)
 
+        self._prewarm_pad_widths()
         self._journal: Optional[Journal] = None
         if dir is not None:
             os.makedirs(dir, exist_ok=True)
@@ -414,9 +434,49 @@ class ServingRuntime:
 
     # ---- apply path ----
 
+    def _prewarm_pad_widths(self) -> None:
+        """Compile every bucketed apply shape UP FRONT: pad widths are a
+        small bounded set (pow-2 from ``_PAD_WIDTH_FLOOR`` to
+        ``max_batch_events``), and paying the traces at construction
+        keeps the wire-speed path free of mid-traffic compile stalls
+        when a rare width first appears.  Each warm call runs on a
+        THROWAWAY state (never the live carry — the jitted fns donate
+        their state argument on donating backends), and the jit dispatch
+        cache is process-global, so later runtimes with the same feed
+        count warm for free."""
+        import jax.numpy as jnp
+
+        # No telemetry span here on purpose: construction runs OUTSIDE
+        # any serving trace root, and an orphan span would break the
+        # one-trace-per-round invariant the span-chain tests pin.
+        widths, E = [], _PAD_WIDTH_FLOOR
+        while E < int(self.max_batch_events):
+            widths.append(E)
+            E *= 2
+        widths.append(int(self.max_batch_events))
+        for E in sorted(set(min(w, int(self.max_batch_events))
+                            for w in widths)):
+            dummy = init_feed_state(self.n_feeds, 0)
+            t = np.zeros(E, np.float32)
+            f = np.zeros(E, np.int32)
+            self._apply(dummy, t, f, np.int32(0), np.int32(0),
+                        self._s_sink, self._q)
+            if self._apply_many is not None:
+                K = self.coalesce
+                dummy = init_feed_state(self.n_feeds, 0)
+                self._apply_many(
+                    dummy, jnp.zeros((K, E), jnp.float32),
+                    jnp.zeros((K, E), jnp.int32),
+                    jnp.zeros((K,), jnp.int32),
+                    jnp.zeros((K,), jnp.int32), np.int32(0),
+                    self._s_sink, self._q)
+
     def _pad(self, batch: EventBatch):
-        return _pad_events(batch.times, batch.feeds,
-                           self.max_batch_events)
+        E = _pad_width(batch.n_events, self.max_batch_events)
+        _telemetry.counter("lanes.pad.real_elems", int(batch.n_events))
+        _telemetry.counter("lanes.pad.padded_elems",
+                           E - int(batch.n_events))
+        return _pad_events(batch.times, batch.feeds, E)
 
     def _append_record(self, batch: EventBatch, decision: Decision,
                        new_state: FeedState) -> None:
@@ -538,10 +598,23 @@ class ServingRuntime:
         chaos acceptance digests are grouping-independent."""
         import jax
 
-        K, E = self.coalesce, self.max_batch_events
+        K = self.coalesce
         k = len(group)
+        # Bucketed pad width for the WHOLE group (one dispatch shape per
+        # poll round): the widest member's bucket, not the configured
+        # max — the unified lane layer's pad-waste lever, bitwise
+        # invariant to the width (see _PAD_WIDTH_FLOOR).
+        real = sum(int(b.n_events) for b, _ in group)
+        E = _pad_width(max(int(b.n_events) for b, _ in group),
+                       self.max_batch_events)
         with _telemetry.span("serving.coalesce") as csp:
-            csp.set(k=k)
+            # Waste is accounted at the DISPATCH shape (K, E) — the
+            # (K - k) empty group rows are padding too, and on lightly
+            # loaded rounds they are the dominant term.
+            csp.set(k=k, pad_width=E,
+                    pad_frac=round(1.0 - real / (K * E), 4))
+            _telemetry.counter("lanes.pad.real_elems", real)
+            _telemetry.counter("lanes.pad.padded_elems", K * E - real)
             times = np.zeros((K, E), np.float32)
             feeds = np.zeros((K, E), np.int32)
             nvalid = np.zeros((K,), np.int32)

@@ -203,9 +203,11 @@ def make_coalesced_apply_fn():
     """The jitted coalesced apply (see :func:`_apply_many`): signature
     ``(state, times[K,E], feeds[K,E], n_valid[K], seqs[K], k_valid,
     s_sink, q) -> (state', (posted[K], t[K], intensity[K]))``.  One
-    compilation per (K, E) shape — the runtime pads every poll round to
-    its configured coalesce width so steady-state serving never
-    recompiles."""
+    compilation per (K, E) shape — K is always the configured coalesce
+    width, while E is the group's pow-2 pad-width bucket
+    (``service._pad_width``), a small bounded set the runtime
+    PRE-COMPILES at construction so steady-state serving never pays a
+    mid-traffic trace/compile stall."""
     return _apply_many_cached(jax.default_backend() != "cpu")
 
 

@@ -514,7 +514,7 @@ def simulate(cfg: SimConfig, params: SourceParams, adj, seed,
 def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
                    max_chunks: int = 100, return_state: bool = False,
                    max_events: Optional[int] = None, sync_every: int = 8,
-                   engine: str = "scan"):
+                   engine: str = "scan", slab: Optional[int] = None):
     """Run B same-shape components in lockstep (params/adj have a leading
     batch axis; ``seeds`` is an int array [B] or a key array [B, 2]).
 
@@ -527,7 +527,23 @@ def simulate_batch(cfg: SimConfig, params: SourceParams, adj, seeds,
     general event-scan engine), ``"pallas"`` (the fused megakernel,
     forced; integer seeds only), or ``"auto"`` (megakernel when
     :func:`select_engine` says it covers this dispatch, scan otherwise
-    with the fallback reason recorded on ``EventLog.engine_reason``)."""
+    with the fallback reason recorded on ``EventLog.engine_reason``).
+
+    ``slab`` dispatches the batch in consecutive ``slab``-lane pieces
+    with bit-identical per-lane results (identical seeds and streams) —
+    the CPU cache-locality lever, sized by the measured auto-tuner
+    (:func:`~redqueen_tpu.parallel.lanes.measured_slab`) rather than a
+    hard-coded constant.  Slab dispatch has no ``SimState`` handoff."""
+    if slab is not None and slab < np.shape(seeds)[0]:
+        if return_state:
+            raise ValueError(
+                "slab dispatch has no SimState handoff (per-slab carries "
+                "cannot merge) — return_state is an unslabbed contract")
+        from .parallel.lanes import simulate_slabbed
+
+        return simulate_slabbed(
+            cfg, params, adj, seeds, slab, max_chunks=max_chunks,
+            sync_every=sync_every, max_events=max_events, engine=engine)
     _check_kinds(cfg, params)
     _check_weights(cfg, params)
     _check_finite_params(cfg, params)

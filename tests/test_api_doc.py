@@ -15,6 +15,7 @@ MODULES = [
     "redqueen_tpu.ops.pallas_engine", "redqueen_tpu.ops.pallas_vmem",
     "redqueen_tpu.parallel.comm", "redqueen_tpu.parallel.multihost",
     "redqueen_tpu.parallel.bigf", "redqueen_tpu.parallel.shard",
+    "redqueen_tpu.parallel.lanes", "redqueen_tpu.presets",
     "redqueen_tpu.data.traces", "redqueen_tpu.models.rmtpp",
     "redqueen_tpu.models.base", "redqueen_tpu.baselines",
     "redqueen_tpu.utils.metrics", "redqueen_tpu.utils.metrics_pandas",

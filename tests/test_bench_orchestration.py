@@ -89,10 +89,9 @@ def test_wedged_engines_still_land_a_cpu_line(monkeypatch, capsys):
     """Round-2 failure shape: probe alive, every TPU engine hangs (None).
     The CPU sweep must still run and print a complete line."""
     runner = Runner({
-        ("scan", "default"): None, ("star", "default"): None,
+        ("scan", "default"): None,
         ("pallas", "default"): None,
         ("scan", "cpu"): _engine_res("cpu", 3_000_000),
-        ("star", "cpu"): _engine_res("cpu", 800_000),
     })
     _patch(monkeypatch, runner, alive=True)
     bench.parent_main(_args())
@@ -106,10 +105,8 @@ def test_tpu_and_cpu_swept_best_backend_wins(monkeypatch, capsys):
     one's line is last (here CPU beats the tunnel-bound TPU)."""
     runner = Runner({
         ("scan", "default"): _engine_res("tpu", 50_000),
-        ("star", "default"): _engine_res("tpu", 30_000),
         ("pallas", "default"): None,
         ("scan", "cpu"): _engine_res("cpu", 3_000_000),
-        ("star", "cpu"): _engine_res("cpu", 800_000),
     })
     _patch(monkeypatch, runner, alive=True)
     bench.parent_main(_args())
@@ -124,7 +121,6 @@ def test_evidence_run_never_touches_cpu(monkeypatch, capsys):
     platform, so no CPU engine may run even when TPU engines are slow."""
     runner = Runner({
         ("scan", "default"): _engine_res("tpu", 50_000),
-        ("star", "default"): _engine_res("tpu", 30_000),
         ("pallas", "default"): _engine_res("tpu", 10_000),
     })
     _patch(monkeypatch, runner, alive=True)
@@ -184,8 +180,7 @@ def test_default_budget_preserves_cpu_reserve(monkeypatch, rem,
 def test_result_line_is_self_auditing(monkeypatch, capsys):
     """Every result line carries the oracle denominator and the quality
     gate (round-3 verdict item 6), and is echoed to RESULT_FILE."""
-    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000),
-                     ("star", "cpu"): _engine_res("cpu", 800_000)})
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000)})
     _patch(monkeypatch, runner, alive=False)
     bench.parent_main(_args())
     line = _last_json(capsys)
@@ -223,38 +218,39 @@ def test_no_oracle_line_has_null_gate(monkeypatch, capsys):
     assert line["gate_ok"] is None
 
 
-@pytest.mark.parametrize("star_res", [None, "slower"],
+@pytest.mark.parametrize("pallas_res", [None, "slower"],
                          ids=["failed-engine", "slower-engine"])
 def test_best_line_reprinted_after_every_engine(monkeypatch, capsys,
-                                                star_res):
+                                                pallas_res):
     """Between the early emit and process exit the tail must stay JSON:
     after EACH later engine — failed OR merely slower — the standing best
     line is re-printed, so even a SIGKILL between engines (which skips
     atexit) leaves a parseable tail."""
-    star = None if star_res is None else _engine_res("cpu", 800_000)
+    pallas = None if pallas_res is None else _engine_res("cpu", 800_000)
     runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000),
-                     ("star", "cpu"): star})
+                     ("pallas", "cpu"): pallas})
     _patch(monkeypatch, runner, alive=False)
-    # star is opt-in since the --engines default narrowed to oracle,scan
-    bench.parent_main(_args(engines="oracle,scan,star"))
+    # --interpret lets the pallas child sweep on the CPU backend (the
+    # correctness slot), giving the sweep a second engine after scan
+    bench.parent_main(_args(engines="oracle,scan,pallas", interpret=True))
     out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     assert json.loads(out[-1])["value"] == pytest.approx(3_000_000)
-    # best emitted once for scan, re-printed once after the star outcome
+    # best emitted once for scan, re-printed once after the pallas outcome
     assert len([ln for ln in out if ln.startswith("{")]) == 2
 
 
-def test_engines_default_excludes_star(monkeypatch, capsys):
-    """--engines defaults to oracle,scan(+pallas-on-TPU): the star
-    engine (20x slower than scan on CPU, BENCH_r05, never wins) must
-    not burn its ~88s unless opted in."""
-    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000),
-                     ("star", "cpu"): _engine_res("cpu", 9_000_000)})
+def test_star_engine_retired(monkeypatch):
+    """The star engine is RETIRED from the headline bench (unified lane
+    batching PR): both the --engines list and the legacy --engine flag
+    must refuse it with the recorded reason — no silently-kept
+    20x-slower opt-in path, and no silent drop either."""
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 3_000_000)})
     _patch(monkeypatch, runner, alive=False)
-    bench.parent_main(_args())
-    assert all(e != "star" for e, _, _ in runner.calls)
-    line = _last_json(capsys)
-    assert line["value"] == pytest.approx(3_000_000)
-    assert line["engine"] == "scan"
+    with pytest.raises(RuntimeError, match="retired"):
+        bench.parent_main(_args(engines="oracle,scan,star"))
+    with pytest.raises(RuntimeError, match="retired"):
+        bench.parent_main(_args(engine="star"))
+    assert runner.calls == [], "a retired engine must not burn child time"
 
 
 def test_engines_default_keeps_pallas_on_tpu(monkeypatch, capsys):
@@ -294,14 +290,16 @@ def test_engines_validation(monkeypatch):
 
 
 def test_legacy_engine_flag_overrides_engines(monkeypatch, capsys):
-    """--engine star (non-auto) still forces exactly that engine, with
+    """--engine NAME (non-auto) still forces exactly that engine, with
     the oracle denominator governed by the --engines list."""
-    runner = Runner({("star", "cpu"): _engine_res("cpu", 800_000)})
+    runner = Runner({("scan", "cpu"): _engine_res("cpu", 800_000),
+                     ("pallas", "cpu"): _engine_res("cpu", 900_000)})
     _patch(monkeypatch, runner, alive=False)
-    bench.parent_main(_args(engine="star"))
-    assert [e for e, _, _ in runner.calls] == ["oracle", "star"]
+    bench.parent_main(_args(engine="scan", engines="oracle,scan,pallas",
+                            interpret=True))
+    assert [e for e, _, _ in runner.calls] == ["oracle", "scan"]
     line = _last_json(capsys)
-    assert line["engine"] == "star"
+    assert line["engine"] == "scan"
 
 
 def test_run_child_recovers_result_from_timeout_stdout(monkeypatch):
@@ -411,7 +409,7 @@ def fake_run_child(args, engine, backend, timeout_s):
         return {{"ok": True, "events": 3_000_000, "secs": 1.0,
                  "top1": 16.1, "top1_std": 1.0, "top1_n": 64,
                  "posts": 50.0, "platform": "cpu"}}
-    # star: the slow loser — lands AFTER the winner's line is on stdout
+    # pallas: the slow loser — lands AFTER the winner's line is on stdout
     for i in range(120):
         print(f"E0730 cpu_aot_loader: executable compiled with +amx-bf16 "
               f"+amx-int8 +prefer-no-gather but host lacks them ({{i}})",
@@ -426,8 +424,8 @@ bench._default_backend_alive = lambda log: False
 args = types.SimpleNamespace(
     quick=False, cpu=True, tpu=False, broadcasters=64, followers=10,
     horizon=20.0, capacity=None, q=1.0, wall_rate=1.0, config=None,
-    engine="auto", engines="oracle,scan,star", deadline=900.0,
-    engine_deadline=420.0, no_oracle=False)
+    engine="auto", engines="oracle,scan,pallas", interpret=True,
+    deadline=900.0, engine_deadline=420.0, no_oracle=False)
 bench.parent_main(args)
 print("late diagnostic after the sweep returned", file=sys.stderr)
 """)

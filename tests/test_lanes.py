@@ -1,0 +1,341 @@
+"""Unified lane batching & slab auto-tuning (parallel.lanes).
+
+The load-bearing contract: bucketed-ragged dispatch is BIT-IDENTICAL per
+lane to the dense-padded reference (``max_buckets=1``) and to the
+unpadded GraphBuilder build, on matched seeds — for the scan engine AND
+the pallas interpreter — because every PRNG stream depends only on
+(lane seed, source index, draw counter), never on the padded shape.
+Plus: the bucket-plan bound/coverage/waste invariants, the measured slab
+autotuner's artifact round trip, slabbed-dispatch bit-identity through
+``sim.simulate_batch(slab=...)``, per-lane health-bit flow through
+bucket reordering (RQ_FAULT lane addressing stays in original order),
+and the power-law preset's typed validation."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from redqueen_tpu.config import ConfigValidationError, GraphBuilder, \
+    stack_components
+from redqueen_tpu.parallel import lanes
+from redqueen_tpu.presets import build_preset, run_preset
+from redqueen_tpu.sim import simulate, simulate_batch
+
+# A deliberately ragged width set: singletons, a mid bucket, one hub.
+COUNTS = np.array([1, 2, 3, 9, 17, 5, 33, 2, 64, 31])
+SEEDS = np.arange(len(COUNTS)) + 7
+T = 12.0
+
+
+# ---------------------------------------------------------------------------
+# Bucket planning
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_plan_bounded_and_covering():
+    plan = lanes.plan_buckets(COUNTS, max_buckets=3)
+    assert plan.n_buckets <= 3
+    w = np.asarray(plan.widths)
+    assert (w[plan.lane_bucket] >= COUNTS).all(), "every lane must fit"
+    # each lane sits in the SMALLEST bucket that holds it
+    for i, c in enumerate(COUNTS):
+        smaller = [x for x in plan.widths if x < w[plan.lane_bucket[i]]]
+        assert all(x < c for x in smaller) or not smaller
+
+    # waste accounting: bucketed <= dense, and the reduction is the
+    # complement ratio of the two waste totals
+    assert plan.bucketed_elems <= plan.dense_elems
+    assert 0.0 <= plan.pad_frac_bucketed <= plan.pad_frac_dense < 1.0
+
+
+def test_bucket_plan_dense_is_one_bucket():
+    plan = lanes.plan_buckets(COUNTS, max_buckets=1)
+    assert plan.n_buckets == 1
+    assert plan.widths[0] == plan.dense_width
+    assert plan.padded_elem_reduction == 0.0
+
+
+def test_plan_floors_width_one_lanes():
+    """Width-1 buckets compile through XLA's tiny-shape scalar math path
+    whose rounding drifts 1 ULP from the vectorized path (measured on
+    the Opt post times) — the planner floors at MIN_BUCKET_WIDTH so the
+    bit-identity contract holds for single-follower lanes too."""
+    assert lanes.MIN_BUCKET_WIDTH >= 2
+    plan = lanes.plan_buckets([1, 1, 1])
+    assert plan.widths == (lanes.MIN_BUCKET_WIDTH,)
+
+
+def test_bucket_plan_rejects_garbage():
+    with pytest.raises(ValueError, match="non-empty"):
+        lanes.plan_buckets([])
+    with pytest.raises(ValueError, match=">= 1"):
+        lanes.plan_buckets([3, 0, 2])
+    with pytest.raises(ValueError, match="max_buckets"):
+        lanes.plan_buckets([1, 2], max_buckets=0)
+
+
+def test_bucket_width_pow2_floor_cap():
+    assert lanes.bucket_width(1) == 1
+    assert lanes.bucket_width(3) == 4
+    assert lanes.bucket_width(64) == 64
+    assert lanes.bucket_width(65) == 128
+    assert lanes.bucket_width(3, floor=16) == 16
+    assert lanes.bucket_width(100, cap=128) == 128
+    with pytest.raises(ValueError, match="exceeds the cap"):
+        lanes.bucket_width(200, cap=128)
+
+
+def test_pad_to_tile():
+    assert lanes.pad_to_tile(1, 128) == 128
+    assert lanes.pad_to_tile(128, 128) == 128
+    assert lanes.pad_to_tile(129, 128) == 256
+
+
+# ---------------------------------------------------------------------------
+# THE bit-identity contract (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def _ragged(engine, max_buckets):
+    return lanes.simulate_ragged(
+        COUNTS, SEEDS, end_time=T, q=1.0, wall_rate=1.0, engine=engine,
+        max_buckets=max_buckets, return_logs=True)
+
+
+@pytest.mark.parametrize("engine", ["scan", "pallas"],
+                         ids=["scan", "pallas-interpret"])
+def test_bucketed_bit_identical_to_dense(engine):
+    """Bucketed-ragged dispatch vs the dense-padded reference on matched
+    seeds: per-lane event logs, counts, metrics — all exactly equal."""
+    rb = _ragged(engine, max_buckets=3)
+    rd = _ragged(engine, max_buckets=1)
+    assert rb.engine == engine
+    assert np.array_equal(rb.n_events, rd.n_events)
+    assert np.array_equal(rb.top_k, rd.top_k)
+    assert np.array_equal(rb.posts, rd.posts)
+    assert (rb.health == 0).all() and (rd.health == 0).all()
+    for i, ((tb, sb), (td, sd)) in enumerate(zip(rb.logs, rd.logs)):
+        assert np.array_equal(tb, td), f"lane {i} times differ"
+        assert np.array_equal(sb, sd), f"lane {i} srcs differ"
+    # and the bucketed plan genuinely pads less
+    assert rb.plan.pad_frac_bucketed < rd.plan.pad_frac_dense
+
+
+def test_ragged_matches_unpadded_graphbuilder_build():
+    """The semantics anchor: a ragged lane equals the unpadded
+    GraphBuilder component with the same follower count and seed."""
+    rb = _ragged("scan", max_buckets=3)
+    for lane in (0, 4, 8):  # a singleton, a mid lane, the hub
+        F = int(COUNTS[lane])
+        width = rb.plan.widths[rb.plan.lane_bucket[lane]]
+        cap = lanes.shape_budget(width, T, 1.0, None)[0]
+        gb = GraphBuilder(n_sinks=F, end_time=T)
+        gb.add_opt(q=1.0)
+        for i in range(F):
+            gb.add_poisson(rate=1.0, sinks=[i])
+        cfg, p0, a0 = gb.build(capacity=cap)
+        log = simulate(cfg, p0, a0, int(SEEDS[lane]))
+        ne = int(np.asarray(log.n_events))
+        assert ne == rb.n_events[lane]
+        t, s = rb.logs[lane]
+        assert np.array_equal(np.asarray(log.times)[:ne], t)
+        assert np.array_equal(np.asarray(log.srcs)[:ne], s)
+
+
+def test_slabbed_dispatch_bit_identical():
+    """sim.simulate_batch(slab=...) equals the unslabbed dispatch lane
+    for lane (the autotuner only picks HOW the batch splits, never what
+    it computes)."""
+    gb = GraphBuilder(n_sinks=10, end_time=T)
+    gb.add_opt(q=1.0)
+    for i in range(10):
+        gb.add_poisson(rate=1.0, sinks=[i])
+    cfg, p0, a0 = gb.build(capacity=128)
+    B = 12
+    params, adj = stack_components([p0] * B, [a0] * B)
+    seeds = np.arange(B) + 100
+    full = simulate_batch(cfg, params, adj, seeds)
+    slabbed = simulate_batch(cfg, params, adj, seeds, slab=4)
+    ne = np.asarray(full.n_events)
+    assert np.array_equal(ne, np.asarray(slabbed.n_events))
+    tf, ts = np.asarray(full.times), np.asarray(slabbed.times)
+    sf, ss = np.asarray(full.srcs), np.asarray(slabbed.srcs)
+    for i in range(B):
+        n = ne[i]
+        assert np.array_equal(tf[i, :n], ts[i, :n])
+        assert np.array_equal(sf[i, :n], ss[i, :n])
+    assert slabbed.chunk_steps >= slabbed.times.shape[-1]
+    with pytest.raises(ValueError, match="return_state"):
+        simulate_batch(cfg, params, adj, seeds, slab=4, return_state=True)
+
+
+def test_memory_ceiling_survives_divisorless_bucket_sizes():
+    """The max_lane_elems clamp must hold even when the bucket's lane
+    count has no divisor in the equal-slab window (slab_size would
+    otherwise fall back to the whole bucket): a ragged remainder slab
+    is taken instead, and results stay identical to the dense plan."""
+    counts = np.full(7, 6)  # prime lane count, width-8 bucket
+    seeds = np.arange(7) + 2
+    small = lanes.simulate_ragged(counts, seeds, end_time=4.0,
+                                  max_lane_elems=8 * 8 * 2)  # slab <= 2
+    big = lanes.simulate_ragged(counts, seeds, end_time=4.0)
+    assert small.dispatches >= 4  # ceil(7/2) slabs, not one 7-lane blow
+    assert np.array_equal(small.n_events, big.n_events)
+    assert np.array_equal(small.top_k, big.top_k)
+
+
+# ---------------------------------------------------------------------------
+# Health-bit flow through bucket reordering (RQ_FAULT lane addressing)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("max_buckets", [1, 3])
+def test_health_bits_flow_through_bucket_reordering(max_buckets,
+                                                    monkeypatch):
+    """RQ_FAULT=numeric:nan@laneN addresses lane N of the ORIGINAL lane
+    order, whatever the bucket plan — the sick lane's bits come back at
+    position N, every other lane stays healthy with unchanged results."""
+    clean = _ragged("scan", max_buckets)
+    monkeypatch.setenv("RQ_FAULT", "numeric:nan@lane4")
+    r = lanes.simulate_ragged(COUNTS, SEEDS, end_time=T,
+                              max_buckets=max_buckets)
+    sick = np.flatnonzero(r.health != 0)
+    assert list(sick) == [4]
+    keep = np.arange(len(COUNTS)) != 4
+    assert np.array_equal(r.n_events[keep], clean.n_events[keep])
+    assert np.array_equal(r.top_k[keep], clean.top_k[keep])
+
+
+# ---------------------------------------------------------------------------
+# Measured slab autotuner
+# ---------------------------------------------------------------------------
+
+
+def test_autotuner_measures_caches_and_reuses(tmp_path):
+    path = str(tmp_path / "autotune.json")
+    calls = []
+
+    def time_fn(slab):
+        calls.append(slab)
+        return {4: 0.5, 6: 0.2, 12: 0.9}[slab]
+
+    ch = lanes.measured_slab(12, backend="cpu", shape_key="t",
+                             time_fn=time_fn, candidates=(4, 6, 12),
+                             cache_path=path)
+    assert ch.source == "measured" and ch.target == 6 and ch.slab == 6
+    assert sorted(calls) == [4, 6, 12]
+    # enveloped artifact: schema + per-candidate measurements recorded
+    obj = json.load(open(path))
+    assert obj["schema"] == lanes.AUTOTUNE_SCHEMA
+    entry = obj["entries"]["cpu|t"]
+    assert entry["target"] == 6
+    assert set(entry["per_lane_cost"]) == {"4", "6", "12"}
+    # second use: cache hit, no re-measure
+    ch2 = lanes.measured_slab(12, backend="cpu", shape_key="t",
+                              time_fn=time_fn, candidates=(4, 6, 12),
+                              cache_path=path)
+    assert ch2.source == "cache" and ch2.slab == 6
+    assert len(calls) == 3
+    # force re-measures
+    ch3 = lanes.measured_slab(12, backend="cpu", shape_key="t",
+                              time_fn=time_fn, candidates=(4, 6, 12),
+                              cache_path=path, force=True)
+    assert ch3.source == "measured" and len(calls) == 6
+
+
+def test_autotuner_fallbacks_are_recorded(tmp_path):
+    path = str(tmp_path / "autotune.json")
+    # no time_fn, no cache -> recorded fallback (median candidate)
+    ch = lanes.measured_slab(10_000, backend="cpu", shape_key="x",
+                             cache_path=path)
+    assert ch.source == "fallback"
+    # tiny batch -> unslabbed
+    ch = lanes.measured_slab(8, backend="cpu", shape_key="x",
+                             candidates=(1250, 2500, 5000),
+                             cache_path=path)
+    assert ch.source == "unslabbed" and ch.slab == 8
+
+
+def test_autotuner_ignores_torn_or_foreign_cache(tmp_path):
+    path = str(tmp_path / "autotune.json")
+    with open(path, "w") as f:
+        f.write('{"schema": "rq.other/9", "entries": {"cpu|t": ')
+    assert lanes.load_autotune_cache(path) == {}
+    with open(path, "w") as f:
+        json.dump({"schema": "rq.other/9", "entries": {"cpu|t": {}}}, f)
+    assert lanes.load_autotune_cache(path) == {}
+
+
+def test_slab_size_equal_divisor_window():
+    assert lanes.slab_size(10_000, 2500) == 2500
+    assert lanes.slab_size(10_000, 3000) == 2500
+    assert lanes.slab_size(64, 2500) == 64
+    # prime batch: no divisor in the window -> unslabbed
+    assert lanes.slab_size(9973, 2500) == 9973
+    assert [r for r in lanes.iter_slabs(10, 4)] == [(0, 4), (4, 8), (8, 10)]
+
+
+# ---------------------------------------------------------------------------
+# Power-law preset (typed validation + one-call 10^6 configs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bad", [
+    dict(B=1.5), dict(B=True), dict(B="1000"), dict(B=0),
+    dict(B=10, alpha=0.0), dict(B=10, alpha=-2.0),
+    dict(B=10, alpha=float("nan")),
+    dict(B=10, max_followers=1),          # degenerate single-follower
+    dict(B=10, min_followers=0),
+    dict(B=10, min_followers=8, max_followers=4),
+])
+def test_power_law_validation(bad):
+    with pytest.raises(ConfigValidationError):
+        build_preset("power_law", **bad)
+
+
+def test_power_law_runs_through_run_preset():
+    kind, counts, opts = build_preset(
+        "power_law", B=64, alpha=2.0, max_followers=32, end_time=6.0,
+        seed=3)
+    assert kind == "ragged" and len(counts) == 64
+    assert counts.min() >= 1 and counts.max() <= 32
+    out = run_preset(("ragged", counts, opts), 0)
+    assert out["events"] > 0
+    assert 0.0 <= out["mean_time_in_top_k"] <= 6.0
+    assert len(out["per_seed_top_k"]) == 64
+
+
+def test_power_law_is_deterministic_per_seed():
+    _, c1, _ = build_preset("power_law", B=100, seed=5)
+    _, c2, _ = build_preset("power_law", B=100, seed=5)
+    _, c3, _ = build_preset("power_law", B=100, seed=6)
+    assert np.array_equal(c1, c2)
+    assert not np.array_equal(c1, c3)
+
+
+# ---------------------------------------------------------------------------
+# Pad-waste telemetry counters
+# ---------------------------------------------------------------------------
+
+
+def test_ragged_dispatch_records_pad_counters():
+    from redqueen_tpu.runtime import telemetry
+
+    tel = telemetry.get()
+    tel.configure(enabled=True, reset=True)
+    try:
+        r = lanes.simulate_ragged(COUNTS, SEEDS, end_time=2.0,
+                                  max_buckets=3)
+        payload = tel.payload()
+        counters = payload.get("counters", {})
+        real = counters.get("lanes.pad.real_elems")
+        padded = counters.get("lanes.pad.padded_elems")
+        assert real == r.plan.real_elems
+        assert padded == r.plan.bucketed_elems - r.plan.real_elems
+        # and the spans carry the per-bucket pad attribution
+        names = {s.get("name") for s in tel.drain_spans()}
+        assert "lanes.ragged" in names and "lanes.ragged.bucket" in names
+    finally:
+        tel.configure(enabled=False, reset=True)

@@ -1,0 +1,81 @@
+"""Property-based invariants of the unified lane-batching layer, via
+hypothesis: ragged width distributions are adversarial inputs (power-law
+tails, constant widths, singletons), so the bucket-plan algebra and the
+bucketed-vs-dense bit-identity are checked over randomized shapes, not
+just the fixture set in test_lanes.py.
+
+Design constraint (same as test_properties.py): simulation-running
+properties keep shapes tiny and example counts low — each example pays
+real kernel compiles; the pure-plan algebra properties run wide."""
+
+import numpy as np
+import pytest
+
+# Without the dependency the whole module skips AT COLLECTION (a skip,
+# not an error — tier-1 must collect clean on minimal containers).
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from redqueen_tpu.parallel import lanes  # noqa: E402
+
+counts_arrays = st.lists(st.integers(min_value=1, max_value=300),
+                         min_size=1, max_size=40).map(np.asarray)
+
+
+@given(counts=counts_arrays, max_buckets=st.integers(1, 6))
+@settings(max_examples=200, deadline=None)
+def test_plan_always_bounded_and_covering(counts, max_buckets):
+    plan = lanes.plan_buckets(counts, max_buckets=max_buckets)
+    assert 1 <= plan.n_buckets <= max_buckets
+    w = np.asarray(plan.widths)
+    assert (np.diff(w) > 0).all(), "widths strictly ascending"
+    assert (w[plan.lane_bucket] >= counts).all(), "every lane fits"
+    assert plan.real_elems <= plan.bucketed_elems <= plan.dense_elems
+    assert 0.0 <= plan.padded_elem_reduction <= 1.0
+
+
+@given(counts=counts_arrays)
+@settings(max_examples=100, deadline=None)
+def test_more_buckets_never_pad_more(counts):
+    """Waste is monotone non-increasing in the bucket allowance."""
+    prev = None
+    for mb in (1, 2, 4, 8):
+        plan = lanes.plan_buckets(counts, max_buckets=mb)
+        if prev is not None:
+            assert plan.bucketed_elems <= prev
+        prev = plan.bucketed_elems
+
+
+@given(counts=counts_arrays)
+@settings(max_examples=100, deadline=None)
+def test_plan_is_permutation_equivariant(counts):
+    """Reordering lanes reorders the plan — bucket membership is a
+    per-lane fact, so health/results can flow back by lane identity."""
+    perm = np.random.RandomState(0).permutation(len(counts))
+    a = lanes.plan_buckets(counts, max_buckets=4)
+    b = lanes.plan_buckets(counts[perm], max_buckets=4)
+    assert a.widths == b.widths
+    wa = np.asarray(a.widths)[a.lane_bucket]
+    wb = np.asarray(b.widths)[b.lane_bucket]
+    assert np.array_equal(wa[perm], wb)
+
+
+@given(counts=st.lists(st.integers(min_value=1, max_value=12),
+                       min_size=2, max_size=6).map(np.asarray),
+       seed0=st.integers(0, 2**16))
+@settings(max_examples=8, deadline=None)
+def test_ragged_never_nan_and_bit_identical_to_dense(counts, seed0):
+    """Over randomized ragged shape distributions: results carry no NaN,
+    health stays clear, and the bucketed dispatch equals the dense-padded
+    reference bit for bit (shapes tiny — each example simulates)."""
+    seeds = np.arange(len(counts)) + seed0
+    rb = lanes.simulate_ragged(counts, seeds, end_time=3.0, max_buckets=3)
+    rd = lanes.simulate_ragged(counts, seeds, end_time=3.0, max_buckets=1)
+    for r in (rb, rd):
+        assert np.isfinite(r.top_k).all()
+        assert np.isfinite(r.posts).all()
+        assert (r.health == 0).all()
+        assert (r.n_events >= 0).all()
+    assert np.array_equal(rb.n_events, rd.n_events)
+    assert np.array_equal(rb.top_k, rd.top_k)
+    assert np.array_equal(rb.posts, rd.posts)

@@ -776,3 +776,47 @@ class TestRepoAndShim:
     def test_severity_and_fails_semantics(self):
         fs = lint(UNSYNCED_BENCH, "bench.py", ["RQ601"])
         assert fs[0].severity == Severity.ERROR and fs[0].fails
+
+
+# ---------------------------------------------------------------------------
+# RQ602 — hard-coded slab/lane-batch-size constants
+# ---------------------------------------------------------------------------
+
+
+class TestRQ602:
+    def test_fires_on_module_level_slab_constant(self):
+        src = """\
+            CPU_SLAB = 2500
+        """
+        fs = lint(src, "bench.py", ["RQ602"])
+        assert ids(fs) == ["RQ602"] and fs[0].line == 1
+
+    def test_fires_on_arith_and_tuple_slabs(self):
+        src = """\
+            TPU_SLAB = 4 * 1024
+            LANE_BATCH_SIZES = (1250, 2500)
+        """
+        fs = lint(src, "redqueen_tpu/ops/x.py", ["RQ602"])
+        assert ids(fs) == ["RQ602", "RQ602"]
+
+    def test_autotuner_candidates_are_sanctioned(self):
+        src = """\
+            SLAB_CANDIDATES = (1250, 2500, 5000)
+        """
+        assert lint(src, "redqueen_tpu/parallel/lanes.py", ["RQ602"]) == []
+
+    def test_non_slab_constants_and_non_ints_are_legal(self):
+        src = """\
+            UNROLL_MAX_OPT_ROWS = 4
+            TILE = 128
+            SLAB_SCHEMA = "rq.lanes.autotune/1"
+            slab = pick_slab(B)
+        """
+        assert lint(src, "redqueen_tpu/ops/x.py", ["RQ602"]) == []
+
+    def test_pragma_pins_a_deliberate_exception(self):
+        src = """\
+            TEST_SLAB = 4  # rqlint: disable=RQ602 fixture shape
+        """
+        fs = lint(src, "redqueen_tpu/ops/x.py", ["RQ602"])
+        assert [f for f in fs if not f.suppressed] == []

@@ -717,21 +717,21 @@ class TestNoProjectEquivalence:
         assert "RQ701" in rule_ids(proj)
         assert engine.check_source(src, "tools/u.py") == []
 
-    def test_cli_no_project_runs_eight_tier1_rules(self, tmp_path,
-                                                   capsys):
+    def test_cli_no_project_runs_nine_tier1_rules(self, tmp_path,
+                                                  capsys):
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path), "--no-project",
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
         out = capsys.readouterr().out
-        assert "8 rules active" in out
+        assert "9 rules active" in out
 
-    def test_project_mode_runs_twelve_rules(self, tmp_path, capsys):
+    def test_project_mode_runs_thirteen_rules(self, tmp_path, capsys):
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path),
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
-        assert "12 rules active" in capsys.readouterr().out
+        assert "13 rules active" in capsys.readouterr().out
 
 
 # ---------------------------------------------------------------------------

@@ -81,6 +81,34 @@ class TestCoalescedApply:
             assert d == d0
             assert norm(dec) == norm(dec0)
 
+    def test_bucketed_pad_width_is_bit_identical(self, tmp_path,
+                                                 monkeypatch):
+        """The live paths pad to pow-2 BUCKET widths (the unified lane
+        layer, service._pad_width) instead of the full configured
+        max_batch_events; the apply step is bitwise invariant to the pad
+        width, so a bucketed run, a full-width run, and recovery's
+        full-width replay must all agree digest-for-digest."""
+        from redqueen_tpu.serving import service as svc
+
+        def run(full_width):
+            if full_width:
+                monkeypatch.setattr(
+                    svc, "_pad_width", lambda n, cap: int(cap))
+            else:
+                monkeypatch.undo()
+            rt = _runtime(tmp_path / f"w{full_width}", coalesce=4,
+                          max_batch_events=256)
+            with rt:
+                decs = []
+                for b in _batches():
+                    rt.submit(b)
+                decs += rt.poll()
+                return rt.state_digest(), decs
+        d_bucket, dec_bucket = run(False)
+        d_full, dec_full = run(True)
+        assert d_bucket == d_full
+        assert dec_bucket == dec_full
+
     def test_fn_level_invariance_vs_sequential(self):
         """make_coalesced_apply_fn == sequential make_apply_fn,
         bitwise, including pad-slot passthrough."""

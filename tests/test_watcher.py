@@ -157,9 +157,9 @@ def test_stages_flag_reaches_capture(watcher, monkeypatch):
     into capture_evidence."""
     rc, calls = _run(watcher, monkeypatch,
                      probes=[(True, 1, "tpu")], capture_rcs=[0],
-                     argv_extra=["--stages", "3", "4", "1", "5"])
+                     argv_extra=["--stages", "3", "7", "1", "5"])
     assert rc == 0
-    assert calls["stages"] == [3, 4, 1, 5]
+    assert calls["stages"] == [3, 7, 1, 5]
 
 
 def test_capture_evidence_builds_stage_args(watcher, monkeypatch, tmp_path):

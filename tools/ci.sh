@@ -110,6 +110,19 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_engine.py \
     tests/test_pallas_chunk.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== unified lane batching: bucketed-dispatch smoke =="
+# The lane layer's acceptance gates before the long suite: bit-identity
+# of bucketed-ragged vs dense-padded dispatch (scan + pallas
+# interpreter), the measured slab autotuner's rq.lanes.autotune/1
+# artifact round trip, RQ_FAULT lane addressing through bucket
+# reordering, and the power-law preset's typed validation — then a
+# seconds-scale end-to-end smoke of the ragged bench harness (identity
+# asserted in-process; no artifact write).
+env JAX_PLATFORMS=cpu python -m pytest tests/test_lanes.py \
+    tests/test_lanes_properties.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly
+env JAX_PLATFORMS=cpu python tools/ragged_bench.py --smoke
+
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log
 # || rc=$? keeps `set -e` from aborting before the pass-count summary:

@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""BENCH_r07: bucketed-ragged lane batching vs dense padding on the
+power-law follower graph — the million-broadcaster scale-out evidence
+(ROADMAP item 3 / ISSUE 14 acceptance artifact).
+
+Two cells, both honest about what they measure:
+
+- **compare** — dense vs bucketed SAME-SESSION at the largest scale the
+  dense reference can actually run (dense pads every lane to the hub
+  width AND locksteps every lane to the hub's event count, so its cost
+  explodes quadratically with the cap; cross-round absolutes don't
+  compare in this sandbox — PR 12's re-measure note — so the speedup is
+  a within-run ratio).  Results are asserted bit-identical between the
+  two plans before any number is recorded.
+- **scale** — the 10^6-broadcaster workload, bucketed (the thing dense
+  padding cannot do: the artifact records the dense plan's padded
+  element count and its estimated memory so "infeasible" is a number,
+  not an adjective), with the measured padded-element-waste reduction.
+
+Slabs come from the MEASURED autotuner (parallel.lanes.measured_slab):
+the big buckets are timed at 2-3 candidate slab sizes first, the
+winners cached in the rq.lanes.autotune/1 artifact, and the artifact
+records every choice with its provenance.  Pad-waste telemetry counters
+are drained per cell and committed alongside.
+
+Usage:
+    python tools/ragged_bench.py                 # the committed artifact
+    python tools/ragged_bench.py --smoke         # CI: seconds, no write
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import _jax_cache  # noqa: E402
+
+_jax_cache.enable_persistent_cache()
+
+import numpy as np  # noqa: E402
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _drain_pad_counters(tel):
+    payload = tel.payload()
+    c = payload.get("counters", {})
+    real = c.get("lanes.pad.real_elems", 0)
+    padded = c.get("lanes.pad.padded_elems", 0)
+    tel.configure(reset=True)
+    return {"real_elems": int(real), "padded_elems": int(padded),
+            "pad_frac": round(padded / (real + padded), 4)
+            if real + padded else 0.0}
+
+
+def _timed_ragged(counts, seeds, reps, **kw):
+    """Warm (compile) + best-of-``reps`` timed runs; returns (result,
+    best seconds).  simulate_ragged crosses device->host per bucket slab
+    before returning, so the region is fully synchronized (the numpy
+    results ARE the block_until_ready)."""
+    from redqueen_tpu.parallel.lanes import simulate_ragged
+
+    res = simulate_ragged(counts, seeds, **kw)  # warm-up: pays compiles
+    secs = np.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()  # rqlint: disable=RQ601 host-synced numpy result
+        res = simulate_ragged(counts, seeds, **kw)
+        secs = min(secs, time.perf_counter() - t0)
+    return res, secs
+
+
+def _autotune_buckets(counts, *, horizon, candidates, cache_path,
+                      max_tuned=3):
+    """Measure slab candidates for the most-populated buckets of this
+    workload's plan and cache the winners — the slabs the timed runs
+    then consult.  Returns the recorded choices."""
+    import jax
+
+    from redqueen_tpu.parallel import lanes
+    from redqueen_tpu.sim import simulate_batch
+
+    plan = lanes.plan_buckets(counts, max_buckets=8)
+    order = sorted(range(plan.n_buckets),
+                   key=lambda b: -plan.lanes_of(b).size)
+    backend = jax.devices()[0].platform
+    choices = {}
+    for b in order[:max_tuned]:
+        width = plan.widths[b]
+        idx = plan.lanes_of(b)
+        if idx.size <= min(candidates):
+            continue
+        cap = lanes.shape_budget(width, horizon, 1.0, None)[0]
+
+        def time_fn(slab):
+            # The canonical probe (one warm pass for the compile, one
+            # timed pass, seconds/lane) over this bucket's real lanes.
+            cfg, params, adj = lanes.ragged_bucket_component(
+                counts[idx[:slab]], width, end_time=horizon,
+                capacity=cap)
+            return lanes.probe_slab_cost(
+                lambda: simulate_batch(cfg, params, adj,
+                                       np.arange(slab)), slab)
+
+        ch = lanes.measured_slab(
+            int(idx.size), backend=backend,
+            shape_key=f"ragged/W{width}", time_fn=time_fn,
+            candidates=candidates, cache_path=cache_path)
+        choices[f"W{width}"] = {
+            "lanes": int(idx.size), "slab": ch.slab,
+            "target": ch.target, "source": ch.source,
+            "per_lane_cost": {str(t): round(v, 9)
+                              for t, v in ch.measurements.items()},
+        }
+        log(f"autotune W{width}: {choices[f'W{width}']}")
+    return choices
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--broadcasters", type=int, default=1_000_000,
+                    help="scale-cell lane count (the 10^6 headline)")
+    ap.add_argument("--alpha", type=float, default=2.2)
+    ap.add_argument("--max-followers", type=int, default=1024)
+    ap.add_argument("--horizon", type=float, default=2.0,
+                    help="scale-cell horizon (events scale with it)")
+    ap.add_argument("--compare-broadcasters", type=int, default=4096)
+    ap.add_argument("--compare-max-followers", type=int, default=128)
+    ap.add_argument("--compare-horizon", type=float, default=4.0)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_r07.json"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI smoke: tiny shapes, 1 rep, no artifact "
+                         "write, identity assertion only")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # sandbox: never the tunnel
+    from redqueen_tpu.parallel import lanes
+    from redqueen_tpu.presets import power_law_graph
+    from redqueen_tpu.runtime import telemetry
+    from redqueen_tpu.runtime.artifacts import atomic_write_json
+
+    if args.smoke:
+        args.compare_broadcasters = 384
+        args.compare_max_followers = 48
+        args.compare_horizon = 3.0
+        args.reps = 1
+
+    tel = telemetry.get()
+    tel.configure(enabled=True, reset=True)
+    platform = jax.devices()[0].platform
+    cache_path = lanes.autotune_cache_path()
+    out = {
+        "metric": "bucketed-ragged vs dense-padded lane batching "
+                  "(power-law follower graph)",
+        "schema": "rq.bench.ragged/1",
+        "provenance": {
+            "platform": platform,
+            "date_utc": time.strftime("%Y-%m-%d", time.gmtime()),
+            "timed": f"best of {args.reps} reps after one warm-up "
+                     f"(compiles excluded)",
+            "note": "compare cell is SAME-SESSION dense-vs-bucketed on "
+                    "identical seeds (cross-round absolutes don't "
+                    "compare in this sandbox — see PR 12's re-measure "
+                    "note); results asserted bit-identical before any "
+                    "number is recorded",
+            "alpha": args.alpha,
+            "autotune_cache": cache_path,
+        },
+    }
+
+    # ---- compare cell: dense vs bucketed, same session, same seeds ----
+    kind, counts, opts = power_law_graph(
+        args.compare_broadcasters, alpha=args.alpha, seed=args.seed,
+        max_followers=args.compare_max_followers,
+        end_time=args.compare_horizon)
+    seeds = np.arange(len(counts)) + 1000
+    kw = dict(end_time=opts["end_time"], q=opts["q"],
+              wall_rate=opts["wall_rate"])
+    log(f"compare cell: B={len(counts)} maxF={counts.max()} "
+        f"T={opts['end_time']}")
+    r_dense, s_dense = _timed_ragged(counts, seeds, args.reps,
+                                     max_buckets=1, **kw)
+    pad_dense = _drain_pad_counters(tel)
+    r_buck, s_buck = _timed_ragged(counts, seeds, args.reps,
+                                   max_buckets=8, **kw)
+    pad_buck = _drain_pad_counters(tel)
+
+    identity_ok = (
+        np.array_equal(r_dense.n_events, r_buck.n_events)
+        and np.array_equal(r_dense.top_k, r_buck.top_k)
+        and np.array_equal(r_dense.posts, r_buck.posts))
+    if not identity_ok:
+        raise SystemExit(
+            "bucketed result diverged from the dense reference — "
+            "refusing to record a speedup for a different computation")
+    ev = r_buck.events
+    out["compare"] = {
+        "broadcasters": len(counts),
+        "max_followers": int(counts.max()),
+        "horizon": opts["end_time"],
+        "events": ev,
+        "identity_ok": True,
+        "dense": {"secs": round(s_dense, 4),
+                  "events_per_sec": round(ev / s_dense, 1),
+                  "n_buckets": 1,
+                  "pad_counters": pad_dense,
+                  "pad_frac": round(r_dense.plan.pad_frac_dense, 4)},
+        "bucketed": {"secs": round(s_buck, 4),
+                     "events_per_sec": round(ev / s_buck, 1),
+                     "n_buckets": r_buck.plan.n_buckets,
+                     "bucket_widths": list(r_buck.plan.widths),
+                     "pad_counters": pad_buck,
+                     "pad_frac": round(r_buck.plan.pad_frac_bucketed, 4)},
+        "speedup": round(s_dense / s_buck, 2),
+        "padded_elem_reduction": round(
+            r_buck.plan.padded_elem_reduction, 4),
+    }
+    log(f"compare: dense {ev / s_dense:,.0f} ev/s vs bucketed "
+        f"{ev / s_buck:,.0f} ev/s -> {s_dense / s_buck:.2f}x, "
+        f"pad waste {pad_dense['pad_frac']:.1%} -> "
+        f"{pad_buck['pad_frac']:.1%}")
+
+    if args.smoke:
+        tel.configure(enabled=False, reset=True)
+        print(json.dumps({"ok": True, "smoke": True,
+                          "speedup": out["compare"]["speedup"],
+                          "identity_ok": True}), flush=True)
+        return 0
+
+    # ---- scale cell: the 10^6-broadcaster workload, bucketed ----
+    kind, counts, opts = power_law_graph(
+        args.broadcasters, alpha=args.alpha, seed=args.seed + 1,
+        max_followers=args.max_followers, end_time=args.horizon)
+    seeds = np.arange(len(counts))
+    log(f"scale cell: B={len(counts)} maxF={counts.max()} "
+        f"T={opts['end_time']} (autotuning slabs first)")
+    autotune = _autotune_buckets(
+        counts, horizon=opts["end_time"],
+        candidates=lanes.SLAB_CANDIDATES, cache_path=cache_path)
+    tel.configure(reset=True)  # autotune probes are not the cell's waste
+    r, secs = _timed_ragged(
+        counts, seeds, max(1, args.reps - 1),
+        max_buckets=8, end_time=opts["end_time"], q=opts["q"],
+        wall_rate=opts["wall_rate"])
+    pad = _drain_pad_counters(tel)
+    plan = r.plan
+    dense_bytes = plan.dense_elems * 4 * 3  # rate+pw+adjacency-ish, f32
+    out["scale"] = {
+        "broadcasters": len(counts),
+        "max_followers": int(counts.max()),
+        "horizon": opts["end_time"],
+        "events": r.events,
+        "secs": round(secs, 3),
+        "events_per_sec": round(r.events / secs, 1),
+        "dispatches": r.dispatches,
+        "n_buckets": plan.n_buckets,
+        "bucket_widths": list(plan.widths),
+        "pad_counters": pad,
+        "pad_frac_bucketed": round(plan.pad_frac_bucketed, 4),
+        "dense_reference": {
+            "infeasible": True,
+            "why": f"dense pads {len(counts)} lanes to width "
+                   f"{plan.dense_width}: {plan.dense_elems:,} padded "
+                   f"source rows (~{dense_bytes / 1e9:.0f} GB of "
+                   f"params+adjacency) and locksteps every lane to the "
+                   f"hub's event count",
+            "pad_frac_dense": round(plan.pad_frac_dense, 4),
+            "dense_elems": plan.dense_elems,
+            "bucketed_elems": plan.bucketed_elems,
+            "real_elems": plan.real_elems,
+        },
+        "padded_elem_reduction": round(plan.padded_elem_reduction, 4),
+    }
+    out["autotune"] = {
+        "schema": lanes.AUTOTUNE_SCHEMA,
+        "choices": autotune,
+        "cache_entries": lanes.load_autotune_cache(cache_path),
+    }
+    log(f"scale: {r.events:,} events in {secs:.2f}s -> "
+        f"{r.events / secs:,.0f} ev/s across {plan.n_buckets} buckets; "
+        f"pad waste dense {plan.pad_frac_dense:.1%} -> bucketed "
+        f"{plan.pad_frac_bucketed:.1%} "
+        f"({plan.padded_elem_reduction:.1%} reduction)")
+
+    tel.configure(enabled=False, reset=True)
+    atomic_write_json(args.out, out, indent=1)
+    log(f"artifact written to {args.out}")
+    print(json.dumps({"ok": True, "artifact": args.out,
+                      "compare_speedup": out["compare"]["speedup"],
+                      "scale_events_per_sec":
+                          out["scale"]["events_per_sec"],
+                      "padded_elem_reduction":
+                          out["scale"]["padded_elem_reduction"]}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,7 @@ from typing import List, Optional, Sequence
 
 from .artifacts import RawArtifactWriteRule
 from .base import FileContext, Rule  # noqa: F401 (re-export)
-from .bench import UnsyncedTimingRule
+from .bench import HardCodedSlabRule, UnsyncedTimingRule
 from .hostsync import HiddenSyncRule, HotLoopTransferRule
 from .numerics import RawNumericsRule
 from .prng import ConstantSeedRule, KeyReuseRule
@@ -34,6 +34,7 @@ REGISTRY = (
     KeyReuseRule,
     ConstantSeedRule,
     UnsyncedTimingRule,
+    HardCodedSlabRule,
     HiddenSyncRule,
     HotLoopTransferRule,
     RecompilationHazardRule,

@@ -23,6 +23,7 @@ line, which doubles as documentation that the region was audited.
 from __future__ import annotations
 
 import ast
+import re
 from typing import List, Optional, Tuple
 
 from ..astutil import attr_chain, chain_tail
@@ -131,3 +132,81 @@ class UnsyncedTimingRule(Rule):
                 elif not tail:  # indirect call (fn(...) via subscript...)
                     has_work = True
         return has_work
+
+
+# Names that declare "this integer is a lane-batch/slab size".  The rule
+# is deliberately name-scoped: a slab constant that does not SAY it is a
+# slab is a naming bug first, and widening to every int assignment would
+# drown the band in noise.
+_SLAB_NAME = re.compile(
+    r"(?:^|_)(?:SLAB|SLABS|LANE_BATCH|LANES_PER_DISPATCH)(?:_|$)",
+    re.IGNORECASE)
+
+#: The autotuner module — the ONE place a slab number may be written
+#: down (its candidate search space; see parallel/lanes.py
+#: SLAB_CANDIDATES).
+_AUTOTUNER_PATH = "redqueen_tpu/parallel/lanes.py"
+
+
+class HardCodedSlabRule(Rule):
+    """RQ602 — a hard-coded slab / lane-batch-size constant outside the
+    autotuner.
+
+    The repo carried ``CPU_SLAB = 2500`` in bench.py for three rounds: a
+    hand-swept cache-locality number that silently went stale whenever
+    the backend, shape, or driver changed.  Slab sizes are MEASURED
+    facts — ``parallel.lanes.measured_slab`` times candidates at first
+    use per (backend, shape bucket) and caches the winner in the
+    ``rq.lanes.autotune/1`` artifact — so a new module-level slab
+    constant anywhere else is the old failure mode coming back.  The
+    autotuner's own candidate tuple is the one sanctioned write-down.
+    Pin a deliberate exception with a line pragma
+    (``# rqlint: disable=RQ602 <why>``).
+    """
+
+    id = "RQ602"
+    name = "hard-coded-slab-constant"
+    description = ("module-level slab/lane-batch-size integer constant "
+                   "outside the measured autotuner "
+                   "(parallel.lanes.measured_slab) — slab sizes are "
+                   "measured per (backend, shape), never hard-coded")
+    paths = ("redqueen_tpu/**", "bench.py", "benchmarks/*.py",
+             "tools/*.py", "experiments/*.py")
+
+    def check(self, ctx):
+        rel = ctx.relpath.replace("\\", "/")
+        if rel == _AUTOTUNER_PATH:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not any(_SLAB_NAME.search(n) for n in names):
+                continue
+            if not self._int_valued(node.value):
+                continue
+            yield finding_at(
+                self.id, ctx, node,
+                f"`{', '.join(names)}` hard-codes a slab/lane-batch "
+                f"size — slab sizes are measured, not guessed: use "
+                f"redqueen_tpu.parallel.lanes.measured_slab (winner "
+                f"cached in the rq.lanes.autotune/1 artifact)")
+
+    @staticmethod
+    def _int_valued(value) -> bool:
+        """Integer literals and pure-literal int arithmetic / tuples of
+        them (``2500``, ``10 * 250``, ``(1250, 2500)``)."""
+        if value is None:
+            return False
+        if isinstance(value, ast.Constant):
+            return isinstance(value.value, int) and not isinstance(
+                value.value, bool)
+        if isinstance(value, ast.BinOp):
+            return (HardCodedSlabRule._int_valued(value.left)
+                    and HardCodedSlabRule._int_valued(value.right))
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return bool(value.elts) and all(
+                HardCodedSlabRule._int_valued(e) for e in value.elts)
+        return False

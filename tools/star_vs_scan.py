@@ -1,136 +1,41 @@
 #!/usr/bin/env python
-"""Star-vs-scan engine comparison across follower counts (round-2 verdict
-item 7): the star engine's claimed advantage is a TPU layout argument with
-zero hardware data — this harness produces the data. It benches both engines
-at F in {1k, 10k, 100k} (the star engine's design regime is big F) by
-invoking ``bench.py --engine {star,scan}`` per shape in deadline-bounded
-subprocesses, and writes one JSON artifact with every measurement plus the
-per-shape winner, so the crossover (if any) is recorded rather than argued.
+"""RETIRED: star-vs-scan engine comparison harness.
 
-Shapes follow the BASELINE presets' scaling logic: B shrinks and q grows
-with F so each cell is a realistic few-posts-per-unit-time workload of
-roughly comparable total work (q ~ F/40 keeps RedQueen's posting volume
-T*sqrt(F*rate/q) ~ 630 posts regardless of F).
+This harness existed to settle the round-2 question "does the star
+engine's TPU-layout argument survive contact with measurement?" — and it
+did: on the broadcaster-batch shapes the scan engine won every cell
+(STAR_VS_SCAN_cpu.json: star 746K ev/s vs scan 15.1M on the headline
+graph, BENCH_r05), and the star engine never produced a round's best
+number.  The unified lane-batching PR retired the star engine from the
+headline bench (``bench.py`` no longer accepts ``--engine star``; the
+recorded reason is ``bench.STAR_RETIRED_REASON``), which removes this
+harness's subject.
 
-Usage:
-    python tools/star_vs_scan.py --cpu        # harness validation (CPU)
-    python tools/star_vs_scan.py --tpu        # the real measurement
-    python tools/star_vs_scan.py --quick ...  # tiny shapes, seconds
+The star KERNEL is not gone: it remains the follower-sharded engine for
+the big-F single-broadcaster presets (configs 2 and 4,
+``redqueen_tpu.parallel.bigf``), where the scan engine's per-event loop
+is hopeless.  Migration note: docs/MIGRATION.md "Star engine
+retirement".  The committed STAR_VS_SCAN_cpu.json artifact stays as the
+measurement that justified the retirement.
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# (F, B, q): follower count, broadcaster lanes, posting-cost weight.
-SHAPES = [
-    (1_000, 64, 25.0),
-    (10_000, 8, 250.0),
-    (100_000, 1, 2500.0),
-]
-QUICK_SHAPES = [(100, 8, 2.5), (1_000, 1, 25.0)]
-
 
 def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--tpu", action="store_true",
-                    help="measure on the default (TPU) backend")
-    ap.add_argument("--cpu", action="store_true",
-                    help="force CPU (harness validation)")
-    ap.add_argument("--quick", action="store_true", help="tiny shapes")
-    ap.add_argument("--horizon", type=float, default=100.0)
-    ap.add_argument("--engine-deadline", type=float, default=600.0)
-    ap.add_argument("--out", default=None,
-                    help="output JSON (default STAR_VS_SCAN_<platform>.json)")
-    args = ap.parse_args()
-
     sys.path.insert(0, REPO)
-    from redqueen_tpu.runtime import (
-        atomic_write_json,
-        heartbeat,
-        supervised_run,
-    )
-    from redqueen_tpu.utils.backend import parse_last_json_line
+    import bench
 
-    backend_flag = "--tpu" if args.tpu else "--cpu"
-    shapes = QUICK_SHAPES if args.quick else SHAPES
-    T = 20.0 if args.quick else args.horizon
-
-    rows = []
-    out_path = args.out
-
-    def flush(platform):
-        # Incremental artifact write after EVERY cell (un-loseable protocol:
-        # a later cell's hang/kill cannot erase completed measurements). An
-        # auto-named path follows the platform: if the first cell failed
-        # entirely (platform "none") and a later cell succeeds, the file is
-        # renamed to the real platform so STAR_VS_SCAN_tpu.json actually
-        # appears for the evidence harness.
-        nonlocal out_path
-        if args.out is None:
-            want = os.path.join(REPO, f"STAR_VS_SCAN_{platform}.json")
-            if out_path is not None and out_path != want and \
-                    os.path.exists(out_path):
-                os.replace(out_path, want)
-            out_path = want
-        atomic_write_json(
-            out_path,
-            {"date_utc": time.strftime("%Y-%m-%d", time.gmtime()),
-             "platform": platform, "cells": rows}, indent=1)
-        heartbeat()
-
-    for F, B, q in shapes:
-        cell = {"followers": F, "broadcasters": B, "q": q, "horizon": T}
-        for engine in ("scan", "star"):
-            cmd = [sys.executable, os.path.join(REPO, "bench.py"),
-                   "--engine", engine, backend_flag, "--no-oracle",
-                   "--followers", str(F), "--broadcasters", str(B),
-                   "--q", str(q), "--horizon", str(T),
-                   "--deadline", str(args.engine_deadline + 120.0),
-                   "--engine-deadline", str(args.engine_deadline)]
-            if args.quick:
-                cmd.append("--quick")
-                # --quick forces CPU unless --tpu; keep the flag's meaning
-            # Supervised dispatch: deadline kill preserves any result
-            # line the child printed before wedging (one policy, the
-            # runtime's) — parse it either way.
-            rc, out, err, wall = supervised_run(
-                cmd, args.engine_deadline + 180.0, cwd=REPO,
-                name=f"star-vs-scan-F{F}-{engine}")
-            parsed = parse_last_json_line(out)
-            if parsed is None:
-                cell[engine] = {"ok": False, "wall_s": round(wall, 1)}
-                print(f"F={F:>7} {engine:5}: FAILED/timeout ({wall:.0f}s)",
-                      flush=True)
-            else:
-                cell[engine] = {"ok": True,
-                                "events_per_sec": parsed["value"],
-                                "platform": parsed.get("platform"),
-                                "wall_s": round(wall, 1)}
-                print(f"F={F:>7} {engine:5}: {parsed['value']:,.0f} ev/s "
-                      f"({parsed.get('platform')}, {wall:.0f}s)", flush=True)
-        ok = {e: cell[e] for e in ("scan", "star") if cell[e]["ok"]}
-        cell["winner"] = (max(ok, key=lambda e: ok[e]["events_per_sec"])
-                          if ok else None)
-        rows.append(cell)
-        platform = next((c[e]["platform"] for c in rows
-                         for e in ("scan", "star") if c[e].get("ok")), "none")
-        flush(platform)
-
-    # Final stdout line follows the repo's child JSON protocol
-    # (utils.backend.parse_last_json_line) so tools/tpu_evidence.py can
-    # detect success without scraping the progress text.
-    print(json.dumps({"ok": any(c["winner"] for c in rows),
-                      "platform": platform, "artifact": out_path,
-                      "winners": {str(c["followers"]): c["winner"]
-                                  for c in rows}}), flush=True)
-    return 0 if any(c["winner"] for c in rows) else 1
+    print(bench.STAR_RETIRED_REASON, file=sys.stderr)
+    print("star_vs_scan.py is retired with it; the committed "
+          "STAR_VS_SCAN_cpu.json records the measurement that justified "
+          "the decision.", file=sys.stderr)
+    return 2
 
 
 if __name__ == "__main__":

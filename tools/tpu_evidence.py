@@ -17,7 +17,6 @@ goes so a mid-sequence wedge keeps everything captured so far:
   7. profiled quick-shape scan        -> BENCH_tpu_profile_<tag>.json
      (+ a jax.profiler trace in benchmarks/profiles/<tag>/)
   3. full-shape Pallas engine         -> BENCH_tpu_pallas_<tag>.json
-  4. star-vs-scan sweep on TPU        -> STAR_VS_SCAN_tpu_<tag>.json
   8. batch-scaling curve on TPU       -> benchmarks/scaling_tpu_<tag>.json
   5. fire-mode crossover on TPU       -> FIRE_MODE_tpu_<tag>.json
 
@@ -49,7 +48,7 @@ if REPO not in sys.path:  # redqueen_tpu.runtime when loaded by path
 
 # The one authoritative stage-number set; tools/tpu_watcher.py imports it
 # for its own --stages validation so the two lists cannot drift.
-STAGE_CHOICES = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+STAGE_CHOICES = (1, 2, 3, 5, 6, 7, 8, 9)  # 4 (star-vs-scan) retired
 
 
 def run_stage(name, cmd, out_json, deadline_s, log_path):
@@ -98,12 +97,6 @@ def main() -> int:
     tag = args.tag
     py = sys.executable
     bench = os.path.join(REPO, "bench.py")
-    # Stage 4 runs 6 bench cells (3 shapes x 2 engines), each allowed up to
-    # sweep_cell deadline + overhead — its stage budget must cover the worst
-    # case, not the single-bench default (the sweep also writes its artifact
-    # incrementally per cell, so even a mid-sweep kill keeps finished cells).
-    sweep_cell = args.deadline / 2
-    sweep_budget = 6 * (sweep_cell + 240.0) + 120.0
     stages = [
         (1, "quick", [py, bench, "--quick", "--tpu"],
          os.path.join(REPO, f"BENCH_tpu_quick_{tag}.json"),
@@ -156,13 +149,11 @@ def main() -> int:
          os.path.join(REPO, f"BENCH_tpu_pallas_{tag}.json"),
          os.path.join(REPO, "benchmarks", f"tpu_pallas_{tag}.log"),
          args.deadline),
-        (4, "star-vs-scan", [py, os.path.join(REPO, "tools", "star_vs_scan.py"),
-                             "--tpu", "--engine-deadline", str(sweep_cell),
-                             "--out",
-                             os.path.join(REPO, f"STAR_VS_SCAN_tpu_{tag}.json")],
-         None,  # star_vs_scan writes its own artifact (incrementally)
-         os.path.join(REPO, "benchmarks", f"tpu_star_vs_scan_{tag}.log"),
-         sweep_budget),
+        # Stage 4 (star-vs-scan) is RETIRED with the star engine's
+        # headline-bench role (see bench.STAR_RETIRED_REASON and
+        # docs/MIGRATION.md): the CPU measurement it produced
+        # (STAR_VS_SCAN_cpu.json) already settled the question — scan
+        # won every cell — so a TPU window must not be spent re-asking.
         # Batch-scaling curve on the chip (how much batch the TPU needs —
         # SURVEY section 6's "on TPU, how much batch the chip needs to
         # reach peak"): B=10000 reuses the cached full-shape executable;

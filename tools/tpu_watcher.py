@@ -77,7 +77,7 @@ def append_log(line: str) -> None:
 # ev/s acceptance target) leads: it is the one number this round cannot
 # bank without the chip.  Stage 6's quick-shape compile precedes it to
 # warm the Mosaic cache inside short alive windows.
-DEFAULT_STAGES = (6, 9, 2, 7, 3, 4, 1, 5, 8)
+DEFAULT_STAGES = (6, 9, 2, 7, 3, 1, 5, 8)  # 4 (star-vs-scan) retired
 
 
 def capture_evidence(total_deadline_s: float, stages=DEFAULT_STAGES,
@@ -130,9 +130,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--max-probes", type=int, default=160)
     ap.add_argument("--probe-deadline", type=float, default=75.0)
     # Must cover the staged capture's worst case: with --deadline 600,
-    # DEFAULT_STAGES is seven 600s stages + the star-vs-scan sweep's
-    # 6*(300+240)+120 = 3360s -> 7560s; headroom on top so the outer kill
-    # can only mean a real hang.
+    # DEFAULT_STAGES is eight 600s stages -> 4800s (the star-vs-scan
+    # sweep stage is retired); headroom on top so the outer kill can
+    # only mean a real hang.
     ap.add_argument("--capture-deadline", type=float, default=9000.0,
                     help="total seconds allowed for the staged capture")
     # choices (imported from tpu_evidence, the owner of the stage table,
