@@ -706,7 +706,13 @@ class Telemetry:
         if len(self.spans) < self.max_spans:
             self.spans.append(s)
         else:
-            self.spans_dropped += 1
+            # COLD path (buffer already full): the += is a non-atomic
+            # read-modify-write, and spans finish on every thread (the
+            # journal flusher among them) — unlocked, concurrent drops
+            # under-count and the truncation flag lies.  The hot path
+            # above stays lock-free.
+            with self._lock:
+                self.spans_dropped += 1
         if self._flight is not None:
             with self._lock:
                 if self._flight is not None:

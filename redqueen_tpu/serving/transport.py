@@ -327,13 +327,20 @@ class Listener:
                 conn, _addr = self._sock.accept()
             except OSError:
                 continue
-            conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-            reader = FrameReader(conn.fileno(), clock=self._clock)
             try:
+                conn.setsockopt(_socket.IPPROTO_TCP,
+                                _socket.TCP_NODELAY, 1)
+                reader = FrameReader(conn.fileno(), clock=self._clock)
                 hello = reader.read_frame(timeout_s=min(5.0, remaining))
-            except TransportError:
+            except (TransportError, OSError):
+                # A hello that never arrives, a reset mid-handshake, a
+                # setsockopt on an already-dead conn: close the fd —
+                # leaking it here wedges the slot — and keep waiting.
                 conn.close()
                 continue
+            except BaseException:
+                conn.close()  # unexpected: still never leak the fd
+                raise
             if (hello.get("kind") != HELLO_KIND
                     or hello.get("token") != token
                     or int(hello.get("shard", -1)) != int(expect_shard)
@@ -370,9 +377,15 @@ def connect_worker(address: str, shard: int, token: str,
     host, _, port = address.rpartition(":")
     sock = _socket.create_connection((host or "127.0.0.1", int(port)),
                                      timeout=float(timeout_s))
-    sock.settimeout(None)
-    sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-    write_frame(sock.fileno(), {"kind": HELLO_KIND, "shard": int(shard),
-                                "token": str(token),
-                                "pid": os.getpid()})
+    try:
+        sock.settimeout(None)
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        write_frame(sock.fileno(),
+                    {"kind": HELLO_KIND, "shard": int(shard),
+                     "token": str(token), "pid": os.getpid()})
+    except BaseException:
+        # the redial loop retries for hours under RetryPolicy backoff —
+        # leaking one fd per failed hello exhausts the process fd table
+        sock.close()
+        raise
     return sock

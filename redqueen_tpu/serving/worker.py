@@ -1097,10 +1097,14 @@ class SocketWorkerHandle(WorkerHandle):
             except OSError:
                 pass
             raise
-        return cls(proc, shard, listener, token, sock, reader,
-                   request_timeout_s=request_timeout_s,
-                   open_timeout_s=open_timeout_s,
-                   read_timeout_s=read_timeout_s, clock=clock)
+        try:
+            return cls(proc, shard, listener, token, sock, reader,
+                       request_timeout_s=request_timeout_s,
+                       open_timeout_s=open_timeout_s,
+                       read_timeout_s=read_timeout_s, clock=clock)
+        except BaseException:
+            _close_quietly(sock)  # never leak the accepted fd
+            raise
 
     @classmethod
     def spawn_socket(cls, dir: str, shard: int, listener: Listener,
@@ -1139,11 +1143,15 @@ class SocketWorkerHandle(WorkerHandle):
         closing the link (the remote supervisor owns the process)."""
         sock, hello, reader = listener.accept(
             token, shard, timeout_s=accept_timeout_s)
-        h = cls(None, shard, listener, token, sock, reader,
-                request_timeout_s=request_timeout_s,
-                open_timeout_s=open_timeout_s,
-                read_timeout_s=read_timeout_s, clock=clock)
-        h.worker_pid = int(hello.get("pid", -1))
+        try:
+            h = cls(None, shard, listener, token, sock, reader,
+                    request_timeout_s=request_timeout_s,
+                    open_timeout_s=open_timeout_s,
+                    read_timeout_s=read_timeout_s, clock=clock)
+            h.worker_pid = int(hello.get("pid", -1))
+        except BaseException:
+            _close_quietly(sock)  # never leak the accepted fd
+            raise
         return h
 
     # -- liveness / link management --
@@ -1188,9 +1196,14 @@ class SocketWorkerHandle(WorkerHandle):
                 expect_pid=expect)
         except TransportError:
             return False
+        try:
+            wfd = sock.fileno()
+        except (OSError, ValueError):
+            _close_quietly(sock)  # torn down under us: treat as no-show
+            return False
         self._sock = sock
         self._reader = reader
-        self._wfd = sock.fileno()
+        self._wfd = wfd
         self._last_frame_t = self._clock()
         if self.proc is None:
             self.worker_pid = int(hello.get("pid", -1))

@@ -1,0 +1,890 @@
+"""rqlint tier-3 tests: the RQ10xx concurrency band (lock discipline
+with thread-entry reachability and the caller-holds-lock lattice,
+lock-order cycles across modules, daemon-thread lifecycle, fd leaks on
+exception paths) and the RQ11xx mesh/collective band (unbound collective
+axes incl. the cross-function summary case, donation-after-use incl.
+cross-module donation and the in-loop rebind contract, shard_map spec
+arity), the new tier-3 summary bits, pragma/baseline round-trips, the
+``--jobs`` byte-identity contract, ``--format sarif``, and the repo
+self-scan pin.
+
+Like the other rqlint suites this file never imports jax: tier-3 must
+stay usable in watchdog/driver contexts where jax is absent.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.rqlint import cli, engine  # noqa: E402
+from tools.rqlint.project import ProjectView  # noqa: E402
+from tools.rqlint.rules import select_rules  # noqa: E402
+
+
+def dedent_all(files):
+    return {rel: textwrap.dedent(src) for rel, src in files.items()}
+
+
+def view_of(files) -> ProjectView:
+    files = dedent_all(files)
+    return ProjectView.build(
+        {rel: ast.parse(src) for rel, src in files.items()}, files)
+
+
+def lint_project(files, select=None):
+    rules = select_rules(select) if select else None
+    return engine.check_sources(dedent_all(files), rules)
+
+
+def rule_ids(findings, include_suppressed=True):
+    return [f.rule for f in findings
+            if include_suppressed or not f.suppressed]
+
+
+def only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# RQ1001 — unguarded shared state
+# ---------------------------------------------------------------------------
+
+RACY_CLASS = """\
+    import threading
+
+    class Buf:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            with self._lock:
+                self._n += 1
+
+        def read(self):
+            return self._n
+
+        def close(self):
+            self._t.join()
+"""
+
+
+class TestUnguardedSharedState:
+    def test_fires_on_unlocked_read_in_threaded_class(self):
+        out = lint_project({"redqueen_tpu/x.py": RACY_CLASS},
+                           ["RQ1001"])
+        fs = only(out["redqueen_tpu/x.py"], "RQ1001")
+        assert len(fs) == 1
+        assert "_n" in fs[0].message and "read" in fs[0].message
+
+    def test_silent_without_thread_entry(self):
+        # same lock discipline, but nothing runs on a thread
+        src = RACY_CLASS.replace(
+            "            self._t = threading.Thread"
+            "(target=self._loop, daemon=True)\n"
+            "            self._t.start()\n", "").replace(
+            "            self._t.join()\n", "            pass\n")
+        out = lint_project({"redqueen_tpu/x.py": src}, ["RQ1001"])
+        assert out["redqueen_tpu/x.py"] == []
+
+    def test_silent_when_every_access_is_locked(self):
+        src = RACY_CLASS.replace(
+            "        def read(self):\n"
+            "            return self._n\n",
+            "        def read(self):\n"
+            "            with self._lock:\n"
+            "                return self._n\n")
+        # keep indentation semantics: rebuild via textwrap in fixture
+        out = lint_project({"redqueen_tpu/x.py": src}, ["RQ1001"])
+        assert out["redqueen_tpu/x.py"] == []
+
+    def test_caller_holds_lock_lattice_sanctions_helper(self):
+        # _bump has no `with` of its own, but its only call site holds
+        # the lock — the inferred lock set keeps it silent (the journal
+        # `_fsync_locked` idiom)
+        files = {"redqueen_tpu/x.py": """\
+            import threading
+
+            class Buf:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+                    self._t = t
+
+                def _loop(self):
+                    with self._lock:
+                        self._bump()
+
+                def _bump(self):
+                    self._n += 1
+
+                def close(self):
+                    self._t.join()
+        """}
+        out = lint_project(files, ["RQ1001"])
+        assert out["redqueen_tpu/x.py"] == []
+
+    def test_init_writes_are_exempt(self):
+        out = lint_project({"redqueen_tpu/x.py": RACY_CLASS.replace(
+            "        def read(self):\n"
+            "            return self._n\n", "")}, ["RQ1001"])
+        assert out["redqueen_tpu/x.py"] == []
+
+    def test_pragma_suppresses(self):
+        src = RACY_CLASS.replace(
+            "            return self._n",
+            "            return self._n  # rqlint: disable=RQ1001 "
+            "monotonic counter, staleness is fine")
+        out = lint_project({"redqueen_tpu/x.py": src}, ["RQ1001"])
+        fs = out["redqueen_tpu/x.py"]
+        assert len(fs) == 1 and fs[0].suppressed and not fs[0].fails
+
+
+# ---------------------------------------------------------------------------
+# RQ1002 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+class TestLockOrderCycle:
+    CYCLE = {
+        "redqueen_tpu/a.py": """\
+            import threading
+            from redqueen_tpu.b import grab_b
+
+            _A_LOCK = threading.Lock()
+
+            def with_a_then_b():
+                with _A_LOCK:
+                    grab_b()
+
+            def take_a():
+                with _A_LOCK:
+                    return 1
+        """,
+        "redqueen_tpu/b.py": """\
+            import threading
+            from redqueen_tpu import a
+
+            _B_LOCK = threading.Lock()
+
+            def grab_b():
+                with _B_LOCK:
+                    return 2
+
+            def with_b_then_a():
+                with _B_LOCK:
+                    a.take_a()
+        """,
+    }
+
+    def test_cross_module_cycle_fires_in_both_files(self):
+        out = lint_project(self.CYCLE, ["RQ1002"])
+        assert rule_ids(out["redqueen_tpu/a.py"]) == ["RQ1002"]
+        assert rule_ids(out["redqueen_tpu/b.py"]) == ["RQ1002"]
+        assert "deadlock" in out["redqueen_tpu/a.py"][0].message
+
+    def test_consistent_order_is_silent(self):
+        files = dict(self.CYCLE)
+        files["redqueen_tpu/b.py"] = """\
+            import threading
+            from redqueen_tpu import a
+
+            _B_LOCK = threading.Lock()
+
+            def grab_b():
+                with _B_LOCK:
+                    return 2
+
+            def with_b_only():
+                with _B_LOCK:
+                    return 3
+        """
+        out = lint_project(files, ["RQ1002"])
+        assert out["redqueen_tpu/a.py"] == []
+        assert out["redqueen_tpu/b.py"] == []
+
+    def test_summary_bits_carry_lock_facts(self):
+        v = view_of(self.CYCLE)
+        s = v.summaries["redqueen_tpu.a::with_a_then_b"]
+        assert "redqueen_tpu.a::_A_LOCK" in s.acquires_lock
+        assert "redqueen_tpu.b::_B_LOCK" in s.acquires_lock  # via callee
+        assert ("redqueen_tpu.a::_A_LOCK",
+                "redqueen_tpu.b::_B_LOCK") in s.lock_edges
+
+
+# ---------------------------------------------------------------------------
+# RQ1003 — unstoppable daemon threads
+# ---------------------------------------------------------------------------
+
+class TestUnstoppableThread:
+    def test_fires_without_join_or_event(self):
+        files = {"redqueen_tpu/x.py": """\
+            import threading
+
+            class Pump:
+                def start(self):
+                    self._t = threading.Thread(target=self._loop,
+                                               daemon=True)
+                    self._t.start()
+
+                def _loop(self):
+                    while True:
+                        pass
+        """}
+        out = lint_project(files, ["RQ1003"])
+        assert rule_ids(out["redqueen_tpu/x.py"]) == ["RQ1003"]
+
+    def test_join_path_is_silent(self):
+        files = {"redqueen_tpu/x.py": """\
+            import threading
+
+            class Pump:
+                def start(self):
+                    self._t = threading.Thread(target=self._loop,
+                                               daemon=True)
+                    self._t.start()
+
+                def _loop(self):
+                    while True:
+                        pass
+
+                def close(self):
+                    self._t.join(timeout=5.0)
+        """}
+        out = lint_project(files, ["RQ1003"])
+        assert out["redqueen_tpu/x.py"] == []
+
+    def test_stop_event_path_is_silent(self):
+        files = {"redqueen_tpu/x.py": """\
+            import threading
+
+            class Pump:
+                def start(self):
+                    self._stop = threading.Event()
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while not self._stop.wait(0.05):
+                        pass
+
+                def close(self):
+                    self._stop.set()
+        """}
+        out = lint_project(files, ["RQ1003"])
+        assert out["redqueen_tpu/x.py"] == []
+
+    def test_local_thread_in_function_scope(self):
+        files = {"redqueen_tpu/x.py": """\
+            import threading
+
+            def run():
+                def _loop():
+                    while True:
+                        pass
+                t = threading.Thread(target=_loop, daemon=True)
+                t.start()
+        """}
+        out = lint_project(files, ["RQ1003"])
+        assert rule_ids(out["redqueen_tpu/x.py"]) == ["RQ1003"]
+        files = {"redqueen_tpu/x.py": """\
+            import threading
+
+            def run():
+                def _loop():
+                    while True:
+                        pass
+                t = threading.Thread(target=_loop, daemon=True)
+                t.start()
+                t.join()
+        """}
+        out = lint_project(files, ["RQ1003"])
+        assert out["redqueen_tpu/x.py"] == []
+
+
+# ---------------------------------------------------------------------------
+# RQ1004 — fd leaks on exception paths
+# ---------------------------------------------------------------------------
+
+class TestFdLeak:
+    LEAKY = {"redqueen_tpu/serving/t.py": """\
+        import socket
+
+        def dial(addr):
+            sock = socket.create_connection(addr)
+            sock.setsockopt(1, 2, 3)
+            return sock
+    """}
+
+    def test_fires_on_unguarded_use(self):
+        out = lint_project(self.LEAKY, ["RQ1004"])
+        fs = only(out["redqueen_tpu/serving/t.py"], "RQ1004")
+        assert len(fs) == 1 and "sock" in fs[0].message
+
+    def test_try_close_guard_is_silent(self):
+        files = {"redqueen_tpu/serving/t.py": """\
+            import socket
+
+            def dial(addr):
+                sock = socket.create_connection(addr)
+                try:
+                    sock.setsockopt(1, 2, 3)
+                except BaseException:
+                    sock.close()
+                    raise
+                return sock
+        """}
+        out = lint_project(files, ["RQ1004"])
+        assert out["redqueen_tpu/serving/t.py"] == []
+
+    def test_close_helper_idiom_is_recognized(self):
+        files = {"redqueen_tpu/serving/t.py": """\
+            import socket
+
+            def _close_quietly(s):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+            def dial(addr):
+                sock = socket.create_connection(addr)
+                try:
+                    sock.setsockopt(1, 2, 3)
+                except BaseException:
+                    _close_quietly(sock)
+                    raise
+                return sock
+        """}
+        out = lint_project(files, ["RQ1004"])
+        assert out["redqueen_tpu/serving/t.py"] == []
+
+    def test_scoped_to_serving(self):
+        out = lint_project({
+            "redqueen_tpu/ops/t.py":
+                self.LEAKY["redqueen_tpu/serving/t.py"]}, ["RQ1004"])
+        assert out["redqueen_tpu/ops/t.py"] == []
+
+
+# ---------------------------------------------------------------------------
+# RQ1101 — unbound collective axes
+# ---------------------------------------------------------------------------
+
+class TestUnboundAxis:
+    def test_raw_collective_in_plain_function_fires(self):
+        files = {"redqueen_tpu/parallel/k.py": """\
+            from jax import lax
+
+            def reduce(x):
+                return lax.psum(x, "data")
+        """}
+        out = lint_project(files, ["RQ1101"])
+        fs = only(out["redqueen_tpu/parallel/k.py"], "RQ1101")
+        assert len(fs) == 1 and "'data'" in fs[0].message
+
+    def test_shard_map_wrapped_function_is_silent(self):
+        files = {"redqueen_tpu/parallel/k.py": """\
+            import jax
+            from jax import lax
+
+            def kernel(x):
+                return lax.psum(x, "data")
+
+            def launch(mesh, xs):
+                f = jax.shard_map(kernel, mesh=mesh, in_specs=None,
+                                  out_specs=None)
+                return f(xs)
+        """}
+        out = lint_project(files, ["RQ1101"])
+        assert out["redqueen_tpu/parallel/k.py"] == []
+
+    def test_helper_called_from_wrapped_kernel_is_silent(self):
+        # the closure follows the call graph: helper is only reachable
+        # inside the binding
+        files = {
+            "redqueen_tpu/parallel/h.py": """\
+                from jax import lax
+
+                def total(x):
+                    return lax.psum(x, "data")
+            """,
+            "redqueen_tpu/parallel/k.py": """\
+                import jax
+                from redqueen_tpu.parallel.h import total
+
+                def kernel(x):
+                    return total(x) + 1
+
+                def launch(mesh, xs):
+                    f = jax.shard_map(kernel, mesh=mesh, in_specs=None,
+                                      out_specs=None)
+                    return f(xs)
+            """,
+        }
+        out = lint_project(files, ["RQ1101"])
+        assert out["redqueen_tpu/parallel/h.py"] == []
+        assert out["redqueen_tpu/parallel/k.py"] == []
+
+    def test_cross_function_unbound_call_path_fires(self):
+        # the tier-2-summaries case: `total` is sanctioned (wrapped via
+        # kernel) but `report` reaches it with NO binding — the finding
+        # lands at report's call site
+        files = {
+            "redqueen_tpu/parallel/h.py": """\
+                from jax import lax
+
+                def total(x):
+                    return lax.psum(x, "data")
+            """,
+            "redqueen_tpu/parallel/k.py": """\
+                import jax
+                from redqueen_tpu.parallel.h import total
+
+                def kernel(x):
+                    return total(x) + 1
+
+                def launch(mesh, xs):
+                    f = jax.shard_map(kernel, mesh=mesh, in_specs=None,
+                                      out_specs=None)
+                    return f(xs)
+
+                def report(x):
+                    return total(x)
+            """,
+        }
+        out = lint_project(files, ["RQ1101"])
+        assert out["redqueen_tpu/parallel/h.py"] == []
+        fs = only(out["redqueen_tpu/parallel/k.py"], "RQ1101")
+        assert len(fs) == 1
+        assert "total" in fs[0].message and "'data'" in fs[0].message
+
+    def test_axis_present_guard_is_silent(self):
+        # the star_run kernel idiom: probe the axis before consuming it
+        files = {"redqueen_tpu/parallel/k.py": """\
+            from jax import lax
+            from redqueen_tpu.parallel import comm
+
+            def offset(n):
+                return lax.axis_index("feed") * n \\
+                    if comm.axis_present("feed") else 0
+        """}
+        out = lint_project(files, ["RQ1101"])
+        assert out["redqueen_tpu/parallel/k.py"] == []
+
+    def test_nested_kernel_wrapped_locally_is_silent(self):
+        files = {"redqueen_tpu/parallel/k.py": """\
+            import jax
+            from jax import lax
+
+            def launch(mesh, xs):
+                def kernel(x):
+                    return lax.psum(x, "data")
+                f = jax.shard_map(kernel, mesh=mesh, in_specs=None,
+                                  out_specs=None)
+                return f(xs)
+        """}
+        out = lint_project(files, ["RQ1101"])
+        assert out["redqueen_tpu/parallel/k.py"] == []
+
+    def test_comm_wrappers_never_fire(self):
+        # dynamic axis parameters are not analyzed: the comm.py guard
+        # wrappers stay silent by construction
+        result = engine.run(paths=["redqueen_tpu/parallel/comm.py"])
+        assert not [f for f in result["findings"]
+                    if f.rule == "RQ1101"]
+
+
+# ---------------------------------------------------------------------------
+# RQ1102 — donation-after-use
+# ---------------------------------------------------------------------------
+
+DONATING_DEF = """\
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(carry, x):
+        return carry + x
+"""
+
+
+class TestDonationAfterUse:
+    def test_read_after_donation_fires(self):
+        files = {"redqueen_tpu/learn/d.py": DONATING_DEF + """\
+
+    def drive(carry, xs):
+        out = step(carry, xs)
+        return out + carry
+"""}
+        out = lint_project(files, ["RQ1102"])
+        fs = only(out["redqueen_tpu/learn/d.py"], "RQ1102")
+        assert len(fs) == 1 and "carry" in fs[0].message
+
+    def test_rebind_over_name_is_silent(self):
+        files = {"redqueen_tpu/learn/d.py": DONATING_DEF + """\
+
+    def drive(carry, xs):
+        carry = step(carry, xs)
+        return carry
+"""}
+        out = lint_project(files, ["RQ1102"])
+        assert out["redqueen_tpu/learn/d.py"] == []
+
+    def test_loop_without_rebind_fires(self):
+        files = {"redqueen_tpu/learn/d.py": DONATING_DEF + """\
+
+    def drive(carry, batches):
+        for b in batches:
+            out = step(carry, b)
+        return out
+"""}
+        out = lint_project(files, ["RQ1102"])
+        fs = only(out["redqueen_tpu/learn/d.py"], "RQ1102")
+        assert len(fs) == 1 and "loop" in fs[0].message
+
+    def test_loop_with_rebind_is_silent(self):
+        files = {"redqueen_tpu/learn/d.py": DONATING_DEF + """\
+
+    def drive(carry, batches):
+        for b in batches:
+            carry = step(carry, b)
+        return carry
+"""}
+        out = lint_project(files, ["RQ1102"])
+        assert out["redqueen_tpu/learn/d.py"] == []
+
+    def test_cross_module_donation_via_summaries(self):
+        files = {
+            "redqueen_tpu/learn/k.py": textwrap.dedent(DONATING_DEF),
+            "redqueen_tpu/learn/d.py": """\
+                from redqueen_tpu.learn.k import step
+
+                def drive(carry, xs):
+                    out = step(carry, xs)
+                    return out + carry
+            """,
+        }
+        out = lint_project(files, ["RQ1102"])
+        fs = only(out["redqueen_tpu/learn/d.py"], "RQ1102")
+        assert len(fs) == 1
+
+    def test_pass_through_helper_donates_transitively(self):
+        # helper hands its param straight to the donating position: the
+        # `donates` summary bit propagates, the helper's CALLER fires
+        files = {
+            "redqueen_tpu/learn/k.py": textwrap.dedent(DONATING_DEF),
+            "redqueen_tpu/learn/h.py": """\
+                from redqueen_tpu.learn.k import step
+
+                def wrapped_step(carry, xs):
+                    return step(carry, xs)
+            """,
+            "redqueen_tpu/learn/d.py": """\
+                from redqueen_tpu.learn.h import wrapped_step
+
+                def drive(carry, xs):
+                    out = wrapped_step(carry, xs)
+                    return out + carry
+            """,
+        }
+        v = view_of(files)
+        assert 0 in v.summaries[
+            "redqueen_tpu.learn.h::wrapped_step"].donates
+        out = lint_project(files, ["RQ1102"])
+        assert len(only(out["redqueen_tpu/learn/d.py"], "RQ1102")) == 1
+
+    def test_local_jit_handle_fires(self):
+        files = {"redqueen_tpu/serving/d.py": """\
+            import jax
+
+            def _apply(state, xs):
+                return state + xs
+
+            apply_fn = jax.jit(_apply, donate_argnums=(0,))
+
+            def drive(state, xs):
+                out = apply_fn(state, xs)
+                return out + state
+        """}
+        out = lint_project(files, ["RQ1102"])
+        assert len(only(out["redqueen_tpu/serving/d.py"],
+                        "RQ1102")) == 1
+
+
+# ---------------------------------------------------------------------------
+# RQ1103 — shard_map spec arity
+# ---------------------------------------------------------------------------
+
+class TestShardMapSpecArity:
+    def test_in_specs_arity_mismatch_fires(self):
+        files = {"redqueen_tpu/parallel/s.py": """\
+            import jax
+
+            def kernel(a, b, c):
+                return (a, b)
+
+            def launch(mesh, P):
+                return jax.shard_map(kernel, mesh=mesh,
+                                     in_specs=(P, P),
+                                     out_specs=(P, P))
+        """}
+        out = lint_project(files, ["RQ1103"])
+        fs = only(out["redqueen_tpu/parallel/s.py"], "RQ1103")
+        assert len(fs) == 1
+        assert "2 entries" in fs[0].message and "3" in fs[0].message
+
+    def test_matching_arity_is_silent(self):
+        files = {"redqueen_tpu/parallel/s.py": """\
+            import jax
+
+            def kernel(a, b, c):
+                return (a, b)
+
+            def launch(mesh, P):
+                return jax.shard_map(kernel, mesh=mesh,
+                                     in_specs=(P, P, P),
+                                     out_specs=(P, P))
+        """}
+        out = lint_project(files, ["RQ1103"])
+        assert out["redqueen_tpu/parallel/s.py"] == []
+
+    def test_out_specs_vs_tuple_return_fires(self):
+        files = {"redqueen_tpu/parallel/s.py": """\
+            import jax
+
+            def kernel(a, b):
+                return (a, b, a + b)
+
+            def launch(mesh, P):
+                return jax.shard_map(kernel, mesh=mesh,
+                                     in_specs=(P, P),
+                                     out_specs=(P, P))
+        """}
+        out = lint_project(files, ["RQ1103"])
+        fs = only(out["redqueen_tpu/parallel/s.py"], "RQ1103")
+        assert len(fs) == 1 and "3-tuples" in fs[0].message
+
+    def test_nested_kernel_resolved_lexically(self):
+        files = {"redqueen_tpu/parallel/s.py": """\
+            import jax
+
+            def launch(mesh, P):
+                def kernel(a, b, c):
+                    return (a, b)
+                return jax.shard_map(kernel, mesh=mesh,
+                                     in_specs=(P,),
+                                     out_specs=(P, P))
+        """}
+        out = lint_project(files, ["RQ1103"])
+        assert len(only(out["redqueen_tpu/parallel/s.py"],
+                        "RQ1103")) == 1
+
+    def test_dynamic_specs_are_skipped(self):
+        files = {"redqueen_tpu/parallel/s.py": """\
+            import jax
+
+            def kernel(a, b, c):
+                return (a, b)
+
+            def launch(mesh, specs):
+                return jax.shard_map(kernel, mesh=mesh,
+                                     in_specs=specs[0],
+                                     out_specs=specs[1])
+        """}
+        out = lint_project(files, ["RQ1103"])
+        assert out["redqueen_tpu/parallel/s.py"] == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline round-trip for the new bands
+# ---------------------------------------------------------------------------
+
+class TestBaselineRoundTrip:
+    def test_rq1101_lands_warn_first_via_baseline(self, tmp_path):
+        pkg = tmp_path / "redqueen_tpu" / "parallel"
+        pkg.mkdir(parents=True)
+        (pkg / "k.py").write_text(textwrap.dedent("""\
+            from jax import lax
+
+            def reduce(x):
+                return lax.psum(x, "data")
+        """))
+        bl = str(tmp_path / "bl.json")
+        assert cli.main(["--root", str(tmp_path), "--baseline", bl,
+                         "-q", "--jobs", "1"]) == 1
+        assert cli.main(["--root", str(tmp_path), "--baseline", bl,
+                         "--jobs", "1", "--update-baseline"]) == 0
+        entries = json.load(open(bl))["findings"]
+        assert [e["rule"] for e in entries] == ["RQ1101"]
+        assert cli.main(["--root", str(tmp_path), "--baseline", bl,
+                         "-q", "--jobs", "1"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# --jobs: byte identity with serial
+# ---------------------------------------------------------------------------
+
+class TestJobs:
+    def test_parallel_scan_byte_identical_to_serial(self, tmp_path):
+        """Full-repo acceptance, in a FRESH jax-free subprocess (the
+        fork pool must never run under this pytest process's jax
+        threads): --jobs 2 findings artifact and exit code are
+        byte-identical to --jobs 1."""
+        a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from tools.rqlint import cli\n"
+            "rc1 = cli.main(['--jobs', '1', '-q', '--json', %r])\n"
+            "rc2 = cli.main(['--jobs', '2', '-q', '--json', %r])\n"
+            "assert rc1 == rc2, (rc1, rc2)\n"
+            "print('RC', rc1)\n" % (REPO, a, b))
+        p = subprocess.run([sys.executable, "-c", code], cwd="/",
+                           capture_output=True, text=True, timeout=300)
+        assert p.returncode == 0, p.stdout + p.stderr
+        da, db = json.load(open(a)), json.load(open(b))
+        assert da["findings"] == db["findings"]
+        assert da["counts"] == db["counts"]
+        assert da["rules"] == db["rules"]
+
+    def test_small_scan_falls_back_to_serial(self, tmp_path):
+        # under _PAR_MIN_FILES the pool is skipped entirely — same
+        # findings either way, no fork cost for tiny pre-commit scans
+        (tmp_path / "bench.py").write_text("x = 1\n")
+        r = engine.run(root=str(tmp_path), use_baseline=False, jobs=8)
+        assert r["files_scanned"] == 1 and r["findings"] == []
+
+    def test_bad_jobs_is_usage_error(self):
+        assert cli.main(["--jobs", "0", "-q"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# --format sarif
+# ---------------------------------------------------------------------------
+
+class TestSarif:
+    def test_violation_renders_as_sarif_result(self, tmp_path, capsys):
+        (tmp_path / "bench.py").write_text(textwrap.dedent("""\
+            import time
+            def bench(fn):
+                t0 = time.perf_counter()
+                r = fn()
+                return r, time.perf_counter() - t0
+        """))
+        rc = cli.main(["--root", str(tmp_path), "--format", "sarif",
+                       "--jobs", "1",
+                       "--baseline", str(tmp_path / "bl.json")])
+        cap = capsys.readouterr()
+        assert rc == 1
+        doc = json.loads(cap.out)  # stdout IS the SARIF document
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "rqlint"
+        assert any(r["id"] == "RQ601"
+                   for r in run["tool"]["driver"]["rules"])
+        res = run["results"]
+        assert res and res[0]["ruleId"] == "RQ601"
+        loc = res[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "bench.py"
+        assert loc["region"]["startLine"] >= 1
+        assert "rules active" in cap.err  # summary moved to stderr
+
+    def test_suppressed_findings_carry_suppressions(self, tmp_path,
+                                                    capsys):
+        (tmp_path / "bench.py").write_text(textwrap.dedent("""\
+            import time
+            def bench(fn):
+                t0 = time.perf_counter()  # rqlint: disable=RQ601 smoke
+                r = fn()
+                return r, time.perf_counter() - t0
+        """))
+        rc = cli.main(["--root", str(tmp_path), "--format", "sarif",
+                       "--jobs", "1",
+                       "--baseline", str(tmp_path / "bl.json")])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        res = doc["runs"][0]["results"]
+        assert res and res[0]["suppressions"][0]["kind"] == "inSource"
+
+    def test_clean_tree_is_empty_results_exit_0(self, tmp_path, capsys):
+        (tmp_path / "bench.py").write_text("x = 1\n")
+        rc = cli.main(["--root", str(tmp_path), "--format", "sarif",
+                       "--jobs", "1",
+                       "--baseline", str(tmp_path / "bl.json")])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["runs"][0]["results"] == []
+
+
+# ---------------------------------------------------------------------------
+# The repo itself
+# ---------------------------------------------------------------------------
+
+class TestRepoSelfScan:
+    def test_tier3_bands_active_and_tree_clean(self):
+        """Acceptance: >= 5 new RQ10xx/RQ11xx rule IDs active, repo
+        exits clean (every audited finding fixed or pragma-justified)."""
+        result = engine.run()
+        bad = engine.failing(result["findings"])
+        assert not bad, "rqlint findings on the repo:\n" + "\n".join(
+            f.format() for f in bad)
+        t3 = {r.id for r in result["rules"]
+              if r.id.startswith(("RQ10", "RQ11"))
+              and len(r.id) == 6}
+        assert len(t3) >= 5, t3
+        assert len(result["rules"]) >= 20
+
+    def test_audited_runtime_summaries(self):
+        """The audited state this PR pins: the journal flusher/telemetry
+        locks export coherent tier-3 summary facts."""
+        view = engine.run(paths=["redqueen_tpu/serving/journal.py"]
+                          )["project"]
+        app = view.summaries[
+            "redqueen_tpu.serving.journal::Journal.append"]
+        assert "redqueen_tpu.serving.journal::Journal._lock" in \
+            app.acquires_lock
+        # no lock-order cycle anywhere in the tree
+        graph = {}
+        for s in view.summaries.values():
+            for a, b in s.lock_edges:
+                graph.setdefault(a, set()).add(b)
+        from tools.rqlint.callgraph import sccs
+        comps = sccs({k: set(v) for k, v in graph.items()})
+        assert all(len(c) == 1 for c in comps)
+
+    def test_no_project_skips_tier3(self):
+        src = textwrap.dedent("""\
+            from jax import lax
+
+            def reduce(x):
+                return lax.psum(x, "data")
+        """)
+        assert engine.check_source(
+            src, "redqueen_tpu/parallel/k.py") == []
+
+    def test_jax_free_subprocess(self):
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import tools.rqlint.engine as engine\n"
+            "r = engine.run(jobs=2)\n"
+            "assert 'jax' not in sys.modules, 'tier-3 pulled jax'\n"
+            "print('OK')\n" % REPO)
+        p = subprocess.run([sys.executable, "-c", code], cwd="/",
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert p.stdout.startswith("OK")
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -726,12 +726,13 @@ class TestNoProjectEquivalence:
         out = capsys.readouterr().out
         assert "9 rules active" in out
 
-    def test_project_mode_runs_thirteen_rules(self, tmp_path, capsys):
+    def test_project_mode_runs_twenty_rules(self, tmp_path, capsys):
+        # 13 tier-1/2 rules + the 7 tier-3 RQ10xx/RQ11xx rules
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path),
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
-        assert "13 rules active" in capsys.readouterr().out
+        assert "20 rules active" in capsys.readouterr().out
 
 
 # ---------------------------------------------------------------------------

@@ -151,6 +151,49 @@ class TestListenerAuth:
                            expect_pid=os.getpid() + 12345)
             sock.close()
 
+    def test_connect_worker_closes_socket_when_hello_write_fails(
+            self, monkeypatch):
+        """rqlint RQ1004 regression (the redial-loop fd leak): a hello
+        that fails to send must CLOSE the dialed socket before the
+        error propagates — the RetryPolicy redial loop retries for
+        hours, and one leaked fd per attempt exhausts the fd table."""
+        from redqueen_tpu.serving import transport as tmod
+
+        def boom(fd, payload):
+            raise OSError("injected hello failure")
+
+        monkeypatch.setattr(tmod, "write_frame", boom)
+        with Listener() as lst:
+            fds_before = len(os.listdir("/proc/self/fd"))
+            with pytest.raises(OSError, match="injected hello"):
+                connect_worker(lst.address, shard=3, token="tok")
+            fds_after = len(os.listdir("/proc/self/fd"))
+        assert fds_after == fds_before, (
+            "connect_worker leaked a socket fd on the failed-hello "
+            "path")
+
+    def test_accept_closes_conn_when_handshake_read_raises(
+            self, monkeypatch):
+        """rqlint RQ1004 regression: an OSError mid-handshake (reset
+        conn, dead fd) must close the accepted connection and keep
+        waiting — never leak the fd or abort the slot."""
+        from redqueen_tpu.serving import transport as tmod
+
+        def boom(self, timeout_s=None):
+            raise OSError("injected reset")
+
+        monkeypatch.setattr(tmod.FrameReader, "read_frame", boom)
+        with Listener() as lst:
+            sock = connect_worker(lst.address, shard=3, token="tok")
+            fds_before = len(os.listdir("/proc/self/fd"))
+            with pytest.raises(TransportTimeout):
+                lst.accept("tok", 3, timeout_s=0.5)
+            fds_after = len(os.listdir("/proc/self/fd"))
+            sock.close()
+        assert fds_after <= fds_before, (
+            "Listener.accept leaked the accepted conn on the "
+            "mid-handshake failure path")
+
     def test_remote_command_shape(self, tmp_path):
         cl = _socket_cluster(tmp_path / "rc", _open_runtimes=False)
         cmds = cl.remote_worker_commands()

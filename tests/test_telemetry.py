@@ -192,6 +192,37 @@ class TestSpans:
 # ---------------------------------------------------------------------------
 
 
+class TestSpansDroppedRace:
+    def test_concurrent_overflow_drops_count_exactly(self):
+        """rqlint RQ1001-band regression (the audited telemetry race):
+        ``spans_dropped`` is a read-modify-write on the overflow path
+        and spans finish on EVERY thread (the journal flusher among
+        them) — unlocked, concurrent drops under-count and the
+        truncation flag lies.  With the lock the count is exact."""
+        import threading
+
+        tel = T.Telemetry(enabled=True, max_spans=0)
+        n_threads, per_thread = 8, 400
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)  # force aggressive interleaving
+        try:
+            def hammer():
+                for _ in range(per_thread):
+                    with tel.trace("t"):
+                        pass
+
+            threads = [threading.Thread(target=hammer)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            sys.setswitchinterval(old)
+        assert tel.spans_dropped == n_threads * per_thread
+        assert tel.spans == []
+
+
 class TestDisabledCost:
     def test_every_disabled_call_returns_the_shared_singleton(self):
         tel = T.Telemetry(enabled=False)

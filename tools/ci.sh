@@ -12,20 +12,40 @@ echo "== rqlint static pass =="
 # First gate: jax-free, so it fails fast before any backend is touched.
 # Runs in project mode (tier-2 whole-program dataflow: call-graph
 # summaries power the RQ7xx hidden-host-sync and RQ8xx recompilation
-# bands plus the cross-function RQ401/RQ501 upgrades; <10s, stdlib-only).
-# RQLINT_FINDINGS.json is the uploaded findings artifact (atomic write;
-# schema rq.rqlint.findings/1 — see docs/API.md).
+# bands plus the cross-function RQ401/RQ501 upgrades) INCLUDING the
+# tier-3 bands: RQ10xx shared-memory concurrency (lock discipline,
+# lock-order cycles, thread/fd lifecycle) and RQ11xx mesh/collective
+# correctness (unbound axes, donation-after-use, shard_map spec arity)
+# — the failure modes of the mesh PRs land against this gate, on a box
+# with no mesh.  RQLINT_FINDINGS.json is the uploaded findings artifact
+# (atomic write; schema rq.rqlint.findings/1 — see docs/API.md).
+#
+# The scan fans the per-file rule pass over --jobs fork workers
+# (findings/exit code byte-identical to serial — pinned by
+# tests/test_rqlint_concurrency.py).  Both walls are logged so the
+# speedup is visible in every CI log.
 #
 # Pre-commit (fast local gate — findings restricted to files you touched
 # vs HEAD; the project view still covers the whole tree so cross-file
-# summaries stay exact):
+# summaries stay exact, and the tier-3 bands run too):
 #     python -m tools.rqlint --changed-only
 # or against a branch point:  python -m tools.rqlint --changed-only main
 # In GitHub Actions, add `--format github` so failing findings render as
-# inline PR annotations. `--prune-baseline` drops baseline entries that
+# inline PR annotations (or `--format sarif > rqlint.sarif` for a
+# code-scanning upload). `--prune-baseline` drops baseline entries that
 # no longer match (a baseline referencing deleted paths FAILS this gate
 # until pruned).
+# The GATE runs first (parallel, artifact written even when findings
+# fail the build — set -e must never eat RQLINT_FINDINGS.json); the
+# serial reference run after it is log-only (|| true) and only executes
+# on a passing gate — a few seconds of wall bought for a speedup number
+# in every green CI log.
+t0=$SECONDS
 python -m tools.rqlint --json RQLINT_FINDINGS.json
+echo "rqlint parallel (--jobs $(nproc)): $((SECONDS - t0))s"
+t0=$SECONDS
+python -m tools.rqlint --jobs 1 -q > /dev/null || true
+echo "rqlint serial reference (--jobs 1): $((SECONDS - t0))s"
 
 echo "== resilience shim (legacy contract) =="
 # The delegating shim must keep the pre-rqlint CLI/exit-code contract

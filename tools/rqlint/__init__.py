@@ -19,11 +19,18 @@ Rule ID bands (see ``rqlint.rules``):
   summary-proven device values; per-iteration transfers in hot loops)
 - ``RQ8xx``  recompilation hazards (tier-2: varying/unhashable static
   jit args, shape-string dispatch, strong-typed constants under jit)
+- ``RQ10xx`` shared-memory concurrency (tier-3: per-class lock
+  discipline with thread-entry reachability, lock-order cycles over
+  the module graph, daemon-thread and fd lifecycle)
+- ``RQ11xx`` mesh/collective correctness (tier-3: unbound collective
+  axes, donation-after-use, shard_map spec arity)
 
 Tier-2 (the default "project mode") parses the whole tree once, builds
 the module/import graph, the name-resolved intra-repo call graph, and
 per-function dataflow summaries (bottom-up over SCCs with a fixpoint
 for cycles), and hands every rule a read-only ``ProjectView``.
+Tier-3 rides the same view with extra summary bits (``acquires_lock``/
+``lock_edges``/``uses_axes``/``binds_axis``/``donates``).
 ``--no-project`` reproduces the tier-1 per-file engine exactly.
 
 The whole package is stdlib-only at import time: it must stay usable in
@@ -39,7 +46,7 @@ CLI, exit codes, and violation text as the pre-rqlint monolith).
 
 from __future__ import annotations
 
-__version__ = "1.1.0"
+__version__ = "1.2.0"
 
 from .findings import Finding, Severity  # noqa: F401
 from .rules import all_rules, select_rules  # noqa: F401

@@ -89,6 +89,56 @@ def assign_target_names(node) -> List[str]:
     return names
 
 
+def const_int_elems(e: ast.AST) -> "set":
+    """Integer constants of a literal int / tuple / list expression —
+    the ``static_argnums``/``donate_argnums`` decorator spellings."""
+    out = set()
+    elems = e.elts if isinstance(e, (ast.Tuple, ast.List)) else [e]
+    for el in elems:
+        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+            out.add(el.value)
+    return out
+
+
+def const_str_elems(e: ast.AST) -> "set":
+    """String constants of a literal str / tuple / list expression."""
+    out = set()
+    elems = e.elts if isinstance(e, (ast.Tuple, ast.List)) else [e]
+    for el in elems:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.add(el.value)
+    return out
+
+
+def jit_donate_info(fn) -> "set":
+    """Parameter POSITIONS a jit decorator on ``fn`` donates
+    (``donate_argnums`` + ``donate_argnames`` mapped through the
+    signature) — empty when none.  Same decorator spellings as
+    :func:`jit_decorated`: bare/dotted ``jit``/``pjit`` and
+    ``partial(jax.jit, ...)``."""
+    nums: set = set()
+    names: set = set()
+    for dec in getattr(fn, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        is_jit = chain_tail(dec.func) in {"jit", "pjit"}
+        if (chain_tail(dec.func) == "partial" and dec.args
+                and chain_tail(dec.args[0]) in {"jit", "pjit"}):
+            is_jit = True
+        if not is_jit:
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "donate_argnums":
+                nums |= const_int_elems(kw.value)
+            elif kw.arg == "donate_argnames":
+                names |= const_str_elems(kw.value)
+    params = param_names(fn)
+    for n in names:
+        if n in params:
+            nums.add(params.index(n))
+    return nums
+
+
 def jit_decorated(fn) -> bool:
     """True when a FunctionDef is jit-compiled via decorator: bare or
     dotted ``jit``/``pjit``/``pmap``, or ``partial(jax.jit, ...)``."""

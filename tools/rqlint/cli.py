@@ -60,21 +60,26 @@ def github_annotation(f: Finding) -> str:
 
 def _atomic_write_json(path: str, obj) -> None:
     """runtime.artifacts.atomic_write_json, acquired without importing
-    jax: the normal package import is preferred (shares any loaded
-    module), with a direct file-load of the same stdlib-only module as
-    the jax-free fallback."""
-    try:
-        from redqueen_tpu.runtime.artifacts import atomic_write_json
-    except Exception:
-        import importlib.util
-        mod_path = os.path.join(engine.repo_root(), "redqueen_tpu",
-                                "runtime", "artifacts.py")
-        spec = importlib.util.spec_from_file_location(
-            "_rqlint_artifacts", mod_path)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        atomic_write_json = mod.atomic_write_json
-    atomic_write_json(path, obj, indent=2)
+    jax: when the package is ALREADY loaded its module is shared, but a
+    cold rqlint process direct-file-loads the same stdlib-only module
+    instead — importing the package would drag jax in, costing the
+    first (jax-free) CI gate seconds and breaking watchdog/driver
+    contexts with no jax installed."""
+    if "redqueen_tpu" in sys.modules:
+        try:
+            from redqueen_tpu.runtime.artifacts import atomic_write_json
+            atomic_write_json(path, obj, indent=2)
+            return
+        except Exception:
+            pass
+    import importlib.util
+    mod_path = os.path.join(engine.repo_root(), "redqueen_tpu",
+                            "runtime", "artifacts.py")
+    spec = importlib.util.spec_from_file_location(
+        "_rqlint_artifacts", mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.atomic_write_json(path, obj, indent=2)
 
 
 def artifact_doc(result: dict) -> dict:
@@ -129,10 +134,17 @@ def main(argv=None) -> int:
                          "git ref (default HEAD) — the fast pre-commit "
                          "gate; the project view still covers the full "
                          "tree")
-    ap.add_argument("--format", choices=("human", "github"),
+    ap.add_argument("--format", choices=("human", "github", "sarif"),
                     default="human",
-                    help="per-finding output: human lines, or GitHub "
-                         "Actions ::error annotations (inline in CI)")
+                    help="per-finding output: human lines, GitHub "
+                         "Actions ::error annotations (inline in CI), "
+                         "or a SARIF 2.1.0 log on stdout (code-scanning "
+                         "upload; summary moves to stderr)")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="fan the per-file rule pass over N fork "
+                         "workers (default: os.cpu_count(); findings "
+                         "and exit codes are byte-identical to --jobs "
+                         "1)")
     ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-q", "--quiet", action="store_true",
@@ -190,13 +202,19 @@ def main(argv=None) -> int:
                   f"{args.changed_only} — nothing to lint")
             return 0
         paths = changed
+    jobs = args.jobs if args.jobs is not None else (os.cpu_count() or 1)
+    if jobs < 1:
+        print(f"rqlint: --jobs must be >= 1, got {jobs}",
+              file=sys.stderr)
+        return 2
     try:
         result = engine.run(root=root, rules=rules,
                             paths=paths,
                             baseline_path=baseline_path,
                             use_baseline=not (args.no_baseline
                                               or args.update_baseline),
-                            project=not args.no_project)
+                            project=not args.no_project,
+                            jobs=jobs)
     except Exception as e:  # engine bugs must not look like a clean tree
         print(f"rqlint: internal error: {e!r}", file=sys.stderr)
         return 2
@@ -277,14 +295,24 @@ def main(argv=None) -> int:
     if args.format == "github":
         for f in failing:
             print(github_annotation(f))
+    elif args.format == "sarif":
+        # stdout IS the SARIF document (pipe it straight to a
+        # code-scanning upload); the human summary moves to stderr
+        import json as _json
+
+        from .sarif import sarif_doc
+        print(_json.dumps(sarif_doc(result), indent=2))
     elif not args.quiet:
         for f in findings:
             print(f.format())
     n_base = sum(1 for f in findings if f.baselined)
     n_supp = sum(1 for f in findings if f.suppressed)
-    print(f"rqlint: {result['files_scanned']} files scanned, "
-          f"{len(rules)} rules active, {len(failing)} failing finding(s)"
-          f" ({n_base} baselined, {n_supp} pragma-suppressed)")
+    summary = (f"rqlint: {result['files_scanned']} files scanned, "
+               f"{len(rules)} rules active, {len(failing)} failing "
+               f"finding(s) ({n_base} baselined, {n_supp} "
+               f"pragma-suppressed)")
+    print(summary, file=sys.stderr if args.format == "sarif"
+          else sys.stdout)
     return 1 if failing else 0
 
 

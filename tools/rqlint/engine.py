@@ -163,12 +163,86 @@ def _read_tree(root: str, rels: Sequence[str]
     return sources, trees, io_findings
 
 
+#: state inherited by fork()ed scan workers (set only for the duration
+#: of the parallel pass; fork shares it copy-on-write, so nothing is
+#: pickled except the relpath in and the findings out)
+_PAR_STATE: Optional[tuple] = None
+
+#: below this many files the fork+pool overhead exceeds the win
+_PAR_MIN_FILES = 8
+
+
+def _scan_one(rel: str) -> List[Finding]:
+    """Worker body for the parallel pass: lint ONE file against the
+    fork-inherited sources/trees/view.  Module-level so the pool can
+    address it; Findings are plain frozen dataclasses and pickle back
+    losslessly."""
+    sources, trees, view, rules = _PAR_STATE
+    mod = view.by_relpath.get(rel) if view is not None else None
+    return check_source(
+        sources[rel], rel, rules, project=view, tree=trees.get(rel),
+        pragma_maps=mod.pragma_maps() if mod is not None else None)
+
+
+def _scan_files(report: Sequence[str], sources, trees, view, rules,
+                jobs: int) -> List[Finding]:
+    """The per-file rule pass — serial, or fanned over a fork pool.
+    Results are collected in the same file order as the serial loop, so
+    findings (and therefore exit codes and artifacts) are byte-identical
+    for any ``jobs``; any pool failure falls back to serial."""
+    rels = [rel for rel in report if rel in sources]
+    findings: List[Finding] = []
+    if jobs > 1 and len(rels) >= _PAR_MIN_FILES:
+        global _PAR_STATE
+        import multiprocessing
+
+        if view is not None:
+            # warm the per-view caches BEFORE forking so every child
+            # inherits them copy-on-write instead of recomputing —
+            # but only the caches a SELECTED rule will actually read
+            # (a --select RQ2 run must not pay the tier-3 closures)
+            ids = {r.id for r in rules}
+            if ids & {"RQ1001", "RQ1002", "RQ1003"}:
+                from .rules.concurrency import (_cyclic_lock_pairs,
+                                                thread_entry_fids)
+                thread_entry_fids(view)
+                _cyclic_lock_pairs(view)
+            if ids & {"RQ1101", "RQ1102"}:
+                from .rules.mesh import (_donating_simple_names,
+                                         _wrapped_axis_names,
+                                         wrapped_closure)
+                wrapped_closure(view)
+                _wrapped_axis_names(view)
+                _donating_simple_names(view)
+        _PAR_STATE = (sources, trees, view, rules)
+        try:
+            ctx = multiprocessing.get_context("fork")
+            chunk = max(1, len(rels) // (jobs * 4))
+            with ctx.Pool(processes=jobs) as pool:
+                for per_file in pool.map(_scan_one, rels,
+                                         chunksize=chunk):
+                    findings.extend(per_file)
+            return findings
+        except (ValueError, OSError, ImportError):
+            findings = []  # fork unavailable/failed: serial fallback
+        finally:
+            _PAR_STATE = None
+    for rel in rels:
+        mod = view.by_relpath.get(rel) if view is not None else None
+        findings.extend(check_source(
+            sources[rel], rel, rules, project=view,
+            tree=trees.get(rel),
+            pragma_maps=mod.pragma_maps() if mod is not None else None))
+    return findings
+
+
 def run(root: Optional[str] = None,
         rules: Optional[Sequence[Rule]] = None,
         paths: Optional[Sequence[str]] = None,
         baseline_path: Optional[str] = None,
         use_baseline: bool = True,
-        project: bool = True) -> dict:
+        project: bool = True,
+        jobs: int = 1) -> dict:
     """Lint the tree.  Returns ``{"findings", "files_scanned", "rules",
     "root", "project"}`` — findings carry their suppressed/baselined
     state; the caller decides presentation and exit code.
@@ -176,7 +250,10 @@ def run(root: Optional[str] = None,
     ``paths`` restricts which files findings are REPORTED for; in
     project mode the whole tree is still parsed so cross-file summaries
     stay exact.  ``project=False`` is the tier-1 engine: per-file only,
-    ``needs_project`` rules skipped."""
+    ``needs_project`` rules skipped.  ``jobs > 1`` fans the per-file
+    rule pass over a fork-based worker pool (the parse + view build
+    stay in-process); findings and exit codes are byte-identical to
+    serial — asserted by tests/test_rqlint_concurrency.py."""
     root = root or repo_root()
     rules = list(rules) if rules is not None else all_rules()
     report = iter_files(root, paths)
@@ -188,14 +265,8 @@ def run(root: Optional[str] = None,
     view = ProjectView.build(trees, sources) if project else None
     findings: List[Finding] = [f for f in io_findings
                                if f.path in set(report)]
-    for rel in report:
-        if rel not in sources:
-            continue  # unreadable: RQ000 already recorded above
-        mod = view.by_relpath.get(rel) if view is not None else None
-        findings.extend(check_source(
-            sources[rel], rel, rules, project=view,
-            tree=trees.get(rel),
-            pragma_maps=mod.pragma_maps() if mod is not None else None))
+    findings.extend(_scan_files(report, sources, trees, view, rules,
+                                int(jobs)))
     if use_baseline:
         bp = baseline_path or os.path.join(root,
                                            baseline_mod.DEFAULT_RELPATH)

@@ -144,6 +144,10 @@ class ProjectView:
         # filled by build(); typed loosely to keep this module standalone
         self.functions: Dict[str, object] = {}
         self.summaries: Dict[str, object] = {}
+        #: fid -> resolved intra-repo callee fids (filled by build();
+        #: tier-3 rules run reachability over it — thread-entry closure,
+        #: shard_map-wrapped closure)
+        self.call_graph: Dict[str, set] = {}
 
     @classmethod
     def build(cls, parsed: Dict[str, ast.AST],
@@ -162,7 +166,8 @@ class ProjectView:
         view = cls(modules)
         from . import callgraph, summaries  # late: avoid import cycles
         view.functions = callgraph.collect_functions(view)
-        view.summaries = summaries.compute(view)
+        view.call_graph = callgraph.call_edges(view)
+        view.summaries = summaries.compute(view, view.call_graph)
         return view
 
     # -- resolution --------------------------------------------------------

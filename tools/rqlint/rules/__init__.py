@@ -3,12 +3,15 @@
 Stable ID bands: RQ1xx resilience, RQ2xx artifacts, RQ3xx numerics,
 RQ4xx trace-safety, RQ5xx PRNG discipline, RQ6xx benchmark honesty,
 RQ7xx hidden host-sync (tier-2), RQ8xx recompilation hazards (tier-2),
-RQ9xx telemetry discipline.
+RQ9xx telemetry discipline, RQ10xx shared-memory concurrency (tier-3),
+RQ11xx mesh/collective correctness (tier-3).
 RQ000 (unparseable file) is emitted by the engine itself, not a rule.
-Tier-2 rules carry ``needs_project`` and are skipped under
+Tier-2/3 rules carry ``needs_project`` and are skipped under
 ``--no-project`` (which therefore reproduces the tier-1 rule set).
 
-``select_rules("RQ4")`` prefix-matches, so a band can be run alone.
+``select_rules("RQ4")`` prefix-matches, so a band can be run alone
+(note ``RQ10``/``RQ11`` prefix-match RQ101/RQ110-style tier-1 IDs too —
+use full IDs to isolate a single tier-3 rule).
 """
 
 from __future__ import annotations
@@ -18,7 +21,11 @@ from typing import List, Optional, Sequence
 from .artifacts import RawArtifactWriteRule
 from .base import FileContext, Rule  # noqa: F401 (re-export)
 from .bench import HardCodedSlabRule, UnsyncedTimingRule
+from .concurrency import (FdLeakRule, LockOrderCycleRule,
+                          UnguardedSharedStateRule, UnstoppableThreadRule)
 from .hostsync import HiddenSyncRule, HotLoopTransferRule
+from .mesh import (AxisUnboundCollectiveRule, DonationAfterUseRule,
+                   ShardMapSpecArityRule)
 from .numerics import RawNumericsRule
 from .prng import ConstantSeedRule, KeyReuseRule
 from .recompile import RecompilationHazardRule, WeakTypeWideningRule
@@ -40,6 +47,13 @@ REGISTRY = (
     RecompilationHazardRule,
     WeakTypeWideningRule,
     RawTimerPairRule,
+    UnguardedSharedStateRule,
+    LockOrderCycleRule,
+    UnstoppableThreadRule,
+    FdLeakRule,
+    AxisUnboundCollectiveRule,
+    DonationAfterUseRule,
+    ShardMapSpecArityRule,
 )
 
 

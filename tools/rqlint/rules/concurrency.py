@@ -1,0 +1,760 @@
+"""RQ1001-RQ1004 — shared-memory concurrency discipline (tier-3).
+
+The serving runtime quietly grew real threads: the journal's background
+group-commit flusher, the watchdog's lease renewer, the native-loader
+build lock, the telemetry flight-recorder lock.  None of that had a
+static safety net — a race here corrupts the durability watermark or the
+crash-forensics ring, the two artifacts every recovery path trusts.
+
+- **RQ1001** — unguarded shared state: an attribute written under
+  ``with self._lock`` in one method but read/written with NO lock in
+  another method of the same class.  Gated on **thread-entry
+  reachability** so only genuinely concurrent state fires: the class
+  must run something on a thread (``threading.Thread(target=self.m)`` /
+  ``threading.Timer(..., self.m)`` in its own methods, a nested-def
+  thread target, or a method reachable in the project call graph from
+  any thread entry), and the attribute must be touched by that thread
+  side.  The **lock-set lattice**: a method with no visible acquisition
+  whose intra-class call sites are ALL under the lock inherits the
+  caller's lock set (the ``_fsync_locked`` idiom — "caller holds
+  _lock" as an inferred fact instead of a docstring promise).
+- **RQ1002** — lock-acquisition-order cycle: lock B acquired while A is
+  held in one function, A acquired while B is held in another —
+  anywhere in the module graph (the (held, acquired) edges ride the
+  tier-2 summaries, so holding A and calling a helper that takes B
+  counts).  Any cycle in the global order graph is a latent deadlock.
+- **RQ1003** — unstoppable daemon thread: a ``daemon=True`` thread is
+  started but no stop path exists — nothing joins it and its target
+  loop waits on no Event that anything sets.  Daemon threads die
+  mid-instruction at interpreter exit; one mid-fsync kills the
+  durability contract silently.
+- **RQ1004** — fd/socket leak on an exception path (``serving/`` only):
+  a locally-created socket/fd (``socket.socket``, ``.accept()``,
+  ``create_connection``, ``os.open``) is used by calls that can raise
+  with no enclosing ``try`` that closes it (and no ``with``).  Scoped
+  to the transport layer, where a leaked accept under a failing
+  handshake wedges the shard slot.
+
+Locks are recognized by the repo convention — the name contains "lock"
+(``summaries.lock_identity``); a mutex named otherwise is invisible
+(accepted false negative, stated policy).  Module-global discipline
+(``native.loader._lock``) is covered by RQ1002's order graph; RQ1001 is
+class-scoped because instance state is where the repo's shared mutable
+data lives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..astutil import attr_chain, chain_tail
+from ..callgraph import sccs
+from ..findings import finding_at
+from .base import Rule
+
+CONC_PATHS = ("*.py", "tools/*.py", "benchmarks/*.py",
+              "experiments/*.py", "redqueen_tpu/**/*.py")
+
+#: threading attrs that are internally synchronized or lifecycle-managed
+#: — accesses to them are never "unguarded shared state"
+_SYNC_CTORS = {"Event", "Condition", "Semaphore", "BoundedSemaphore",
+               "Barrier", "Thread", "Timer", "Lock", "RLock", "local",
+               "Queue", "SimpleQueue", "LifoQueue", "deque", "count"}
+
+
+def _thread_target(call: ast.Call) -> Optional[ast.AST]:
+    """The callable a ``threading.Thread``/``Timer`` constructor runs,
+    or None."""
+    tail = chain_tail(call.func)
+    if tail == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if tail == "Timer":
+        for kw in call.keywords:
+            if kw.arg == "function":
+                return kw.value
+        if len(call.args) >= 2:
+            return call.args[1]
+    return None
+
+
+def thread_entry_fids(view) -> Set[str]:
+    """Project-wide closure of functions that may run on a spawned
+    thread: every resolvable ``Thread(target=...)``/``Timer`` callback
+    target, closed forward over the call graph.  Cached per view."""
+    cached = view.__dict__.get("_rq10_thread_closure")
+    if cached is not None:
+        return cached
+    roots: Set[str] = set()
+    for fid, info in view.functions.items():
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            tgt = _thread_target(node)
+            if tgt is None:
+                continue
+            chain = attr_chain(tgt)
+            if not chain:
+                continue
+            r = view.resolve(info.modname, chain, info.encl_class)
+            if r is not None and r[0] == "func":
+                roots.add(r[1])
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        fid = frontier.pop()
+        for callee in view.call_graph.get(fid, ()):
+            if callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    view.__dict__["_rq10_thread_closure"] = seen
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# RQ1001 — per-class lock discipline
+# ---------------------------------------------------------------------------
+
+
+class _Access:
+    __slots__ = ("attr", "write", "locked", "node")
+
+    def __init__(self, attr: str, write: bool, locked: bool,
+                 node: ast.AST) -> None:
+        self.attr = attr
+        self.write = write
+        self.locked = locked
+        self.node = node
+
+
+class _MethodScan:
+    """One method's (or nested thread target's) lock-context facts:
+    ``self.*`` accesses, intra-class ``self.m()`` call sites with their
+    lock context, and whether the body acquires the class lock itself."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.accesses: List[_Access] = []
+        self.self_calls: List[Tuple[str, bool]] = []
+        self.acquires_directly = False
+        self.thread_targets: Set[str] = set()  # self.m spawned as thread
+        self.nested: Dict[str, "_MethodScan"] = {}
+
+
+def _is_lock_attr(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+def _scan_method(fn: ast.AST, name: str) -> _MethodScan:
+    ms = _MethodScan(name)
+
+    def record_exprs(node: ast.AST, locked: bool) -> None:
+        skip: Set[int] = set()
+        for sub in ast.walk(node):
+            if id(sub) in skip:
+                continue
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                for s2 in ast.walk(sub):
+                    skip.add(id(s2))
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    # a nested def is a separate scope — scanned
+                    # UNLOCKED (it runs whenever it is called, typically
+                    # on the spawned thread)
+                    nested = _scan_method(sub, f"{name}.{sub.name}")
+                    ms.nested[sub.name] = nested
+                continue
+            if isinstance(sub, ast.Call):
+                tgt = _thread_target(sub)
+                if tgt is not None:
+                    chain = attr_chain(tgt)
+                    if len(chain) == 2 and chain[0] == "self":
+                        ms.thread_targets.add(chain[1])
+                    elif len(chain) == 1 and chain[0] in ms.nested:
+                        ms.thread_targets.add(f"{name}.{chain[0]}")
+                chain = attr_chain(sub.func)
+                if len(chain) == 2 and chain[0] == "self":
+                    ms.self_calls.append((chain[1], locked))
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == "self":
+                if _is_lock_attr(sub.attr):
+                    continue
+                write = isinstance(sub.ctx, (ast.Store, ast.Del))
+                ms.accesses.append(_Access(sub.attr, write, locked, sub))
+
+    def walk(stmts: Iterable[ast.stmt], locked: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.ClassDef):
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested = _scan_method(stmt, f"{name}.{stmt.name}")
+                ms.nested[stmt.name] = nested
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = locked
+                for item in stmt.items:
+                    record_exprs(item.context_expr, inner)
+                    chain = attr_chain(item.context_expr)
+                    if chain and _is_lock_attr(chain[-1]):
+                        inner = True
+                        ms.acquires_directly = True
+                walk(stmt.body, inner)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                record_exprs(stmt.iter, locked)
+                record_exprs(stmt.target, locked)
+                walk(stmt.body, locked)
+                walk(stmt.orelse, locked)
+            elif isinstance(stmt, ast.While):
+                record_exprs(stmt.test, locked)
+                walk(stmt.body, locked)
+                walk(stmt.orelse, locked)
+            elif isinstance(stmt, ast.If):
+                record_exprs(stmt.test, locked)
+                walk(stmt.body, locked)
+                walk(stmt.orelse, locked)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, locked)
+                for h in stmt.handlers:
+                    walk(h.body, locked)
+                walk(stmt.orelse, locked)
+                walk(stmt.finalbody, locked)
+            else:
+                record_exprs(stmt, locked)
+
+    walk(getattr(fn, "body", []), False)
+    return ms
+
+
+def _exempt_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes bound to internally-synchronized threading objects in
+    ``__init__`` (Event/Thread/Queue/...) — their method calls are safe
+    without the class lock."""
+    out: Set[str] = set()
+    for fn in cls.body:
+        if not (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name == "__init__"):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if not (isinstance(v, ast.Call)
+                    and chain_tail(v.func) in _SYNC_CTORS):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.add(t.attr)
+    return out
+
+
+class UnguardedSharedStateRule(Rule):
+    id = "RQ1001"
+    name = "unguarded-shared-state"
+    description = ("attribute written under the class lock in one "
+                   "method but read/written with no lock in another, "
+                   "in a class that provably runs on a thread — a data "
+                   "race on state both sides trust")
+    paths = CONC_PATHS
+    needs_project = True
+
+    def check(self, ctx):
+        view = getattr(ctx, "project", None)
+        if view is None:
+            return
+        mod = view.by_relpath.get(ctx.relpath)
+        modname = mod.name if mod else None
+        reachable = thread_entry_fids(view)
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls, modname, reachable)
+
+    def _check_class(self, ctx, cls: ast.ClassDef, modname: Optional[str],
+                     reachable: Set[str]):
+        # pre-filter: without a `with self.<lock>` somewhere in the
+        # class there can be no locked write, hence no finding
+        if not any(_is_lock_attr(chain[-1])
+                   for w in ast.walk(cls)
+                   if isinstance(w, (ast.With, ast.AsyncWith))
+                   for item in w.items
+                   for chain in [attr_chain(item.context_expr)]
+                   if chain):
+            return
+        scans: Dict[str, _MethodScan] = {}
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scans[fn.name] = _scan_method(fn, fn.name)
+        if not scans:
+            return
+        # -- thread side: self-spawned targets + project-reachable
+        # methods, closed over intra-class self.m() calls --------------
+        entries: Set[str] = set()
+        for ms in scans.values():
+            entries |= ms.thread_targets
+        if modname is not None:
+            for mname in scans:
+                if f"{modname}::{cls.name}.{mname}" in reachable:
+                    entries.add(mname)
+        if not entries:
+            return  # no concurrency: lock use is belt-and-braces only
+        thread_side: Set[str] = set()
+        frontier = [e for e in entries]
+        while frontier:
+            m = frontier.pop()
+            if m in thread_side:
+                continue
+            thread_side.add(m)
+            ms = self._scope(scans, m)
+            if ms is None:
+                continue
+            for callee, _locked in ms.self_calls:
+                if callee in scans and callee not in thread_side:
+                    frontier.append(callee)
+        # -- lock-set lattice: a method with no acquisition of its own
+        # whose intra-class call sites are ALL under the lock runs under
+        # the lock itself (the `_fsync_locked` caller-holds-lock idiom);
+        # thread entries are excluded — they start with no caller.
+        effective_locked: Set[str] = set()
+
+        def _call_sites(target: str) -> List[bool]:
+            out = []
+            for ms in self._all_scopes(scans):
+                top = "." not in ms.name
+                root = ms.name.split(".")[0]
+                for callee, locked in ms.self_calls:
+                    if callee == target:
+                        out.append(locked or
+                                   (top and root in effective_locked))
+            return out
+
+        for _ in range(2):  # settles caller-of-caller chains
+            for mname, ms in scans.items():
+                if ms.acquires_directly or mname in effective_locked \
+                        or mname in entries:
+                    continue
+                sites = _call_sites(mname)
+                if sites and all(sites):
+                    effective_locked.add(mname)
+        exempt = _exempt_attrs(cls)
+
+        def is_locked(scope: str, acc: _Access) -> bool:
+            # the inferred caller-held lock covers the top-level method
+            # body only — a nested def runs whenever it is called
+            return acc.locked or ("." not in scope
+                                  and scope in effective_locked)
+
+        # -- per-attribute verdicts ------------------------------------
+        locked_writers: Dict[str, Set[str]] = {}
+        touched_by_thread: Set[str] = set()
+        all_accesses: List[Tuple[str, _Access]] = []
+        for ms in self._all_scopes(scans):
+            root = ms.name.split(".")[0]
+            if root == "__init__":
+                continue  # construction is single-threaded by contract
+            for acc in ms.accesses:
+                if acc.attr in exempt:
+                    continue
+                all_accesses.append((ms.name, acc))
+                if acc.write and is_locked(ms.name, acc):
+                    locked_writers.setdefault(acc.attr, set()).add(root)
+                if root in thread_side or ms.name in thread_side:
+                    touched_by_thread.add(acc.attr)
+        reported: Set[Tuple[str, str]] = set()
+        for scope, acc in all_accesses:
+            root = scope.split(".")[0]
+            writers = locked_writers.get(acc.attr)
+            if not writers or acc.attr not in touched_by_thread:
+                continue
+            if is_locked(scope, acc):
+                continue
+            if writers == {root}:
+                continue  # same-method mix: publication idiom, not a race
+            key = (acc.attr, root)
+            if key in reported:
+                continue
+            reported.add(key)
+            kind = "written" if acc.write else "read"
+            yield finding_at(
+                self.id, ctx, acc.node,
+                f"`self.{acc.attr}` is {kind} without the lock in "
+                f"`{cls.name}.{root}` but written under the class lock "
+                f"in `{cls.name}.{sorted(writers)[0]}` — and the class "
+                f"runs on a thread, so both can interleave; take the "
+                f"lock (or make the publication idiom explicit with a "
+                f"pragma)")
+
+    @staticmethod
+    def _scope(scans: Dict[str, _MethodScan],
+               name: str) -> Optional[_MethodScan]:
+        parts = name.split(".")
+        ms = scans.get(parts[0])
+        for p in parts[1:]:
+            if ms is None:
+                return None
+            ms = ms.nested.get(p)
+        return ms
+
+    @staticmethod
+    def _all_scopes(scans: Dict[str, _MethodScan]):
+        stack = list(scans.values())
+        while stack:
+            ms = stack.pop()
+            yield ms
+            stack.extend(ms.nested.values())
+
+
+# ---------------------------------------------------------------------------
+# RQ1002 — lock-acquisition-order cycles
+# ---------------------------------------------------------------------------
+
+
+def _cyclic_lock_pairs(view) -> Set[Tuple[str, str]]:
+    """(held, acquired) pairs lying on a cycle of the global lock-order
+    graph (union of every function summary's ``lock_edges``).  Cached
+    per view."""
+    cached = view.__dict__.get("_rq10_lock_cycles")
+    if cached is not None:
+        return cached
+    graph: Dict[str, Set[str]] = {}
+    for s in view.summaries.values():
+        for a, b in getattr(s, "lock_edges", ()):
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+    comp_of: Dict[str, int] = {}
+    for i, comp in enumerate(sccs(graph)):
+        for lock in comp:
+            comp_of[lock] = i
+    sizes: Dict[int, int] = {}
+    for lock, c in comp_of.items():
+        sizes[c] = sizes.get(c, 0) + 1
+    cyclic = {(a, b)
+              for a, nbrs in graph.items() for b in nbrs
+              if comp_of.get(a) == comp_of.get(b)
+              and sizes.get(comp_of.get(a), 0) > 1}
+    view.__dict__["_rq10_lock_cycles"] = cyclic
+    return cyclic
+
+
+class LockOrderCycleRule(Rule):
+    id = "RQ1002"
+    name = "lock-order-cycle"
+    description = ("two locks acquired in opposite orders somewhere in "
+                   "the module graph (held->acquired edges follow call "
+                   "summaries) — a latent deadlock; pick one global "
+                   "order")
+    paths = CONC_PATHS
+    needs_project = True
+
+    def check(self, ctx):
+        view = getattr(ctx, "project", None)
+        if view is None:
+            return
+        cyclic = _cyclic_lock_pairs(view)
+        if not cyclic:
+            return
+        from ..summaries import lock_axis_walk
+        mod = view.by_relpath.get(ctx.relpath)
+        if mod is None:
+            return
+        for fid, info in view.functions.items():
+            if info.modname != mod.name:
+                continue
+            sites: List = []
+            lock_axis_walk(view, info, view.summaries, sites=sites)
+            seen: Set[Tuple[str, str]] = set()
+            for held, acquired, node in sites:
+                if (held, acquired) not in cyclic or \
+                        (held, acquired) in seen:
+                    continue
+                seen.add((held, acquired))
+                yield finding_at(
+                    self.id, ctx, node,
+                    f"`{acquired.split('::')[-1]}` is acquired while "
+                    f"`{held.split('::')[-1]}` is held, and the global "
+                    f"lock-order graph also orders them the other way "
+                    f"round — a latent deadlock; acquire these locks in "
+                    f"one global order")
+
+
+# ---------------------------------------------------------------------------
+# RQ1003 — unstoppable daemon threads
+# ---------------------------------------------------------------------------
+
+
+def _const_true_kw(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _chains_in(node: ast.AST, tail: str) -> List[Tuple[str, ...]]:
+    """Receiver chains of every ``<recv>.<tail>(...)`` call under
+    ``node`` (nested scopes included — a join in a helper closure still
+    counts)."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == tail:
+            chain = attr_chain(sub.func.value)
+            if chain:
+                out.append(chain)
+    return out
+
+
+class UnstoppableThreadRule(Rule):
+    id = "RQ1003"
+    name = "unstoppable-daemon-thread"
+    description = ("a daemon thread is started but nothing can stop it "
+                   "— no join path and no stop-Event its target waits "
+                   "on; daemon threads die mid-instruction at exit "
+                   "(mid-fsync, mid-write)")
+    paths = CONC_PATHS
+    needs_project = True
+
+    def check(self, ctx):
+        if getattr(ctx, "project", None) is None:
+            return
+        if "Thread" not in ctx.source and "Timer" not in ctx.source:
+            return  # spawn sites always spell the constructor
+        # search scope for the stop path: the enclosing class when the
+        # thread lands on self.*, else the enclosing function.  ``seen``
+        # dedups spawn sites visited through more than one unit (a
+        # nested function is inside its parent unit too).
+        seen: Set[int] = set()
+        in_class = {id(fn) for cls in ast.walk(ctx.tree)
+                    if isinstance(cls, ast.ClassDef)
+                    for fn in cls.body
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+        for scope in ast.walk(ctx.tree):
+            if isinstance(scope, ast.ClassDef):
+                yield from self._check_scope(ctx, scope, scope, seen)
+            elif isinstance(scope, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and \
+                    id(scope) not in in_class:
+                yield from self._check_scope(ctx, scope, scope, seen)
+
+    def _check_scope(self, ctx, hot: ast.AST, search: ast.AST,
+                     seen: Set[int]):
+        """``hot`` holds the spawn sites; ``search`` is where a stop
+        path may live (the class for methods, the function itself
+        otherwise)."""
+        if isinstance(hot, ast.ClassDef):
+            spawn_nodes = [fn for fn in hot.body
+                           if isinstance(fn, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))]
+        else:
+            spawn_nodes = [hot]
+        joins = _chains_in(search, "join")
+        sets = _chains_in(search, "set")
+        for holder in spawn_nodes:
+            for node in ast.walk(holder):
+                if not isinstance(node, ast.Call):
+                    continue
+                if id(node) in seen:
+                    continue
+                if chain_tail(node.func) not in ("Thread", "Timer"):
+                    continue
+                if not _const_true_kw(node, "daemon"):
+                    continue
+                seen.add(id(node))
+                tgt = _thread_target(node)
+                if tgt is None:
+                    continue
+                ref = self._thread_ref(holder, node)
+                if ref is not None and any(c == ref for c in joins):
+                    continue  # join path exists
+                waits = self._target_waits(ctx, search, holder, tgt)
+                if waits and any(c in waits for c in sets):
+                    continue  # stop-event path exists
+                yield finding_at(
+                    self.id, ctx, node,
+                    f"daemon thread started with no stop path: nothing "
+                    f"joins it and its target waits on no Event that "
+                    f"anything sets — it dies mid-instruction at "
+                    f"interpreter exit; add a stop Event + join (see "
+                    f"Journal.close for the idiom)")
+
+    @staticmethod
+    def _thread_ref(holder: ast.AST,
+                    ctor: ast.Call) -> Optional[Tuple[str, ...]]:
+        """The name/attr chain the constructed thread is bound to (the
+        ref a join must target), or None for an anonymous thread."""
+        for sub in ast.walk(holder):
+            if isinstance(sub, ast.Assign) and sub.value is ctor:
+                t = sub.targets[0]
+                chain = attr_chain(t)
+                if chain:
+                    return chain
+        return None
+
+    @staticmethod
+    def _target_waits(ctx, search: ast.AST, holder: ast.AST,
+                      tgt: ast.AST) -> List[Tuple[str, ...]]:
+        """Receiver chains the thread TARGET waits on (``.wait()`` /
+        ``.is_set()``) — candidates for a stop Event."""
+        chain = attr_chain(tgt)
+        body: Optional[ast.AST] = None
+        if len(chain) == 2 and chain[0] == "self" and \
+                isinstance(search, ast.ClassDef):
+            for fn in search.body:
+                if isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) and \
+                        fn.name == chain[1]:
+                    body = fn
+        elif len(chain) == 1:
+            for fn in ast.walk(holder):
+                if isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) and \
+                        fn.name == chain[0]:
+                    body = fn
+        if body is None:
+            return []
+        return _chains_in(body, "wait") + _chains_in(body, "is_set")
+
+
+# ---------------------------------------------------------------------------
+# RQ1004 — fd/socket leak on exception paths (serving transport)
+# ---------------------------------------------------------------------------
+
+_FD_TAILS = {"accept", "create_connection"}
+
+
+def _fd_producing(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    if not chain:
+        return False
+    tail = chain[-1]
+    if tail in _FD_TAILS:
+        return True
+    if tail == "socket" and len(chain) >= 2 and \
+            chain[0] in ("socket", "_socket"):
+        return True
+    return chain == ("os", "open")
+
+
+def _is_close_call(call: ast.Call, name: str) -> bool:
+    """``name.close()`` / ``name.shutdown()``, or the helper idiom — a
+    function whose name mentions close/shutdown taking ``name`` as an
+    argument (``_close_quietly(sock)``)."""
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in ("close", "shutdown") and \
+            attr_chain(call.func.value) == (name,):
+        return True
+    tail = chain_tail(call.func).lower()
+    return ("close" in tail or "shutdown" in tail) and any(
+        isinstance(a, ast.Name) and a.id == name for a in call.args)
+
+
+def _closes(block: Iterable[ast.stmt], name: str) -> bool:
+    for stmt in block:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and _is_close_call(sub, name):
+                return True
+    return False
+
+
+class FdLeakRule(Rule):
+    id = "RQ1004"
+    name = "fd-leak-on-exception"
+    description = ("a locally-created socket/fd is used by calls that "
+                   "can raise with no enclosing try that closes it — "
+                   "an exception mid-handshake leaks the fd and wedges "
+                   "the slot")
+    paths = ("redqueen_tpu/serving/*.py",)
+    needs_project = True
+
+    def check(self, ctx):
+        if getattr(ctx, "project", None) is None:
+            return
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx, fn: ast.AST):
+        skip: Set[int] = set()
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        binds: List[Tuple[str, ast.AST]] = []
+        for node in ast.walk(fn):
+            if id(node) in skip or not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Call)
+                    and _fd_producing(node.value)):
+                continue
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                binds.append((t.id, node))
+            elif isinstance(t, ast.Tuple) and t.elts and \
+                    isinstance(t.elts[0], ast.Name) and \
+                    chain_tail(node.value.func) == "accept":
+                binds.append((t.elts[0].id, node))
+        if not binds:
+            return
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(fn):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        for name, bind in binds:
+            use = self._first_unguarded_use(fn, name, bind, parents,
+                                            skip)
+            if use is not None:
+                yield finding_at(
+                    self.id, ctx, use,
+                    f"`{name}` holds a live socket/fd but this call can "
+                    f"raise with no enclosing try that closes it — the "
+                    f"fd leaks on the exception path; wrap the "
+                    f"post-create section in try/except with "
+                    f"`{name}.close()`")
+
+    @staticmethod
+    def _first_unguarded_use(fn, name: str, bind: ast.AST,
+                             parents: Dict[int, ast.AST],
+                             skip: Set[int]) -> Optional[ast.AST]:
+        bind_pos = (bind.lineno, bind.col_offset)
+        uses = []
+        for node in ast.walk(fn):
+            if id(node) in skip or not isinstance(node, ast.Call):
+                continue
+            pos = (node.lineno, node.col_offset)
+            if pos <= bind_pos:
+                continue
+            if _is_close_call(node, name):
+                continue
+            if any(isinstance(s, ast.Name) and s.id == name
+                   for s in ast.walk(node)):
+                uses.append((pos, node))
+        for _pos, use in sorted(uses, key=lambda u: u[0]):
+            guarded = False
+            node: Optional[ast.AST] = use
+            while node is not None and node is not fn:
+                parent = parents.get(id(node))
+                if isinstance(parent, ast.Try):
+                    blocks = [h.body for h in parent.handlers]
+                    blocks.append(parent.finalbody)
+                    if any(_closes(b, name) for b in blocks):
+                        guarded = True
+                        break
+                if isinstance(parent, (ast.With, ast.AsyncWith)):
+                    for item in parent.items:
+                        if any(isinstance(s, ast.Name) and s.id == name
+                               for s in ast.walk(item.context_expr)):
+                            guarded = True
+                    if guarded:
+                        break
+                node = parent
+            if not guarded:
+                return use
+        return None

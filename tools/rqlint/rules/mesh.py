@@ -1,0 +1,624 @@
+"""RQ1101-RQ1103 — mesh/collective correctness (tier-3).
+
+The next ROADMAP arc shards sweeps and E-step partials across the
+multihost mesh (psum the chunk partials, shard_map the sweep) — code
+whose failure modes only surface AT RUNTIME on hardware this box mostly
+doesn't have.  This band makes them fail in the jax-free CI gate
+instead:
+
+- **RQ1101** — unbound collective axis: a raw ``lax.psum``/``pmean``/
+  ``all_gather``/``axis_index``-family call names an axis that nothing
+  provably binds.  The **escape policy** (what sanctions a raw site),
+  in order: (1) the owning function is wrapped — passed to
+  ``shard_map``/``pmap`` anywhere in the repo (resolved first-arg,
+  closed forward over the call graph: a helper called from a wrapped
+  kernel is wrapped too), or pmap/shard_map-decorated, or the nested
+  def is wrapped within its enclosing function; (2) the repo guard
+  idiom — ``comm.axis_present(axis)`` / ``axis_size_or_1(axis)`` probed
+  in the same lexical def chain (the ``star_run`` kernel pattern); (3)
+  a line pragma with prose.  The ``comm.py`` wrappers never fire by
+  construction: their ``lax.*`` calls take the axis as a parameter, and
+  dynamic axes are not analyzed.  The cross-function case summaries
+  make detectable: an UNwrapped function calling a helper whose
+  ``uses_axes`` summary is non-empty — the helper's own site is
+  sanctioned (it is also called from wrapped code), but THIS call path
+  reaches the collective with the axis unbound.
+- **RQ1102** — donation-after-use: an argument passed at a
+  ``donate_argnums`` position of a jitted dispatch and then read
+  afterwards — the donated buffer is dead; on TPU the read returns
+  garbage or raises.  Covers decorator-jitted defs cross-function
+  (the ``donates`` summary bit follows helpers), and the file-local
+  ``f = jax.jit(g, donate_argnums=(0,))`` handle idiom.  Inside a
+  loop the call statement must REBIND the donated name (``carry =
+  step(carry, ...)``) or the next iteration itself is the
+  use-after-donate.
+- **RQ1103** — ``shard_map`` spec arity: a literal ``in_specs`` tuple
+  whose length differs from the wrapped function's positional
+  signature, or a literal ``out_specs`` tuple whose length differs from
+  the function's (consistent) tuple-return arity.  Resolves module
+  functions through the project view and nested defs lexically (the
+  repo's kernels are nested closures).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..astutil import (attr_chain, chain_tail, const_int_elems,
+                       param_names)
+from ..findings import finding_at
+from ..summaries import (AXIS_BINDERS, EMPTY, binds_axis_call,
+                         collective_axis, guarded_axis)
+from .base import Rule
+
+MESH_PATHS = ("*.py", "tools/*.py", "benchmarks/*.py",
+              "experiments/*.py", "redqueen_tpu/**/*.py")
+
+
+def _wrap_target(call: ast.Call) -> Optional[ast.AST]:
+    """The function argument of an axis-binding wrapper call, or None."""
+    if not binds_axis_call(call):
+        return None
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg in ("f", "fun"):
+            return kw.value
+    return None
+
+
+def wrapped_closure(view) -> Set[str]:
+    """Every fid passed to a ``shard_map``/``pmap``/``vmap(axis_name=)``
+    wrapper anywhere in the repo (or decorated with one), closed
+    FORWARD over the call graph — a helper called from a wrapped kernel
+    runs inside the binding too.  Cached per view."""
+    cached = view.__dict__.get("_rq11_wrapped")
+    if cached is not None:
+        return cached
+    roots: Set[str] = set()
+    for modname, mod in view.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tgt = _wrap_target(node)
+            if tgt is None:
+                continue
+            chain = attr_chain(tgt)
+            if not chain:
+                continue
+            r = view.resolve(modname, chain)
+            if r is not None and r[0] == "func":
+                roots.add(r[1])
+    for fid, info in view.functions.items():
+        for dec in getattr(info.node, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if chain_tail(target) in AXIS_BINDERS:
+                roots.add(fid)
+            elif (isinstance(dec, ast.Call)
+                    and chain_tail(dec.func) == "partial" and dec.args
+                    and chain_tail(dec.args[0]) in AXIS_BINDERS):
+                roots.add(fid)
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        fid = frontier.pop()
+        for callee in view.call_graph.get(fid, ()):
+            if callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    view.__dict__["_rq11_wrapped"] = seen
+    return seen
+
+
+def _wrapped_axis_names(view) -> Set[str]:
+    """Simple (unqualified) names of wrapped functions whose summaries
+    raw-consume axes — the only callees the RQ1101 cross-function check
+    ever needs to resolve.  Cached per view."""
+    cached = view.__dict__.get("_rq11_wrapped_axis_names")
+    if cached is not None:
+        return cached
+    wrapped = wrapped_closure(view)
+    names = {fid.split("::")[-1].split(".")[-1]
+             for fid in wrapped
+             if getattr(view.summaries.get(fid), "uses_axes", None)}
+    view.__dict__["_rq11_wrapped_axis_names"] = names
+    return names
+
+
+def _donating_simple_names(view) -> Set[str]:
+    """Simple names of functions whose summaries donate — the RQ1102
+    candidate-call pre-filter.  Cached per view."""
+    cached = view.__dict__.get("_rq11_donating_names")
+    if cached is not None:
+        return cached
+    names = {fid.split("::")[-1].split(".")[-1]
+             for fid, s in view.summaries.items()
+             if getattr(s, "donates", None)}
+    view.__dict__["_rq11_donating_names"] = names
+    return names
+
+
+def _def_tree(fn: ast.AST) -> Dict[int, ast.AST]:
+    """node id -> nearest enclosing def (fn itself or a nested def)."""
+    owner: Dict[int, ast.AST] = {}
+
+    def walk(node: ast.AST, cur: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            nxt = cur
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                nxt = child
+            owner[id(child)] = cur
+            walk(child, nxt)
+
+    owner[id(fn)] = fn
+    walk(fn, fn)
+    return owner
+
+
+def _def_chain(d: ast.AST, fn: ast.AST,
+               owner: Dict[int, ast.AST]) -> List[ast.AST]:
+    """``d`` plus its enclosing defs up to (and including) ``fn``."""
+    chain = [d]
+    # owner maps a def node to ITS enclosing def; walk upward
+    cur = d
+    while cur is not fn:
+        nxt = owner.get(id(cur))
+        if nxt is None or nxt is cur:
+            break
+        cur = nxt
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) or cur is fn:
+            chain.append(cur)
+    return chain
+
+
+def _guards_of(d: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(d):
+        if isinstance(node, ast.Call):
+            g = guarded_axis(node)
+            if g is not None:
+                out.add(g)
+    return out
+
+
+def _locally_wrapped_names(fn: ast.AST) -> Set[str]:
+    """Names passed to an axis-binding wrapper within ``fn`` — the
+    nested-kernel sanction (``comm.shard_map(kernel, ...)``)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            tgt = _wrap_target(node)
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+class AxisUnboundCollectiveRule(Rule):
+    id = "RQ1101"
+    name = "unbound-collective-axis"
+    description = ("raw lax collective names an axis nothing provably "
+                   "binds (no shard_map/pmap wrapping path, no "
+                   "comm.axis_present guard) — a NameError at trace "
+                   "time on the mesh, invisible on 1 device")
+    paths = MESH_PATHS
+    needs_project = True
+
+    def check(self, ctx):
+        view = getattr(ctx, "project", None)
+        if view is None:
+            return
+        mod = view.by_relpath.get(ctx.relpath)
+        if mod is None:
+            return
+        wrapped = wrapped_closure(view)
+        for qual, node in mod.defs.items():
+            fid = f"{mod.name}::{qual}"
+            encl = qual.split(".")[0] if "." in qual else None
+            yield from self._check_def(ctx, view, node, fid, wrapped,
+                                       encl)
+
+    def _check_def(self, ctx, view, fn: ast.AST, fid: str,
+                   wrapped: Set[str], encl_class: Optional[str]):
+        # pre-filter: collect raw collective sites and candidate
+        # cross-function calls in ONE cheap pass; the expensive scaffold
+        # (def tree, guard chains) is built only when something matched
+        callee_names = _wrapped_axis_names(view)
+        raw_sites: List[ast.Call] = []
+        cand_calls: List[ast.Call] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if collective_axis(node) is not None:
+                raw_sites.append(node)
+            elif callee_names and chain_tail(node.func) in callee_names:
+                cand_calls.append(node)
+        if not raw_sites and not cand_calls:
+            return
+        owner = _def_tree(fn)
+        lw = _locally_wrapped_names(fn)
+        guards_cache: Dict[int, Set[str]] = {}
+        fn_wrapped = fid in wrapped
+
+        def chain_guards(d: ast.AST) -> Set[str]:
+            out: Set[str] = set()
+            for link in _def_chain(d, fn, owner):
+                if id(link) not in guards_cache:
+                    guards_cache[id(link)] = _guards_of(link)
+                out |= guards_cache[id(link)]
+            return out
+
+        for node in raw_sites + cand_calls:
+            ax = collective_axis(node)
+            if ax is not None:
+                d = owner.get(id(node), fn)
+                while not isinstance(d, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                    d = owner.get(id(d), fn)
+                if fn_wrapped:
+                    continue
+                if any(isinstance(link, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                       and link.name in lw and link is not fn
+                       for link in _def_chain(d, fn, owner)):
+                    continue  # nested kernel wrapped within this fn
+                if ax in chain_guards(d):
+                    continue  # comm.axis_present-guarded (repo idiom)
+                yield finding_at(
+                    self.id, ctx, node,
+                    f"collective consumes axis '{ax}' but no "
+                    f"shard_map/pmap wrapping path binds it and no "
+                    f"comm.axis_present('{ax}') guard covers it — "
+                    f"NameError at trace time on the mesh")
+            elif not fn_wrapped:
+                # cross-function: this UNwrapped function calls a
+                # helper whose summary raw-consumes axes and whose own
+                # sites are sanctioned (wrapped via another path)
+                chain = attr_chain(node.func)
+                if not chain:
+                    continue
+                mod = view.by_relpath.get(ctx.relpath)
+                cal = view.resolve(mod.name, chain, encl_class)
+                if cal is None or cal[0] != "func" or \
+                        cal[1] not in wrapped:
+                    continue
+                summ = view.summaries.get(cal[1], EMPTY)
+                d = owner.get(id(node), fn)
+                while not isinstance(d, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                    d = owner.get(id(d), fn)
+                loose = {a for a in getattr(summ, "uses_axes", ())
+                         if a not in chain_guards(d)}
+                if loose:
+                    qual = cal[1].split("::")[-1]
+                    ax = sorted(loose)[0]
+                    yield finding_at(
+                        self.id, ctx, node,
+                        f"`{qual}()` consumes axis '{ax}' "
+                        f"(summary-proven) but THIS call path has no "
+                        f"shard_map/pmap binding it — the collective "
+                        f"is unbound when reached from here")
+
+
+# ---------------------------------------------------------------------------
+# RQ1102 — donation-after-use
+# ---------------------------------------------------------------------------
+
+
+def _local_donating_handles(scope: ast.AST) -> Dict[str, Set[int]]:
+    """Names bound to ``jax.jit(f, donate_argnums=...)`` (or the
+    functools.partial spelling applied to a function) within ``scope``
+    -> donated positions."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        if chain_tail(call.func) not in ("jit", "pjit"):
+            continue
+        nums: Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                nums |= const_int_elems(kw.value)
+        if not nums:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = nums
+    return out
+
+
+class DonationAfterUseRule(Rule):
+    id = "RQ1102"
+    name = "donation-after-use"
+    description = ("argument passed at a donate_argnums position and "
+                   "read afterwards — the donated buffer is dead; on "
+                   "TPU the read is garbage or an error (rebind the "
+                   "result over the name)")
+    paths = MESH_PATHS
+    needs_project = True
+
+    def check(self, ctx):
+        view = getattr(ctx, "project", None)
+        if view is None:
+            return
+        mod = view.by_relpath.get(ctx.relpath)
+        if mod is None:
+            return
+        handles = _local_donating_handles(ctx.tree)
+        dnames = _donating_simple_names(view)
+        if not handles and not dnames:
+            return
+        # candidate calls in one cheap pass; everything else is built
+        # only when one exists in this file
+        cands = [n for n in ast.walk(ctx.tree)
+                 if isinstance(n, ast.Call)
+                 and (chain_tail(n.func) in dnames
+                      or chain_tail(n.func) in handles)]
+        if not cands:
+            return
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents.setdefault(id(child), node)
+        encl: Dict[int, str] = {}
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                for sub in cls.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        encl[id(sub)] = cls.name
+
+        def scope_of(node: ast.AST):
+            cur: Optional[ast.AST] = parents.get(id(node))
+            cls = None
+            fn = None
+            while cur is not None:
+                if fn is None and isinstance(
+                        cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = cur
+                cur = parents.get(id(cur))
+            scope = fn if fn is not None else ctx.tree
+            if fn is not None:
+                cls = encl.get(id(fn))
+            return scope, cls
+
+        for call in cands:
+            scope, encl_class = scope_of(call)
+            donated = self._donated_args(view, mod, call, encl_class,
+                                         handles)
+            if not donated:
+                continue
+            yield from self._check_call(ctx, scope, parents, call,
+                                        donated)
+
+    def _check_call(self, ctx, scope, parents: Dict[int, ast.AST],
+                    call: ast.Call, donated: List[str]):
+        def stmt_of(node: ast.AST) -> Optional[ast.stmt]:
+            cur = node
+            while cur is not None and not isinstance(cur, ast.stmt):
+                cur = parents.get(id(cur))
+            return cur
+
+        def loops_of(node: ast.AST) -> List[ast.AST]:
+            out = []
+            cur: Optional[ast.AST] = parents.get(id(node))
+            while cur is not None and cur is not scope:
+                if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                    out.append(cur)
+                cur = parents.get(id(cur))
+            return out
+
+        from ..astutil import assign_target_names
+        body_stmts = [n for n in ast.walk(scope)
+                      if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                        ast.AugAssign, ast.For,
+                                        ast.AsyncFor))]
+
+        def rebinds(name: str, stmt: ast.AST) -> bool:
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                return name in {x.id for x in ast.walk(stmt.target)
+                                if isinstance(x, ast.Name)}
+            return name in assign_target_names(stmt)
+
+        cstmt = stmt_of(call)
+        if cstmt is not None:
+            cpos = (call.lineno, call.col_offset)
+            in_call = {id(s) for s in ast.walk(call)}
+            for name in donated:
+                rebound_here = rebinds(name, cstmt)
+                loops = loops_of(call)
+                if loops:
+                    loop = loops[-1]  # outermost enclosing loop
+                    loop_rebinds = any(
+                        rebinds(name, s) for s in ast.walk(loop)
+                        if isinstance(s, (ast.Assign, ast.AnnAssign,
+                                          ast.AugAssign, ast.For,
+                                          ast.AsyncFor)))
+                    if not loop_rebinds:
+                        yield finding_at(
+                            self.id, ctx, call,
+                            f"`{name}` is donated here inside a loop "
+                            f"but never rebound in the loop — the next "
+                            f"iteration reuses the dead buffer; rebind "
+                            f"the result (`{name} = ...`)")
+                        continue
+                if rebound_here:
+                    continue
+                # doc-order: a Load of the name after the call, before
+                # the first rebind
+                rebind_pos = [
+                    (s.lineno, s.col_offset) for s in body_stmts
+                    if rebinds(name, s)
+                    and (s.lineno, s.col_offset) > cpos]
+                horizon = min(rebind_pos) if rebind_pos else None
+                for nd in ast.walk(scope):
+                    if not (isinstance(nd, ast.Name) and nd.id == name
+                            and isinstance(nd.ctx, ast.Load)):
+                        continue
+                    if id(nd) in in_call:
+                        continue
+                    pos = (nd.lineno, nd.col_offset)
+                    if pos <= cpos:
+                        continue
+                    if horizon is not None and pos >= horizon:
+                        continue
+                    yield finding_at(
+                        self.id, ctx, nd,
+                        f"`{name}` is read after being donated to a "
+                        f"jitted dispatch at line {call.lineno} — the "
+                        f"buffer is dead; read the RESULT, or drop "
+                        f"the donation")
+                    break
+
+    @staticmethod
+    def _donated_args(view, mod, call: ast.Call,
+                      encl_class: Optional[str],
+                      handles: Dict[str, Set[int]]) -> List[str]:
+        """Plain-Name arguments of ``call`` sitting at donated
+        positions (cross-function via summaries, or a file-local jit
+        handle)."""
+        out: List[str] = []
+        chain = attr_chain(call.func)
+        if len(chain) == 1 and chain[0] in handles:
+            for i, a in enumerate(call.args):
+                if i in handles[chain[0]] and isinstance(a, ast.Name):
+                    out.append(a.id)
+            return out
+        if not chain:
+            return out
+        r = view.resolve(mod.name, chain, encl_class)
+        if r is None or r[0] != "func":
+            return out
+        summ = view.summaries.get(r[1], EMPTY)
+        donates = getattr(summ, "donates", frozenset())
+        if not donates:
+            return out
+        for idx, arg in view.callee_arg_indices(r[1], call):
+            if idx in donates and isinstance(arg, ast.Name):
+                out.append(arg.id)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# RQ1103 — shard_map spec arity vs signature
+# ---------------------------------------------------------------------------
+
+
+class ShardMapSpecArityRule(Rule):
+    id = "RQ1103"
+    name = "shard-map-spec-arity"
+    description = ("literal in_specs/out_specs tuple whose arity "
+                   "disagrees with the wrapped function's signature / "
+                   "return arity — a pytree mismatch error at trace "
+                   "time on the mesh")
+    paths = MESH_PATHS
+    needs_project = True
+
+    def check(self, ctx):
+        view = getattr(ctx, "project", None)
+        if view is None:
+            return
+        mod = view.by_relpath.get(ctx.relpath)
+        if mod is None or "shard_map" not in ctx.source:
+            return  # the call site always spells the name
+        calls = [n for n in ast.walk(ctx.tree)
+                 if isinstance(n, ast.Call)
+                 and chain_tail(n.func) == "shard_map"]
+        if not calls:
+            return
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents.setdefault(id(child), node)
+        for call in calls:
+            # local defs visible at the call: nested defs of the
+            # NEAREST enclosing function (the repo's kernel idiom)
+            local_defs: Dict[str, ast.AST] = {}
+            cur = parents.get(id(call))
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    local_defs = {
+                        sub.name: sub for sub in ast.walk(cur)
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))
+                        and sub is not cur}
+                    break
+                cur = parents.get(id(cur))
+            yield from self._check_site(ctx, view, mod, call,
+                                        local_defs)
+
+    def _check_site(self, ctx, view, mod, call: ast.Call,
+                    local_defs: Dict[str, ast.AST]):
+        tgt = _wrap_target(call)
+        fn_node = None
+        if isinstance(tgt, ast.Name) and tgt.id in local_defs:
+            fn_node = local_defs[tgt.id]
+        elif tgt is not None:
+            chain = attr_chain(tgt)
+            if chain:
+                r = view.resolve(mod.name, chain)
+                if r is not None and r[0] == "func":
+                    fn_node = view.functions[r[1]].node
+        if fn_node is None:
+            return
+        in_specs = self._spec_arg(call, "in_specs", 2)
+        out_specs = self._spec_arg(call, "out_specs", 3)
+        params = param_names(fn_node)
+        a = fn_node.args
+        if a.vararg is None and isinstance(in_specs, (ast.Tuple,
+                                                      ast.List)):
+            n_pos = len(getattr(a, "posonlyargs", [])) + len(a.args)
+            if len(in_specs.elts) != n_pos:
+                yield finding_at(
+                    self.id, ctx, in_specs,
+                    f"in_specs has {len(in_specs.elts)} entries but "
+                    f"`{fn_node.name}` takes {n_pos} positional "
+                    f"argument(s) ({', '.join(params[:n_pos])}) — "
+                    f"pytree mismatch at trace time")
+        if isinstance(out_specs, (ast.Tuple, ast.List)):
+            arity = self._return_arity(fn_node)
+            if arity is not None and arity != len(out_specs.elts):
+                yield finding_at(
+                    self.id, ctx, out_specs,
+                    f"out_specs has {len(out_specs.elts)} entries but "
+                    f"`{fn_node.name}` returns {arity}-tuples — "
+                    f"pytree mismatch at trace time")
+
+    @staticmethod
+    def _spec_arg(call: ast.Call, kw_name: str,
+                  pos: int) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == kw_name:
+                return kw.value
+        if len(call.args) > pos:
+            return call.args[pos]
+        return None
+
+    @staticmethod
+    def _return_arity(fn: ast.AST) -> Optional[int]:
+        """Consistent literal-tuple return arity of ``fn``, else None
+        (mixed or non-tuple returns are not judged)."""
+        arities: Set[int] = set()
+        skip: Set[int] = set()
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        for node in ast.walk(fn):
+            if id(node) in skip or not isinstance(node, ast.Return):
+                continue
+            if not isinstance(node.value, ast.Tuple):
+                return None
+            arities.add(len(node.value.elts))
+        if len(arities) == 1:
+            return arities.pop()
+        return None

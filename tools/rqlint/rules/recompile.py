@@ -40,7 +40,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from ..astutil import attr_chain, chain_tail, name_ids, param_names
+from ..astutil import (attr_chain, chain_tail, const_int_elems,
+                       const_str_elems, name_ids, param_names)
 from ..callgraph import body_nodes
 from ..findings import finding_at
 from .base import Rule
@@ -76,28 +77,10 @@ def jit_static_info(fn) -> Tuple[Set[int], Set[str]]:
             continue
         for kw in dec.keywords:
             if kw.arg == "static_argnums":
-                nums |= _int_elems(kw.value)
+                nums |= const_int_elems(kw.value)
             elif kw.arg == "static_argnames":
-                names |= _str_elems(kw.value)
+                names |= const_str_elems(kw.value)
     return nums, names
-
-
-def _int_elems(e: ast.AST) -> Set[int]:
-    out: Set[int] = set()
-    elems = e.elts if isinstance(e, (ast.Tuple, ast.List)) else [e]
-    for el in elems:
-        if isinstance(el, ast.Constant) and isinstance(el.value, int):
-            out.add(el.value)
-    return out
-
-
-def _str_elems(e: ast.AST) -> Set[str]:
-    out: Set[str] = set()
-    elems = e.elts if isinstance(e, (ast.Tuple, ast.List)) else [e]
-    for el in elems:
-        if isinstance(el, ast.Constant) and isinstance(el.value, str):
-            out.add(el.value)
-    return out
 
 
 def _static_positions(fn) -> Tuple[Set[int], List[str]]:

@@ -1,0 +1,88 @@
+"""SARIF 2.1.0 output (``--format sarif``) — the GitHub code-scanning
+ingestion format, so rqlint findings render as repository code-scanning
+alerts alongside the ``--format github`` inline annotations.
+
+One run per invocation: the full rule catalogue goes into
+``tool.driver.rules`` (a reader needs no rqlint checkout, same
+self-description contract as the ``rq.rqlint.findings/1`` artifact —
+which is UNCHANGED; SARIF is a presentation, not a second source of
+truth).  Every finding becomes a result; pragma-suppressed and
+baselined findings are carried with a ``suppressions`` entry
+(``inSource`` / ``external``) instead of being dropped, so the alert
+set and the exit-code set stay explainable from one document.
+
+Stdlib-only, like the rest of rqlint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from . import __version__
+from .findings import Finding, Severity
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+_LEVEL = {Severity.ERROR: "error", Severity.WARN: "warning"}
+
+
+def _result(f: Finding) -> Dict:
+    out: Dict = {
+        "ruleId": f.rule,
+        "level": _LEVEL.get(f.severity, "error"),
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                # repo-relative URI with NO uriBaseId binding: the
+                # consumer (GitHub code scanning) resolves it against
+                # the checkout root — emitting file:/// here would
+                # make conformant readers resolve to wrong absolutes
+                "artifactLocation": {"uri": f.path},
+                # SARIF lines/columns are 1-based; line 0 means a
+                # file-level finding — pin it to line 1
+                "region": {"startLine": max(f.line, 1),
+                           "startColumn": f.col + 1},
+            },
+        }],
+    }
+    if f.code:
+        out["locations"][0]["physicalLocation"]["region"]["snippet"] = {
+            "text": f.code}
+    suppressions = []
+    if f.suppressed:
+        suppressions.append({"kind": "inSource",
+                             "justification": "rqlint pragma"})
+    if f.baselined:
+        suppressions.append({"kind": "external",
+                             "justification":
+                                 "tools/rqlint_baseline.json"})
+    if suppressions:
+        out["suppressions"] = suppressions
+    return out
+
+
+def sarif_doc(result: dict) -> Dict:
+    """The SARIF log for one engine run (``engine.run`` result dict)."""
+    findings: List[Finding] = result["findings"]
+    rules_meta = [{
+        "id": r.id,
+        "name": r.name,
+        "shortDescription": {"text": r.name},
+        "fullDescription": {"text": r.description},
+        "defaultConfiguration": {
+            "level": _LEVEL.get(r.severity, "error")},
+    } for r in result["rules"]]
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "rqlint",
+                "version": __version__,
+                "rules": rules_meta,
+            }},
+            "results": [_result(f) for f in findings],
+        }],
+    }

@@ -45,9 +45,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-from typing import Dict, FrozenSet, List, Optional, Set
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
-from .astutil import attr_chain, chain_tail, jit_decorated
+from .astutil import (attr_chain, chain_tail, jit_decorated,
+                      jit_donate_info)
 from .callgraph import body_nodes
 
 #: calls producing fresh PRNG keys; consuming a key THROUGH these is
@@ -106,6 +107,24 @@ class Summary:
     returns_device: bool = False
     returns_host: bool = False
     jitted: bool = False
+    # -- tier-3 bits (same SCC fixpoint) ------------------------------------
+    #: lock identities (``module::Class.attr`` / ``module::name``) this
+    #: function may acquire — directly, or through a resolved callee.
+    acquires_lock: FrozenSet[str] = frozenset()
+    #: ordered (held, acquired) lock pairs observed in this body — the
+    #: per-function slice of the global lock-order graph (RQ1002).
+    lock_edges: FrozenSet[Tuple[str, str]] = frozenset()
+    #: collective axis names raw-consumed (constant-string ``lax.psum``
+    #: family) by this function or a resolved callee, minus the axes the
+    #: function guards with ``comm.axis_present``/``axis_size_or_1``.
+    uses_axes: FrozenSet[str] = frozenset()
+    #: the function creates an axis-binding wrapper (``shard_map`` /
+    #: ``pmap`` / ``vmap(axis_name=...)``) somewhere in its body.
+    binds_axis: bool = False
+    #: parameter positions the function's OWN jit decorator donates, or
+    #: that it passes straight through to a donating callee — the buffer
+    #: a caller must not read after the call (RQ1102).
+    donates: FrozenSet[int] = frozenset()
 
 
 EMPTY = Summary()
@@ -115,6 +134,235 @@ EMPTY = Summary()
 #: the pragmas module's spelling for a blanket disable
 _CONC_PRAGMAS = frozenset({"RQ701", "RQ702", "RQ401", "all"})
 _KEY_PRAGMAS = frozenset({"RQ501", "all"})
+
+
+# ---------------------------------------------------------------------------
+# Tier-3 shared classifiers: locks, collectives, axis guards.
+# ---------------------------------------------------------------------------
+
+#: ``lax.*`` collective tails whose axis name must be bound by an
+#: enclosing shard_map/pmap (single source of truth — rules/mesh.py
+#: imports these).
+COLLECTIVE_TAILS = {"psum", "pmean", "pmin", "pmax", "all_gather",
+                    "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                    "pbroadcast", "axis_index"}
+
+#: repo guard idiom sanctioning a raw collective: the axis was probed
+#: first, so the unbound case never reaches the collective
+#: (``comm.axis_present`` / ``comm.axis_size_or_1``).
+AXIS_GUARD_TAILS = {"axis_present", "axis_size_or_1"}
+
+#: wrapper tails that bind collective axes over their function argument
+AXIS_BINDERS = {"shard_map", "pmap", "xmap"}
+
+
+def collective_axis(call: ast.Call) -> Optional[str]:
+    """The constant-string axis name of a raw ``lax.*`` collective call,
+    or None (non-collective, or a dynamic axis expression — dynamic axes
+    stay un-analyzed: precision over noise)."""
+    chain = attr_chain(call.func)
+    if not chain:
+        return None
+    if not (chain[0] == "lax" or chain[:2] == ("jax", "lax")):
+        return None
+    tail = chain[-1]
+    if tail not in COLLECTIVE_TAILS:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "axis_name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    idx = 0 if tail == "axis_index" else 1
+    if len(call.args) > idx:
+        a = call.args[idx]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+def guarded_axis(call: ast.Call) -> Optional[str]:
+    """The constant axis name an ``axis_present``-family guard probes."""
+    if chain_tail(call.func) not in AXIS_GUARD_TAILS:
+        return None
+    args = list(call.args) + [k.value for k in call.keywords]
+    if args and isinstance(args[0], ast.Constant) \
+            and isinstance(args[0].value, str):
+        return args[0].value
+    return None
+
+
+def binds_axis_call(call: ast.Call) -> bool:
+    """True when ``call`` creates an axis-binding wrapper: any
+    ``shard_map``/``pmap``/``xmap`` spelling (jax.* or the comm.py
+    pin-translating wrapper), or ``vmap`` with an ``axis_name``."""
+    tail = chain_tail(call.func)
+    if tail in AXIS_BINDERS:
+        return True
+    return tail == "vmap" and any(k.arg == "axis_name"
+                                  for k in call.keywords)
+
+
+def lock_identity(expr: ast.AST, modname: str,
+                  encl_class: Optional[str],
+                  params: Optional[List[str]] = None) -> Optional[str]:
+    """Stable identity of a lock expression, or None when it cannot be
+    attributed: ``self._lock`` in a method -> ``module::Class._lock``,
+    a bare module-global ``_LOCK`` -> ``module::_LOCK``.  Only names
+    containing "lock" qualify (the repo convention; a mutex named
+    otherwise is invisible — accepted false negative), and a lock
+    PARAMETER stays None (its identity belongs to the caller)."""
+    chain = attr_chain(expr)
+    if not chain:
+        return None
+    tail = chain[-1]
+    if "lock" not in tail.lower():
+        return None
+    if chain[0] == "self" and len(chain) == 2 and encl_class:
+        return f"{modname}::{encl_class}.{tail}"
+    if len(chain) == 1 and tail not in (params or ()):
+        return f"{modname}::{tail}"
+    return None
+
+
+def _tier3_static(view, info) -> dict:
+    """The summaries-independent slice of one function's tier-3 facts —
+    computed (and name-resolved) ONCE per function per view, cached:
+    direct lock acquisitions with their held context, direct raw
+    collective axes, axis guards, binder calls, and the resolved call
+    sites with the lock set held at each.  :func:`lock_axis_walk` then
+    just merges callee summaries over these, so the SCC fixpoint never
+    re-resolves a call."""
+    cache = view.__dict__.setdefault("_tier3_static", {})
+    st = cache.get(info.fid)
+    if st is not None:
+        return st
+    acquires: Set[str] = set()
+    sites: List[Tuple[Tuple[str, ...], str, ast.AST]] = []
+    calls: List[Tuple[Tuple[str, ...], str, ast.AST]] = []
+    axes: Set[str] = set()
+    guards: Set[str] = set()
+    binds = False
+
+    def _acquire(lock: str, held: Tuple[str, ...], node) -> None:
+        acquires.add(lock)
+        sites.append((held, lock, node))
+
+    def handle_call(call: ast.Call, held: Tuple[str, ...]) -> None:
+        nonlocal binds
+        if binds_axis_call(call):
+            binds = True
+        ax = collective_axis(call)
+        if ax is not None:
+            axes.add(ax)
+        g = guarded_axis(call)
+        if g is not None:
+            guards.add(g)
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "acquire"):
+            lk = lock_identity(call.func.value, info.modname,
+                               info.encl_class, info.params)
+            if lk is not None:
+                _acquire(lk, held, call)
+        chain = attr_chain(call.func)
+        if not chain:
+            return
+        fid = view.resolve_func(info.modname, chain, info.encl_class)
+        if fid is not None:
+            calls.append((held, fid, call))
+
+    def visit_expr(e: Optional[ast.AST], held: Tuple[str, ...]) -> None:
+        if e is None:
+            return
+        skip: Set[int] = set()
+        for node in ast.walk(e):
+            if id(node) in skip:
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+                continue
+            if isinstance(node, ast.Call):
+                handle_call(node, held)
+
+    def walk(stmts, held: Tuple[str, ...]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in stmt.items:
+                    visit_expr(item.context_expr, inner)
+                    lk = lock_identity(item.context_expr, info.modname,
+                                       info.encl_class, info.params)
+                    if lk is not None:
+                        _acquire(lk, inner, stmt)
+                        inner = inner + (lk,)
+                walk(stmt.body, inner)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                visit_expr(stmt.iter, held)
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.While):
+                visit_expr(stmt.test, held)
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.If):
+                visit_expr(stmt.test, held)
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, held)
+                for h in stmt.handlers:
+                    walk(h.body, held)
+                walk(stmt.orelse, held)
+                walk(stmt.finalbody, held)
+            else:
+                visit_expr(stmt, held)
+
+    body = getattr(info.node, "body", [])
+    walk(body if isinstance(body, list) else [], ())
+    st = {"acquires": acquires, "sites": sites, "calls": calls,
+          "axes": axes, "guards": guards, "binds": binds}
+    cache[info.fid] = st
+    return st
+
+
+def lock_axis_walk(view, info, summaries: Dict[str, "Summary"],
+                   sites: Optional[List] = None) -> dict:
+    """One function's tier-3 facts: lock identities acquired (directly
+    or via resolved callees), ordered (held, acquired) lock pairs, raw
+    collective axes consumed (guarded axes subtracted), and whether the
+    body creates an axis-binding wrapper.  ``sites`` (when given)
+    collects ``(held, acquired, node)`` triples so RQ1002 can anchor
+    findings.  Nested defs/lambdas/classes are skipped — separate (or
+    deferred) execution scopes, consistent with the rest of the summary
+    layer."""
+    st = _tier3_static(view, info)
+    acquires: Set[str] = set(st["acquires"])
+    edges: Set[Tuple[str, str]] = set()
+    axes: Set[str] = set(st["axes"])
+    for held, lock, node in st["sites"]:
+        for h in held:
+            if h != lock:
+                edges.add((h, lock))
+                if sites is not None:
+                    sites.append((h, lock, node))
+    for held, fid, call in st["calls"]:
+        s = summaries.get(fid)
+        if s is None:
+            continue
+        acquires.update(s.acquires_lock)
+        for lk in s.acquires_lock:
+            for h in held:
+                if h != lk:
+                    edges.add((h, lk))
+                    if sites is not None:
+                        sites.append((h, lk, call))
+        axes.update(s.uses_axes)
+    return {"acquires": acquires, "edges": edges,
+            "axes": axes - st["guards"], "binds": st["binds"]}
 
 
 def _is_tree_op(chain) -> bool:
@@ -189,11 +437,15 @@ def device_expr(e: ast.AST, device_names, resolve, summaries) -> bool:
                if isinstance(c, ast.expr))
 
 
-def compute(view) -> Dict[str, Summary]:
+def compute(view, graph: Optional[Dict[str, Set[str]]] = None
+            ) -> Dict[str, Summary]:
     """All summaries, bottom-up over SCCs (callees before callers), with
-    a per-SCC fixpoint so recursion cycles converge."""
+    a per-SCC fixpoint so recursion cycles converge.  ``graph`` reuses
+    an already-resolved call graph (the view builder passes its own so
+    edges are resolved exactly once per run)."""
     from .callgraph import call_edges, sccs
-    graph = call_edges(view)
+    if graph is None:
+        graph = call_edges(view)
     summaries: Dict[str, Summary] = {}
     for comp in sccs(graph):
         changed = True
@@ -237,6 +489,7 @@ def _transfer(view, info, summaries: Dict[str, Summary]) -> Summary:
     mod = view.modules.get(info.modname)
     concretizes: Set[int] = set()
     consumes: Set[int] = set()
+    donates: Set[int] = set(jit_donate_info(info.node))
     returns_key = False
     returns_host = False
     returns_device = jit_decorated(info.node)
@@ -359,6 +612,12 @@ def _transfer(view, info, summaries: Dict[str, Summary]) -> Summary:
                 if idx in summ.consumes_key and not sanctioned(
                         call, _KEY_PRAGMAS):
                     consumes.update(p & st.key_params)
+                if idx in summ.donates and isinstance(arg, ast.Name) \
+                        and arg.id in st.param_idx:
+                    # a param handed STRAIGHT to a donating position is
+                    # donated by this function too (derived expressions
+                    # donate a temporary, not the param's buffer)
+                    donates.add(st.param_idx[arg.id])
         elif chain and tail not in DERIVERS and chain[0] not in NP_HEADS \
                 and not (tail in CONCRETIZERS and len(chain) == 1):
             # unresolved non-deriving call: tier-1 conservatism — a key
@@ -429,9 +688,15 @@ def _transfer(view, info, summaries: Dict[str, Summary]) -> Summary:
             if expr_host(node.value):
                 returns_host = True
 
+    la = lock_axis_walk(view, info, summaries)
     return Summary(concretizes=frozenset(concretizes),
                    consumes_key=frozenset(consumes),
                    returns_key=returns_key,
                    returns_device=returns_device,
                    returns_host=returns_host,
-                   jitted=jit_decorated(info.node))
+                   jitted=jit_decorated(info.node),
+                   acquires_lock=frozenset(la["acquires"]),
+                   lock_edges=frozenset(la["edges"]),
+                   uses_axes=frozenset(la["axes"]),
+                   binds_axis=la["binds"],
+                   donates=frozenset(donates))
