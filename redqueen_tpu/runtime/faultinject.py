@@ -113,6 +113,41 @@ Two ways in:
                             maps onto the router's health machine
                             (degrade/quarantine/heal), never a router
                             crash
+      repl:mode@peerK[,batchN]
+                            deterministic REPLICATION fault on follower
+                            peer K of a quorum-replicated journal
+                            (:mod:`redqueen_tpu.serving.replication`),
+                            fired around the record carrying batch
+                            sequence number N (omitted = first
+                            opportunity).  ``kill`` SIGKILLs the
+                            follower process (or drops a thread
+                            follower's in-memory store) mid-replication
+                            — its held records die with it; ``partition``
+                            severs the leader→follower link so the
+                            leader must shrink the quorum (or degrade to
+                            local fsync when the quorum cannot be met);
+                            ``slow`` delays the follower's acks past the
+                            leader's quorum deadline — the
+                            slow-follower shape that forces the leader
+                            to re-elect its quorum from the remaining
+                            peers.  Data-plane kind: validated at
+                            :func:`maybe_inject`, APPLIED by the
+                            replication layer via :func:`repl_fault`
+      disk:mode@fsyncN      deterministic DISK fault on the journal's
+                            checkpoint/fsync path
+                            (:mod:`redqueen_tpu.serving.journal`): the
+                            N-th fsync THIS PROCESS attempts (1-based,
+                            counted per journal instance) fails with
+                            ``EIO`` (``mode=eio``: media error — the
+                            background checkpointer counts it in
+                            ``flush_errors`` and retries next tick) or
+                            ``ENOSPC`` (``mode=enospc``: volume full —
+                            same transient-retry contract; a
+                            persistent failure fills the window and the
+                            inline fsync raises, taking the fatal-
+                            append path).  Data-plane kind: validated
+                            at :func:`maybe_inject`, APPLIED by the
+                            journal via :func:`disk_fault`
       shard:mode@shardK[,batchN]
                             deterministic SHARD-granularity fault in the
                             sharded serving cluster
@@ -185,6 +220,14 @@ __all__ = [
     "NET_MODES",
     "parse_net",
     "net_fault",
+    "ReplFault",
+    "REPL_MODES",
+    "parse_repl",
+    "repl_fault",
+    "DiskFault",
+    "DISK_MODES",
+    "parse_disk",
+    "disk_fault",
     "hang_forever",
     "crash_with",
     "flaky",
@@ -225,15 +268,18 @@ def parse_fault(spec: str) -> FaultSpec:
     kind, _, arg = spec.strip().partition(":")
     kind = kind.strip().lower()
     if kind not in ("hang", "crash", "transient", "oom", "corrupt",
-                    "numeric", "ingest", "shard", "worker", "net"):
+                    "numeric", "ingest", "shard", "worker", "net",
+                    "repl", "disk"):
         raise ValueError(f"unknown fault spec {spec!r} "
                          f"(want hang|crash|transient|oom[:arg], "
                          f"corrupt:mode@path, "
                          f"numeric:mode@laneN[,chunkM], "
                          f"ingest:mode@batchN, "
                          f"shard:mode@shardK[,batchN], "
-                         f"worker:mode@shardK[,batchN], or "
-                         f"net:mode@shardK[,batchN])")
+                         f"worker:mode@shardK[,batchN], "
+                         f"net:mode@shardK[,batchN], "
+                         f"repl:mode@peerK[,batchN], or "
+                         f"disk:mode@fsyncN)")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -305,6 +351,14 @@ def inject(spec: FaultSpec) -> None:
         # Same data-plane contract: validated here, applied by the
         # socket-placed shard worker via net_fault().
         parse_net(spec.arg)
+    elif spec.kind == "repl":
+        # Same data-plane contract: validated here, applied by the
+        # quorum-replication layer via repl_fault().
+        parse_repl(spec.arg)
+    elif spec.kind == "disk":
+        # Same data-plane contract: validated here, applied by the
+        # journal's checkpoint/fsync path via disk_fault().
+        parse_disk(spec.arg)
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -506,13 +560,13 @@ class ShardFault(NamedTuple):
 
 
 def _parse_shard_addressed(arg: Optional[str], kind: str,
-                           modes: Tuple[str, ...]
+                           modes: Tuple[str, ...], prefix: str = "shard"
                            ) -> Tuple[str, int, Optional[int]]:
-    """Shared parser for the ``mode@shardK[,batchN]`` spec shape the
-    ``shard`` and ``worker`` kinds both use."""
+    """Shared parser for the ``mode@<prefix>K[,batchN]`` spec shape the
+    ``shard``, ``worker``, ``net``, and ``repl`` kinds all use."""
     if not arg or "@" not in arg:
         raise ValueError(
-            f"{ENV_FAULT}={kind} needs 'mode@shardK[,batchN]' "
+            f"{ENV_FAULT}={kind} needs 'mode@{prefix}K[,batchN]' "
             f"(mode: {'|'.join(modes)})")
     mode, _, where = arg.partition("@")
     mode = mode.strip().lower()
@@ -522,14 +576,17 @@ def _parse_shard_addressed(arg: Optional[str], kind: str,
     shard_s, _, batch_s = where.partition(",")
     shard_s = shard_s.strip().lower()
     batch_s = batch_s.strip().lower()
-    if not shard_s.startswith("shard"):
-        raise ValueError(f"{kind} fault needs 'shardK', got {shard_s!r}")
+    if not shard_s.startswith(prefix):
+        raise ValueError(
+            f"{kind} fault needs '{prefix}K', got {shard_s!r}")
     try:
-        shard = int(shard_s[5:])
+        shard = int(shard_s[len(prefix):])
     except ValueError as e:
-        raise ValueError(f"bad shard in {kind} fault: {shard_s!r}") from e
+        raise ValueError(
+            f"bad {prefix} in {kind} fault: {shard_s!r}") from e
     if shard < 0:
-        raise ValueError(f"{kind} fault shard must be >= 0, got {shard}")
+        raise ValueError(
+            f"{kind} fault {prefix} must be >= 0, got {shard}")
     batch: Optional[int] = None
     if batch_s:
         if not batch_s.startswith("batch"):
@@ -629,6 +686,93 @@ def net_fault() -> Optional[NetFault]:
     if parsed.kind != "net":
         return None
     return parse_net(parsed.arg)
+
+
+# --- repl (quorum-replication data-plane) faults: follower failures -------
+
+REPL_MODES = ("kill", "partition", "slow")
+
+
+class ReplFault(NamedTuple):
+    """Parsed ``repl:mode@peerK[,batchN]`` spec.  ``peer`` is the
+    follower index inside one shard's replication group (one in-memory
+    record holder); ``batch`` the record sequence number around whose
+    replication the fault fires (None = first opportunity), so the
+    same spec hits the same stream point in an uninterrupted run and
+    in a quorum-shrink-and-heal run."""
+
+    mode: str            # kill | partition | slow
+    peer: int
+    batch: Optional[int]
+
+
+def parse_repl(arg: Optional[str]) -> ReplFault:
+    """Parse the argument of a ``repl`` fault spec."""
+    return ReplFault(*_parse_shard_addressed(arg, "repl", REPL_MODES,
+                                             prefix="peer"))
+
+
+def repl_fault() -> Optional[ReplFault]:
+    """The env-configured repl fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "repl":
+        return None
+    return parse_repl(parsed.arg)
+
+
+# --- disk (journal checkpoint-path) faults: fsync errno injection ---------
+
+DISK_MODES = ("eio", "enospc")
+
+
+class DiskFault(NamedTuple):
+    """Parsed ``disk:mode@fsyncN`` spec: the N-th fsync a journal
+    instance attempts (1-based) fails with the given errno.  Counted
+    per instance, not per process, so the same spec hits the same
+    checkpoint in an uninterrupted run and a recover-and-continue
+    run."""
+
+    mode: str   # eio | enospc
+    fsync: int
+
+
+def parse_disk(arg: Optional[str]) -> DiskFault:
+    """Parse the argument of a ``disk`` fault spec."""
+    if not arg or "@" not in arg:
+        raise ValueError(
+            f"{ENV_FAULT}=disk needs 'mode@fsyncN' "
+            f"(mode: {'|'.join(DISK_MODES)})")
+    mode, _, where = arg.partition("@")
+    mode = mode.strip().lower()
+    if mode not in DISK_MODES:
+        raise ValueError(f"unknown disk fault mode {mode!r} "
+                         f"(want {'|'.join(DISK_MODES)})")
+    where = where.strip().lower()
+    if not where.startswith("fsync"):
+        raise ValueError(f"disk fault needs 'fsyncN', got {where!r}")
+    try:
+        n = int(where[5:])
+    except ValueError as e:
+        raise ValueError(f"bad fsync index in disk fault: {where!r}") from e
+    if n < 1:
+        raise ValueError(f"disk fault fsync index must be >= 1, got {n}")
+    return DiskFault(mode, n)
+
+
+def disk_fault() -> Optional[DiskFault]:
+    """The env-configured disk fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "disk":
+        return None
+    return parse_disk(parsed.arg)
 
 
 # --- picklable callable faults (spawned-child targets for tests) ---------

@@ -19,6 +19,11 @@ deterministic fault injection) made load-bearing:
   GROUP-COMMIT durability (explicit bounded loss window, the
   wire-speed ack contract — docs/DESIGN.md "Durability modes & the
   ack contract");
+- :mod:`~redqueen_tpu.serving.replication` — quorum-replicated group
+  commit (:class:`ReplicatedJournal`): append() acks when a quorum of
+  follower processes hold the record in memory, fsync demoted to a
+  lagging background checkpoint, :func:`heal_from_replicas` re-seeding
+  a dead leader's journal from the surviving holders;
 - :mod:`~redqueen_tpu.serving.service`  — :class:`ServingRuntime`
   (bounded queue, backpressure, shed accounting, stale-but-served
   decisions) and :func:`recover` (snapshot + journal replay,
@@ -69,7 +74,14 @@ __all__ = [
     "JOURNAL_SCHEMA",
     "JOURNAL_GROUP_SCHEMA",
     "FLUSH_MODES",
+    "JOURNAL_FORMATS",
+    "journal_format",
+    "migrate_to_binary",
+    "durability_info",
     "tear_tail",
+    "ReplicatedJournal",
+    "heal_from_replicas",
+    "REPLICA_DIR_PREFIX",
     "ServingMetrics",
     "METRICS_SCHEMA",
     "ClusterMetrics",
@@ -116,12 +128,21 @@ __all__ = [
 # (RQ_SERVING_WORKER=1 worker-child) path.
 _STREAM_NAMES = ("stream", "drive", "FINAL_SCHEMA",
                  "CLUSTER_FINAL_SCHEMA", "cluster_final_payload")
-# Never imported eagerly: ``worker`` doubles as a -m entry point (the
-# runpy reason above) and ``transport`` only matters to worker-placement
-# code that imports it by module path anyway.
-_LAZY_ONLY = ("worker", "transport")
+# Never imported eagerly: ``worker`` and ``replication`` double as -m
+# entry points (the runpy reason above; replication's follower child
+# runs as ``python -m redqueen_tpu.serving.replication``) and
+# ``transport`` only matters to worker-placement code that imports it
+# by module path anyway.  The replication NAMES ride the same lazy
+# path so importing the serving package never pays for (or pre-binds)
+# the follower entry-point module.
+_LAZY_ONLY = ("worker", "transport", "replication",
+              "ReplicatedJournal", "heal_from_replicas",
+              "REPLICA_DIR_PREFIX")
 _LAZY_ATTRS = {
-    "worker": None, "transport": None,
+    "worker": None, "transport": None, "replication": None,
+    "ReplicatedJournal": ".replication",
+    "heal_from_replicas": ".replication",
+    "REPLICA_DIR_PREFIX": ".replication",
     "cluster": None, "events": None, "ingest": None, "journal": None,
     "metrics": None, "service": None, "state": None,
     "CLUSTER_SCHEMA": ".cluster", "ClusterAdmission": ".cluster",
@@ -135,6 +156,8 @@ _LAZY_ATTRS = {
     "Sequencer": ".ingest",
     "JOURNAL_SCHEMA": ".journal", "Journal": ".journal",
     "JOURNAL_GROUP_SCHEMA": ".journal", "FLUSH_MODES": ".journal",
+    "JOURNAL_FORMATS": ".journal", "journal_format": ".journal",
+    "migrate_to_binary": ".journal", "durability_info": ".journal",
     "JournalError": ".journal", "tear_tail": ".journal",
     "CLUSTER_METRICS_SCHEMA": ".metrics", "ClusterMetrics": ".metrics",
     "METRICS_SCHEMA": ".metrics", "ServingMetrics": ".metrics",

@@ -316,6 +316,10 @@ class ServingCluster:
                  flush_mode: str = "sync",
                  max_unflushed_records: int = 64,
                  max_flush_delay_ms: float = 50.0, coalesce: int = 1,
+                 journal_format: Optional[str] = None,
+                 replication_factor: int = 0,
+                 replication_quorum: Optional[int] = None,
+                 replication_mode: str = "thread",
                  placement: str = "in-process",
                  restart_policy: Optional[RetryPolicy] = None,
                  worker_request_timeout_s: float = 30.0,
@@ -362,6 +366,14 @@ class ServingCluster:
         if int(coalesce) < 1:
             raise ValueError(f"coalesce must be >= 1, got {coalesce}")
         self.coalesce = int(coalesce)
+        if int(replication_factor) < 0:
+            raise ValueError(f"replication_factor must be >= 0, got "
+                             f"{replication_factor}")
+        self.journal_format = journal_format
+        self.replication_factor = int(replication_factor)
+        self.replication_quorum = (None if replication_quorum is None
+                                   else int(replication_quorum))
+        self.replication_mode = str(replication_mode)
         self.placement = placement
         self.restart_policy = restart_policy or DEFAULT_RESTART_POLICY
         self._restart_rng = self.restart_policy.rng()
@@ -500,6 +512,13 @@ class ServingCluster:
             "max_unflushed_records": self.max_unflushed_records,
             "max_flush_delay_ms": self.max_flush_delay_ms,
             "coalesce": self.coalesce,
+            # Likewise non-identity: the journal encoding and the
+            # replication group shape change where/when records
+            # persist, never what they say.
+            "journal_format": self.journal_format,
+            "replication_factor": self.replication_factor,
+            "replication_quorum": self.replication_quorum,
+            "replication_mode": self.replication_mode,
         }
 
     def _check_or_write_config(self) -> None:
@@ -536,7 +555,11 @@ class ServingCluster:
             flush_mode=self.flush_mode,
             max_unflushed_records=self.max_unflushed_records,
             max_flush_delay_ms=self.max_flush_delay_ms,
-            coalesce=self.coalesce, clock=self._clock)
+            coalesce=self.coalesce,
+            journal_format=self.journal_format,
+            replication_factor=self.replication_factor,
+            replication_quorum=self.replication_quorum,
+            replication_mode=self.replication_mode, clock=self._clock)
 
     # ---- worker placement plumbing ----
 
@@ -556,7 +579,11 @@ class ServingCluster:
                 "flush_mode": self.flush_mode,
                 "max_unflushed_records": self.max_unflushed_records,
                 "max_flush_delay_ms": self.max_flush_delay_ms,
-                "coalesce": self.coalesce}
+                "coalesce": self.coalesce,
+                "journal_format": self.journal_format,
+                "replication_factor": self.replication_factor,
+                "replication_quorum": self.replication_quorum,
+                "replication_mode": self.replication_mode}
 
     def _spawn_worker(self, slot: _ShardSlot) -> "WorkerHandle":  # noqa: F821
         from .worker import SocketWorkerHandle, WorkerHandle
@@ -1851,9 +1878,15 @@ class ServingCluster:
         definition)."""
         from .journal import durability_info
 
+        repl = (None if not self.replication_factor
+                else {"factor": self.replication_factor,
+                      "quorum": (self.replication_quorum
+                                 if self.replication_quorum is not None
+                                 else self.replication_factor // 2 + 1)})
         return durability_info(self.flush_mode, self.fsync_every_n,
                                self.max_unflushed_records,
-                               self.max_flush_delay_ms, self.coalesce)
+                               self.max_flush_delay_ms, self.coalesce,
+                               replication=repl)
 
     def close(self) -> None:
         for slot in self._slots:

@@ -32,19 +32,26 @@ Stdlib + numpy only; safe to import before jax.
 
 from __future__ import annotations
 
+import errno as _errno
 import json
+import mmap
 import os
+import struct
 import threading
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..runtime import faultinject as _faultinject
 from ..runtime import integrity as _integrity
 from ..runtime import telemetry as _telemetry
 
 __all__ = ["Journal", "JournalError", "replay", "tear_tail",
            "rotate", "prune_segments", "segment_paths",
-           "durability_info",
+           "durability_info", "migrate_to_binary", "journal_format",
            "JOURNAL_SCHEMA", "JOURNAL_GROUP_SCHEMA", "JOURNAL_FILENAME",
-           "FLUSH_MODES"]
+           "FLUSH_MODES", "JOURNAL_FORMATS",
+           "BINARY_HEADER_MAGIC", "BINARY_RECORD_MAGIC",
+           "BINARY_SLOT_BYTES"]
 
 JOURNAL_SCHEMA = "rq.serving.journal/1"
 # One coalesced poll ROUND per record: {"seqs", "counts", flat "times"/
@@ -74,24 +81,70 @@ FLUSH_MODES = ("sync", "group")
 
 # The on-disk journal filename inside a runtime/shard directory — a
 # cross-subsystem contract: the serving runtime writes it and external
-# consumers (learn.ingest.from_journal) locate it by this name.
+# consumers (learn.ingest.from_journal) locate it by this name.  The
+# name is format-agnostic on purpose: a file that BEGINS with
+# ``BINARY_HEADER_MAGIC`` holds the binary fixed-slot segment format,
+# anything else is JSONL — every reader sniffs (:func:`journal_format`),
+# so migration never breaks a consumer that locates journals by name.
 JOURNAL_FILENAME = "journal.jsonl"
+
+# On-disk record encodings.  ``jsonl`` is the PR 6 format: one
+# checksummed ``make_envelope`` JSON object per line.  ``binary`` is the
+# mmap'd FIXED-SLOT segment format (modeled on the telemetry flight
+# ring): records land in slot-aligned frames — a 20-byte header
+# (``BINARY_RECORD_MAGIC`` | payload_len | crc32 | trailing seq) + the
+# compact-JSON payload, zero-padded to a multiple of
+# ``BINARY_SLOT_BYTES`` — written through one mmap'd preallocated
+# region.  What it buys: no per-record sha256 envelope and ONE
+# serialization instead of two (the envelope serializes the payload for
+# its digest, then serializes the wrapper again), with crc32 as the
+# integrity check; what it keeps: bit-identical replay (the payload
+# dict round-trips through the same JSON), the torn-tail quarantine
+# (slot alignment localizes a torn write, exactly like a torn flight-
+# ring slot), and the mid-file-corruption refusal.  Migration from
+# JSONL is ONE-WAY (:func:`migrate_to_binary`).
+JOURNAL_FORMATS = ("jsonl", "binary")
+
+#: First bytes of a binary-format journal file (the sniffing contract).
+BINARY_HEADER_MAGIC = b"RQJH"
+#: Per-record frame magic inside a binary journal.
+BINARY_RECORD_MAGIC = b"RQJ3"
+#: Fixed slot width: record frames are zero-padded to a multiple of
+#: this, so a torn concurrent/crashed write is localized to its own
+#: frame and the scan resynchronizes on slot boundaries.
+BINARY_SLOT_BYTES = 256
+#: mmap grow granularity (slots): the region is extended in chunks so
+#: the append path never pays a per-record ftruncate+remap.
+_BINARY_GROW_SLOTS = 4096
+#: ``>4sIIq``: record magic, payload byte length, crc32(payload),
+#: trailing applied seq (-1 = none recorded).
+_BINARY_RECORD_HDR = struct.Struct(">4sIIq")
 
 
 def durability_info(flush_mode: str, fsync_every_n: int,
                     max_unflushed_records: int,
                     max_flush_delay_ms: float,
-                    coalesce: int) -> Dict[str, Any]:
+                    coalesce: int,
+                    replication: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
     """THE durability-window description (one definition — the runtime
     and the cluster both embed it in their metrics artifacts, and the
     two must never drift): what an ack MEANS under this configuration,
     and the bounded loss a machine-level crash may consume.  See
-    docs/DESIGN.md "Durability modes & the ack contract"."""
+    docs/DESIGN.md "Durability tiers & the ack contract".
+
+    ``replication`` (the quorum tier) is the
+    ``{"factor": R, "quorum": Q}`` description of a replication group:
+    an ack then additionally means Q of the R+1 holders (leader
+    included) held the record in memory at ack time, so the loss
+    window applies only when EVERY holder dies before the lagging
+    checkpoint — any single-node loss (SIGKILL, machine crash of one
+    host) is survived outright."""
     if flush_mode == "group":
         window_records = int(max_unflushed_records) - 1
     else:
         window_records = int(fsync_every_n) - 1
-    return {
+    out = {
         "flush_mode": str(flush_mode),
         "fsync_every_n": int(fsync_every_n),
         "max_unflushed_records": int(max_unflushed_records),
@@ -106,7 +159,141 @@ def durability_info(flush_mode: str, fsync_every_n: int,
         # batch bound is the product.
         "loss_window_records": window_records,
         "loss_window_batches": window_records * int(coalesce),
+        # The three-tier name: "sync" (ack == fsync), "window" (ack
+        # races a bounded fsync), "quorum" (ack == Q in-memory holders;
+        # fsync is the lagging checkpoint).
+        "tier": "sync" if window_records == 0 else "window",
+        "ack_survives_single_node_loss": window_records == 0,
     }
+    if replication:
+        out["replication"] = {
+            "factor": int(replication.get("factor", 0)),
+            "quorum": int(replication.get("quorum", 0)),
+        }
+        if out["replication"]["factor"] >= 1 \
+                and out["replication"]["quorum"] >= 1:
+            out["tier"] = "quorum"
+            out["ack_survives_single_node_loss"] = True
+    return out
+
+
+def _slot_ceil(n: int) -> int:
+    """Round up to the fixed slot width."""
+    return -(-int(n) // BINARY_SLOT_BYTES) * BINARY_SLOT_BYTES
+
+
+def journal_format(path: str) -> Optional[str]:
+    """Sniff a journal file's on-disk format: ``"binary"`` when it
+    begins with ``BINARY_HEADER_MAGIC``, ``"jsonl"`` for any other
+    non-empty file, None when the file is missing or empty (no format
+    committed yet).  Every reader goes through this, so a mixed tree
+    (JSONL segments + binary live file, mid-migration) replays."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(len(BINARY_HEADER_MAGIC))
+    except (FileNotFoundError, IsADirectoryError, NotADirectoryError):
+        return None
+    if not head:
+        return None
+    return "binary" if head == BINARY_HEADER_MAGIC else "jsonl"
+
+
+def _binary_header_slot() -> bytes:
+    meta = json.dumps({"kind": "rq.jbin/1", "slot": BINARY_SLOT_BYTES},
+                      separators=(",", ":")).encode("utf-8")
+    hdr = BINARY_HEADER_MAGIC + meta
+    return hdr + b"\x00" * (BINARY_SLOT_BYTES - len(hdr))
+
+
+def _pack_binary_frame(body: bytes, seq: Optional[int]) -> bytes:
+    """One slot-padded record frame: header + compact-JSON payload
+    bytes, zero-padded to the slot multiple."""
+    frame = _BINARY_RECORD_HDR.pack(
+        BINARY_RECORD_MAGIC, len(body), zlib.crc32(body) & 0xFFFFFFFF,
+        -1 if seq is None else int(seq)) + body
+    return frame + b"\x00" * (_slot_ceil(len(frame)) - len(frame))
+
+
+def _payload_trailing_seq(payload: Dict[str, Any]) -> Optional[int]:
+    """The record's last applied seq, derived the same way for both
+    schemas (group records carry ``seqs``, singles ``seq``)."""
+    if "seqs" in payload and payload["seqs"]:
+        return int(payload["seqs"][-1])
+    if "seq" in payload:
+        return int(payload["seq"])
+    return None
+
+
+def _parse_binary(data: bytes
+                  ) -> Tuple[List[Tuple[int, bytes, Optional[int]]],
+                             int, Optional[Tuple[int, str]]]:
+    """Parse a binary journal image.  Returns ``(records, used, bad)``:
+    ``records`` is ``[(frame_offset, payload_bytes, seq), ...]`` for
+    each verified frame, ``used`` is the offset after the last verified
+    frame (>= the header slot), and ``bad`` is None for a clean image
+    else ``(offset, detail)`` where the first invalid bytes start.  A
+    zero-filled remainder is NOT bad — it is the preallocated tail
+    (clean EOF), exactly like an unwritten flight-ring slot."""
+    hdr = _BINARY_RECORD_HDR
+    records: List[Tuple[int, bytes, Optional[int]]] = []
+    off = BINARY_SLOT_BYTES
+    n = len(data)
+    while off < n:
+        chunk = data[off:off + hdr.size]
+        if len(chunk) < hdr.size:
+            if chunk.strip(b"\x00") == b"":
+                return records, off, None
+            return records, off, (off, "truncated frame header")
+        magic, plen, crc, seq = hdr.unpack(chunk)
+        if magic == b"\x00\x00\x00\x00":
+            # Zero frame magic: clean preallocated EOF iff every
+            # remaining byte is zero.
+            if data[off:].strip(b"\x00") == b"":
+                return records, off, None
+            return records, off, (off,
+                                  "nonzero bytes after zero frame magic")
+        if magic != BINARY_RECORD_MAGIC:
+            return records, off, (off, f"bad record magic {magic!r}")
+        end = off + hdr.size + int(plen)
+        if end > n:
+            return records, off, (off, "frame extends past EOF")
+        body = data[off + hdr.size:end]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            return records, off, (off, "crc32 mismatch")
+        pad_end = off + _slot_ceil(hdr.size + int(plen))
+        if data[end:min(pad_end, n)].strip(b"\x00") != b"":
+            return records, off, (off, "nonzero slot padding")
+        records.append((off, body, None if seq == -1 else int(seq)))
+        off = min(pad_end, n)
+    return records, off, None
+
+
+def _scan_binary_end(path: str) -> Tuple[int, bool]:
+    """(offset after the last whole record, tail-is-clean)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    _, used, bad = _parse_binary(data)
+    return used, bad is None
+
+
+def _binary_frame_after(data: bytes, off: int) -> bool:
+    """True when a VALID record frame exists on a slot boundary after
+    ``off`` — the mid-file-corruption discriminator: a torn append can
+    only damage the final frame, so valid records after the bad bytes
+    mean real corruption of the fsynced prefix (refuse), not a tear
+    (quarantine)."""
+    hdr = _BINARY_RECORD_HDR
+    pos = off + BINARY_SLOT_BYTES
+    while pos + hdr.size <= len(data):
+        magic, plen, crc, _seq = hdr.unpack_from(data, pos)
+        if magic == BINARY_RECORD_MAGIC:
+            end = pos + hdr.size + int(plen)
+            if end <= len(data) and \
+                    zlib.crc32(data[pos + hdr.size:end]) & 0xFFFFFFFF \
+                    == crc:
+                return True
+        pos += BINARY_SLOT_BYTES
+    return False
 
 
 class JournalError(RuntimeError):
@@ -151,7 +338,9 @@ class Journal:
     def __init__(self, path: str, fsync_every_n: int = 1,
                  flush_mode: str = "sync",
                  max_unflushed_records: int = 64,
-                 max_flush_delay_ms: float = 50.0):
+                 max_flush_delay_ms: float = 50.0,
+                 fmt: Optional[str] = None,
+                 stage: str = "serving.journal.append"):
         if int(fsync_every_n) < 1:
             raise ValueError(
                 f"fsync_every_n must be >= 1, got {fsync_every_n}")
@@ -164,25 +353,62 @@ class Journal:
         if float(max_flush_delay_ms) <= 0:
             raise ValueError(f"max_flush_delay_ms must be > 0, got "
                              f"{max_flush_delay_ms}")
+        if fmt is not None and fmt not in JOURNAL_FORMATS:
+            raise ValueError(f"fmt must be one of {JOURNAL_FORMATS}, "
+                             f"got {fmt!r}")
         self.path = path
         self.fsync_every_n = int(fsync_every_n)
         self.flush_mode = flush_mode
         self.max_unflushed_records = int(max_unflushed_records)
         self.max_flush_delay_ms = float(max_flush_delay_ms)
+        # Telemetry stage name for appends: replica-side journals label
+        # theirs differently (serving.repl.replica.append) so the
+        # serving round's stage breakdown never conflates the leader's
+        # critical-path append with background replica copies.
+        self._stage = str(stage)
         self._unsynced = 0
-        self._f = open(path, "a", encoding="utf-8")
+        # Format resolution: explicit wins; an EXISTING file's sniffed
+        # format wins over the default (a binary-migrated directory
+        # reopened without the knob must never append JSONL lines into
+        # a binary file); a fresh file defaults to JSONL.
+        on_disk = journal_format(path)
+        self.fmt = fmt or on_disk or "jsonl"
+        if on_disk is not None and self.fmt != on_disk:
+            raise ValueError(
+                f"journal {path} holds the {on_disk!r} format but the "
+                f"writer was constructed with fmt={self.fmt!r} — "
+                f"migration is one-way and explicit "
+                f"(journal.migrate_to_binary), never an append-time "
+                f"rewrite")
+        self._mm: Optional[mmap.mmap] = None
+        self._mm_size = 0
+        self._lock = threading.Lock()
+        if self.fmt == "binary":
+            self._open_binary()
+        else:
+            self._f = open(path, "a", encoding="utf-8")
+            self._written_offset = self._f.tell()
         # Durability watermark.  Pre-existing bytes were fsynced by the
         # writer that produced them (close/rotation/recovery all sync),
         # so the baseline is the current EOF; ``durable_seq`` is None
         # until this instance forces its first fsync (records before
         # this instance are outside its ack window by construction).
-        self._lock = threading.Lock()
-        self._written_offset = self._f.tell()
         self._written_seq: Optional[int] = None
         self._written_records = 0
         self._durable_offset = self._written_offset
         self._durable_seq: Optional[int] = None
         self._durable_records = 0
+        # The EXACT live durability window: one entry per acked-but-not-
+        # yet-forced record (its trailing seq, or None when the record
+        # carried no seq), trimmed as the watermark advances — what
+        # power_loss() reports record-exactly under BOTH flush modes.
+        self._pending_seqs: List[Optional[int]] = []
+        # 1-based lifetime fsync-attempt counter — the ``disk:*`` fault
+        # kind addresses "the N-th fsync this instance attempts", and
+        # the health block reports attempts/failures side by side.
+        self._fsync_attempts = 0
+        self._fsync_lock = threading.Lock()
+        self._disk_fault = _faultinject.disk_fault()
         self._stop = threading.Event()
         self._flush_errors = 0
         self._flusher: Optional[threading.Thread] = None
@@ -191,6 +417,70 @@ class Journal:
                 target=self._flush_loop, daemon=True,
                 name=f"journal-flush:{os.path.basename(path)}")
             self._flusher.start()
+
+    # -- binary fixed-slot backend ------------------------------------
+
+    def _open_binary(self) -> None:
+        """Open (or create) the mmap'd fixed-slot file.  The region is
+        preallocated in ``_BINARY_GROW_SLOTS`` chunks; records append at
+        slot-aligned offsets through the mapping (page-cache durability
+        — exactly what a process SIGKILL preserves, the same contract
+        as the flight ring); close() truncates back to the used bytes
+        so segments and cleanly-closed files are exact-sized."""
+        existed = os.path.exists(self.path) \
+            and os.path.getsize(self.path) > 0
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            self._f = os.fdopen(fd, "r+b", buffering=0)
+        except BaseException:
+            os.close(fd)
+            raise
+        try:
+            # ``self._f`` owns the descriptor from here on — post-open
+            # work addresses it through fileno() and the except arm
+            # closes the owner, which closes the fd.
+            if existed:
+                used, clean = _scan_binary_end(self.path)
+                if not clean:
+                    # Same trust rule as replay: torn bytes are never
+                    # appended after and never silently deleted.
+                    _quarantine_tail(self.path, used, "torn tail record",
+                                     "unterminated binary frame at reopen")
+            else:
+                os.pwrite(self._f.fileno(), _binary_header_slot(), 0)
+                used = BINARY_SLOT_BYTES
+            want = (_slot_ceil(used)
+                    + _BINARY_GROW_SLOTS * BINARY_SLOT_BYTES)
+            size = os.fstat(self._f.fileno()).st_size
+            if size < want:
+                os.ftruncate(self._f.fileno(), want)
+                size = want
+            mm = mmap.mmap(self._f.fileno(), size)
+        except BaseException:
+            self._f.close()
+            raise
+        # Publication happens under the lock only to make the handoff
+        # explicit: the flusher thread does not exist yet (it starts at
+        # the end of __init__), but the invariant "offset fields mutate
+        # under _lock" should not carry an asterisk.
+        with self._lock:
+            self._written_offset = used
+            self._mm = mm
+            self._mm_size = size
+
+    def _write_binary_locked(self, frame: bytes) -> None:
+        """Append one padded record frame through the mapping (caller
+        holds ``_lock``)."""
+        off = self._written_offset
+        end = off + len(frame)
+        if end > self._mm_size:
+            grow = _slot_ceil(end) \
+                + _BINARY_GROW_SLOTS * BINARY_SLOT_BYTES
+            os.ftruncate(self._f.fileno(), grow)
+            self._mm.resize(grow)
+            self._mm_size = grow
+        self._mm[off:end] = frame
+        self._written_offset = end
 
     # -- durability watermark (what a power-style crash provably keeps) --
 
@@ -222,15 +512,56 @@ class Journal:
         with self._lock:
             return self._written_records - self._durable_records
 
+    def health(self) -> Dict[str, Any]:
+        """The journal-health block the metrics artifacts embed:
+        background-flush failures, lifetime fsync attempts, and the
+        checkpoint-lag watermark (acked-but-unforced records/bytes and
+        the written-vs-durable seq pair) — a silently failing fsync
+        thread is visible here BEFORE a crash makes it matter."""
+        with self._lock:
+            return {
+                "format": self.fmt,
+                "flush_mode": self.flush_mode,
+                "flush_errors": self._flush_errors,
+                "fsync_attempts": self._fsync_attempts,
+                "unsynced_records": (self._written_records
+                                     - self._durable_records),
+                "unsynced_bytes": (self._written_offset
+                                   - self._durable_offset),
+                "written_seq": self._written_seq,
+                "durable_seq": self._durable_seq,
+            }
+
+    def _do_fsync(self, fd: int) -> None:
+        """One fsync attempt — THE media barrier both durability paths
+        (inline and background) funnel through, and therefore the one
+        place the ``disk:*`` fault kind applies: when the 1-based
+        lifetime attempt counter matches ``disk:eio@fsyncN`` /
+        ``disk:enospc@fsyncN`` the corresponding OSError is raised
+        instead of syncing.  On Linux fsync(fd) also writes back dirty
+        mmap pages, so the binary backend needs no separate msync."""
+        with self._fsync_lock:
+            self._fsync_attempts += 1
+            n = self._fsync_attempts
+        df = self._disk_fault
+        if df is not None and n == df.fsync:
+            err = _errno.EIO if df.mode == "eio" else _errno.ENOSPC
+            raise OSError(err, f"{os.strerror(err)} "
+                               f"(injected disk fault: fsync #{n})")
+        os.fsync(fd)
+
     def _fsync_locked(self) -> None:
         """fsync + advance the watermark.  Caller holds ``_lock`` —
         the INLINE path only (window bound, sync mode, close): blocking
-        the ack here is the contract, not a stall."""
-        os.fsync(self._f.fileno())
+        the ack here is the contract, not a stall.  An OSError (real or
+        a ``disk:*`` injected one) propagates — the fatal-append
+        contract — WITHOUT advancing the watermark."""
+        self._do_fsync(self._f.fileno())
         self._durable_offset = self._written_offset
         self._durable_seq = self._written_seq
         self._durable_records = self._written_records
         self._unsynced = 0
+        self._pending_seqs.clear()
 
     def _flush_loop(self) -> None:
         """The background group-commit flusher: every
@@ -253,9 +584,15 @@ class Journal:
                 off = self._written_offset
                 seq = self._written_seq
                 recs = self._written_records
+                lag = recs - self._durable_records
                 fd = self._f.fileno()
+            # The checkpoint-lag watermark, exported per tick so the
+            # rqtrace histogram report shows how far behind the media
+            # barrier actually runs (not just that it runs).
+            _telemetry.observe("serving.journal.checkpoint_lag_records",
+                               float(lag))
             try:
-                os.fsync(fd)
+                self._do_fsync(fd)
                 # Counter, not a span: this thread has no trace context
                 # (a span here would start orphan root traces per tick).
                 _telemetry.counter("serving.journal.bg_fsync")
@@ -264,16 +601,23 @@ class Journal:
             except OSError:
                 # A transient fsync failure must not PERMANENTLY void
                 # the advertised time bound: count it (visible via
-                # ``flush_errors``) and retry next tick — the volume
-                # may heal.  A persistent failure still fails loudly:
-                # the window fills, append()'s INLINE fsync raises, and
-                # the runtime's fatal-append contract takes the
-                # process down.
+                # ``flush_errors`` and the metrics journal-health
+                # block) and retry next tick — the volume may heal.  A
+                # persistent failure still fails loudly: the window
+                # fills, append()'s INLINE fsync raises, and the
+                # runtime's fatal-append contract takes the process
+                # down.
                 with self._lock:
                     self._flush_errors += 1
+                _telemetry.counter("serving.journal.flush_error")
                 continue
             with self._lock:
                 if off > self._durable_offset:
+                    # Trim the EXACT pending window by how many records
+                    # this fsync made durable (captured count minus the
+                    # already-durable count — an inline fsync cannot
+                    # have advanced past ``recs`` or we'd skip here).
+                    del self._pending_seqs[:recs - self._durable_records]
                     self._durable_offset = off
                     self._durable_seq = seq
                     self._durable_records = recs
@@ -285,34 +629,78 @@ class Journal:
         """Append one record.  ``seq`` tags the record's LAST applied
         sequence number for the durability watermark (group records pass
         their trailing seq)."""
-        with _telemetry.span("serving.journal.append"):
-            env = _integrity.make_envelope(
-                payload, schema=(JOURNAL_GROUP_SCHEMA if "seqs" in payload
-                                 else JOURNAL_SCHEMA))
-            line = json.dumps(env, separators=(",", ":")) + "\n"
-            with self._lock:
+        with _telemetry.span(self._stage):
+            rec_seq: Optional[int] = None
+            if seq is not None:
+                rec_seq = int(seq)
+            elif "seq" in payload:
+                rec_seq = int(payload["seq"])
+            if self.fmt == "binary":
+                # ONE serialization, crc32 instead of the sha256
+                # envelope: the frame header carries the integrity
+                # check and the trailing seq.
+                body = json.dumps(payload,
+                                  separators=(",", ":")).encode("utf-8")
+                self._commit(_pack_binary_frame(body, rec_seq), None,
+                             rec_seq)
+            else:
+                env = _integrity.make_envelope(
+                    payload,
+                    schema=(JOURNAL_GROUP_SCHEMA if "seqs" in payload
+                            else JOURNAL_SCHEMA))
+                line = json.dumps(env, separators=(",", ":")) + "\n"
+                self._commit(None, line, rec_seq)
+
+    def append_raw(self, body: bytes,
+                   seq: Optional[int] = None) -> None:
+        """Append one PRE-SERIALIZED record body — the exact compact-
+        JSON bytes :meth:`append` would produce.  The replication
+        path's single-serialization contract: the leader encodes a
+        record once and the same bytes land in its own binary journal,
+        on the wire, and in every replica — bit-identical replay by
+        construction, no per-follower re-encode.  A JSONL journal
+        still pays its envelope (the body is parsed back and routed
+        through :meth:`append`); a binary journal frames the bytes
+        directly."""
+        rec_seq = None if seq is None else int(seq)
+        if self.fmt != "binary":
+            payload = json.loads(body.decode("utf-8"))
+            self.append(payload, seq=rec_seq)
+            return
+        with _telemetry.span(self._stage):
+            self._commit(_pack_binary_frame(body, rec_seq), None,
+                         rec_seq)
+
+    def _commit(self, frame: Optional[bytes], line: Optional[str],
+                rec_seq: Optional[int]) -> None:
+        """The locked tail shared by :meth:`append` / :meth:`append_raw`:
+        land the encoded record, advance the watermark bookkeeping, and
+        enforce the flush-mode bound."""
+        with self._lock:
+            if frame is not None:
+                self._write_binary_locked(frame)
+            else:
                 self._f.write(line)
                 self._f.flush()
                 self._written_offset = self._f.tell()
-                self._written_records += 1
-                if seq is not None:
-                    self._written_seq = int(seq)
-                elif "seq" in payload:
-                    self._written_seq = int(payload["seq"])
-                self._unsynced += 1
-                if self.flush_mode == "group":
-                    # The record bound: the ack below may precede the
-                    # fsync by at most max_unflushed_records records —
-                    # when the window is full the append BLOCKS on the
-                    # fsync (the hard bound; the background thread
-                    # normally keeps the window far from full).
-                    if (self._written_records - self._durable_records
-                            >= self.max_unflushed_records):
-                        with _telemetry.span("serving.journal.fsync"):
-                            self._fsync_locked()
-                elif self._unsynced >= self.fsync_every_n:
+            self._written_records += 1
+            if rec_seq is not None:
+                self._written_seq = rec_seq
+            self._pending_seqs.append(rec_seq)
+            self._unsynced += 1
+            if self.flush_mode == "group":
+                # The record bound: the ack below may precede the
+                # fsync by at most max_unflushed_records records —
+                # when the window is full the append BLOCKS on the
+                # fsync (the hard bound; the background thread
+                # normally keeps the window far from full).
+                if (self._written_records - self._durable_records
+                        >= self.max_unflushed_records):
                     with _telemetry.span("serving.journal.fsync"):
                         self._fsync_locked()
+            elif self._unsynced >= self.fsync_every_n:
+                with _telemetry.span("serving.journal.fsync"):
+                    self._fsync_locked()
 
     def sync(self) -> None:
         """Force any group-commit tail to media now (a no-op at
@@ -334,14 +722,29 @@ class Journal:
         afterwards (the caller exits)."""
         with self._lock:
             self._stop.set()
-            self._f.flush()
-            end = self._f.tell()
+            if self._mm is not None:
+                end = self._written_offset
+                self._mm.close()
+                self._mm = None
+            else:
+                self._f.flush()
+                end = self._f.tell()
+            self._f.close()
+            # EXACT accounting under BOTH flush modes: the pending
+            # window (one entry per acked-but-unforced record) is
+            # trimmed precisely as the watermark advances, so count and
+            # seqs here are record-exact — what the chaos soak asserts
+            # against the recovery report.
+            dropped = self._written_records - self._durable_records
+            dropped_seqs = tuple(
+                s for s in self._pending_seqs if s is not None)
             os.truncate(self.path, self._durable_offset)
             return {"path": self.path,
                     "durable_offset": self._durable_offset,
                     "durable_seq": self._durable_seq,
                     "dropped_bytes": end - self._durable_offset,
-                    "dropped_records": self._unsynced}
+                    "dropped_records": dropped,
+                    "dropped_seqs": dropped_seqs}
 
     def close(self) -> None:
         self._stop.set()
@@ -352,6 +755,15 @@ class Journal:
                 if self._written_records > self._durable_records:
                     self._f.flush()
                     self._fsync_locked()
+                if self._mm is not None:
+                    # Exact-size the file (drop the preallocated zero
+                    # tail) so cleanly-closed files and rotated
+                    # segments carry no slack bytes.
+                    self._mm.flush()
+                    self._mm.close()
+                    self._mm = None
+                    os.ftruncate(self._f.fileno(), self._written_offset)
+                    os.fsync(self._f.fileno())
                 self._f.close()
 
     def __enter__(self):
@@ -400,10 +812,68 @@ def _quarantine_tail(path: str, offset: int, reason: str,
 def _replay_file(path: str, quarantine_torn_tail: bool,
                  tail_allowed: bool, record_base: int
                  ) -> Tuple[List[Dict[str, Any]], Optional[Dict[str, Any]]]:
-    """Verify one journal file.  ``tail_allowed`` is True only for the
-    LIVE (unsuffixed) file: a rotated segment was complete and fsynced
-    at rotation, so ANY failure there is real corruption, never a torn
-    append.  ``record_base`` offsets the record index in errors."""
+    """Verify one journal file, dispatching on the sniffed per-file
+    format — a mid-migration tree (JSONL segments + binary live file,
+    or the reverse) replays through one code path."""
+    if journal_format(path) == "binary":
+        return _replay_binary_file(path, quarantine_torn_tail,
+                                   tail_allowed, record_base)
+    return _replay_jsonl_file(path, quarantine_torn_tail,
+                              tail_allowed, record_base)
+
+
+def _replay_binary_file(path: str, quarantine_torn_tail: bool,
+                        tail_allowed: bool, record_base: int
+                        ) -> Tuple[List[Dict[str, Any]],
+                                   Optional[Dict[str, Any]]]:
+    """Binary-format counterpart of :func:`_replay_jsonl_file`: same
+    trust rules (tail tear quarantined, mid-file corruption refused),
+    enforced per slot-aligned frame instead of per line."""
+    with open(path, "rb") as f:
+        data = f.read()
+    records, used, bad = _parse_binary(data)
+    payloads: List[Dict[str, Any]] = []
+    for i, (_off, body, _seq) in enumerate(records):
+        try:
+            payloads.append(json.loads(body.decode("utf-8")))
+        except ValueError as e:
+            raise JournalError(path, record_base + i,
+                               f"undecodable payload (crc32 passed — "
+                               f"writer bug or targeted corruption): "
+                               f"{e}") from e
+    torn_info: Optional[Dict[str, Any]] = None
+    if bad is not None:
+        off, detail = bad
+        if _binary_frame_after(data, off):
+            raise JournalError(
+                path, record_base + len(payloads),
+                f"{detail}, with valid records after it — a torn "
+                f"append can only damage the final frame, so this is "
+                f"mid-file corruption")
+        if not tail_allowed:
+            raise JournalError(path, record_base + len(payloads),
+                               f"{detail} (rotated segments are "
+                               f"complete by construction)")
+        torn_info = {"reason": "torn tail record", "detail": detail,
+                     "records_kept": record_base + len(payloads),
+                     "sidecar": None, "report": None}
+        if quarantine_torn_tail:
+            sidecar, report = _quarantine_tail(
+                path, used, "torn tail record", detail)
+            torn_info["sidecar"] = sidecar
+            torn_info["report"] = report
+    return payloads, torn_info
+
+
+def _replay_jsonl_file(path: str, quarantine_torn_tail: bool,
+                       tail_allowed: bool, record_base: int
+                       ) -> Tuple[List[Dict[str, Any]],
+                                  Optional[Dict[str, Any]]]:
+    """Verify one JSONL journal file.  ``tail_allowed`` is True only
+    for the LIVE (unsuffixed) file: a rotated segment was complete and
+    fsynced at rotation, so ANY failure there is real corruption, never
+    a torn append.  ``record_base`` offsets the record index in
+    errors."""
     payloads: List[Dict[str, Any]] = []
     bad: Optional[Tuple[int, str, str]] = None  # (offset, reason, detail)
     offset = 0
@@ -497,8 +967,13 @@ def rotate(path: str, seq: int) -> Optional[str]:
     ≤ seq, complete by construction: rotation runs right after the
     snapshot at ``seq`` landed, and appends are serialized with it).
     Bounds the live file; :func:`prune_segments` bounds the segments.
-    No-op (returns None) when the live file is missing or empty."""
+    No-op (returns None) when the live file is missing or empty — for
+    the binary format "empty" means header slot only (no record
+    frames)."""
     if not os.path.exists(path) or os.path.getsize(path) == 0:
+        return None
+    if journal_format(path) == "binary" \
+            and os.path.getsize(path) <= BINARY_SLOT_BYTES:
         return None
     seg = f"{path}.{int(seq):012d}"
     os.replace(path, seg)
@@ -526,10 +1001,21 @@ def tear_tail(path: str, keep_bytes: Optional[int] = None) -> dict:
     crash-mid-append shape the ``ingest:torn_journal`` fault kind drives:
     the final line is truncated to half its length (or ``keep_bytes``),
     exactly as if the process died between the ``write`` and the
-    ``fsync`` landing the full line.  Returns what was done, for test
-    assertions.  No randomness: same bytes in, same tear out."""
+    ``fsync`` landing the full line (binary format: the final frame is
+    cut mid-slot).  Returns what was done, for test assertions.  No
+    randomness: same bytes in, same tear out."""
     with open(path, "rb") as f:
         data = f.read()
+    if journal_format(path) == "binary":
+        records, _used, _bad = _parse_binary(data)
+        if not records:
+            raise ValueError(f"cannot tear empty journal {path}")
+        start, body, _seq = records[-1]
+        full = _BINARY_RECORD_HDR.size + len(body)
+        keep = full // 2 if keep_bytes is None else int(keep_bytes)
+        os.truncate(path, start + keep)
+        return {"path": path, "record_offset": start,
+                "record_was": full, "record_now": keep}
     if not data.strip():
         raise ValueError(f"cannot tear empty journal {path}")
     body = data[:-1] if data.endswith(b"\n") else data
@@ -539,3 +1025,53 @@ def tear_tail(path: str, keep_bytes: Optional[int] = None) -> dict:
     os.truncate(path, start + keep)
     return {"path": path, "record_offset": start,
             "record_was": len(last), "record_now": keep}
+
+
+def migrate_to_binary(path: str) -> Dict[str, Any]:
+    """ONE-WAY in-place migration of a JSONL journal tree (rotated
+    segments, then the live file) to the binary fixed-slot format.
+    Each file is fully verified first (no quarantine — a torn or
+    corrupt file REFUSES migration; run recovery to quarantine the
+    tail, then migrate), rewritten beside itself and atomically
+    ``os.replace``d, with the directory fsynced at the end.  Payloads
+    round-trip bit-identically: :func:`replay` of the migrated tree
+    returns the same payload dicts in the same order as before.  There
+    is deliberately no reverse migration — the binary frame does not
+    carry the envelope sha256, so "migrating back" would mint
+    envelopes the original writer never signed."""
+    targets = segment_paths(path)
+    if os.path.exists(path) and os.path.getsize(path) > 0:
+        targets.append(path)
+    migrated: List[str] = []
+    total = 0
+    for p in targets:
+        if journal_format(p) == "binary":
+            continue  # idempotent re-run / mixed tree
+        recs, torn = _replay_file(p, quarantine_torn_tail=False,
+                                  tail_allowed=(p == path),
+                                  record_base=0)
+        if torn is not None:
+            raise ValueError(
+                f"refusing to migrate {p}: torn tail present "
+                f"({torn['detail']}) — recover first, then migrate")
+        tmp = p + ".migrate"
+        with open(tmp, "wb") as f:
+            f.write(_binary_header_slot())
+            for payload in recs:
+                body = json.dumps(
+                    payload, separators=(",", ":")).encode("utf-8")
+                f.write(_pack_binary_frame(
+                    body, _payload_trailing_seq(payload)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+        migrated.append(p)
+        total += len(recs)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                  os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return {"path": path, "format": "binary", "migrated": migrated,
+            "records": total}

@@ -1,0 +1,704 @@
+"""Quorum-replicated group commit: durability as a NETWORK property.
+
+PR 13 left the serving journal disk-bound: even async group commit
+(``flush_mode="group"``) keeps ``serving.journal.append`` near half the
+round wall, and its ack contract is "bounded loss window", not "no
+loss".  This module moves the durability point off the disk entirely: a
+:class:`ReplicatedJournal` streams every record to R follower peers over
+the PR 11 socket transport (same token hello, same frame protocol, same
+error taxonomy), and :meth:`ReplicatedJournal.append` acknowledges once
+a QUORUM of followers confirm in-memory receipt.  The local fsync is
+demoted to a lagging background checkpoint — each follower runs its own
+group-commit journal and reports its durable watermark back inside every
+ack, so the leader always knows the weakest checkpoint in the group.
+
+The ack contract (the "quorum" tier of
+``journal.durability_info``): an acked record is held by >= quorum+1
+processes (leader included) at ack time, so ANY single-node death —
+SIGKILL, OOM-kill, machine crash of one host — loses nothing: the
+survivors re-seed the leader journal through
+:func:`heal_from_replicas` before recovery replays.  A record is LOST
+iff every holder died before its lagging checkpoint landed — and
+recovery reports exactly that set, never a superset
+(``RecoveryInfo.lost_acked_seqs`` stays exact).
+
+Degradation is never silent and never weakens the ack:
+
+- **dead follower** (EOF / SIGKILL): quorum shrinks to the survivors;
+  if the survivors still reach quorum, acks continue at network speed.
+- **partition / slow follower** (no ack before ``ack_timeout_s``): the
+  straggler is demoted from the quorum set ("re-election" of the
+  voting group) and re-admitted only when its acks catch back up.
+- **quorum unmeetable**: append falls back to the INLINE local fsync —
+  the ack means "on my disk" again (sync tier) rather than pretending
+  the network still backs it.  ``degraded_appends`` counts every such
+  fallback; the metrics journal-health block surfaces it.
+
+The ``repl:*`` chaos kinds (``runtime.faultinject``) drive each path
+deterministically on CPU CI: ``repl:kill@peerK[,batchN]`` SIGKILLs (or,
+for thread followers, hard-closes) peer K at batch N,
+``repl:partition@peerK[,batchN]`` drops the leader<->K link both ways,
+``repl:slow@peerK[,batchN]`` makes follower K sleep past the ack
+deadline from batch N on.
+
+Followers run in-process (threads — the deterministic CI default) or as
+real subprocesses (``python -m redqueen_tpu.serving.replication`` — the
+SIGKILL chaos target); both execute the same serve loop against the
+same per-follower :class:`~redqueen_tpu.serving.journal.Journal`, and
+the cluster token travels via ``RQ_WORKER_TOKEN`` (environment, never
+argv).  Stdlib only; safe to import before jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..runtime import faultinject as _faultinject
+from ..runtime import telemetry as _telemetry
+from . import transport as _transport
+from .journal import JOURNAL_FILENAME, Journal
+from .journal import replay as _journal_replay
+
+__all__ = ["ReplicatedJournal", "heal_from_replicas", "follower_main",
+           "REPLICA_DIR_PREFIX"]
+
+#: Follower k's storage directory under the replica root:
+#: ``<replica_root>/<REPLICA_DIR_PREFIX><k>/journal.jsonl``.
+REPLICA_DIR_PREFIX = "replica"
+
+# Frame kinds of the replication sub-protocol (rides the PR 11 frame
+# transport verbatim; the hello frame is transport.HELLO_KIND with
+# shard == peer index).
+_KIND_APPEND = "repl.append"
+_KIND_ACK = "repl.ack"
+_KIND_ROTATE = "repl.rotate"
+_KIND_CLOSE = "repl.close"
+_KIND_BYE = "repl.bye"
+
+#: How long a ``repl:slow`` follower sleeps per poisoned batch — chosen
+#: to overshoot any reasonable ``ack_timeout_s`` so the demotion path is
+#: deterministic on CI.
+_SLOW_SLEEP_S = 0.5
+
+#: Replica checkpoint cadence: the background fsync bound of a follower
+#: journal (records / ms).  Deliberately much wider than a leader
+#: journal's group window — the quorum ack certifies RECEIPT (mmap /
+#: page cache), and the checkpoint is only the lagging fsync whose
+#: watermark rides back on acks, so a wide bound costs nothing in ack
+#: durability while keeping R followers from turning one disk into an
+#: fsync storm.
+CHECKPOINT_EVERY_N = 512
+CHECKPOINT_DELAY_MS = 200.0
+
+#: One combined-select slice of the leader's ack drain — short enough
+#: that ``_await_quorum`` re-checks its deadline promptly, long enough
+#: that a blocked leader yields the core to its follower threads.
+_ACK_POLL_S = 0.005
+
+
+def _write_all(fd: int, data: bytes) -> None:
+    """Complete a possibly-short write (one writer per socket by
+    construction, same as ``transport.write_frame``)."""
+    view = memoryview(data)
+    while view:
+        view = view[os.write(fd, view):]
+
+
+def _replica_dir(root: str, peer: int) -> str:
+    return os.path.join(root, f"{REPLICA_DIR_PREFIX}{int(peer)}")
+
+
+class _FollowerLink:
+    """Leader-side state for one follower peer."""
+
+    def __init__(self, idx: int, dir: str):
+        self.idx = int(idx)
+        self.dir = dir
+        self.conn = None            # connected socket
+        self.reader: Optional[_transport.FrameReader] = None
+        self.thread: Optional[threading.Thread] = None
+        self.proc: Optional[subprocess.Popen] = None
+        self.live = False
+        self.partitioned = False
+        self.lagging = False
+        self.acked_n = 0            # highest replication batch acked
+        self.checkpoint_seq: Optional[int] = None  # follower durable seq
+
+    def voting(self) -> bool:
+        """In the current quorum set: alive, reachable, keeping up."""
+        return self.live and not self.partitioned and not self.lagging
+
+    def describe(self) -> Dict[str, Any]:
+        return {"peer": self.idx, "live": self.live,
+                "partitioned": self.partitioned, "lagging": self.lagging,
+                "acked_batches": self.acked_n,
+                "checkpoint_seq": self.checkpoint_seq,
+                "process": bool(self.proc is not None)}
+
+
+class ReplicatedJournal:
+    """A :class:`~redqueen_tpu.serving.journal.Journal` whose ack point
+    is a follower quorum instead of an fsync.
+
+    Drop-in for the places the serving runtime touches its journal
+    (``append``/``sync``/``close``/``path``/``flush_errors``/
+    ``durable_seq``/``unsynced``/``health``/``power_loss``), plus the
+    replication surface (``followers``, ``degraded_appends``,
+    ``min_checkpoint_seq``).  The local journal runs in ``group`` mode
+    regardless of the requested flush knobs — the background flusher IS
+    the lagging checkpoint; the requested mode only shapes the fallback
+    tier when quorum is unmeetable."""
+
+    def __init__(self, path: str, factor: int, quorum: Optional[int] = None,
+                 replica_root: Optional[str] = None,
+                 mode: str = "thread",
+                 token: Optional[str] = None,
+                 ack_timeout_s: float = 1.0,
+                 fsync_every_n: int = 1,
+                 max_unflushed_records: int = 64,
+                 max_flush_delay_ms: float = 50.0,
+                 fmt: Optional[str] = None,
+                 clock=time.monotonic):
+        if int(factor) < 1:
+            raise ValueError(f"replication factor must be >= 1, got "
+                             f"{factor}")
+        if mode not in ("thread", "process"):
+            raise ValueError(f"mode must be 'thread' or 'process', got "
+                             f"{mode!r}")
+        self.factor = int(factor)
+        self.quorum = (self.factor // 2 + 1 if quorum is None
+                       else int(quorum))
+        if not 1 <= self.quorum <= self.factor:
+            raise ValueError(
+                f"quorum must be in [1, factor={self.factor}], got "
+                f"{self.quorum}")
+        self.mode = mode
+        self.ack_timeout_s = float(ack_timeout_s)
+        self._clock = clock
+        self._jkw = dict(fsync_every_n=fsync_every_n,
+                         flush_mode="group",
+                         max_unflushed_records=max_unflushed_records,
+                         max_flush_delay_ms=max_flush_delay_ms,
+                         fmt=fmt)
+        self._local = Journal(path, **self._jkw)
+        self.path = path
+        self.fmt = self._local.fmt
+        self.replica_root = (replica_root
+                             or os.path.join(os.path.dirname(path)
+                                             or ".", "replicas"))
+        # The token gates accidental cross-talk exactly like the worker
+        # transport; generated fresh when not supplied and handed to
+        # follower subprocesses via the environment, never argv.
+        self._token = token or os.urandom(16).hex()
+        self._fault = _faultinject.repl_fault()
+        self._n = 0                       # 1-based replication batch
+        self.degraded_appends = 0
+        self.quorum_appends = 0
+        self._followers: List[_FollowerLink] = []
+        self._closed = False
+        try:
+            self._start_followers(fmt)
+        except BaseException:
+            self.close()
+            raise
+
+    # -- follower lifecycle -------------------------------------------
+
+    def _start_followers(self, fmt: Optional[str]) -> None:
+        for k in range(self.factor):
+            st = _FollowerLink(k, _replica_dir(self.replica_root, k))
+            os.makedirs(st.dir, exist_ok=True)
+            with _transport.Listener() as lst:
+                if self.mode == "process":
+                    env = os.environ.copy()
+                    env[_transport.ENV_WORKER_TOKEN] = self._token
+                    env["RQ_SERVING_WORKER"] = "1"
+                    st.proc = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "redqueen_tpu.serving.replication",
+                         "--connect", lst.address, "--peer", str(k),
+                         "--dir", st.dir]
+                        + (["--fmt", fmt] if fmt else []),
+                        env=env)
+                else:
+                    st.thread = threading.Thread(
+                        target=_follower_serve_addr,
+                        args=(lst.address, k, st.dir, self._token, fmt),
+                        daemon=True, name=f"repl-follower:{k}")
+                    st.thread.start()
+                st.conn, _hello, st.reader = lst.accept(
+                    self._token, expect_shard=k, timeout_s=30.0)
+            st.live = True
+            self._followers.append(st)
+
+    def _drop(self, st: _FollowerLink, kill: bool = False) -> None:
+        """Tear down one follower link (and, for ``kill``, the follower
+        itself: SIGKILL for a process, hard socket close for a thread —
+        its flushed bytes survive either way, which is the point)."""
+        st.live = False
+        if kill and st.proc is not None:
+            try:
+                st.proc.kill()
+            except OSError:
+                pass
+        if st.conn is not None:
+            try:
+                st.conn.close()
+            except OSError:
+                pass
+            st.conn = None
+
+    def _apply_leader_faults(self) -> None:
+        f = self._fault
+        if f is None or self._n != (f.batch or 1):
+            return
+        if f.mode == "kill" and 0 <= f.peer < len(self._followers):
+            self._drop(self._followers[f.peer], kill=True)
+        elif f.mode == "partition" and 0 <= f.peer < len(self._followers):
+            # The link is down BOTH ways: nothing sent, acks ignored.
+            # The follower process/thread stays alive with everything
+            # it already holds — that is what distinguishes a partition
+            # from a death when the loss accounting runs.
+            self._followers[f.peer].partitioned = True
+
+    # -- the replicated append path -----------------------------------
+
+    def append(self, payload: Dict[str, Any],
+               seq: Optional[int] = None) -> None:
+        """Local group-commit write (page cache, no fsync), then
+        broadcast + quorum wait.  Returns when either (a) >= quorum
+        followers acked batch ``n`` — the quorum-tier ack — or (b) the
+        quorum was unmeetable / timed out and the local journal was
+        INLINE-fsynced instead (degraded tier; counted, surfaced,
+        never silent).
+
+        Single-serialization contract: the record is encoded ONCE here;
+        the same bytes land in the leader's binary journal
+        (``append_raw``), ride the wire as an out-of-band body after a
+        small header frame, and land in every replica — so replication
+        cost does not scale the Python encode with the factor, and
+        replica replay is bit-identical by construction.
+
+        The leader finishes its own journal write BEFORE broadcasting:
+        waking the follower threads first looks like overlap but on a
+        small box it just schedules them against the leader's own mmap
+        copy — measured slower than letting the leader finish and then
+        yield the core for the whole quorum wait."""
+        if seq is None and "seq" in payload:
+            seq = int(payload["seq"])
+        body = json.dumps(payload,
+                          separators=(",", ":")).encode("utf-8")
+        if self.fmt == "binary":
+            self._local.append_raw(body, seq=seq)
+        else:
+            self._local.append(payload, seq=seq)
+        self._n += 1
+        n = self._n
+        self._apply_leader_faults()
+        with _telemetry.span("serving.repl.quorum") as tsp:
+            blob = _transport.encode_frame(
+                {"kind": _KIND_APPEND, "n": n, "seq": seq,
+                 "body_len": len(body)}) + body
+            for st in self._followers:
+                if st.live and not st.partitioned:
+                    try:
+                        _write_all(st.conn.fileno(), blob)
+                    except (OSError, _transport.TransportError):
+                        self._drop(st)
+            ok = self._await_quorum(n)
+            tsp.set(n=n, quorum=int(ok))
+        if ok:
+            self.quorum_appends += 1
+            return
+        # Quorum unmeetable: the ack must not weaken — fall back to the
+        # sync tier for THIS record (and every one after, until the
+        # group heals).
+        self.degraded_appends += 1
+        _telemetry.counter("serving.repl.degraded_append")
+        with _telemetry.span("serving.journal.fsync"):
+            self._local.sync()
+
+    def _await_quorum(self, n: int) -> bool:
+        deadline = self._clock() + self.ack_timeout_s
+        while True:
+            votes = sum(1 for st in self._followers
+                        if st.voting() and st.acked_n >= n)
+            if votes >= self.quorum:
+                for st in self._followers:
+                    # Re-admission: a demoted straggler that caught
+                    # back up rejoins the quorum set.
+                    if st.lagging and st.live and st.acked_n >= n:
+                        st.lagging = False
+                return True
+            if not any(st.voting() and st.acked_n < n
+                       for st in self._followers):
+                # Nobody left who could still supply a vote.
+                self._demote_stragglers(n)
+                return False
+            if self._clock() >= deadline:
+                self._demote_stragglers(n)
+                return False
+            self._drain_acks()
+
+    def _demote_stragglers(self, n: int) -> None:
+        for st in self._followers:
+            if st.live and not st.partitioned and st.acked_n < n:
+                st.lagging = True
+
+    def _drain_acks(self) -> None:
+        """Serve already-buffered acks, then ONE ``select`` across every
+        live follower fd — never a serialized per-follower blocking
+        read.  With Q < R the quorum is made by whichever follower
+        answers FIRST; a per-fd timeout poll makes that fast ack wait
+        out the slow peer's whole slice (measured: that serialized wait
+        was most of the quorum tier's gap vs the PR 11 config at the
+        socket-cluster placement on a one-core box)."""
+        pending: Dict[int, _FollowerLink] = {}
+        progressed = False
+        for st in self._followers:
+            if not st.live or st.reader is None or st.partitioned:
+                continue
+            before = st.acked_n
+            if self._pump_acks(st):
+                pending[st.conn.fileno()] = st
+                progressed = progressed or st.acked_n > before
+        if progressed or not pending:
+            # The non-blocking pre-pass already advanced a watermark:
+            # hand control straight back to the vote check instead of
+            # sleeping a full select slice on sockets that just spoke.
+            return
+        try:
+            ready, _, _ = select.select(list(pending), [], [],
+                                        _ACK_POLL_S)
+        except (OSError, ValueError):
+            # An fd torn down under the select: let the per-follower
+            # reads below classify which one died.
+            ready = list(pending)
+        for fd in ready:
+            self._pump_acks(pending[fd])
+
+    def _pump_acks(self, st: "_FollowerLink") -> bool:
+        """Non-blocking: decode every ack frame this follower already
+        delivered.  False if the follower was dropped."""
+        while True:
+            try:
+                frame = st.reader.read_frame(timeout_s=0.0)
+            except _transport.TransportTimeout:
+                return True
+            except (_transport.TransportError, OSError):
+                self._drop(st)
+                return False
+            if frame.get("kind") == _KIND_ACK:
+                st.acked_n = max(st.acked_n, int(frame.get("n", 0)))
+                cp = frame.get("checkpoint_seq")
+                if cp is not None:
+                    st.checkpoint_seq = int(cp)
+
+    # -- Journal-compatible surface -----------------------------------
+
+    @property
+    def flush_mode(self) -> str:
+        return self._local.flush_mode
+
+    @property
+    def flush_errors(self) -> int:
+        return self._local.flush_errors
+
+    @property
+    def durable_seq(self) -> Optional[int]:
+        return self._local.durable_seq
+
+    @property
+    def unsynced(self) -> int:
+        return self._local.unsynced
+
+    def followers(self) -> List[Dict[str, Any]]:
+        return [st.describe() for st in self._followers]
+
+    def min_checkpoint_seq(self) -> Optional[int]:
+        """The weakest LAGGING CHECKPOINT in the group (leader's
+        durable seq included): everything at or below it is on media
+        somewhere even if every process dies."""
+        seqs = [st.checkpoint_seq for st in self._followers
+                if st.live and st.checkpoint_seq is not None]
+        mine = self._local.durable_seq
+        if mine is not None:
+            seqs.append(mine)
+        return min(seqs) if seqs else None
+
+    def health(self) -> Dict[str, Any]:
+        out = self._local.health()
+        out["replication"] = {
+            "factor": self.factor, "quorum": self.quorum,
+            "mode": self.mode,
+            "quorum_appends": self.quorum_appends,
+            "degraded_appends": self.degraded_appends,
+            "min_checkpoint_seq": self.min_checkpoint_seq(),
+            "followers": self.followers(),
+        }
+        return out
+
+    def sync(self) -> None:
+        self._local.sync()
+
+    def rotate_local(self, seq: int,
+                     oldest_retained_seq: Optional[int] = None) -> None:
+        """Snapshot-time rotation, replication-aware: rotate + prune
+        the LOCAL live journal while KEEPING the follower links up (the
+        naive close-and-reconstruct would respawn the whole follower
+        group per snapshot), and tell each live follower to rotate its
+        replica in stream order — the replica trees stay bounded by the
+        same retained-snapshot window as the leader's."""
+        from . import journal as _journal_mod
+
+        self._local.close()
+        _journal_mod.rotate(self.path, seq)
+        if oldest_retained_seq is not None:
+            _journal_mod.prune_segments(self.path, oldest_retained_seq)
+        self._local = Journal(self.path, **self._jkw)
+        frame = {"kind": _KIND_ROTATE, "seq": int(seq),
+                 "prune": (None if oldest_retained_seq is None
+                           else int(oldest_retained_seq))}
+        for st in self._followers:
+            if st.live and not st.partitioned:
+                try:
+                    _transport.write_frame(st.conn.fileno(), frame)
+                except (OSError, _transport.TransportError):
+                    self._drop(st)
+
+    def power_loss(self) -> Dict[str, Any]:
+        """Leader node death: the leader's unflushed window evaporates
+        (``Journal.power_loss``) and its links drop — but the FOLLOWERS
+        and their directories survive, which is exactly what
+        :func:`heal_from_replicas` consumes.  The returned dict adds
+        ``replica_dirs`` (the surviving holders) to the local report."""
+        for st in self._followers:
+            self._drop(st)
+        info = self._local.power_loss()
+        info["replica_dirs"] = [st.dir for st in self._followers]
+        self._closed = True
+        return info
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for st in self._followers:
+            if st.live and st.conn is not None and not st.partitioned:
+                try:
+                    _transport.write_frame(st.conn.fileno(),
+                                           {"kind": _KIND_CLOSE})
+                    st.reader.read_frame(timeout_s=2.0)
+                except (_transport.TransportError, OSError):
+                    pass
+            self._drop(st)
+            if st.thread is not None:
+                st.thread.join(timeout=5.0)
+            if st.proc is not None:
+                try:
+                    st.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    st.proc.kill()
+                    st.proc.wait(timeout=5.0)
+        self._local.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Follower side (one serve loop for threads AND subprocesses)
+# ---------------------------------------------------------------------------
+
+def _follower_serve_addr(address: str, peer: int, dir: str,
+                         token: str, fmt: Optional[str]) -> None:
+    """Dial the leader and serve (the thread-mode entry)."""
+    sock = _transport.connect_worker(address, shard=peer, token=token)
+    try:
+        _follower_serve(sock, peer, dir, fmt)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _follower_serve(sock, peer: int, dir: str,
+                    fmt: Optional[str]) -> None:
+    """The follower loop: hold every streamed record (page cache via a
+    group-mode journal — in-memory receipt that a later SIGKILL of this
+    process does NOT evaporate), ack immediately, checkpoint lazily.
+    The ack carries this follower's durable watermark — the
+    peer-exchanged checkpoint the leader aggregates."""
+    fault = _faultinject.repl_fault()
+    # The replica checkpoint is the LAGGING leg of the quorum tier:
+    # receipt (mmap/page cache) is what the ack certifies, so the
+    # background fsync can run at a much wider cadence than a leader
+    # journal without weakening the contract — and a tight cadence
+    # makes R followers per leader into an fsync storm on one disk.
+    journal = Journal(os.path.join(dir, JOURNAL_FILENAME),
+                      flush_mode="group", fmt=fmt,
+                      max_unflushed_records=CHECKPOINT_EVERY_N,
+                      max_flush_delay_ms=CHECKPOINT_DELAY_MS,
+                      stage="serving.repl.replica.append")
+    reader = _transport.FrameReader(sock.fileno())
+    try:
+        while True:
+            try:
+                frame = reader.read_frame(timeout_s=0.25)
+            except _transport.TransportTimeout:
+                continue
+            except (_transport.TransportError, OSError):
+                return  # leader gone: keep what we hold, exit
+            kind = frame.get("kind")
+            if kind == _KIND_APPEND:
+                n = int(frame.get("n", 0))
+                # Out-of-band body: the leader's single serialization
+                # of the record, read BEFORE any injected slowness so
+                # the stream stays frame-aligned.
+                body = None
+                if "body_len" in frame:
+                    try:
+                        body = reader.read_bytes(
+                            int(frame["body_len"]), timeout_s=30.0)
+                    except (_transport.TransportError, OSError):
+                        return
+                if (fault is not None and fault.mode == "slow"
+                        and fault.peer == peer
+                        and n >= (fault.batch or 1)):
+                    time.sleep(_SLOW_SLEEP_S)
+                seq = frame.get("seq")
+                seq = None if seq is None else int(seq)
+                if body is not None:
+                    journal.append_raw(body, seq=seq)
+                else:
+                    journal.append(frame["payload"], seq=seq)
+                try:
+                    _transport.write_frame(
+                        sock.fileno(),
+                        {"kind": _KIND_ACK, "n": n,
+                         "checkpoint_seq": journal.durable_seq})
+                except (OSError, _transport.TransportError):
+                    return
+            elif kind == _KIND_ROTATE:
+                # In stream order by construction (one frame channel),
+                # so every later append lands in the fresh live file —
+                # the replica's segment boundaries mirror the leader's.
+                from . import journal as _journal_mod
+                journal.close()
+                _journal_mod.rotate(journal.path, int(frame["seq"]))
+                if frame.get("prune") is not None:
+                    _journal_mod.prune_segments(journal.path,
+                                                int(frame["prune"]))
+                journal = Journal(journal.path, flush_mode="group",
+                                  fmt=fmt,
+                                  max_unflushed_records=CHECKPOINT_EVERY_N,
+                                  max_flush_delay_ms=CHECKPOINT_DELAY_MS,
+                                  stage="serving.repl.replica.append")
+            elif kind == _KIND_CLOSE:
+                try:
+                    _transport.write_frame(sock.fileno(),
+                                           {"kind": _KIND_BYE})
+                except (OSError, _transport.TransportError):
+                    pass
+                return
+    finally:
+        # Thread mode reaches here on leader EOF/close — the journal
+        # fsync is a bonus over the page-cache guarantee.  A real
+        # SIGKILL (process mode) never runs this, by design.
+        journal.close()
+
+
+def follower_main(argv: Optional[List[str]] = None) -> int:
+    """Subprocess entry (``python -m redqueen_tpu.serving.replication
+    --connect HOST:PORT --peer K --dir DIR [--fmt binary]``).  The
+    token is read from ``RQ_WORKER_TOKEN`` — never argv."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="redqueen_tpu.serving.replication")
+    ap.add_argument("--connect", required=True)
+    ap.add_argument("--peer", type=int, required=True)
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--fmt", default=None)
+    args = ap.parse_args(argv)
+    token = os.environ.get(_transport.ENV_WORKER_TOKEN)
+    if not token:
+        raise SystemExit(
+            f"{_transport.ENV_WORKER_TOKEN} must be set in the "
+            f"environment (the token never travels via argv)")
+    os.makedirs(args.dir, exist_ok=True)
+    _follower_serve_addr(args.connect, args.peer, args.dir, token,
+                         args.fmt)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Healing: surviving holders re-seed the leader journal
+# ---------------------------------------------------------------------------
+
+def heal_from_replicas(path: str, replica_dirs: List[str],
+                       fmt: Optional[str] = None) -> Dict[str, Any]:
+    """After a leader node death: re-append every acked record that
+    survives ONLY on follower replicas, so the subsequent
+    ``service.recover`` replays it like any other journal record and
+    ``RecoveryInfo.lost_acked_seqs`` shrinks to the records EVERY
+    holder lost — the exact quorum-loss accounting.
+
+    Records are keyed by their trailing applied seq (records the stream
+    never tagged with a seq cannot be identified across holders and are
+    skipped — the serving runtime always tags).  Two holders presenting
+    DIFFERENT payloads for the same seq is corruption, not healing
+    material: that raises.  Returns ``{"healed_seqs", "holders",
+    "leader_tail_seq"}``."""
+    from .journal import _payload_trailing_seq
+
+    leader_recs, _ = _journal_replay(path, quarantine_torn_tail=True)
+    leader_tail = -1
+    for rec in leader_recs:
+        t = _payload_trailing_seq(rec)
+        if t is not None:
+            leader_tail = max(leader_tail, t)
+    candidates: Dict[int, Dict[str, Any]] = {}
+    holders: Dict[int, List[str]] = {}
+    for rdir in replica_dirs:
+        rpath = os.path.join(rdir, JOURNAL_FILENAME)
+        if not os.path.exists(rpath):
+            continue
+        recs, _ = _journal_replay(rpath, quarantine_torn_tail=False)
+        for rec in recs:
+            tail = _payload_trailing_seq(rec)
+            if tail is None:
+                continue
+            if tail <= leader_tail:
+                continue
+            if tail in candidates and candidates[tail] != rec:
+                raise RuntimeError(
+                    f"replica holders disagree on the record ending at "
+                    f"seq {tail} ({rdir} vs {holders[tail]}) — "
+                    f"refusing to heal from inconsistent replicas")
+            candidates[tail] = rec
+            holders.setdefault(tail, []).append(rdir)
+    healed: List[int] = []
+    if candidates:
+        with Journal(path, fmt=fmt) as j:
+            for tail in sorted(candidates):
+                j.append(candidates[tail], seq=tail)
+                healed.append(tail)
+            j.sync()
+    return {"healed_seqs": healed,
+            "holders": {s: holders[s] for s in healed},
+            "leader_tail_seq": leader_tail}
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    sys.exit(follower_main())

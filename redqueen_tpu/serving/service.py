@@ -96,7 +96,15 @@ class RecoveryInfo(NamedTuple):
     # window a power-style crash actually consumed.  Non-empty only when
     # the caller told recover() its ack high-water mark (``acked_seq``);
     # the source's retransmit past ``recovered_seq`` heals exactly these.
+    # Under quorum replication this is the EXACT quorum-loss set: with
+    # ``heal_replicas`` the surviving holders re-seed the journal before
+    # replay, so a seq appears here iff EVERY holder died before its
+    # lagging checkpoint.
     lost_acked_seqs: Tuple[int, ...] = ()
+    # Seqs re-seeded from surviving replica holders before replay
+    # (``heal_replicas``): records the leader's disk lost but the quorum
+    # kept — present in the recovered carry, absent from the loss set.
+    healed_seqs: Tuple[int, ...] = ()
 
 
 #: Smallest padded dispatch width the live apply paths use: pad widths
@@ -145,6 +153,11 @@ class ServingRuntime:
                  fsync_every_n: int = 1, flush_mode: str = "sync",
                  max_unflushed_records: int = 64,
                  max_flush_delay_ms: float = 50.0, coalesce: int = 1,
+                 journal_format: Optional[str] = None,
+                 replication_factor: int = 0,
+                 replication_quorum: Optional[int] = None,
+                 replication_mode: str = "thread",
+                 replication_ack_timeout_s: float = 1.0,
                  clock=time.monotonic,
                  _state: Optional[FeedState] = None):
         import jax.numpy as jnp
@@ -189,6 +202,15 @@ class ServingRuntime:
         if int(coalesce) < 1:
             raise ValueError(f"coalesce must be >= 1, got {coalesce}")
         self.coalesce = int(coalesce)
+        if int(replication_factor) < 0:
+            raise ValueError(f"replication_factor must be >= 0, got "
+                             f"{replication_factor}")
+        self.journal_format = journal_format
+        self.replication_factor = int(replication_factor)
+        self.replication_quorum = (None if replication_quorum is None
+                                   else int(replication_quorum))
+        self.replication_mode = str(replication_mode)
+        self.replication_ack_timeout_s = float(replication_ack_timeout_s)
         self._clock = clock
         self._s_sink = jnp.asarray(s, jnp.float32)
         self._q = jnp.asarray(self.q, jnp.float32)
@@ -238,6 +260,15 @@ class ServingRuntime:
                 "max_unflushed_records": self.max_unflushed_records,
                 "max_flush_delay_ms": self.max_flush_delay_ms,
                 "coalesce": self.coalesce,
+                # Same non-identity class: the journal encoding and the
+                # replication group shape where/when records persist,
+                # never what they say — replay is format-sniffing and
+                # quorum is an ack property, so both are recorded for
+                # recover() but excluded from the refusal below.
+                "journal_format": self.journal_format,
+                "replication_factor": self.replication_factor,
+                "replication_quorum": self.replication_quorum,
+                "replication_mode": self.replication_mode,
             }
             if os.path.exists(cfg_path):
                 # The stored config is the directory's identity: the
@@ -264,12 +295,26 @@ class ServingRuntime:
             else:
                 _integrity.write_json(cfg_path, cfg,
                                       schema=CONFIG_SCHEMA)
-            self._journal = Journal(
-                os.path.join(dir, _JOURNAL),
-                fsync_every_n=self.fsync_every_n,
-                flush_mode=self.flush_mode,
-                max_unflushed_records=self.max_unflushed_records,
-                max_flush_delay_ms=self.max_flush_delay_ms)
+            if self.replication_factor >= 1:
+                from .replication import ReplicatedJournal
+                self._journal = ReplicatedJournal(
+                    os.path.join(dir, _JOURNAL),
+                    factor=self.replication_factor,
+                    quorum=self.replication_quorum,
+                    mode=self.replication_mode,
+                    ack_timeout_s=self.replication_ack_timeout_s,
+                    fsync_every_n=self.fsync_every_n,
+                    max_unflushed_records=self.max_unflushed_records,
+                    max_flush_delay_ms=self.max_flush_delay_ms,
+                    fmt=self.journal_format)
+            else:
+                self._journal = Journal(
+                    os.path.join(dir, _JOURNAL),
+                    fsync_every_n=self.fsync_every_n,
+                    flush_mode=self.flush_mode,
+                    max_unflushed_records=self.max_unflushed_records,
+                    max_flush_delay_ms=self.max_flush_delay_ms,
+                    fmt=self.journal_format)
 
     # ---- ingest path ----
 
@@ -756,18 +801,25 @@ class ServingRuntime:
             _checkpoint.save(snap_dir, seq, self._state)
             self._since_snapshot = 0
             if self._journal is not None:
-                path = self._journal.path
-                self._journal.close()
-                _journal_mod.rotate(path, seq)
                 steps = [int(n) for n in os.listdir(snap_dir)
                          if n.isdigit()]
-                if steps:
-                    _journal_mod.prune_segments(path, min(steps))
-                self._journal = Journal(
-                    path, fsync_every_n=self.fsync_every_n,
-                    flush_mode=self.flush_mode,
-                    max_unflushed_records=self.max_unflushed_records,
-                    max_flush_delay_ms=self.max_flush_delay_ms)
+                oldest = min(steps) if steps else None
+                if hasattr(self._journal, "rotate_local"):
+                    # Replicated: rotate leader + replicas in stream
+                    # order, keeping the follower group attached.
+                    self._journal.rotate_local(seq, oldest)
+                else:
+                    path = self._journal.path
+                    self._journal.close()
+                    _journal_mod.rotate(path, seq)
+                    if oldest is not None:
+                        _journal_mod.prune_segments(path, oldest)
+                    self._journal = Journal(
+                        path, fsync_every_n=self.fsync_every_n,
+                        flush_mode=self.flush_mode,
+                        max_unflushed_records=self.max_unflushed_records,
+                        max_flush_delay_ms=self.max_flush_delay_ms,
+                        fmt=self.journal_format)
         return seq
 
     def durability(self) -> Dict[str, Any]:
@@ -778,9 +830,16 @@ class ServingRuntime:
         definition)."""
         from .journal import durability_info
 
+        repl = None
+        if self.replication_factor >= 1:
+            repl = {"factor": self.replication_factor,
+                    "quorum": (self.replication_quorum
+                               if self.replication_quorum is not None
+                               else self.replication_factor // 2 + 1)}
         return durability_info(self.flush_mode, self.fsync_every_n,
                                self.max_unflushed_records,
-                               self.max_flush_delay_ms, self.coalesce)
+                               self.max_flush_delay_ms, self.coalesce,
+                               replication=repl)
 
     def write_metrics(self, path: Optional[str] = None) -> Dict[str, Any]:
         """The ``rq.serving.metrics/1`` artifact (defaults into the
@@ -794,6 +853,13 @@ class ServingRuntime:
             extra={"n_feeds": self.n_feeds, "q": self.q,
                    "applied_seq": self.applied_seq,
                    "durability": self.durability(),
+                   # The journal-health block (flush_errors, fsync
+                   # attempts, checkpoint-lag watermark, replication
+                   # follower states): a silently failing fsync thread
+                   # or a lagging checkpoint is visible in every
+                   # metrics artifact BEFORE a crash makes it matter.
+                   "journal": (None if self._journal is None
+                               else self._journal.health()),
                    "health_sick_edges": int(np.count_nonzero(
                        np.asarray(self._state.health)))})
 
@@ -855,7 +921,8 @@ def _record_batches(rec: Dict[str, Any]
 
 
 def recover(dir: str, clock=time.monotonic,
-            acked_seq: Optional[int] = None
+            acked_seq: Optional[int] = None,
+            heal_replicas: Optional[List[str]] = None
             ) -> Tuple[ServingRuntime, RecoveryInfo]:
     """Rebuild a runtime from its serving directory after a crash.
 
@@ -876,7 +943,15 @@ def recover(dir: str, clock=time.monotonic,
     less — the async-group-commit loss window a power-style crash
     consumed — the exact lost seqs come back in
     ``RecoveryInfo.lost_acked_seqs`` so the caller can retransmit them
-    deliberately instead of discovering the gap by timeout."""
+    deliberately instead of discovering the gap by timeout.
+
+    ``heal_replicas`` (quorum-replicated directories): the surviving
+    follower replica dirs — acked records the leader's disk lost are
+    re-seeded from them (``replication.heal_from_replicas``) BEFORE the
+    replay, so ``lost_acked_seqs`` shrinks to exactly the records EVERY
+    holder lost.  None (the default) auto-discovers the default local
+    replica root (``<dir>/replicas/replica*``) when the stored config
+    says the directory ran replicated; pass ``[]`` to skip healing."""
     import jax
     import jax.numpy as jnp
 
@@ -890,7 +965,22 @@ def recover(dir: str, clock=time.monotonic,
     step = _checkpoint.latest_valid_step(snap_dir, like=like)
     state = (like if step is None
              else _checkpoint.restore(snap_dir, step=step, like=like))
-    records, torn = journal_replay(os.path.join(dir, _JOURNAL))
+    journal_path = os.path.join(dir, _JOURNAL)
+    healed: Tuple[int, ...] = ()
+    if heal_replicas is None \
+            and int(cfg.get("replication_factor") or 0) >= 1:
+        from .replication import REPLICA_DIR_PREFIX
+        root = os.path.join(dir, "replicas")
+        if os.path.isdir(root):
+            heal_replicas = sorted(
+                os.path.join(root, n) for n in os.listdir(root)
+                if n.startswith(REPLICA_DIR_PREFIX))
+    if heal_replicas:
+        from .replication import heal_from_replicas
+        h = heal_from_replicas(journal_path, list(heal_replicas),
+                               fmt=cfg.get("journal_format"))
+        healed = tuple(h["healed_seqs"])
+    records, torn = journal_replay(journal_path)
     apply_fn = make_apply_fn()
     co_fn = None
     s_sink = jnp.asarray(np.asarray(cfg["s_sink"], np.float64),
@@ -976,7 +1066,12 @@ def recover(dir: str, clock=time.monotonic,
         flush_mode=str(cfg.get("flush_mode", "sync")),
         max_unflushed_records=int(cfg.get("max_unflushed_records", 64)),
         max_flush_delay_ms=float(cfg.get("max_flush_delay_ms", 50.0)),
-        coalesce=K_cfg, clock=clock, _state=state)
+        coalesce=K_cfg,
+        journal_format=cfg.get("journal_format"),
+        replication_factor=int(cfg.get("replication_factor") or 0),
+        replication_quorum=cfg.get("replication_quorum"),
+        replication_mode=str(cfg.get("replication_mode", "thread")),
+        clock=clock, _state=state)
     rt._last_decision = last_decision
     recovered_seq = int(jax.device_get(state.seq))
     lost: Tuple[int, ...] = ()
@@ -986,7 +1081,8 @@ def recover(dir: str, clock=time.monotonic,
         lost = tuple(range(recovered_seq + 1, int(acked_seq) + 1))
     info = RecoveryInfo(
         snapshot_seq=step, replayed=replayed, skipped=skipped, torn=torn,
-        recovered_seq=recovered_seq, lost_acked_seqs=lost)
+        recovered_seq=recovered_seq, lost_acked_seqs=lost,
+        healed_seqs=healed)
     return rt, info
 
 

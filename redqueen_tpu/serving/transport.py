@@ -233,6 +233,35 @@ class FrameReader:
                     f"no complete frame within {timeout_s}s "
                     f"({len(self._buf)} bytes buffered)")
 
+    def read_bytes(self, n: int, timeout_s: Optional[float] = None
+                   ) -> bytes:
+        """Exactly ``n`` raw bytes that FOLLOW a frame — the replication
+        append sub-protocol's out-of-band record body (a small JSON
+        header frame announces ``body_len``, then the pre-serialized
+        record bytes ride the stream verbatim: no base64, no second
+        JSON encode, no ``MAX_FRAME_BYTES`` coupling).  Same deadline
+        semantics as :meth:`read_frame`; an EOF mid-body raises
+        :class:`TransportEOF` with the torn length in
+        ``partial_bytes``."""
+        n = int(n)
+        if n < 0:
+            raise FrameError(f"negative raw-body length {n}")
+        deadline = (None if timeout_s is None
+                    else self._clock() + float(timeout_s))
+        while len(self._buf) < n:
+            if self._eof:
+                raise TransportEOF(
+                    f"peer closed the pipe mid-body "
+                    f"({len(self._buf)} of {n} bytes arrived)",
+                    partial_bytes=len(self._buf))
+            if not self._fill(deadline):
+                raise TransportTimeout(
+                    f"no complete {n}-byte body within {timeout_s}s "
+                    f"({len(self._buf)} bytes buffered)")
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
     def _try_decode(self) -> Optional[Dict[str, Any]]:
         if len(self._buf) < HEADER_BYTES:
             return None

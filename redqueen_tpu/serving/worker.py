@@ -353,7 +353,11 @@ class _Worker:
                 cfg.get("max_unflushed_records", 64)),
             max_flush_delay_ms=float(
                 cfg.get("max_flush_delay_ms", 50.0)),
-            coalesce=int(cfg.get("coalesce", 1)))
+            coalesce=int(cfg.get("coalesce", 1)),
+            journal_format=cfg.get("journal_format"),
+            replication_factor=int(cfg.get("replication_factor") or 0),
+            replication_quorum=cfg.get("replication_quorum"),
+            replication_mode=str(cfg.get("replication_mode", "thread")))
         return {"applied_seq": self.rt.applied_seq, "pid": os.getpid()}
 
     def _handle_recover(self, req: Dict[str, Any]) -> Dict[str, Any]:
@@ -361,7 +365,8 @@ class _Worker:
 
         acked = req.get("acked_seq")
         self.rt, info = recover(
-            self.dir, acked_seq=None if acked is None else int(acked))
+            self.dir, acked_seq=None if acked is None else int(acked),
+            heal_replicas=req.get("heal_replicas"))
         return {"applied_seq": self.rt.applied_seq, "pid": os.getpid(),
                 "info": {"snapshot_seq": info.snapshot_seq,
                          "replayed": info.replayed,
@@ -369,7 +374,8 @@ class _Worker:
                          "torn": info.torn,
                          "recovered_seq": info.recovered_seq,
                          "lost_acked_seqs":
-                             list(info.lost_acked_seqs)}}
+                             list(info.lost_acked_seqs),
+                         "healed_seqs": list(info.healed_seqs)}}
 
     def _adm_dict(self, adm) -> Dict[str, Any]:
         return {"status": adm.status, "seq": adm.seq,
@@ -856,7 +862,9 @@ class WorkerHandle:
             skipped=int(i["skipped"]), torn=i["torn"],
             recovered_seq=int(i["recovered_seq"]),
             lost_acked_seqs=tuple(
-                int(s) for s in i.get("lost_acked_seqs", ())))
+                int(s) for s in i.get("lost_acked_seqs", ())),
+            healed_seqs=tuple(
+                int(s) for s in i.get("healed_seqs", ())))
 
     def start_submit(self, batch: EventBatch) -> int:
         return self._send("submit", seq=int(batch.seq),

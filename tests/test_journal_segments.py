@@ -1,0 +1,344 @@
+"""Journal segment lifecycle edges, the binary fixed-slot format, and
+exact power-loss accounting (ISSUE 16).
+
+Three groups, all jax-free and deterministic on CPU:
+
+- **Segment edges** — torn tail exactly at a segment boundary,
+  ``rotate()`` racing ``prune_segments()``, and replay across a
+  quarantine sidecar sitting mid-tree (sidecars are evidence, never
+  segments).
+- **Binary format** — bit-identical round trips, reopen sniffing, the
+  one-way JSONL→binary migration, torn-tail quarantine vs mid-file
+  refusal (the discriminator: a valid crc-checked frame AFTER the bad
+  offset means corruption, not a crash tear).
+- **power_loss() exactness** — under BOTH flush modes the simulated cut
+  reports the exact unsynced record count and seqs (the group-mode
+  path was approximate before this PR; these are its regression
+  tests).
+"""
+
+import os
+import threading
+
+import pytest
+
+from redqueen_tpu.serving.journal import (
+    BINARY_SLOT_BYTES, Journal, JournalError, journal_format,
+    migrate_to_binary, prune_segments, replay, rotate, segment_paths,
+    tear_tail)
+
+FORMATS = ("jsonl", "binary")
+
+
+def _j(path, fmt, **kw):
+    return Journal(str(path), fmt=None if fmt == "jsonl" else fmt, **kw)
+
+
+def _fill(path, fmt, seqs, **kw):
+    with _j(path, fmt, **kw) as j:
+        for s in seqs:
+            j.append({"seq": s, "v": s * 3}, seq=s)
+
+
+def _seqs(path):
+    recs, torn = replay(str(path))
+    return [r["seq"] for r in recs], torn
+
+
+# ---------------------------------------------------------------------------
+# Segment lifecycle edges
+# ---------------------------------------------------------------------------
+
+
+class TestSegmentEdges:
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_torn_tail_exactly_at_segment_boundary(self, tmp_path, fmt):
+        """The tear lands on the FIRST record after a rotation: the
+        segment stays complete, only the live record quarantines."""
+        p = tmp_path / "journal.jsonl"
+        _fill(p, fmt, range(5))
+        assert rotate(str(p), 4) is not None
+        _fill(p, fmt, [5])
+        tear_tail(str(p))
+        seqs, torn = _seqs(p)
+        assert seqs == [0, 1, 2, 3, 4]
+        assert torn is not None and torn["records_kept"] == 5
+        # idempotent: the quarantined tree replays clean
+        seqs, torn = _seqs(p)
+        assert seqs == [0, 1, 2, 3, 4] and torn is None
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_tear_to_zero_records_leaves_empty_live(self, tmp_path, fmt):
+        """keep_bytes=0: the cut lands BEFORE any byte of the record —
+        the live file degenerates to empty (jsonl) / header-only
+        (binary), which is a CLEAN tree (nothing torn remains), and the
+        next writer starts fresh after the boundary."""
+        p = tmp_path / "journal.jsonl"
+        _fill(p, fmt, range(3))
+        rotate(str(p), 2)
+        _fill(p, fmt, [3])
+        tear_tail(str(p), keep_bytes=0)
+        seqs, torn = _seqs(p)
+        assert seqs == [0, 1, 2] and torn is None
+        _fill(p, fmt, [3, 4])
+        seqs, torn = _seqs(p)
+        assert seqs == [0, 1, 2, 3, 4] and torn is None
+
+    def test_rotate_racing_prune(self, tmp_path):
+        """rotate() and prune_segments() interleaving from two threads
+        never corrupts the tree: every surviving record replays, the
+        retained tail is contiguous, and no call raises."""
+        p = str(tmp_path / "journal.jsonl")
+        errors = []
+        start = threading.Barrier(2)
+
+        def pruner():
+            start.wait()
+            for k in range(200):
+                try:
+                    prune_segments(p, k)
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    errors.append(e)
+
+        t = threading.Thread(target=pruner)
+        t.start()
+        start.wait()
+        seq = 0
+        for _round in range(40):
+            with Journal(p) as j:
+                for _ in range(3):
+                    j.append({"seq": seq}, seq=seq)
+                    seq += 1
+            rotate(p, seq - 1)
+        t.join()
+        assert errors == []
+        seqs, torn = _seqs(p)
+        assert torn is None
+        # whatever pruning kept must be an exact contiguous tail
+        assert seqs == list(range(seq - len(seqs), seq))
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_replay_across_mid_tree_quarantine_sidecar(self, tmp_path,
+                                                       fmt):
+        """A quarantine sidecar (``.torn-*``) written mid-history must
+        never be picked up as a segment: tear → quarantine → keep
+        appending → rotate → more records, then one replay across the
+        whole tree."""
+        p = tmp_path / "journal.jsonl"
+        _fill(p, fmt, range(4))
+        tear_tail(str(p))
+        seqs, torn = _seqs(p)  # quarantines seq 3's torn bytes
+        assert seqs == [0, 1, 2] and torn is not None
+        assert any(".torn-" in os.path.basename(f)
+                   for f in os.listdir(tmp_path))
+        _fill(p, fmt, [3, 4])
+        rotate(str(p), 4)
+        _fill(p, fmt, [5, 6])
+        assert len(segment_paths(str(p))) == 1
+        seqs, torn = _seqs(p)
+        assert seqs == [0, 1, 2, 3, 4, 5, 6] and torn is None
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_corrupt_middle_segment_refuses_replay(self, tmp_path, fmt):
+        """Segments are complete by construction — damage INSIDE one is
+        corruption and must refuse replay, never quarantine."""
+        p = tmp_path / "journal.jsonl"
+        _fill(p, fmt, range(4))
+        seg = rotate(str(p), 3)
+        _fill(p, fmt, [4, 5])
+        with open(seg, "r+b") as f:
+            data = f.read()
+            # binary: inside record 0's crc-covered payload (past the
+            # 20-byte frame header); jsonl: mid-file line damage
+            off = (BINARY_SLOT_BYTES + 24 if fmt == "binary"
+                   else len(data) // 2)
+            f.seek(off)
+            f.write(b"\xff\xff\xff")
+        with pytest.raises(JournalError):
+            replay(str(p))
+
+
+# ---------------------------------------------------------------------------
+# Binary fixed-slot format
+# ---------------------------------------------------------------------------
+
+
+class TestBinaryFormat:
+    def test_round_trip_and_reopen_sniffs_format(self, tmp_path):
+        p = tmp_path / "journal.jsonl"
+        recs = [{"seq": i, "payload": {"x": [i, i + 1]}} for i in range(7)]
+        with Journal(str(p), fmt="binary") as j:
+            for r in recs:
+                j.append(r, seq=r["seq"])
+        assert journal_format(str(p)) == "binary"
+        got, torn = replay(str(p))
+        assert got == recs and torn is None
+        # reopen WITHOUT the knob: the on-disk format wins
+        with Journal(str(p)) as j:
+            assert j.fmt == "binary"
+            j.append({"seq": 7}, seq=7)
+        got, _ = replay(str(p))
+        assert [g["seq"] for g in got] == list(range(8))
+
+    def test_format_conflict_refuses(self, tmp_path):
+        p = tmp_path / "journal.jsonl"
+        _fill(p, "jsonl", range(2))
+        with pytest.raises(ValueError, match="one-way"):
+            Journal(str(p), fmt="binary")
+
+    def test_mid_file_corruption_refuses(self, tmp_path):
+        """A valid frame AFTER the bad offset proves mid-file damage —
+        that refuses replay; only a trailing tear quarantines."""
+        p = tmp_path / "journal.jsonl"
+        _fill(p, "binary", range(5))
+        with open(p, "r+b") as f:
+            f.seek(BINARY_SLOT_BYTES + 24)  # inside record 0's payload
+            f.write(b"\x00\xff\x00")
+        with pytest.raises(JournalError, match="valid record"):
+            replay(str(p))
+
+    def test_torn_tail_quarantined_at_reopen(self, tmp_path):
+        p = tmp_path / "journal.jsonl"
+        _fill(p, "binary", range(4))
+        tear_tail(str(p))
+        with Journal(str(p)) as j:  # reopen quarantines, then appends
+            j.append({"seq": 99}, seq=99)
+        seqs, torn = _seqs(p)
+        assert seqs == [0, 1, 2, 99] and torn is None
+
+    def test_migration_is_bit_identical_across_tree(self, tmp_path):
+        p = tmp_path / "journal.jsonl"
+        _fill(p, "jsonl", range(4))
+        rotate(str(p), 3)
+        _fill(p, "jsonl", [4, 5])
+        before, _ = replay(str(p))
+        out = migrate_to_binary(str(p))
+        assert out["records"] == 6 and len(out["migrated"]) == 2
+        assert journal_format(str(p)) == "binary"
+        for seg in segment_paths(str(p)):
+            assert journal_format(seg) == "binary"
+        after, torn = replay(str(p))
+        assert after == before and torn is None
+        # append keeps working post-migration
+        _fill(p, "binary", [6])
+        after, _ = replay(str(p))
+        assert [a["seq"] for a in after] == list(range(7))
+
+    def test_migration_refuses_torn_live_file(self, tmp_path):
+        p = tmp_path / "journal.jsonl"
+        _fill(p, "jsonl", range(3))
+        tear_tail(str(p))
+        with pytest.raises(ValueError, match="recover first"):
+            migrate_to_binary(str(p))
+
+    def test_binary_layout_is_fixed_slot(self, tmp_path):
+        """The per-record cost is slots, not envelopes: a small record
+        occupies exactly one 256-byte slot, so the closed file is
+        header + N slots on the nose (the invariant the mmap append
+        path and the boundary scanner both lean on)."""
+        pb = tmp_path / "b.jsonl"
+        with Journal(str(pb), fmt="binary") as jb:
+            for i in range(50):
+                jb.append({"seq": i, "v": i}, seq=i)
+        assert os.path.getsize(pb) == BINARY_SLOT_BYTES * (1 + 50)
+
+
+# ---------------------------------------------------------------------------
+# power_loss() exactness (the group-mode regression tests)
+# ---------------------------------------------------------------------------
+
+
+class TestPowerLossExact:
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_group_mode_reports_exact_window(self, tmp_path, fmt):
+        """flush_mode='group' with the flusher effectively off: every
+        record is acked-but-unsynced and the cut must name each one."""
+        p = tmp_path / "journal.jsonl"
+        j = _j(p, fmt, flush_mode="group", max_unflushed_records=1000,
+               max_flush_delay_ms=60_000.0)
+        for s in range(7):
+            j.append({"seq": 100 + s}, seq=100 + s)
+        pl = j.power_loss()
+        assert pl["dropped_records"] == 7
+        assert pl["dropped_seqs"] == tuple(range(100, 107))
+        seqs, torn = _seqs(p)
+        assert seqs == [] and torn is None
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_partial_sync_reports_only_the_unsynced_tail(self, tmp_path,
+                                                         fmt):
+        p = tmp_path / "journal.jsonl"
+        j = _j(p, fmt, flush_mode="group", max_unflushed_records=1000,
+               max_flush_delay_ms=60_000.0)
+        for s in range(4):
+            j.append({"seq": s}, seq=s)
+        j.sync()
+        for s in range(4, 9):
+            j.append({"seq": s}, seq=s)
+        pl = j.power_loss()
+        assert pl["dropped_records"] == 5
+        assert pl["dropped_seqs"] == (4, 5, 6, 7, 8)
+        seqs, _ = _seqs(p)
+        assert seqs == [0, 1, 2, 3]
+
+    def test_sync_mode_stays_exact(self, tmp_path):
+        p = tmp_path / "journal.jsonl"
+        j = Journal(str(p), fsync_every_n=3)
+        for s in range(5):
+            j.append({"seq": s}, seq=s)
+        # fsync fired at record 3; records 4-5 (seqs 3,4) are pending
+        pl = j.power_loss()
+        assert pl["dropped_records"] == 2
+        assert pl["dropped_seqs"] == (3, 4)
+
+    def test_records_without_seq_count_but_name_nothing(self, tmp_path):
+        p = tmp_path / "journal.jsonl"
+        j = Journal(str(p), flush_mode="group",
+                    max_unflushed_records=1000,
+                    max_flush_delay_ms=60_000.0)
+        j.append({"kind": "meta"})
+        j.append({"seq": 7}, seq=7)
+        pl = j.power_loss()
+        assert pl["dropped_records"] == 2
+        assert pl["dropped_seqs"] == (7,)
+
+
+# ---------------------------------------------------------------------------
+# disk:* fault kind (the checkpoint-path EIO/ENOSPC matrix)
+# ---------------------------------------------------------------------------
+
+
+class TestDiskFaults:
+    def test_inline_fsync_eio_surfaces(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RQ_FAULT", "disk:eio@fsync2")
+        j = Journal(str(tmp_path / "journal.jsonl"), fsync_every_n=1)
+        j.append({"seq": 0}, seq=0)
+        with pytest.raises(OSError, match="injected disk fault"):
+            j.append({"seq": 1}, seq=1)
+
+    def test_bg_checkpoint_eio_counts_and_retries(self, tmp_path,
+                                                  monkeypatch):
+        import time
+
+        monkeypatch.setenv("RQ_FAULT", "disk:enospc@fsync1")
+        j = Journal(str(tmp_path / "journal.jsonl"), flush_mode="group",
+                    max_unflushed_records=64, max_flush_delay_ms=10.0)
+        j.append({"seq": 0}, seq=0)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            h = j.health()
+            if h["flush_errors"] >= 1 and h["unsynced_records"] == 0:
+                break
+            time.sleep(0.01)
+        h = j.health()
+        assert h["flush_errors"] >= 1 and h["unsynced_records"] == 0
+        assert j.power_loss()["dropped_records"] == 0
+
+    def test_health_block_shape(self, tmp_path):
+        j = Journal(str(tmp_path / "journal.jsonl"))
+        j.append({"seq": 0}, seq=0)
+        h = j.health()
+        assert h["format"] == "jsonl" and h["flush_mode"] == "sync"
+        assert h["fsync_attempts"] == 1 and h["flush_errors"] == 0
+        assert h["unsynced_records"] == 0 and h["durable_seq"] == 0
+        j.close()

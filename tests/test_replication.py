@@ -1,0 +1,382 @@
+"""Quorum-replicated durability (ISSUE 16): the ack contract is a
+NETWORK property.
+
+The contracts under test, all deterministic on CPU:
+
+- **Quorum ack**: ``ReplicatedJournal.append`` returns once a quorum
+  of followers hold the record in memory (page-cache, SIGKILL-proof);
+  fsync is a lagging background checkpoint, not the ack gate.
+- **Degradation never weakens the ack**: a dead/partitioned/slow
+  follower that breaks quorum demotes the append to the inline-fsync
+  tier (counted as ``degraded_appends``) — the ack still means
+  "survives a crash", just via the disk instead of the network.
+- **Exact quorum-loss accounting**: after leader power loss,
+  ``heal_from_replicas`` re-seeds every acked record any holder kept;
+  a record is reported lost iff EVERY holder died before checkpoint —
+  reported lost seqs == actually lost seqs, everything else replays
+  bit-identically.
+- **Single-node SIGKILL survival**: with ``mode="process"`` the
+  followers are real processes and the kill fault is a real SIGKILL
+  (the acceptance criterion's literal case).
+- **Runtime wiring**: ``ServingRuntime(replication_factor=R)`` serves
+  through the replicated journal, ``recover()`` auto-discovers the
+  local replica root and heals before replay, and the knobs ride the
+  cluster config without becoming directory identity.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from redqueen_tpu import serving
+from redqueen_tpu.serving.journal import (
+    JOURNAL_FILENAME, Journal, durability_info, replay)
+from redqueen_tpu.serving.replication import (
+    REPLICA_DIR_PREFIX, ReplicatedJournal, heal_from_replicas)
+
+N_FEEDS = 8
+
+
+def _recs(n):
+    return [{"seq": i, "v": [i, i * 2]} for i in range(n)]
+
+
+def _append_all(rj, recs):
+    for r in recs:
+        rj.append(r, seq=r["seq"])
+
+
+def _replayed_seqs(path):
+    recs, torn = replay(str(path))
+    return [r["seq"] for r in recs], torn
+
+
+# ---------------------------------------------------------------------------
+# Quorum ack + follower mirroring
+# ---------------------------------------------------------------------------
+
+
+class TestQuorumAck:
+    @pytest.mark.parametrize("fmt", [None, "binary"])
+    def test_quorum_appends_and_mirrored_streams(self, tmp_path, fmt):
+        p = str(tmp_path / JOURNAL_FILENAME)
+        recs = _recs(9)
+        with ReplicatedJournal(p, factor=2, quorum=2, fmt=fmt) as rj:
+            _append_all(rj, recs)
+            assert rj.quorum_appends == 9 and rj.degraded_appends == 0
+            dirs = [st.dir for st in rj._followers]
+        # every follower holds the full stream, bit-identically
+        for d in dirs:
+            got, torn = replay(os.path.join(d, JOURNAL_FILENAME))
+            assert got == recs and torn is None
+
+    def test_rotation_mirrors_segment_boundaries(self, tmp_path):
+        p = str(tmp_path / JOURNAL_FILENAME)
+        with ReplicatedJournal(p, factor=1, quorum=1) as rj:
+            _append_all(rj, _recs(4))
+            rj.rotate_local(3)
+            _append_all(rj, [{"seq": 4, "v": [4, 8]}])
+            dirs = [st.dir for st in rj._followers]
+            rj.sync()
+            seqs, _ = _replayed_seqs(p)
+            assert seqs == [0, 1, 2, 3, 4]
+            fp = os.path.join(dirs[0], JOURNAL_FILENAME)
+            from redqueen_tpu.serving.journal import segment_paths
+            assert len(segment_paths(fp)) == 1
+            fseqs, _ = _replayed_seqs(fp)
+            assert fseqs == [0, 1, 2, 3, 4]
+
+    def test_health_block_carries_replication(self, tmp_path):
+        p = str(tmp_path / JOURNAL_FILENAME)
+        with ReplicatedJournal(p, factor=2, quorum=1) as rj:
+            _append_all(rj, _recs(3))
+            h = rj.health()
+            r = h["replication"]
+            assert r["factor"] == 2 and r["quorum"] == 1
+            assert r["quorum_appends"] == 3
+            assert len(r["followers"]) == 2
+
+    def test_quorum_validation(self, tmp_path):
+        p = str(tmp_path / JOURNAL_FILENAME)
+        with pytest.raises(ValueError):
+            ReplicatedJournal(p, factor=0)
+        with pytest.raises(ValueError):
+            ReplicatedJournal(p, factor=2, quorum=3)
+
+    def test_durability_info_quorum_tier(self):
+        info = durability_info("group", 1, 64, 50.0, 1,
+                               replication={"factor": 2, "quorum": 2})
+        assert info["tier"] == "quorum"
+        assert info["ack_survives_single_node_loss"] is True
+        base = durability_info("group", 1, 64, 50.0, 1)
+        assert base["tier"] == "window"
+        assert base["ack_survives_single_node_loss"] is False
+
+
+# ---------------------------------------------------------------------------
+# Exact loss accounting + healing
+# ---------------------------------------------------------------------------
+
+
+class TestHealing:
+    def test_leader_power_loss_heals_from_replicas(self, tmp_path):
+        p = str(tmp_path / JOURNAL_FILENAME)
+        recs = _recs(8)
+        rj = ReplicatedJournal(p, factor=2, quorum=2)
+        _append_all(rj, recs)
+        pl = rj.power_loss()
+        assert pl["dropped_records"] == 8  # nothing locally durable
+        h = heal_from_replicas(p, pl["replica_dirs"])
+        assert sorted(h["healed_seqs"]) == list(range(8))
+        assert all(len(ds) >= 1 for ds in h["holders"].values())
+        got, torn = replay(p)
+        assert got == recs and torn is None  # bit-identical
+
+    def test_partial_local_durability_heals_only_the_tail(self, tmp_path):
+        p = str(tmp_path / JOURNAL_FILENAME)
+        recs = _recs(6)
+        rj = ReplicatedJournal(p, factor=1, quorum=1)
+        _append_all(rj, recs[:3])
+        rj.sync()
+        _append_all(rj, recs[3:])
+        pl = rj.power_loss()
+        assert pl["dropped_seqs"] == (3, 4, 5)
+        h = heal_from_replicas(p, pl["replica_dirs"])
+        assert sorted(h["healed_seqs"]) == [3, 4, 5]
+        got, _ = replay(p)
+        assert got == recs
+
+    def test_inconsistent_holders_refuse_healing(self, tmp_path):
+        p = str(tmp_path / JOURNAL_FILENAME)
+        rj = ReplicatedJournal(p, factor=2, quorum=2)
+        _append_all(rj, _recs(4))
+        pl = rj.power_loss()
+        # corrupt one holder's copy of seq 3 (same seq, different body)
+        bad = os.path.join(pl["replica_dirs"][0], JOURNAL_FILENAME)
+        recs, _ = replay(bad)
+        recs[-1]["v"] = ["tampered"]
+        os.remove(bad)
+        with Journal(bad) as j:
+            for r in recs:
+                j.append(r, seq=r["seq"])
+        with pytest.raises(RuntimeError, match="inconsistent"):
+            heal_from_replicas(p, pl["replica_dirs"])
+
+
+# ---------------------------------------------------------------------------
+# The repl:* fault matrix (thread mode — fast, deterministic)
+# ---------------------------------------------------------------------------
+
+
+class TestReplFaults:
+    def test_follower_kill_quorum_survives(self, tmp_path, monkeypatch):
+        """Kill 1 of 2 followers at batch 3 with quorum=1: the ack path
+        shrinks to the survivor, zero degraded appends, and healing
+        recovers everything from the surviving holder."""
+        monkeypatch.setenv("RQ_FAULT", "repl:kill@peer0,batch3")
+        p = str(tmp_path / JOURNAL_FILENAME)
+        recs = _recs(7)
+        rj = ReplicatedJournal(p, factor=2, quorum=1)
+        _append_all(rj, recs)
+        assert rj.quorum_appends == 7 and rj.degraded_appends == 0
+        assert sum(1 for f in rj.followers() if not f["live"]) == 1
+        pl = rj.power_loss()
+        heal = heal_from_replicas(p, pl["replica_dirs"])
+        lost = set(pl["dropped_seqs"]) - set(heal["healed_seqs"])
+        assert lost == set()
+        got, _ = replay(p)
+        assert got == recs
+
+    def test_quorum_break_demotes_to_fsync_tier(self, tmp_path,
+                                                monkeypatch):
+        """Kill the ONLY follower with quorum=1: every later append
+        degrades to inline fsync — acked records survive with no
+        replica at all."""
+        monkeypatch.setenv("RQ_FAULT", "repl:kill@peer0,batch2")
+        p = str(tmp_path / JOURNAL_FILENAME)
+        recs = _recs(5)
+        rj = ReplicatedJournal(p, factor=1, quorum=1,
+                               ack_timeout_s=0.25)
+        _append_all(rj, recs)
+        assert rj.degraded_appends == 4  # batches 2..5
+        assert rj.durable_seq == 4  # inline fsyncs advanced the mark
+        pl = rj.power_loss()
+        assert pl["dropped_records"] == 0
+        got, _ = replay(p)
+        assert got == recs
+
+    def test_partition_keeps_follower_but_degrades(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("RQ_FAULT", "repl:partition@peer0,batch3")
+        p = str(tmp_path / JOURNAL_FILENAME)
+        rj = ReplicatedJournal(p, factor=1, quorum=1,
+                               ack_timeout_s=0.25)
+        _append_all(rj, _recs(5))
+        assert rj.quorum_appends == 2 and rj.degraded_appends == 3
+        # partitioned, not dead: the process/thread is still up
+        assert all(f["live"] for f in rj.followers())
+        assert rj.power_loss()["dropped_records"] == 0
+
+    def test_slow_follower_is_demoted_not_trusted(self, tmp_path,
+                                                  monkeypatch):
+        """A follower slower than the ack deadline cannot count toward
+        quorum: the leader demotes it and falls back to inline fsync
+        rather than acking on hope."""
+        monkeypatch.setenv("RQ_FAULT", "repl:slow@peer0,batch2")
+        p = str(tmp_path / JOURNAL_FILENAME)
+        recs = _recs(4)
+        rj = ReplicatedJournal(p, factor=1, quorum=1,
+                               ack_timeout_s=0.15)
+        _append_all(rj, recs)
+        assert rj.degraded_appends >= 1
+        assert any(f["lagging"] for f in rj.followers())
+        assert rj.power_loss()["dropped_records"] == 0
+        got, _ = replay(p)
+        assert got == recs
+
+
+# ---------------------------------------------------------------------------
+# Real-process followers + real SIGKILL (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestProcessFollowers:
+    def test_sigkill_one_follower_no_acked_record_lost(self, tmp_path,
+                                                       monkeypatch):
+        """factor=2 quorum=1, follower 0 SIGKILLed (a REAL kill -9 of a
+        real process) mid-replication, then leader power loss: every
+        acked record is healed from page cache the kill could not
+        claw back — ack-durability survives any single-node death."""
+        monkeypatch.setenv("RQ_FAULT", "repl:kill@peer0,batch4")
+        p = str(tmp_path / JOURNAL_FILENAME)
+        recs = _recs(8)
+        rj = ReplicatedJournal(p, factor=2, quorum=1, mode="process",
+                               fmt="binary")
+        _append_all(rj, recs)
+        dead = [f for f in rj.followers() if not f["live"]]
+        assert len(dead) == 1
+        pl = rj.power_loss()
+        heal = heal_from_replicas(p, pl["replica_dirs"], fmt="binary")
+        lost = set(pl["dropped_seqs"]) - set(heal["healed_seqs"])
+        assert lost == set()
+        got, torn = replay(p)
+        assert got == recs and torn is None
+        # the killed holder kept a prefix; the survivor held the rest
+        assert max(len(ds) for ds in heal["holders"].values()) >= 1
+
+    def test_process_followers_never_get_token_via_argv(self, tmp_path):
+        rj = ReplicatedJournal(str(tmp_path / JOURNAL_FILENAME),
+                               factor=1, quorum=1, mode="process",
+                               token="s3cret")
+        try:
+            st = rj._followers[0]
+            assert "s3cret" not in " ".join(st.proc.args)
+        finally:
+            rj.close()
+
+
+# ---------------------------------------------------------------------------
+# ServingRuntime / recover() wiring
+# ---------------------------------------------------------------------------
+
+
+def _batches(n):
+    return serving.synthetic_stream(0, n, N_FEEDS, events_per_batch=4)
+
+
+class TestRuntimeWiring:
+    def test_replicated_runtime_survives_total_local_loss(self,
+                                                          tmp_path):
+        rt = serving.ServingRuntime(
+            n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
+            snapshot_every=10 ** 9, replication_factor=2,
+            journal_format="binary")
+        for b in _batches(10):
+            assert rt.submit(b).status == "accepted"
+        while rt.pending:
+            rt.poll()
+        digest = rt.state_digest()
+        d = rt.durability()
+        assert d["tier"] == "quorum"
+        assert d["ack_survives_single_node_loss"] is True
+        pl = rt._journal.power_loss()
+        assert pl["dropped_records"] > 0  # the fsync tier WOULD lose
+        rt2, info = serving.recover(str(tmp_path))  # auto-discovers
+        assert info.lost_acked_seqs == ()
+        assert len(info.healed_seqs) == pl["dropped_records"]
+        assert rt2.state_digest() == digest
+        rt2.close()
+
+    def test_recover_can_skip_healing(self, tmp_path):
+        rt = serving.ServingRuntime(
+            n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
+            snapshot_every=10 ** 9, replication_factor=1)
+        batches = list(_batches(6))
+        for b in batches:
+            rt.submit(b)
+        while rt.pending:
+            rt.poll()
+        pl = rt._journal.power_loss()
+        dropped = set(pl["dropped_seqs"])
+        rt2, info = serving.recover(
+            str(tmp_path), acked_seq=5, heal_replicas=[])
+        assert info.healed_seqs == ()
+        assert set(info.lost_acked_seqs) == dropped
+        rt2.close()
+
+    def test_metrics_artifact_embeds_journal_health(self, tmp_path):
+        rt = serving.ServingRuntime(
+            n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
+            snapshot_every=10 ** 9, replication_factor=1)
+        for b in _batches(3):
+            rt.submit(b)
+        while rt.pending:
+            rt.poll()
+        payload = rt.write_metrics()
+        j = payload["journal"]
+        assert j["flush_errors"] == 0
+        assert j["replication"]["factor"] == 1
+        assert "unsynced_records" in j  # the checkpoint-lag watermark
+        rt.close()
+
+    def test_snapshot_rotates_replicated_journal(self, tmp_path):
+        rt = serving.ServingRuntime(
+            n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
+            snapshot_every=4, replication_factor=1)
+        for b in _batches(9):
+            rt.submit(b)
+        while rt.pending:
+            rt.poll()
+        digest = rt.state_digest()
+        rt._journal.power_loss()
+        rt2, info = serving.recover(str(tmp_path))
+        assert info.lost_acked_seqs == ()
+        assert rt2.state_digest() == digest
+        rt2.close()
+
+    def test_replication_knobs_are_not_directory_identity(self,
+                                                          tmp_path):
+        rt = serving.ServingRuntime(
+            n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
+            snapshot_every=10 ** 9, replication_factor=1)
+        for b in _batches(2):
+            rt.submit(b)
+        while rt.pending:
+            rt.poll()
+        rt.close()
+        # reopen the directory UNREPLICATED: allowed (non-identity)
+        rt2 = serving.ServingRuntime(
+            n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
+            snapshot_every=10 ** 9)
+        rt2.close()
+
+    def test_replica_root_layout(self, tmp_path):
+        rt = serving.ServingRuntime(
+            n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
+            snapshot_every=10 ** 9, replication_factor=2)
+        root = tmp_path / "replicas"
+        assert sorted(os.listdir(root)) == [
+            f"{REPLICA_DIR_PREFIX}0", f"{REPLICA_DIR_PREFIX}1"]
+        rt.close()

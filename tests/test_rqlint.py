@@ -820,3 +820,73 @@ class TestRQ602:
         """
         fs = lint(src, "redqueen_tpu/ops/x.py", ["RQ602"])
         assert [f for f in fs if not f.suppressed] == []
+
+
+# ---------------------------------------------------------------------------
+# RQ1005 — ack emitted before the durability point
+# ---------------------------------------------------------------------------
+
+
+class TestRQ1005:
+    def test_fires_on_ack_before_journal_append(self):
+        src = """\
+            def handle(journal, conn, rec):
+                write_frame(conn, {"kind": "repl.ack", "n": 1})
+                journal.append(rec)
+        """
+        fs = lint(src, "redqueen_tpu/serving/replication.py", ["RQ1005"])
+        assert ids(fs) == ["RQ1005"] and fs[0].line == 2
+        assert "before its durability point" in fs[0].message
+
+    def test_fires_on_admission_before_sync(self):
+        src = """\
+            def submit(self, batch):
+                adm = Admission("accepted", batch.seq)
+                self._journal.sync()
+                return adm
+        """
+        fs = lint(src, "redqueen_tpu/serving/service.py", ["RQ1005"])
+        assert ids(fs) == ["RQ1005"]
+
+    def test_fires_on_constant_name_ack_kind(self):
+        src = """\
+            def handle(journal, conn, rec):
+                write_frame(conn, {"kind": _KIND_ACK, "n": 1})
+                journal.append(rec)
+        """
+        assert ids(lint(src, "redqueen_tpu/serving/replication.py",
+                        ["RQ1005"])) == ["RQ1005"]
+
+    def test_append_then_ack_is_legal(self):
+        src = """\
+            def handle(journal, conn, rec):
+                journal.append(rec)
+                write_frame(conn, {"kind": "repl.ack", "n": 1})
+        """
+        assert lint(src, "redqueen_tpu/serving/replication.py",
+                    ["RQ1005"]) == []
+
+    def test_relay_without_durability_call_is_out_of_scope(self):
+        src = """\
+            def relay(conn, ack):
+                write_frame(conn, {"kind": "repl.ack", "n": ack})
+        """
+        assert lint(src, "redqueen_tpu/serving/cluster.py",
+                    ["RQ1005"]) == []
+
+    def test_list_append_is_not_a_durability_point(self):
+        src = """\
+            def handle(acks, conn, rec):
+                write_frame(conn, {"kind": "repl.ack", "n": 1})
+                acks.append(rec)
+        """
+        assert lint(src, "redqueen_tpu/serving/replication.py",
+                    ["RQ1005"]) == []
+
+    def test_scoped_to_serving(self):
+        src = """\
+            def handle(journal, conn, rec):
+                write_frame(conn, {"kind": "repl.ack", "n": 1})
+                journal.append(rec)
+        """
+        assert lint(src, "tools/some_tool.py", ["RQ1005"]) == []

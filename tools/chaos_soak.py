@@ -1,0 +1,256 @@
+"""Chaos soak: loop the ``repl:*`` / ``disk:*`` fault matrix and fail
+on any non-exact loss report.
+
+Every scenario drives a real journal (or quorum-replicated journal
+group) under one injected fault, simulates the crash with
+``power_loss()``, heals from the surviving replica holders where the
+tier promises it, and then holds the robustness PR's acceptance bar:
+
+- **exact loss accounting** — the reported lost seqs equal the seqs
+  actually absent after recovery, no more, no fewer (a record is lost
+  iff every holder died before checkpoint);
+- **bit-identical replay** — every record NOT reported lost replays
+  byte-for-byte equal to what was appended.
+
+The matrix crosses fault kinds (follower SIGKILL, leader partition,
+slow follower forcing quorum demotion, fsync EIO/ENOSPC) with both
+journal formats and both follower placements, and ``--rounds N`` loops
+it N times — the soak exists to catch the rare interleavings a single
+pass gets lucky on.  Deterministic CPU-only; no accelerator, no jax.
+
+Usage::
+
+    python tools/chaos_soak.py [--rounds N] [--json PATH]
+    bash tools/ci.sh chaos-soak [N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# The journal/replication layer is deliberately jax-free (worker
+# children import it under this same guard); the soak never touches an
+# accelerator, so skip the package's eager jax-pulling re-exports.
+os.environ.setdefault("RQ_SERVING_WORKER", "1")
+
+from redqueen_tpu.runtime import integrity as _integrity  # noqa: E402
+from redqueen_tpu.serving.journal import (  # noqa: E402
+    JOURNAL_FILENAME, Journal, replay)
+from redqueen_tpu.serving.replication import (  # noqa: E402
+    ReplicatedJournal, heal_from_replicas)
+
+
+class SoakFailure(AssertionError):
+    """One scenario's accounting came back non-exact."""
+
+
+def _payloads(n: int) -> List[Dict[str, Any]]:
+    return [{"seq": i, "v": [i, i * 10], "tag": f"r{i}"}
+            for i in range(n)]
+
+
+def _replayed_by_seq(path: str) -> Dict[int, Dict[str, Any]]:
+    recs, _torn = replay(path)
+    return {int(r["seq"]): r for r in recs}
+
+
+def _check_exact(name: str, appended: List[Dict[str, Any]],
+                 reported_lost: List[int], path: str) -> Dict[str, Any]:
+    """The soak's one assertion, shared by every scenario: reported
+    lost seqs == actually lost seqs, and every kept record replays
+    bit-identically."""
+    kept = _replayed_by_seq(path)
+    acked = {int(p["seq"]) for p in appended}
+    actual_lost = sorted(acked - set(kept))
+    if sorted(reported_lost) != actual_lost:
+        raise SoakFailure(
+            f"{name}: NON-EXACT loss report — reported "
+            f"{sorted(reported_lost)} but actually lost {actual_lost}")
+    for p in appended:
+        s = int(p["seq"])
+        if s in kept and kept[s] != p:
+            raise SoakFailure(
+                f"{name}: replay of seq {s} is not bit-identical — "
+                f"appended {p!r}, replayed {kept[s]!r}")
+    return {"scenario": name, "acked": len(acked),
+            "lost": actual_lost, "exact": True}
+
+
+def _repl_scenario(name: str, fault: str, *, factor: int, quorum: int,
+                   mode: str, fmt: Optional[str], n: int = 8,
+                   ack_timeout_s: float = 0.25) -> Dict[str, Any]:
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    path = os.path.join(d, JOURNAL_FILENAME)
+    os.environ["RQ_FAULT"] = fault
+    try:
+        recs = _payloads(n)
+        with ReplicatedJournal(path, factor=factor, quorum=quorum,
+                               mode=mode, fmt=fmt,
+                               ack_timeout_s=ack_timeout_s) as rj:
+            for p in recs:
+                rj.append(p, seq=p["seq"])
+            degraded = rj.degraded_appends
+            pl = rj.power_loss()
+        heal = heal_from_replicas(path, pl["replica_dirs"], fmt=fmt)
+        reported = sorted(set(int(s) for s in pl["dropped_seqs"])
+                          - set(int(s) for s in heal["healed_seqs"]))
+        out = _check_exact(name, recs, reported, path)
+        out.update(degraded_appends=degraded,
+                   healed=len(heal["healed_seqs"]))
+        return out
+    finally:
+        os.environ.pop("RQ_FAULT", None)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _disk_eio_group_scenario() -> Dict[str, Any]:
+    """``disk:eio@fsync1`` under group commit: the first background
+    checkpoint fails (counted, retried), the volume "heals", the next
+    tick forces the same tail — zero records may be reported lost."""
+    name = "disk:eio@fsync1 group retry"
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    path = os.path.join(d, JOURNAL_FILENAME)
+    os.environ["RQ_FAULT"] = "disk:eio@fsync1"
+    try:
+        recs = _payloads(6)
+        j = Journal(path, flush_mode="group", max_unflushed_records=64,
+                    max_flush_delay_ms=10.0)
+        for p in recs:
+            j.append(p, seq=p["seq"])
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            h = j.health()
+            if h["flush_errors"] >= 1 and h["unsynced_records"] == 0:
+                break
+            time.sleep(0.01)
+        else:
+            raise SoakFailure(
+                f"{name}: background checkpoint never both failed and "
+                f"recovered within the deadline (health={j.health()})")
+        pl = j.power_loss()
+        out = _check_exact(name, recs,
+                           [int(s) for s in pl["dropped_seqs"]], path)
+        out["flush_errors"] = h["flush_errors"]
+        return out
+    finally:
+        os.environ.pop("RQ_FAULT", None)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _disk_enospc_sync_scenario() -> Dict[str, Any]:
+    """``disk:enospc@fsync3`` under sync mode: the third append's fsync
+    raises (the fatal-append contract), the crash cuts there, and the
+    report must name exactly the one record the media never took."""
+    name = "disk:enospc@fsync3 sync fatal"
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    path = os.path.join(d, JOURNAL_FILENAME)
+    os.environ["RQ_FAULT"] = "disk:enospc@fsync3"
+    try:
+        recs = _payloads(3)
+        j = Journal(path, flush_mode="sync", fsync_every_n=1)
+        j.append(recs[0], seq=0)
+        j.append(recs[1], seq=1)
+        try:
+            j.append(recs[2], seq=2)
+        except OSError:
+            pass
+        else:
+            raise SoakFailure(
+                f"{name}: injected ENOSPC did not surface through the "
+                f"inline fsync — the fatal-append contract is broken")
+        pl = j.power_loss()
+        # Only seqs 0-1 were ever acked; seq 2's append RAISED, so it
+        # is not in the acked set — but the report must still name it
+        # (written, never durable) and replay must keep exactly 0-1.
+        if tuple(pl["dropped_seqs"]) != (2,):
+            raise SoakFailure(
+                f"{name}: expected dropped_seqs == (2,), got "
+                f"{pl['dropped_seqs']!r}")
+        return _check_exact(name, recs[:2], [], path)
+    finally:
+        os.environ.pop("RQ_FAULT", None)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def scenario_matrix() -> List[Any]:
+    """One entry per (fault kind x placement x format) cell; each is a
+    zero-arg callable returning the scenario's result dict."""
+    return [
+        # Follower SIGKILL mid-replication — the acceptance bar's "any
+        # single-node SIGKILL" case, with a REAL process kill.
+        lambda: _repl_scenario(
+            "repl:kill@peer0,batch3 process binary",
+            "repl:kill@peer0,batch3", factor=2, quorum=1,
+            mode="process", fmt="binary"),
+        lambda: _repl_scenario(
+            "repl:kill@peer1,batch4 thread jsonl",
+            "repl:kill@peer1,batch4", factor=3, quorum=2,
+            mode="thread", fmt=None),
+        # Leader partitioned from its only follower: every append past
+        # the cut demotes to the degraded tier (inline fsync) — acked
+        # records survive with NO replica help.
+        lambda: _repl_scenario(
+            "repl:partition@peer0,batch2 thread binary",
+            "repl:partition@peer0,batch2", factor=1, quorum=1,
+            mode="thread", fmt="binary"),
+        # Slow follower forcing quorum demotion: the straggler misses
+        # the ack deadline, the leader demotes it and falls back to the
+        # fsync tier rather than silently weakening the ack.
+        lambda: _repl_scenario(
+            "repl:slow@peer0,batch2 thread jsonl",
+            "repl:slow@peer0,batch2", factor=2, quorum=2,
+            mode="thread", fmt=None, n=5, ack_timeout_s=0.15),
+        _disk_eio_group_scenario,
+        _disk_enospc_sync_scenario,
+    ]
+
+
+def run_soak(rounds: int) -> Dict[str, Any]:
+    results: List[Dict[str, Any]] = []
+    t0 = time.monotonic()
+    for r in range(rounds):
+        for fn in scenario_matrix():
+            res = fn()
+            res["round"] = r
+            results.append(res)
+            print(f"  round {r} {res['scenario']}: acked "
+                  f"{res['acked']}, lost {res['lost']} — exact")
+    return {"rounds": rounds, "scenarios": len(scenario_matrix()),
+            "runs": len(results), "wall_s": round(
+                time.monotonic() - t0, 3), "results": results}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="times to loop the full fault matrix")
+    ap.add_argument("--json", default=None,
+                    help="write the structured soak report here")
+    args = ap.parse_args(argv)
+    if args.rounds < 1:
+        ap.error(f"--rounds must be >= 1, got {args.rounds}")
+    try:
+        report = run_soak(args.rounds)
+    except SoakFailure as e:
+        print(f"CHAOS SOAK FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        _integrity.write_json(args.json, report,
+                              schema="rq.chaos.soak/1")
+    print(f"chaos soak OK: {report['runs']} scenario runs "
+          f"({report['rounds']}x{report['scenarios']}), every loss "
+          f"report exact, {report['wall_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

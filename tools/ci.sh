@@ -4,9 +4,23 @@
 # the JSON findings artifact), the integrity/watchdog fault-injection
 # pass (every corruption-detection / quarantine / fallback /
 # self-healing path, deterministically on CPU), then the tier-1 suite
-# (the exact ROADMAP verify command).  Usage: bash tools/ci.sh
+# (the exact ROADMAP verify command).
+#
+# Usage: bash tools/ci.sh              # the full gate
+#        bash tools/ci.sh chaos-soak [N]
+#                                     # loop the repl:*/disk:* fault
+#                                     # matrix N times (default 10) and
+#                                     # fail on any non-exact loss
+#                                     # report — the durability soak
+#                                     # alone, for nightly/long runs
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "chaos-soak" ]]; then
+    echo "== chaos soak: repl:*/disk:* fault matrix =="
+    exec python tools/chaos_soak.py --rounds "${2:-10}" \
+        --json CHAOS_SOAK.json
+fi
 
 echo "== rqlint static pass =="
 # First gate: jax-free, so it fails fast before any backend is touched.
@@ -87,6 +101,15 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
     tests/test_serving_wirespeed.py tests/test_serving_sockets.py \
     tests/test_rqlint.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== durability chaos soak (repl:*/disk:* matrix) =="
+# Every quorum/disk degradation path under injected faults, 3 rounds:
+# follower SIGKILL (real process kill), leader-quorum partition, slow
+# follower forcing demotion to the fsync tier, checkpoint-path
+# EIO/ENOSPC.  Fails on ANY non-exact loss report (reported lost seqs
+# != actually lost) or non-bit-identical replay of a kept record.
+# Nightly runs loop harder: `bash tools/ci.sh chaos-soak 50`.
+python tools/chaos_soak.py --rounds 3
 
 echo "== telemetry suite + overhead smoke =="
 # The unified-telemetry contracts, UNFILTERED (tier-1 runs the fast

@@ -1,0 +1,107 @@
+"""RQ1005 — ack emitted before the durability point.
+
+The serving ack contract (docs/DESIGN.md "Durability modes & the ack
+contract") is positional: an admission/ack frame may only leave a
+function AFTER the statement that makes the acked record durable — the
+journal ``append`` (whose flush mode embeds the fsync/window contract),
+an explicit ``sync``/fsync, or the replication quorum wait.  A refactor
+that hoists the ack above the durability call keeps every test green on
+the happy path and silently converts "acked" into "acked unless we
+crash in the next microsecond" — exactly the regression class the
+quorum work exists to close.
+
+The check is per-function and intra-procedural: a function that BOTH
+emits an ack (a ``write_frame`` whose payload mentions an ack kind, or
+an ``Admission(... "accepted" ...)`` construction) AND contains a
+durability call fires when the first ack emission precedes the first
+durability call in source order.  Functions that only relay acks
+(routers, metrics) contain no durability call and are out of scope by
+construction — the rule polices ordering, not architecture.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import attr_chain, call_args, chain_tail, walk_calls
+from ..findings import finding_at
+from .base import Rule
+
+#: Call tails that ARE a durability point on any path that reaches the
+#: media or the quorum: the journal append (its flush mode embeds the
+#: contract), explicit syncs, and the replication quorum wait.
+DURABILITY_TAILS = {"sync", "fsync", "_fsync_locked", "_do_fsync",
+                    "_await_quorum"}
+
+#: Receiver names that make a bare ``.append(...)`` a JOURNAL append
+#: (list.append is not a durability point).
+_JOURNALISH = {"j", "jr", "_local", "local"}
+
+
+def _is_durability_call(call: ast.Call) -> bool:
+    tail = chain_tail(call.func)
+    if tail in DURABILITY_TAILS:
+        return True
+    if tail == "append":
+        chain = attr_chain(call.func)
+        if len(chain) >= 2:
+            recv = chain[-2].lower()
+            return "journal" in recv or recv in _JOURNALISH
+    return False
+
+
+def _mentions_ack(node: ast.AST) -> bool:
+    """True when the expression subtree names an ack: a string constant
+    containing "ack" or an identifier containing it (``_KIND_ACK``,
+    ``repl.ack`` — the constant-name spelling must count or hoisting the
+    kind into a module constant would blind the rule)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and "ack" in sub.value.lower():
+            return True
+        if isinstance(sub, ast.Name) and "ack" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "ack" in sub.attr.lower():
+            return True
+    return False
+
+
+def _is_ack_emission(call: ast.Call) -> bool:
+    tail = chain_tail(call.func)
+    if tail == "write_frame":
+        return any(_mentions_ack(a) for a in call_args(call))
+    if tail == "Admission":
+        return any(isinstance(a, ast.Constant) and a.value == "accepted"
+                   for a in call_args(call))
+    return False
+
+
+class AckBeforeDurabilityRule(Rule):
+    id = "RQ1005"
+    name = "ack-before-durability"
+    description = ("serving path emits an admission/ack before the "
+                   "durability point (journal append / fsync / quorum "
+                   "wait) that makes the ack true")
+    paths = ("redqueen_tpu/serving/*.py",)
+
+    def check(self, ctx):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            first_durable = None
+            first_ack = None
+            for call in walk_calls(fn):
+                pos = (call.lineno, call.col_offset)
+                if first_durable is None and _is_durability_call(call):
+                    first_durable = pos
+                if first_ack is None and _is_ack_emission(call):
+                    first_ack = pos
+            if first_ack and first_durable and first_ack < first_durable:
+                yield finding_at(
+                    self.id, ctx, None,
+                    f"{fn.name}() emits an ack at line {first_ack[0]} "
+                    f"before its durability point at line "
+                    f"{first_durable[0]} — an ack must never precede "
+                    f"the call that makes it true",
+                    line=first_ack[0], col=first_ack[1])
