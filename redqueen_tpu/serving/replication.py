@@ -29,14 +29,21 @@ Degradation is never silent and never weakens the ack:
 - **partition / slow follower** (no ack before ``ack_timeout_s``): the
   straggler is demoted from the quorum set ("re-election" of the
   voting group) and re-admitted only when its acks catch back up.
+  Demoted followers keep RECEIVING the stream and their acks keep
+  being DRAINED on every append — catch-up (and therefore
+  re-admission) works even while the group is fully degraded, and an
+  unread ack backlog can never wedge the socket pair.
 - **quorum unmeetable**: append falls back to the INLINE local fsync —
   the ack means "on my disk" again (sync tier) rather than pretending
   the network still backs it.  ``degraded_appends`` counts every such
   fallback; the metrics journal-health block surfaces it.
 
 The ``repl:*`` chaos kinds (``runtime.faultinject``) drive each path
-deterministically on CPU CI: ``repl:kill@peerK[,batchN]`` SIGKILLs (or,
-for thread followers, hard-closes) peer K at batch N,
+deterministically on CPU CI: ``repl:kill@peerK[,batchN]`` kills peer K
+at batch N — a real SIGKILL for a process follower; a thread follower
+simulates the node death by power-lossing its replica journal back to
+the checkpoint watermark, so its un-checkpointed records die with the
+"node" exactly as the fault vocabulary promises,
 ``repl:partition@peerK[,batchN]`` drops the leader<->K link both ways,
 ``repl:slow@peerK[,batchN]`` makes follower K sleep past the ack
 deadline from batch N on.
@@ -101,14 +108,6 @@ CHECKPOINT_DELAY_MS = 200.0
 #: that ``_await_quorum`` re-checks its deadline promptly, long enough
 #: that a blocked leader yields the core to its follower threads.
 _ACK_POLL_S = 0.005
-
-
-def _write_all(fd: int, data: bytes) -> None:
-    """Complete a possibly-short write (one writer per socket by
-    construction, same as ``transport.write_frame``)."""
-    view = memoryview(data)
-    while view:
-        view = view[os.write(fd, view):]
 
 
 def _replica_dir(root: str, peer: int) -> str:
@@ -230,7 +229,8 @@ class ReplicatedJournal:
                 else:
                     st.thread = threading.Thread(
                         target=_follower_serve_addr,
-                        args=(lst.address, k, st.dir, self._token, fmt),
+                        args=(lst.address, k, st.dir, self._token, fmt,
+                              True),
                         daemon=True, name=f"repl-follower:{k}")
                     st.thread.start()
                 st.conn, _hello, st.reader = lst.accept(
@@ -240,8 +240,14 @@ class ReplicatedJournal:
 
     def _drop(self, st: _FollowerLink, kill: bool = False) -> None:
         """Tear down one follower link (and, for ``kill``, the follower
-        itself: SIGKILL for a process, hard socket close for a thread —
-        its flushed bytes survive either way, which is the point)."""
+        itself).  A process follower gets a real SIGKILL — its flushed
+        bytes survive in the page cache, which is the point of the
+        acceptance criterion.  A thread follower cannot be SIGKILLed,
+        so its serve loop simulates the node death on EOF: it
+        power-losses its replica journal back to the checkpoint
+        watermark (un-checkpointed records die with the "node") — we
+        join the thread here so that simulation is complete, not
+        racing, by the time loss accounting reads the replica tree."""
         st.live = False
         if kill and st.proc is not None:
             try:
@@ -254,6 +260,8 @@ class ReplicatedJournal:
             except OSError:
                 pass
             st.conn = None
+        if kill and st.thread is not None:
+            st.thread.join(timeout=5.0)
 
     def _apply_leader_faults(self) -> None:
         f = self._fault
@@ -267,6 +275,47 @@ class ReplicatedJournal:
             # it already holds — that is what distinguishes a partition
             # from a death when the loss accounting runs.
             self._followers[f.peer].partitioned = True
+
+    def _send_blob(self, st: _FollowerLink, blob: bytes) -> bool:
+        """Deadline-bounded broadcast write.  The happy path is one
+        buffered send; when the peer's pipe backs up (a stalled or
+        wedged follower), pump its acks while waiting for writability —
+        the classic wedge is a follower blocked on an ack write nobody
+        reads, which in turn stops it reading appends — and if the send
+        still cannot complete before the ack deadline, DROP the peer: a
+        follower that many buffered bytes behind is gone for quorum
+        purposes, and append() must never block on a stuck fd."""
+        if st.conn is None:
+            return False
+        fd = st.conn.fileno()
+        deadline = self._clock() + self.ack_timeout_s
+        view = memoryview(blob)
+        try:
+            os.set_blocking(fd, False)
+            while view:
+                try:
+                    sent = os.write(fd, view)
+                except BlockingIOError:
+                    sent = 0
+                if sent:
+                    view = view[sent:]
+                    continue
+                if self._clock() >= deadline:
+                    self._drop(st)
+                    return False
+                if not self._pump_acks(st):
+                    return False  # peer died under the ack drain
+                select.select([], [fd], [], _ACK_POLL_S)
+        except (OSError, ValueError, _transport.TransportError):
+            self._drop(st)
+            return False
+        finally:
+            if st.conn is not None:
+                try:
+                    os.set_blocking(fd, True)
+                except OSError:
+                    pass
+        return True
 
     # -- the replicated append path -----------------------------------
 
@@ -306,12 +355,14 @@ class ReplicatedJournal:
             blob = _transport.encode_frame(
                 {"kind": _KIND_APPEND, "n": n, "seq": seq,
                  "body_len": len(body)}) + body
+            # Every live reachable follower gets the stream — INCLUDING
+            # demoted (lagging) ones: receiving + acking is how a
+            # straggler catches back up for re-admission.  The send is
+            # deadline-bounded, so a wedged peer is dropped, never
+            # allowed to block the serving hot path.
             for st in self._followers:
                 if st.live and not st.partitioned:
-                    try:
-                        _write_all(st.conn.fileno(), blob)
-                    except (OSError, _transport.TransportError):
-                        self._drop(st)
+                    self._send_blob(st, blob)
             ok = self._await_quorum(n)
             tsp.set(n=n, quorum=int(ok))
         if ok:
@@ -328,14 +379,19 @@ class ReplicatedJournal:
     def _await_quorum(self, n: int) -> bool:
         deadline = self._clock() + self.ack_timeout_s
         while True:
+            # Drain FIRST, every iteration, from every live follower —
+            # demoted ones included.  This is load-bearing twice over:
+            # (a) a fully-degraded group (zero voters) must still
+            # consume follower acks, or the unread backlog eventually
+            # fills both socket buffers and wedges the broadcast; and
+            # (b) acked_n is the only signal a demoted straggler has
+            # caught up, so re-admission must not depend on the vote
+            # ever succeeding.
+            self._drain_acks()
+            self._readmit(n)
             votes = sum(1 for st in self._followers
                         if st.voting() and st.acked_n >= n)
             if votes >= self.quorum:
-                for st in self._followers:
-                    # Re-admission: a demoted straggler that caught
-                    # back up rejoins the quorum set.
-                    if st.lagging and st.live and st.acked_n >= n:
-                        st.lagging = False
                 return True
             if not any(st.voting() and st.acked_n < n
                        for st in self._followers):
@@ -345,7 +401,18 @@ class ReplicatedJournal:
             if self._clock() >= deadline:
                 self._demote_stragglers(n)
                 return False
-            self._drain_acks()
+
+    def _readmit(self, n: int) -> None:
+        """Re-admission, independent of the current vote's outcome: a
+        demoted straggler whose acks caught up through batch ``n - 1``
+        (everything except the batch still in flight) rejoins the
+        quorum set — the vote loop then waits on its ack of ``n`` like
+        any voter's, and a follower that is still genuinely slow just
+        gets demoted again at the deadline."""
+        for st in self._followers:
+            if (st.lagging and st.live and not st.partitioned
+                    and st.acked_n >= n - 1):
+                st.lagging = False
 
     def _demote_stragglers(self, n: int) -> None:
         for st in self._followers:
@@ -463,15 +530,13 @@ class ReplicatedJournal:
         if oldest_retained_seq is not None:
             _journal_mod.prune_segments(self.path, oldest_retained_seq)
         self._local = Journal(self.path, **self._jkw)
-        frame = {"kind": _KIND_ROTATE, "seq": int(seq),
-                 "prune": (None if oldest_retained_seq is None
-                           else int(oldest_retained_seq))}
+        blob = _transport.encode_frame(
+            {"kind": _KIND_ROTATE, "seq": int(seq),
+             "prune": (None if oldest_retained_seq is None
+                       else int(oldest_retained_seq))})
         for st in self._followers:
             if st.live and not st.partitioned:
-                try:
-                    _transport.write_frame(st.conn.fileno(), frame)
-                except (OSError, _transport.TransportError):
-                    self._drop(st)
+                self._send_blob(st, blob)
 
     def power_loss(self) -> Dict[str, Any]:
         """Leader node death: the leader's unflushed window evaporates
@@ -479,9 +544,34 @@ class ReplicatedJournal:
         and their directories survive, which is exactly what
         :func:`heal_from_replicas` consumes.  The returned dict adds
         ``replica_dirs`` (the surviving holders) to the local report."""
+        # Cut the local journal FIRST: the crash is instantaneous, so
+        # the leader's unflushed window must be frozen before anything
+        # below spends wall time — reaping followers can take long
+        # enough for the background flusher to land the tail and
+        # silently shrink the simulated loss window to nothing.
+        info = self._local.power_loss()
         for st in self._followers:
             self._drop(st)
-        info = self._local.power_loss()
+        # Reap the followers: they exit on leader EOF (threads run
+        # their finally — fsync + close of the replica journal; process
+        # followers do the same and then terminate).  Waiting here is
+        # not part of the simulated crash — the replica DIRECTORIES are
+        # what survives — it keeps the loss accounting deterministic
+        # (the replica files are quiescent before healing reads them)
+        # and stops a chaos-soak loop from accumulating zombie
+        # subprocesses, since ``close()`` is a no-op after this.
+        for st in self._followers:
+            if st.thread is not None:
+                st.thread.join(timeout=5.0)
+            if st.proc is not None:
+                try:
+                    st.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    st.proc.kill()
+                    try:
+                        st.proc.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        pass
         info["replica_dirs"] = [st.dir for st in self._followers]
         self._closed = True
         return info
@@ -490,12 +580,23 @@ class ReplicatedJournal:
         if self._closed:
             return
         self._closed = True
+        bye_blob = _transport.encode_frame({"kind": _KIND_CLOSE})
         for st in self._followers:
-            if st.live and st.conn is not None and not st.partitioned:
+            if (st.live and st.conn is not None and not st.partitioned
+                    and self._send_blob(st, bye_blob)):
+                # The follower may have buffered unread acks ahead of
+                # its BYE — consume frames until the BYE itself (or
+                # EOF/timeout), so the handshake is actually confirmed
+                # rather than satisfied by whatever frame came first.
+                deadline = self._clock() + 2.0
                 try:
-                    _transport.write_frame(st.conn.fileno(),
-                                           {"kind": _KIND_CLOSE})
-                    st.reader.read_frame(timeout_s=2.0)
+                    while True:
+                        remaining = deadline - self._clock()
+                        if remaining <= 0:
+                            break
+                        frame = st.reader.read_frame(timeout_s=remaining)
+                        if frame.get("kind") == _KIND_BYE:
+                            break
                 except (_transport.TransportError, OSError):
                     pass
             self._drop(st)
@@ -521,11 +622,15 @@ class ReplicatedJournal:
 # ---------------------------------------------------------------------------
 
 def _follower_serve_addr(address: str, peer: int, dir: str,
-                         token: str, fmt: Optional[str]) -> None:
-    """Dial the leader and serve (the thread-mode entry)."""
+                         token: str, fmt: Optional[str],
+                         simulate_kill: bool = False) -> None:
+    """Dial the leader and serve.  ``simulate_kill=True`` is the
+    thread-mode entry: a ``repl:kill`` fault targeting this peer is
+    simulated in-loop (replica power-loss on the killed link), since a
+    thread cannot receive the real SIGKILL a process follower does."""
     sock = _transport.connect_worker(address, shard=peer, token=token)
     try:
-        _follower_serve(sock, peer, dir, fmt)
+        _follower_serve(sock, peer, dir, fmt, simulate_kill)
     finally:
         try:
             sock.close()
@@ -533,14 +638,27 @@ def _follower_serve_addr(address: str, peer: int, dir: str,
             pass
 
 
-def _follower_serve(sock, peer: int, dir: str,
-                    fmt: Optional[str]) -> None:
+def _follower_serve(sock, peer: int, dir: str, fmt: Optional[str],
+                    simulate_kill: bool = False) -> None:
     """The follower loop: hold every streamed record (page cache via a
     group-mode journal — in-memory receipt that a later SIGKILL of this
     process does NOT evaporate), ack immediately, checkpoint lazily.
     The ack carries this follower's durable watermark — the
     peer-exchanged checkpoint the leader aggregates."""
     fault = _faultinject.repl_fault()
+    # Thread-mode ``repl:kill``: the leader severs this link right
+    # before broadcasting the fault batch; when THAT disconnect lands
+    # (we saw exactly the batches before it), this "node" is dead —
+    # power-loss the replica journal so un-checkpointed records die
+    # with it, as the fault vocabulary documents.  A process follower
+    # never takes this path: it gets the real SIGKILL, whose page-cache
+    # survivals are the thing under test.
+    kill_batch: Optional[int] = None
+    if (simulate_kill and fault is not None and fault.mode == "kill"
+            and fault.peer == peer):
+        kill_batch = fault.batch or 1
+    last_n = 0
+    node_dead = False
     # The replica checkpoint is the LAGGING leg of the quorum tier:
     # receipt (mmap/page cache) is what the ack certifies, so the
     # background fsync can run at a much wider cadence than a leader
@@ -552,6 +670,14 @@ def _follower_serve(sock, peer: int, dir: str,
                       max_flush_delay_ms=CHECKPOINT_DELAY_MS,
                       stage="serving.repl.replica.append")
     reader = _transport.FrameReader(sock.fileno())
+
+    def _disconnected() -> bool:
+        """A severed leader link: the kill shape iff this peer is the
+        thread-kill target and the stream got exactly as far as the
+        fault batch's cut (the leader drops the link BEFORE
+        broadcasting ``kill_batch``, so we hold batches < it)."""
+        return kill_batch is not None and last_n >= kill_batch - 1
+
     try:
         while True:
             try:
@@ -559,10 +685,14 @@ def _follower_serve(sock, peer: int, dir: str,
             except _transport.TransportTimeout:
                 continue
             except (_transport.TransportError, OSError):
-                return  # leader gone: keep what we hold, exit
+                # leader gone (or this "node" killed): exit the loop,
+                # the finally decides what the replica keeps
+                node_dead = _disconnected()
+                return
             kind = frame.get("kind")
             if kind == _KIND_APPEND:
                 n = int(frame.get("n", 0))
+                last_n = max(last_n, n)
                 # Out-of-band body: the leader's single serialization
                 # of the record, read BEFORE any injected slowness so
                 # the stream stays frame-aligned.
@@ -572,6 +702,7 @@ def _follower_serve(sock, peer: int, dir: str,
                         body = reader.read_bytes(
                             int(frame["body_len"]), timeout_s=30.0)
                     except (_transport.TransportError, OSError):
+                        node_dead = _disconnected()
                         return
                 if (fault is not None and fault.mode == "slow"
                         and fault.peer == peer
@@ -589,6 +720,7 @@ def _follower_serve(sock, peer: int, dir: str,
                         {"kind": _KIND_ACK, "n": n,
                          "checkpoint_seq": journal.durable_seq})
                 except (OSError, _transport.TransportError):
+                    node_dead = _disconnected()
                     return
             elif kind == _KIND_ROTATE:
                 # In stream order by construction (one frame channel),
@@ -613,10 +745,19 @@ def _follower_serve(sock, peer: int, dir: str,
                     pass
                 return
     finally:
-        # Thread mode reaches here on leader EOF/close — the journal
-        # fsync is a bonus over the page-cache guarantee.  A real
-        # SIGKILL (process mode) never runs this, by design.
-        journal.close()
+        if node_dead:
+            # The simulated SIGKILL of a thread follower: this "node"
+            # died, so everything past its lagging checkpoint dies too
+            # (``Journal.power_loss`` truncates to the durable
+            # watermark) — the page cache a real SIGKILL would leave
+            # behind belongs to the dead host in this simulation, not
+            # to the still-running test process.
+            journal.power_loss()
+        else:
+            # Thread mode reaches here on leader EOF/close — the
+            # journal fsync is a bonus over the page-cache guarantee.
+            # A real SIGKILL (process mode) never runs this, by design.
+            journal.close()
 
 
 def follower_main(argv: Optional[List[str]] = None) -> int:

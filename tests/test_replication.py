@@ -52,6 +52,14 @@ def _replayed_seqs(path):
     return [r["seq"] for r in recs], torn
 
 
+#: Tests that assert a NONZERO unflushed window at ``power_loss()``
+#: time must hold the leader's background flusher far away — with the
+#: default 50ms cadence the window races the wall clock and closes
+#: itself under a loaded CI box, turning "acked via quorum, not yet on
+#: local media" into a flake.
+_NO_BG_FLUSH_MS = 600_000.0
+
+
 # ---------------------------------------------------------------------------
 # Quorum ack + follower mirroring
 # ---------------------------------------------------------------------------
@@ -123,7 +131,8 @@ class TestHealing:
     def test_leader_power_loss_heals_from_replicas(self, tmp_path):
         p = str(tmp_path / JOURNAL_FILENAME)
         recs = _recs(8)
-        rj = ReplicatedJournal(p, factor=2, quorum=2)
+        rj = ReplicatedJournal(p, factor=2, quorum=2,
+                               max_flush_delay_ms=_NO_BG_FLUSH_MS)
         _append_all(rj, recs)
         pl = rj.power_loss()
         assert pl["dropped_records"] == 8  # nothing locally durable
@@ -136,7 +145,8 @@ class TestHealing:
     def test_partial_local_durability_heals_only_the_tail(self, tmp_path):
         p = str(tmp_path / JOURNAL_FILENAME)
         recs = _recs(6)
-        rj = ReplicatedJournal(p, factor=1, quorum=1)
+        rj = ReplicatedJournal(p, factor=1, quorum=1,
+                               max_flush_delay_ms=_NO_BG_FLUSH_MS)
         _append_all(rj, recs[:3])
         rj.sync()
         _append_all(rj, recs[3:])
@@ -149,7 +159,8 @@ class TestHealing:
 
     def test_inconsistent_holders_refuse_healing(self, tmp_path):
         p = str(tmp_path / JOURNAL_FILENAME)
-        rj = ReplicatedJournal(p, factor=2, quorum=2)
+        rj = ReplicatedJournal(p, factor=2, quorum=2,
+                               max_flush_delay_ms=_NO_BG_FLUSH_MS)
         _append_all(rj, _recs(4))
         pl = rj.power_loss()
         # corrupt one holder's copy of seq 3 (same seq, different body)
@@ -218,6 +229,35 @@ class TestReplFaults:
         assert all(f["live"] for f in rj.followers())
         assert rj.power_loss()["dropped_records"] == 0
 
+    def test_thread_kill_drops_unchecked_records(self, tmp_path,
+                                                 monkeypatch):
+        """A killed THREAD follower must honor the fault vocabulary —
+        'its held records die with it': the serve loop simulates the
+        node death by power-lossing its replica journal, so only a
+        checkpointed prefix survives (here: nothing), and the kill
+        scenario actually exercises quorum-loss accounting instead of
+        quietly fsyncing the replica on EOF."""
+        monkeypatch.setenv("RQ_FAULT", "repl:kill@peer0,batch3")
+        p = str(tmp_path / JOURNAL_FILENAME)
+        recs = _recs(6)
+        rj = ReplicatedJournal(p, factor=2, quorum=1)
+        _append_all(rj, recs)
+        st = rj._followers[0]
+        assert not st.live and not st.thread.is_alive()
+        got, _ = replay(os.path.join(st.dir, JOURNAL_FILENAME))
+        # Only a checkpointed prefix may survive the simulated node
+        # death (normally nothing — the lagging checkpoint cadence is
+        # 200ms — but a loaded box may land one tick), and certainly
+        # nothing from the kill batch on.
+        assert got == recs[:len(got)] and len(got) <= 2
+        # ...and exact accounting still heals everything from the
+        # surviving holder.
+        pl = rj.power_loss()
+        heal = heal_from_replicas(p, pl["replica_dirs"])
+        assert set(pl["dropped_seqs"]) - set(heal["healed_seqs"]) == set()
+        got, _ = replay(p)
+        assert got == recs
+
     def test_slow_follower_is_demoted_not_trusted(self, tmp_path,
                                                   monkeypatch):
         """A follower slower than the ack deadline cannot count toward
@@ -234,6 +274,81 @@ class TestReplFaults:
         assert rj.power_loss()["dropped_records"] == 0
         got, _ = replay(p)
         assert got == recs
+
+
+# ---------------------------------------------------------------------------
+# Degraded-path robustness: re-admission, ack drain, bounded broadcast
+# ---------------------------------------------------------------------------
+
+
+class TestDegradedPathRobustness:
+    def test_demoted_follower_is_readmitted_when_caught_up(self,
+                                                           tmp_path):
+        """Re-admission must not depend on a quorum vote succeeding:
+        with factor=1 a demoted follower means ZERO voters, and the
+        only way back is the per-append ack drain noticing it caught
+        up.  A transient blip must never permanently degrade the group
+        to the sync tier."""
+        p = str(tmp_path / JOURNAL_FILENAME)
+        with ReplicatedJournal(p, factor=1, quorum=1) as rj:
+            _append_all(rj, _recs(3))
+            assert rj.quorum_appends == 3
+            rj._followers[0].lagging = True  # a demotion blip
+            rj.append({"seq": 3, "v": [3, 6]}, seq=3)
+            assert rj._followers[0].lagging is False  # re-admitted
+            assert rj.quorum_appends == 4
+            assert rj.degraded_appends == 0
+
+    def test_stalled_peer_is_dropped_not_wedging_append(self):
+        """The broadcast write is deadline-bounded: a follower that
+        stopped reading (full socket buffers both ways — the ack-write
+        deadlock shape) is DROPPED, and the send returns instead of
+        blocking the serving hot path forever."""
+        import socket as _socket
+        import time as _time
+
+        from redqueen_tpu.serving import transport as _transport
+        from redqueen_tpu.serving.replication import _FollowerLink
+
+        rj = ReplicatedJournal.__new__(ReplicatedJournal)
+        rj._clock = _time.monotonic
+        rj.ack_timeout_s = 0.2
+        a, b = _socket.socketpair()
+        try:
+            a.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 8192)
+            st = _FollowerLink(0, "unused")
+            st.conn = a
+            st.live = True
+            st.reader = _transport.FrameReader(a.fileno())
+            t0 = _time.monotonic()
+            ok = rj._send_blob(st, b"x" * (4 << 20))  # peer never reads
+            wall = _time.monotonic() - t0
+            assert ok is False and st.live is False
+            assert wall < 5.0  # bounded — never a wedge
+        finally:
+            b.close()
+
+    def test_power_loss_reaps_follower_threads(self, tmp_path):
+        """power_loss() quiesces the follower group (threads joined)
+        even though close() becomes a no-op afterwards — the replica
+        files must be static before healing reads them."""
+        rj = ReplicatedJournal(str(tmp_path / JOURNAL_FILENAME),
+                               factor=2, quorum=2)
+        _append_all(rj, _recs(3))
+        threads = [st.thread for st in rj._followers]
+        rj.power_loss()
+        assert all(not t.is_alive() for t in threads)
+        rj.close()  # already closed: still a safe no-op
+
+    def test_close_confirms_bye_past_buffered_acks(self, tmp_path):
+        """With quorum < factor the slower follower's acks routinely
+        sit unread when close() runs; the CLOSE/BYE handshake must
+        consume them and still find the BYE."""
+        p = str(tmp_path / JOURNAL_FILENAME)
+        rj = ReplicatedJournal(p, factor=2, quorum=1)
+        _append_all(rj, _recs(20))
+        rj.close()
+        assert all(not st.thread.is_alive() for st in rj._followers)
 
 
 # ---------------------------------------------------------------------------
@@ -266,6 +381,17 @@ class TestProcessFollowers:
         # the killed holder kept a prefix; the survivor held the rest
         assert max(len(ds) for ds in heal["holders"].values()) >= 1
 
+    def test_power_loss_reaps_follower_processes(self, tmp_path):
+        """Process-mode followers exit on leader EOF; power_loss()
+        must wait() them so a chaos-soak loop never accumulates
+        zombies (close() is a no-op after power_loss)."""
+        rj = ReplicatedJournal(str(tmp_path / JOURNAL_FILENAME),
+                               factor=1, quorum=1, mode="process")
+        _append_all(rj, _recs(3))
+        procs = [st.proc for st in rj._followers]
+        rj.power_loss()
+        assert all(p.poll() is not None for p in procs)
+
     def test_process_followers_never_get_token_via_argv(self, tmp_path):
         rj = ReplicatedJournal(str(tmp_path / JOURNAL_FILENAME),
                                factor=1, quorum=1, mode="process",
@@ -292,7 +418,8 @@ class TestRuntimeWiring:
         rt = serving.ServingRuntime(
             n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
             snapshot_every=10 ** 9, replication_factor=2,
-            journal_format="binary")
+            journal_format="binary",
+            max_flush_delay_ms=_NO_BG_FLUSH_MS)
         for b in _batches(10):
             assert rt.submit(b).status == "accepted"
         while rt.pending:
@@ -312,7 +439,8 @@ class TestRuntimeWiring:
     def test_recover_can_skip_healing(self, tmp_path):
         rt = serving.ServingRuntime(
             n_feeds=N_FEEDS, seed=0, dir=str(tmp_path),
-            snapshot_every=10 ** 9, replication_factor=1)
+            snapshot_every=10 ** 9, replication_factor=1,
+            max_flush_delay_ms=_NO_BG_FLUSH_MS)
         batches = list(_batches(6))
         for b in batches:
             rt.submit(b)
