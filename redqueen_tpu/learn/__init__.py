@@ -12,7 +12,14 @@ parameters back into a RedQueen-controlled simulation.
 - ``learn.hawkes_mle`` — the two solvers (MM/EM, Frank-Wolfe) behind
   :func:`fit_hawkes`; enveloped ``rq.learn.fit/1`` resume checkpoints.
 - ``learn.control``    — fitted :class:`HawkesFit` → ``config.add_hawkes``
-  sources for re-simulation under control.
+  sources for re-simulation under control; stationary-rate reduction
+  (:func:`fit_s_sink`) and the seeded cross-exciting ground-truth
+  simulator (:func:`simulate_cross_exciting`).
+- ``learn.streaming``  — fit WHILE serving: :class:`StreamingEM` tails
+  a serving journal, folds events into exponentially-forgotten
+  sufficient statistics, checkpoints every step, and emits candidate
+  fits for the ``serving.paramswap`` hot-swap gate (docs/DESIGN.md
+  "Fit-while-serving & guarded hot-swap").
 - ``learn.ckpt``       — the shared fit-checkpoint envelope (also used by
   ``models.rmtpp.fit``).
 
@@ -30,6 +37,9 @@ from .control import (
     control_component,
     control_cost,
     cross_excitation_mass,
+    fit_s_sink,
+    simulate_cross_exciting,
+    stationary_rates,
 )
 from .hawkes_mle import SOLVERS, FitError, HawkesFit, fit_hawkes
 from .ingest import (
@@ -42,6 +52,7 @@ from .ingest import (
     from_traces,
 )
 from .loglik import LoglikResult, hawkes_loglik
+from .streaming import StreamingEM, StreamingUpdate, holdout_nll, run_sidecar
 
 __all__ = [
     "EventStream",
@@ -63,4 +74,11 @@ __all__ = [
     "add_fit_walls",
     "control_component",
     "control_cost",
+    "stationary_rates",
+    "fit_s_sink",
+    "simulate_cross_exciting",
+    "StreamingEM",
+    "StreamingUpdate",
+    "holdout_nll",
+    "run_sidecar",
 ]

@@ -338,6 +338,10 @@ def from_journal(dir: str, n_dims: Optional[int] = None,
             quarantine_torn_tail=False)
         top = -1
         for rec in records:
+            if "feeds" not in rec:
+                # Parameter-install (epoch) records share the journal
+                # stream with batch records but carry no events.
+                continue
             f = np.asarray(rec["feeds"], np.int64)
             times_l.append(np.asarray(rec["times"], np.float64))
             feeds_l.append(f + base)

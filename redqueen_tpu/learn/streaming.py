@@ -1,0 +1,431 @@
+"""Fit-while-serving: streaming EM over the live serving journal.
+
+The offline fitter (``learn.hawkes_mle``) answers "what model explains
+this corpus"; this module answers "what model explains the feeds a
+RUNNING deployment is seeing RIGHT NOW" — and keeps the answer fresh as
+the traffic regime drifts.  It is the learner half of the fit-while-
+serving loop; ``serving.paramswap`` is the serving half (gate + atomic
+epoch install).  The two halves share exactly one artifact: the
+integrity-enveloped candidate fit (``rq.learn.candidate/1``).
+
+Design:
+
+- **Tail, don't re-fit.**  Each update step replays the retained journal
+  (``learn.ingest.from_journal`` — JSONL and binary segments alike),
+  keeps only events past the last consumed timestamp, and folds that
+  batch into decayed sufficient statistics::
+
+      acc <- gamma * acc + batch_stats
+
+  with ``batch_stats = (s0, S, W, G, counts, span)`` from the SAME
+  O(n·D) scan the offline EM solver uses (``loglik._stream_pass`` /
+  ``_censored_mass`` — one objective definition repo-wide).  The M-step
+  is the offline solver's closed form on the accumulated statistics, so
+  a stationary stream converges to the batch EM fixed point while a
+  regime shift decays the stale past at rate ``gamma`` per step.
+
+- **Crash-only.**  The learner runs as a supervised sidecar
+  (``runtime.supervisor`` heartbeats + ``runtime.preempt`` checkpoints):
+  its checkpoint (``learn.ckpt``, fingerprinted by the streaming
+  CONFIG — the data is unbounded, the trajectory key is the recipe) is
+  the only state that survives, and every step lands it atomically
+  BEFORE honoring preemption.  A SIGKILL'd learner rerun with the same
+  arguments resumes mid-stream; serving never notices either way.
+
+- **Hand-off is an artifact, not a call.**  ``emit_candidate`` writes
+  the enveloped candidate next to the journal; serving's
+  :class:`~redqueen_tpu.serving.paramswap.ParamSwapper` polls, gates,
+  and installs it.  The learner holds NO handle to the runtime — a
+  learner crash/hang/OOM structurally cannot touch serving.
+
+Deterministic fault drill (``RQ_FAULT``, ``runtime.faultinject``):
+``learn:kill@stepN`` SIGKILLs the process mid-update (after statistics,
+before the checkpoint — the worst spot); ``learn:hang@stepN`` wedges it
+so the supervisor's staleness bound must fire; ``learn:badfit@stepN``
+poisons the M-step output (NaN mu, supercritical alpha) and STILL emits
+the candidate — the serving gate must reject it; ``learn:stale@stepN``
+silences candidate emission without killing the process — serving must
+surface ``stale_params``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Any, Dict, NamedTuple, Optional
+
+import numpy as np
+
+from ..runtime import faultinject as _faultinject
+from ..runtime import preempt as _preempt
+from ..runtime import telemetry as _telemetry
+from ..runtime.supervisor import heartbeat as _heartbeat
+from . import ckpt as _ckpt
+from .control import fit_s_sink
+from .hawkes_mle import _default_beta0, _sanitize
+from .ingest import chunk_events, from_journal, make_stream
+from .loglik import _censored_mass, _stream_pass
+
+__all__ = [
+    "StreamingEM",
+    "StreamingUpdate",
+    "holdout_nll",
+    "run_sidecar",
+]
+
+
+class StreamingUpdate(NamedTuple):
+    """One ``run_once`` outcome: what the learner did this step."""
+
+    step: int              # 1-based update-step counter (the fault clock)
+    n_events: int          # events folded in this step (0 = idle poll)
+    loglik: float          # batch loglik at the PRE-update parameters
+    candidate: Optional[str]   # emitted artifact path, or None
+    fingerprint: Optional[str]  # candidate fingerprint when emitted
+
+
+def holdout_nll(stream, mu, alpha, beta, chunk_size: int = 1024) -> float:
+    """Exact negative log-likelihood of ``(mu, alpha, beta)`` on a
+    held-back event stream — the canary the install gate compares
+    candidate-vs-live on (``serving.paramswap.ParamGate``).  One shared
+    scan + compensator: the SAME objective the fit optimizes, so the
+    gate can never pass a candidate on a different score than the one
+    it was trained against."""
+    import jax
+    import jax.numpy as jnp
+
+    data = chunk_events(stream, chunk_size=chunk_size)
+    D = data.n_dims
+    mu32 = jnp.asarray(np.asarray(mu, np.float64), jnp.float32)
+    a32 = jnp.asarray(np.asarray(alpha, np.float64), jnp.float32)
+    b32 = jnp.asarray(np.asarray(beta, np.float64), jnp.float32)
+    ll_ev, _s0, _S, _W, _h = _stream_pass(
+        jnp.asarray(data.dt), jnp.asarray(data.dims),
+        jnp.asarray(data.mask), mu32, a32, b32, n_dims=D)
+    G = _censored_mass(jnp.asarray(data.tail), jnp.asarray(data.dims),
+                       jnp.asarray(data.mask),
+                       jnp.asarray(data.counts, jnp.float32), b32,
+                       n_dims=D)
+    comp = mu32.sum() * float(data.span) + (a32 * G[None, :]).sum()
+    ll, c = jax.device_get((ll_ev, comp))  # rqlint: disable=RQ701 one blocked transfer per canary evaluation
+    return float(c) - float(ll)
+
+
+class StreamingEM:
+    """Streaming EM consumer of one serving runtime directory.
+
+    ``gamma`` is the per-step forgetting factor on every sufficient
+    statistic (1.0 = never forget — plain incremental EM; smaller
+    adapts faster to regime shifts at the cost of variance).
+    ``holdout_frac`` of each ingested batch (its TAIL — the freshest
+    events) is held back from fitting and kept as the canary window the
+    install gate scores candidates on.  ``ckpt_path`` lands a resumable
+    ``rq.learn.fit/1`` checkpoint every step; ``candidate_path``
+    defaults to ``<dir>/candidate_fit.json``."""
+
+    def __init__(self, dir: str, n_feeds: int, gamma: float = 0.9,
+                 chunk_size: int = 1024, beta_floor: float = 1e-3,
+                 beta_cap: float = 1e4, holdout_frac: float = 0.2,
+                 ckpt_path: Optional[str] = None,
+                 candidate_path: Optional[str] = None,
+                 emit_every: int = 1):
+        if not 0.0 < gamma <= 1.0:
+            raise ValueError(f"gamma must be in (0, 1], got {gamma!r}")
+        if not 0.0 <= holdout_frac < 1.0:
+            raise ValueError(
+                f"holdout_frac must be in [0, 1), got {holdout_frac!r}")
+        if n_feeds < 1:
+            raise ValueError(f"n_feeds must be >= 1, got {n_feeds}")
+        if emit_every < 1:
+            raise ValueError(f"emit_every must be >= 1, got {emit_every}")
+        self.dir = str(dir)
+        self.n_feeds = int(n_feeds)
+        self.gamma = float(gamma)
+        self.chunk_size = int(chunk_size)
+        self.beta_floor = float(beta_floor)
+        self.beta_cap = float(beta_cap)
+        self.holdout_frac = float(holdout_frac)
+        self.emit_every = int(emit_every)
+        from ..serving.paramswap import CANDIDATE_FILENAME
+        self.candidate_path = (
+            os.path.join(self.dir, CANDIDATE_FILENAME)
+            if candidate_path is None else str(candidate_path))
+        self.ckpt_path = ckpt_path
+        # The trajectory key: the RECIPE, not the data (the stream is
+        # unbounded — a resumed learner continues the same trajectory
+        # iff it would compute the same updates from the same journal).
+        self._fp = _ckpt.fingerprint_arrays(dict(
+            kind="streaming_em", n_feeds=self.n_feeds, gamma=self.gamma,
+            chunk_size=self.chunk_size, beta_floor=self.beta_floor,
+            beta_cap=self.beta_cap, holdout_frac=self.holdout_frac,
+            emit_every=self.emit_every))
+        D = self.n_feeds
+        self.step = 0               # 1-based after the first update
+        self.last_t = -np.inf       # consume-watermark (event time)
+        self.holdout = None         # EventStream | None — canary window
+        # Decayed sufficient statistics (host f64).
+        self.acc_s0 = np.zeros(D)
+        self.acc_S = np.zeros((D, D))
+        self.acc_W = np.zeros(D)
+        self.acc_G = np.zeros(D)
+        self.acc_counts = np.zeros(D)
+        self.acc_span = 0.0
+        # Current parameter estimate (sanitized after every M-step).
+        self.mu = np.zeros(D)
+        self.alpha = np.zeros((D, D))
+        self.beta = np.ones(D)
+        self.health = np.zeros(D, np.uint32)
+        self._resume()
+
+    # -- checkpoint / resume ----------------------------------------------
+
+    def _resume(self) -> None:
+        if self.ckpt_path is None:
+            return
+        loaded = _ckpt.load_fit(self.ckpt_path, self._fp)
+        if loaded is None:
+            return
+        step, z, meta = loaded
+        self.step = int(step)
+        self.last_t = float(meta.get("last_t", -np.inf))
+        self.mu = np.asarray(z["mu"], np.float64)
+        self.alpha = np.asarray(z["alpha"], np.float64)
+        self.beta = np.asarray(z["beta"], np.float64)
+        self.health = np.asarray(z["health"], np.uint32)
+        self.acc_s0 = np.asarray(z["s0"], np.float64)
+        self.acc_S = np.asarray(z["S"], np.float64)
+        self.acc_W = np.asarray(z["W"], np.float64)
+        self.acc_G = np.asarray(z["G"], np.float64)
+        self.acc_counts = np.asarray(z["counts"], np.float64)
+        self.acc_span = float(meta.get("span", 0.0))
+
+    def _checkpoint(self) -> None:
+        if self.ckpt_path is None:
+            return
+        _ckpt.save_fit(
+            self.ckpt_path, self._fp, self.step,
+            {"mu": self.mu, "alpha": self.alpha, "beta": self.beta,
+             "health": self.health, "s0": self.acc_s0, "S": self.acc_S,
+             "W": self.acc_W, "G": self.acc_G,
+             "counts": self.acc_counts},
+            meta={"last_t": float(self.last_t),
+                  "span": float(self.acc_span),
+                  "n_feeds": self.n_feeds})
+        # Durable boundary: prove progress, then honor a pending
+        # SIGTERM/SIGINT (a rerun resumes from this artifact).
+        _heartbeat()
+        _preempt.check_preempt(f"streaming EM step {self.step}")
+
+    # -- the stream tail ---------------------------------------------------
+
+    def ingest(self):
+        """New events past the consume-watermark, as a fit window
+        ``[last_t, t_newest]`` — or None when the journal has nothing
+        new (an idle poll).  Reads BOTH journal formats through the one
+        shared adapter (``from_journal`` sniffs per record)."""
+        with _telemetry.span("learn.stream.ingest") as sp:
+            try:
+                full = from_journal(self.dir)
+            except FileNotFoundError:
+                sp.set(n_events=0)
+                return None
+            t = np.asarray(full.times, np.float64)
+            d = np.asarray(full.dims, np.int64)
+            keep = t > self.last_t
+            if not keep.any():
+                sp.set(n_events=0)
+                return None
+            t, d = t[keep], d[keep]
+            t_start = float(self.last_t) if np.isfinite(self.last_t) \
+                else float(min(t[0], 0.0))
+            stream = make_stream(t, d, self.n_feeds,
+                                 t_end=float(t[-1]), t_start=t_start)
+            sp.set(n_events=stream.n_events)
+            return stream
+
+    # -- one EM blend ------------------------------------------------------
+
+    def update(self, stream) -> float:
+        """Fold one ingested window into the decayed statistics and
+        re-solve the closed-form M-step.  Returns the window loglik at
+        the pre-update parameters.  The ``learn:*`` fault point: the
+        1-based step counter is the learner's logical clock."""
+        import jax
+        import jax.numpy as jnp
+
+        self.step += 1
+        lf = _faultinject.learn_fault()
+        fire = (lf is not None
+                and (lf.step is None or lf.step == self.step))
+        if fire and lf.mode == "hang":
+            # Wedge (never heartbeat again): the supervisor's staleness
+            # bound — not this process — must end it.
+            while True:  # pragma: no cover — killed externally
+                time.sleep(0.05)
+        with _telemetry.span("learn.stream.update") as sp:
+            sp.set(step=self.step, n_events=stream.n_events)
+            n = stream.n_events
+            n_hold = int(n * self.holdout_frac)
+            if n_hold and n - n_hold >= 1:
+                cut = n - n_hold
+                t_cut = float(stream.times[cut - 1])
+                self.holdout = make_stream(
+                    stream.times[cut:], stream.dims[cut:], self.n_feeds,
+                    t_end=stream.t_end, t_start=t_cut)
+                stream = make_stream(
+                    stream.times[:cut], stream.dims[:cut], self.n_feeds,
+                    t_end=t_cut, t_start=stream.t_start)
+            data = chunk_events(stream, chunk_size=self.chunk_size)
+            D = self.n_feeds
+            if self.acc_span == 0.0 and not self.mu.any():
+                # First window: seed the estimate from the batch itself
+                # (the offline solver's init).  Zero parameters are an
+                # EM fixed point — with ``alpha = 0`` the E-step
+                # attributes no excitation, so ``S`` (and with it every
+                # later alpha) would stay zero forever.
+                counts64 = np.asarray(data.counts, np.float64)
+                span0 = max(float(data.span), 1e-300)
+                self.mu = 0.5 * counts64 / max(span0, 1e-300)
+                self.beta = _default_beta0(counts64, span0,
+                                           self.beta_floor, self.beta_cap)
+                self.alpha = np.broadcast_to(
+                    (0.1 * self.beta / max(D, 1))[None, :], (D, D)).copy()
+            mu32 = jnp.asarray(self.mu, jnp.float32)
+            a32 = jnp.asarray(self.alpha, jnp.float32)
+            b32 = jnp.asarray(self.beta, jnp.float32)
+            ll_ev, s0, S, W, health = _stream_pass(
+                jnp.asarray(data.dt), jnp.asarray(data.dims),
+                jnp.asarray(data.mask), mu32, a32, b32, n_dims=D)
+            G = _censored_mass(
+                jnp.asarray(data.tail), jnp.asarray(data.dims),
+                jnp.asarray(data.mask),
+                jnp.asarray(data.counts, jnp.float32), b32, n_dims=D)
+            comp = mu32.sum() * float(data.span) + (a32 * G[None, :]).sum()
+            ll_h, s0_h, S_h, W_h, G_h, health_h, comp_h = jax.device_get(  # rqlint: disable=RQ701,RQ702 one blocked sync per streaming update
+                (ll_ev, s0, S, W, G, health, comp))
+            g = self.gamma
+            self.acc_s0 = g * self.acc_s0 + np.asarray(s0_h, np.float64)
+            self.acc_S = g * self.acc_S + np.asarray(S_h, np.float64)
+            self.acc_W = g * self.acc_W + np.asarray(W_h, np.float64)
+            self.acc_G = g * self.acc_G + np.asarray(G_h, np.float64)
+            self.acc_counts = (g * self.acc_counts
+                               + np.asarray(data.counts, np.float64))
+            self.acc_span = g * self.acc_span + float(data.span)
+            # Closed-form M-step on the accumulated statistics (the
+            # offline solver's update, over the decayed horizon).
+            span = max(self.acc_span, 1e-300)
+            mu_n = self.acc_s0 / max(span, 1e-300)
+            alpha_n = self.acc_S / np.maximum(self.acc_G[None, :], 1e-300)
+            P = self.acc_S.sum(0)
+            W_safe = self.acc_W
+            beta_n = np.where(W_safe > 0,
+                              P / np.maximum(W_safe, 1e-300), self.beta)
+            beta_n = np.clip(beta_n, self.beta_floor, self.beta_cap)
+            if fire and lf.mode == "kill":
+                # Mid-fit SIGKILL: statistics computed, checkpoint NOT
+                # landed — the worst instant.  A rerun resumes from the
+                # previous step's checkpoint; serving never notices.
+                os.kill(os.getpid(), signal.SIGKILL)
+            if fire and lf.mode == "badfit":
+                # Poison the fit (NaN base rate + supercritical
+                # excitation) but SKIP sanitization and still emit: the
+                # serving-side gate is the component under test.
+                mu_n = np.full(D, np.nan)
+                alpha_n = np.full((D, D), 2.0)
+                beta_n = np.ones(D)
+                self.mu, self.alpha, self.beta = mu_n, alpha_n, beta_n
+            else:
+                # Health is NOT sticky across streaming updates (unlike
+                # one offline fit): a transient poisoned window must not
+                # quarantine a dimension for the rest of an unbounded
+                # stream — the next clean window re-estimates it.
+                scan_bits = np.asarray(health_h, np.uint32)
+                self.mu, self.alpha, self.beta, self.health = _sanitize(
+                    mu_n, alpha_n, beta_n, self.acc_counts, span,
+                    scan_bits)
+            ll = float(ll_h) - float(comp_h)
+            self.last_t = float(stream.t_end if self.holdout is None
+                                else self.holdout.t_end)
+            sp.set(loglik=ll)
+            return ll
+
+    # -- candidate hand-off ------------------------------------------------
+
+    def candidate_fingerprint(self) -> str:
+        return _ckpt.fingerprint_arrays(
+            {"step": self.step}, self.mu, self.alpha, self.beta)
+
+    def emit_candidate(self) -> Optional[str]:
+        """Write the current estimate as an enveloped candidate for the
+        serving gate.  ``learn:stale`` silences this (the process stays
+        alive — the staleness the serving side must surface); the write
+        itself is atomic (``runtime.integrity``)."""
+        lf = _faultinject.learn_fault()
+        if (lf is not None and lf.mode == "stale"
+                and (lf.step is None or self.step >= lf.step)):
+            return None
+        from ..serving.paramswap import write_candidate
+        fp = self.candidate_fingerprint()
+        with _telemetry.span("learn.stream.swap") as sp:
+            sp.set(step=self.step, fingerprint=fp)
+            s_sink = fit_s_sink((self.mu, self.alpha, self.beta))
+            write_candidate(
+                self.candidate_path, mu=self.mu, alpha=self.alpha,
+                beta=self.beta, s_sink=s_sink, fingerprint=fp,
+                step=self.step,
+                meta={"gamma": self.gamma,
+                      "last_t": float(self.last_t),
+                      "span": float(self.acc_span)})
+        return self.candidate_path
+
+    # -- the sidecar step --------------------------------------------------
+
+    def run_once(self) -> StreamingUpdate:
+        """One sidecar iteration: tail → blend → checkpoint → emit.
+        The checkpoint lands BEFORE the candidate: a crash between the
+        two re-emits the same candidate on resume (the swapper dedups
+        by fingerprint) rather than losing a step."""
+        stream = self.ingest()
+        if stream is None:
+            _heartbeat()
+            return StreamingUpdate(self.step, 0, 0.0, None, None)
+        ll = self.update(stream)
+        self._checkpoint()
+        path = fp = None
+        if self.step % self.emit_every == 0:
+            path = self.emit_candidate()
+            fp = self.candidate_fingerprint() if path else None
+        return StreamingUpdate(self.step, stream.n_events, ll, path, fp)
+
+
+def run_sidecar(dir: str, n_feeds: int, poll_s: float = 0.5,
+                max_steps: Optional[int] = None,
+                idle_limit: Optional[int] = None,
+                **kwargs) -> Dict[str, Any]:
+    """Supervised-sidecar entry point: loop ``run_once`` against a
+    runtime directory, heartbeating every iteration, until ``max_steps``
+    updates land (None = forever, the production shape) or the journal
+    stays silent for ``idle_limit`` consecutive polls.  Returns a
+    summary dict (steps, events, last fingerprint)."""
+    em = StreamingEM(dir, n_feeds, **kwargs)
+    events = 0
+    idle = 0
+    last_fp = None
+    while True:
+        upd = em.run_once()
+        if upd.n_events:
+            idle = 0
+            events += upd.n_events
+            if upd.fingerprint:
+                last_fp = upd.fingerprint
+        else:
+            idle += 1
+            if idle_limit is not None and idle >= idle_limit:
+                break
+        if max_steps is not None and em.step >= max_steps:
+            break
+        if upd.n_events == 0:
+            time.sleep(poll_s)
+    return {"steps": em.step, "events": events,
+            "fingerprint": last_fp, "last_t": float(em.last_t)}

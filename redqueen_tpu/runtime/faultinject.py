@@ -133,6 +133,46 @@ Two ways in:
                             peers.  Data-plane kind: validated at
                             :func:`maybe_inject`, APPLIED by the
                             replication layer via :func:`repl_fault`
+      learn:mode[@stepN]    deterministic fault in the STREAMING LEARNER
+                            sidecar (:mod:`redqueen_tpu.learn.streaming`),
+                            fired when the learner reaches update step N
+                            (1-based; omitted = the first step).  ``kill``
+                            hard-exits the learner mid-fit (``os._exit``,
+                            the SIGKILL shape — serving must keep
+                            last-good parameters and a restarted learner
+                            must resume from its fingerprinted
+                            checkpoint); ``hang`` wedges the learner past
+                            its supervisor deadline (the stale-learner
+                            shape — serving degrades to a surfaced
+                            ``stale_params`` state, never an error);
+                            ``badfit`` poisons the candidate fit the
+                            learner emits at step N (a NaN planted in
+                            mu plus a supercritical branching matrix —
+                            the validation gate must REJECT it, keep
+                            last-good, and count the rejection);
+                            ``stale`` stops the learner emitting
+                            candidates from step N on without dying —
+                            the silent-drift shape the staleness
+                            deadline exists for.  Data-plane kind:
+                            validated at :func:`maybe_inject`, APPLIED
+                            by the learner loop via :func:`learn_fault`
+      swap:mode             deterministic fault on the PARAMETER
+                            HOT-SWAP path
+                            (:mod:`redqueen_tpu.serving.paramswap`).
+                            ``corrupt`` scribbles the candidate-fit
+                            artifact before the gate reads it (the
+                            integrity envelope must catch it —
+                            quarantine, keep last-good); ``reject``
+                            forces the validation gate to veto an
+                            otherwise-good candidate (the
+                            counted-rejection path with no numerics in
+                            the loop); ``rollback`` forces the
+                            post-install canary to report a regression
+                            right after the next install, driving the
+                            rollback-to-last-good path.  Data-plane
+                            kind: validated at :func:`maybe_inject`,
+                            APPLIED by the gate/swapper via
+                            :func:`swap_fault`
       disk:mode@fsyncN      deterministic DISK fault on the journal's
                             checkpoint/fsync path
                             (:mod:`redqueen_tpu.serving.journal`): the
@@ -228,6 +268,14 @@ __all__ = [
     "DISK_MODES",
     "parse_disk",
     "disk_fault",
+    "LearnFault",
+    "LEARN_MODES",
+    "parse_learn",
+    "learn_fault",
+    "SwapFault",
+    "SWAP_MODES",
+    "parse_swap",
+    "swap_fault",
     "hang_forever",
     "crash_with",
     "flaky",
@@ -269,7 +317,7 @@ def parse_fault(spec: str) -> FaultSpec:
     kind = kind.strip().lower()
     if kind not in ("hang", "crash", "transient", "oom", "corrupt",
                     "numeric", "ingest", "shard", "worker", "net",
-                    "repl", "disk"):
+                    "repl", "disk", "learn", "swap"):
         raise ValueError(f"unknown fault spec {spec!r} "
                          f"(want hang|crash|transient|oom[:arg], "
                          f"corrupt:mode@path, "
@@ -278,8 +326,10 @@ def parse_fault(spec: str) -> FaultSpec:
                          f"shard:mode@shardK[,batchN], "
                          f"worker:mode@shardK[,batchN], "
                          f"net:mode@shardK[,batchN], "
-                         f"repl:mode@peerK[,batchN], or "
-                         f"disk:mode@fsyncN)")
+                         f"repl:mode@peerK[,batchN], "
+                         f"disk:mode@fsyncN, "
+                         f"learn:mode[@stepN], or "
+                         f"swap:mode)")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -359,6 +409,14 @@ def inject(spec: FaultSpec) -> None:
         # Same data-plane contract: validated here, applied by the
         # journal's checkpoint/fsync path via disk_fault().
         parse_disk(spec.arg)
+    elif spec.kind == "learn":
+        # Same data-plane contract: validated here, applied by the
+        # streaming-learner loop via learn_fault().
+        parse_learn(spec.arg)
+    elif spec.kind == "swap":
+        # Same data-plane contract: validated here, applied by the
+        # parameter gate/swapper via swap_fault().
+        parse_swap(spec.arg)
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -773,6 +831,98 @@ def disk_fault() -> Optional[DiskFault]:
     if parsed.kind != "disk":
         return None
     return parse_disk(parsed.arg)
+
+
+# --- learn (streaming-learner sidecar) faults: fit-loop failures ----------
+
+LEARN_MODES = ("kill", "hang", "badfit", "stale")
+
+
+class LearnFault(NamedTuple):
+    """Parsed ``learn:mode[@stepN]`` spec.  ``step`` is the learner's
+    1-based UPDATE-STEP counter (its logical clock — one sufficient-
+    statistic blend + M-step per step), not wall time, so the same spec
+    hits the same fit in an uninterrupted run and in a
+    resume-from-checkpoint run; None fires at the first step."""
+
+    mode: str            # kill | hang | badfit | stale
+    step: Optional[int]
+
+
+def parse_learn(arg: Optional[str]) -> LearnFault:
+    """Parse the argument of a ``learn`` fault spec."""
+    if not arg:
+        raise ValueError(
+            f"{ENV_FAULT}=learn needs 'mode[@stepN]' "
+            f"(mode: {'|'.join(LEARN_MODES)})")
+    mode, _, where = arg.partition("@")
+    mode = mode.strip().lower()
+    if mode not in LEARN_MODES:
+        raise ValueError(f"unknown learn fault mode {mode!r} "
+                         f"(want {'|'.join(LEARN_MODES)})")
+    step: Optional[int] = None
+    where = where.strip().lower()
+    if where:
+        if not where.startswith("step"):
+            raise ValueError(f"learn fault needs 'stepN', got {where!r}")
+        try:
+            step = int(where[4:])
+        except ValueError as e:
+            raise ValueError(f"bad step in learn fault: {where!r}") from e
+        if step < 1:
+            raise ValueError(
+                f"learn fault step must be >= 1, got {step}")
+    return LearnFault(mode, step)
+
+
+def learn_fault() -> Optional[LearnFault]:
+    """The env-configured learn fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "learn":
+        return None
+    return parse_learn(parsed.arg)
+
+
+# --- swap (parameter hot-swap) faults: gate/install failures --------------
+
+SWAP_MODES = ("corrupt", "reject", "rollback")
+
+
+class SwapFault(NamedTuple):
+    """Parsed ``swap:mode`` spec.  No positional qualifier: the swap
+    path is already serialized (one candidate in flight at a time), so
+    the fault deterministically hits the next gate/install attempt."""
+
+    mode: str   # corrupt | reject | rollback
+
+
+def parse_swap(arg: Optional[str]) -> SwapFault:
+    """Parse the argument of a ``swap`` fault spec."""
+    if not arg:
+        raise ValueError(
+            f"{ENV_FAULT}=swap needs 'mode' "
+            f"(mode: {'|'.join(SWAP_MODES)})")
+    mode = arg.strip().lower()
+    if mode not in SWAP_MODES:
+        raise ValueError(f"unknown swap fault mode {mode!r} "
+                         f"(want {'|'.join(SWAP_MODES)})")
+    return SwapFault(mode)
+
+
+def swap_fault() -> Optional[SwapFault]:
+    """The env-configured swap fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "swap":
+        return None
+    return parse_swap(parsed.arg)
 
 
 # --- picklable callable faults (spawned-child targets for tests) ---------

@@ -31,6 +31,14 @@ deterministic fault injection) made load-bearing:
 - :mod:`~redqueen_tpu.serving.metrics`  — steady-state counters +
   latency percentiles, landed as the enveloped ``rq.serving.metrics/1``
   artifact;
+- :mod:`~redqueen_tpu.serving.paramswap` — the guarded live parameter
+  hot-swap (:class:`ParamGate` / :class:`ParamSwapper`): every
+  candidate fit from the streaming learner passes finiteness /
+  subcriticality / canary-NLL validation before a digest-asserted
+  atomic install (two-slot epoch swap, epoch + fingerprint journaled
+  so recovery is bit-identical); rejected fits keep last-good, a
+  silent learner surfaces ``stale_params`` (docs/DESIGN.md
+  "Fit-while-serving & guarded hot-swap");
 - :mod:`~redqueen_tpu.serving.cluster`  — sharded fault domains
   (:class:`ServingCluster` / ShardRouter): per-shard journals +
   snapshots + sequencers, health-aware routing
@@ -79,6 +87,18 @@ __all__ = [
     "migrate_to_binary",
     "durability_info",
     "tear_tail",
+    "GROUP_BODY_MAGIC",
+    "pack_group_body",
+    "unpack_group_body",
+    "ParamGate",
+    "ParamSwapper",
+    "ValidatedParams",
+    "GateResult",
+    "CANDIDATE_FILENAME",
+    "write_candidate",
+    "read_candidate",
+    "params_digest",
+    "spectral_radius",
     "ReplicatedJournal",
     "heal_from_replicas",
     "REPLICA_DIR_PREFIX",
@@ -159,6 +179,14 @@ _LAZY_ATTRS = {
     "JOURNAL_FORMATS": ".journal", "journal_format": ".journal",
     "migrate_to_binary": ".journal", "durability_info": ".journal",
     "JournalError": ".journal", "tear_tail": ".journal",
+    "GROUP_BODY_MAGIC": ".journal", "pack_group_body": ".journal",
+    "unpack_group_body": ".journal",
+    "paramswap": None,
+    "ParamGate": ".paramswap", "ParamSwapper": ".paramswap",
+    "ValidatedParams": ".paramswap", "GateResult": ".paramswap",
+    "CANDIDATE_FILENAME": ".paramswap",
+    "write_candidate": ".paramswap", "read_candidate": ".paramswap",
+    "params_digest": ".paramswap", "spectral_radius": ".paramswap",
     "CLUSTER_METRICS_SCHEMA": ".metrics", "ClusterMetrics": ".metrics",
     "METRICS_SCHEMA": ".metrics", "ServingMetrics": ".metrics",
     "Admission": ".service", "CONFIG_SCHEMA": ".service",

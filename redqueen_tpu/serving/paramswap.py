@@ -1,0 +1,413 @@
+"""Guarded live parameter hot-swap: the only road into the policy.
+
+The serving runtime's control law u*(t) = sqrt(s/q)*r(t) is only as
+good as the intensity parameters feeding it, and a live parameter swap
+is the one mutation the serving stack has no other defense for: a bad
+fit installed uncritically is a silent correctness outage.  This module
+is the defense — docs/DESIGN.md "Fit-while-serving & guarded hot-swap":
+
+- **Gate policy** (:class:`ParamGate`): a candidate fit must be
+  structurally sound (finite, non-negative, shapes consistent),
+  SUBCRITICAL (spectral radius of the branching matrix alpha/beta < 1
+  — the same domain contract ``config.add_hawkes`` warns on), and must
+  not regress a held-back-window NLL canary past a relative bound.
+  Only the gate mints :class:`ValidatedParams`; rqlint RQ1006 makes a
+  raw assignment to the live policy params a tier-1 finding, so the
+  type system and the linter close the same door.
+- **Epoch protocol**: ``ServingRuntime.install_params`` performs a
+  two-slot epoch swap — the new arrays are installed under an
+  incremented epoch and the previous slot is retained; in-flight
+  jitted applies captured the old arrays as arguments, so they finish
+  on the old epoch with no lock on the decision path.  Every install
+  is journaled (epoch, params, fit fingerprint, params digest) and
+  mirrored into a ``params_log.json`` sidecar so recovery replays
+  every batch under the epoch that actually decided it, even after
+  pre-install segments are pruned.
+- **Rollback**: a post-install canary regression (or the forced
+  ``swap:rollback`` fault) re-installs the previous last-good params
+  as a NEW epoch through the same gate/install path — rollback is an
+  install, never a mutation.
+- **Staleness contract** (:meth:`ParamSwapper.status`): a learner dead
+  past ``stale_after_s`` degrades to a surfaced ``stale_params`` state
+  — serving keeps last-good and keeps answering; staleness is a
+  reported condition, never an error on the decision path.
+
+Failure drill (``runtime.faultinject``): ``swap:corrupt`` scribbles
+the candidate artifact before the gate reads it (integrity quarantine,
+keep last-good), ``swap:reject`` forces a gate veto on a good
+candidate (counted rejection), ``swap:rollback`` forces a post-install
+canary regression (rollback path).  All deterministic, CPU-only.
+
+jax-free on purpose: the gate, the swapper, and the artifact I/O run
+in jax-free contexts (chaos soak, worker children); the NLL canary is
+a caller-supplied callable so the jax-backed loglik scan stays in
+:mod:`redqueen_tpu.learn.streaming`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import numpy as np
+
+from ..runtime import faultinject as _faultinject
+from ..runtime import integrity as _integrity
+from ..runtime import telemetry as _telemetry
+
+__all__ = [
+    "CANDIDATE_SCHEMA",
+    "CANDIDATE_FILENAME",
+    "PARAMS_LOG_SCHEMA",
+    "PARAMS_LOG_FILENAME",
+    "ValidatedParams",
+    "GateResult",
+    "ParamGate",
+    "ParamSwapper",
+    "write_candidate",
+    "read_candidate",
+    "params_digest",
+    "spectral_radius",
+]
+
+# The learner's hand-off artifact: an enveloped JSON candidate fit.
+# Enveloped (sha256) so a torn/scribbled hand-off is DETECTED at the
+# gate, quarantined, and serving keeps last-good — never a crash.
+CANDIDATE_SCHEMA = "rq.learn.candidate/1"
+CANDIDATE_FILENAME = "candidate_fit.json"
+
+# Sidecar install log beside the journal: the full install history
+# (epoch, seq, params, fingerprint).  Recovery needs it when the
+# journal segments holding old epoch records have been pruned: prune
+# only drops segments covered by the OLDEST retained snapshot, so the
+# newest sidecar entry with seq <= the restored snapshot's seq is
+# always the params that were live at that snapshot.
+PARAMS_LOG_SCHEMA = "rq.serving.params_log/1"
+PARAMS_LOG_FILENAME = "params_log.json"
+
+# Gate defaults: a candidate may not regress the held-back-window NLL
+# by more than this relative bound, and the branching matrix's
+# spectral radius must stay strictly below the cap (subcritical — a
+# supercritical fit predicts infinite stationary intensity and the
+# control law's sqrt(s/q) scaling is meaningless).
+DEFAULT_NLL_BOUND = 0.05
+DEFAULT_BRANCHING_CAP = 1.0
+
+
+def params_digest(s_sink: np.ndarray, q: float) -> str:
+    """16-hex digest of exactly the arrays that go live.  Asserted by
+    ``install_params`` immediately before the flip (the gate computed
+    it from the arrays it validated; a mismatch means the token was
+    tampered with between gate and install) and journaled with the
+    epoch so recovery can re-assert bit-identity."""
+    h = hashlib.sha256(b"rq.params/1")
+    a = np.ascontiguousarray(np.asarray(s_sink, np.float64))
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    h.update(np.float64(q).tobytes())
+    return h.hexdigest()[:16]
+
+
+def spectral_radius(branching: np.ndarray) -> float:
+    """max |eigenvalue| of the branching matrix B = alpha / beta (the
+    expected direct-offspring counts); rho(B) < 1 iff the fitted
+    process is stationary."""
+    b = np.asarray(branching, np.float64)
+    if b.ndim == 1:
+        b = np.diag(b)
+    return float(np.max(np.abs(np.linalg.eigvals(b))))
+
+
+class ValidatedParams(NamedTuple):
+    """The install token: parameters that passed the gate.  Minted ONLY
+    by :class:`ParamGate` — ``ServingRuntime.install_params`` refuses
+    anything else, and rqlint RQ1006 flags raw assignments that would
+    bypass both."""
+
+    s_sink: np.ndarray    # f64[F] significance vector, normalized
+    q: float              # cost price (operator-set; fits may echo it)
+    fingerprint: str      # the FIT fingerprint (learner ckpt identity)
+    digest: str           # params_digest(s_sink, q) — asserted at install
+    step: int             # learner update step that produced the fit
+    meta: Dict[str, Any]  # gate measurements (nll, rho, ...) for the log
+
+
+class GateResult(NamedTuple):
+    ok: bool
+    reason: str                        # "" when ok
+    params: Optional[ValidatedParams]  # None when rejected
+    measurements: Dict[str, Any]       # rho, nll_candidate, nll_baseline
+
+
+def write_candidate(path: str, *, mu, alpha, beta, s_sink,
+                    fingerprint: str, step: int, q: Optional[float] = None,
+                    meta: Optional[Dict[str, Any]] = None) -> None:
+    """Atomically land the learner's candidate fit as an enveloped
+    artifact.  ``alpha`` is the FULL branching-numerator matrix (the
+    off-diagonal mass is what the gate's subcriticality check needs;
+    ``s_sink`` is already the stationary-intensity reduction of it)."""
+    payload = {
+        "mu": np.asarray(mu, np.float64).tolist(),
+        "alpha": np.asarray(alpha, np.float64).tolist(),
+        "beta": np.asarray(beta, np.float64).tolist(),
+        "s_sink": np.asarray(s_sink, np.float64).tolist(),
+        "q": None if q is None else float(q),
+        "fingerprint": str(fingerprint),
+        "step": int(step),
+        "meta": dict(meta or {}),
+    }
+    _integrity.write_json(path, payload, schema=CANDIDATE_SCHEMA)
+
+
+def read_candidate(path: str) -> Dict[str, Any]:
+    """Read + verify a candidate artifact; raises
+    :class:`runtime.integrity.CorruptArtifactError` (after moving the
+    file aside) on any integrity failure — the quarantine path the
+    ``swap:corrupt`` fault exercises."""
+    return _integrity.read_json(path, schema=CANDIDATE_SCHEMA)
+
+
+class ParamGate:
+    """The validation gate.  Stateless apart from its bounds; every
+    :meth:`validate` call is a fresh verdict."""
+
+    def __init__(self, nll_bound: float = DEFAULT_NLL_BOUND,
+                 branching_cap: float = DEFAULT_BRANCHING_CAP):
+        if not (nll_bound >= 0.0):
+            raise ValueError(f"nll_bound must be >= 0, got {nll_bound}")
+        if not (0.0 < branching_cap <= 1.0):
+            raise ValueError(
+                f"branching_cap must be in (0, 1], got {branching_cap}")
+        self.nll_bound = float(nll_bound)
+        self.branching_cap = float(branching_cap)
+
+    def validate(self, candidate: Dict[str, Any],
+                 current_q: float,
+                 canary: Optional[Callable[..., float]] = None,
+                 baseline_nll: Optional[float] = None) -> GateResult:
+        """Judge one candidate fit.
+
+        ``canary(mu, alpha, beta) -> float`` computes the candidate's
+        NLL on a held-back window; ``baseline_nll`` is last-good's NLL
+        on the SAME window.  Either absent -> the canary check is
+        skipped (structural + subcriticality still hold the line)."""
+        meas: Dict[str, Any] = {}
+        sf = _faultinject.swap_fault()
+        if sf is not None and sf.mode == "reject":
+            return GateResult(False, "forced reject (swap:reject fault)",
+                              None, meas)
+        try:
+            mu = np.asarray(candidate["mu"], np.float64)
+            alpha = np.asarray(candidate["alpha"], np.float64)
+            beta = np.asarray(candidate["beta"], np.float64)
+            s_sink = np.asarray(candidate["s_sink"], np.float64)
+            fingerprint = str(candidate["fingerprint"])
+            step = int(candidate["step"])
+        except (KeyError, TypeError, ValueError) as e:
+            return GateResult(False, f"malformed candidate: {e}", None, meas)
+        if alpha.ndim == 1:
+            alpha = np.diag(alpha)
+        d = mu.shape[0] if mu.ndim == 1 else -1
+        if (mu.ndim != 1 or beta.shape != (d,) or alpha.shape != (d, d)
+                or s_sink.ndim != 1 or s_sink.size == 0):
+            return GateResult(
+                False, f"inconsistent shapes: mu {mu.shape}, alpha "
+                       f"{alpha.shape}, beta {beta.shape}, s_sink "
+                       f"{s_sink.shape}", None, meas)
+        for name, arr in (("mu", mu), ("alpha", alpha), ("beta", beta),
+                          ("s_sink", s_sink)):
+            if not np.all(np.isfinite(arr)):
+                return GateResult(False, f"non-finite {name}", None, meas)
+            if np.any(arr < 0.0):
+                return GateResult(False, f"negative {name}", None, meas)
+        if np.any(beta <= 0.0):
+            return GateResult(False, "beta must be > 0", None, meas)
+        if not (s_sink.sum() > 0.0):
+            return GateResult(False, "s_sink sums to 0", None, meas)
+        rho = spectral_radius(alpha / beta[None, :])
+        meas["rho"] = rho
+        if not (rho < self.branching_cap):
+            return GateResult(
+                False, f"supercritical fit: spectral radius {rho:.4f} "
+                       f">= {self.branching_cap}", None, meas)
+        if canary is not None and baseline_nll is not None:
+            cand_nll = float(canary(mu, alpha, beta))
+            meas["nll_candidate"] = cand_nll
+            meas["nll_baseline"] = float(baseline_nll)
+            if not np.isfinite(cand_nll):
+                return GateResult(False, "non-finite canary NLL",
+                                  None, meas)
+            bound = baseline_nll + self.nll_bound * abs(baseline_nll)
+            if cand_nll > bound:
+                return GateResult(
+                    False, f"canary NLL regression: {cand_nll:.6g} > "
+                           f"bound {bound:.6g} (baseline "
+                           f"{baseline_nll:.6g})", None, meas)
+        q = float(current_q if candidate.get("q") is None
+                  else candidate["q"])
+        s64 = np.ascontiguousarray(s_sink, dtype=np.float64)
+        vp = ValidatedParams(s_sink=s64, q=q, fingerprint=fingerprint,
+                             digest=params_digest(s64, q), step=step,
+                             meta=meas)
+        return GateResult(True, "", vp, meas)
+
+    def revalidate(self, s_sink, q: float, fingerprint: str,
+                   step: int = 0) -> ValidatedParams:
+        """Re-mint a token for parameters that ALREADY served live (the
+        rollback path re-installs last-good): structural checks only —
+        they held the line once; the canary cannot be re-run against a
+        window that has moved on."""
+        s = np.ascontiguousarray(np.asarray(s_sink, np.float64))
+        if s.ndim != 1 or s.size == 0 or not np.all(np.isfinite(s)) \
+                or np.any(s < 0.0) or not (s.sum() > 0.0):
+            raise ValueError(f"rollback params fail structural checks: "
+                             f"{s!r}")
+        qf = float(q)
+        if not (np.isfinite(qf) and qf > 0.0):
+            raise ValueError(f"rollback q must be finite > 0, got {qf}")
+        return ValidatedParams(s_sink=s, q=qf, fingerprint=str(fingerprint),
+                               digest=params_digest(s, qf), step=int(step),
+                               meta={"rollback": True})
+
+
+class ParamSwapper:
+    """Drives candidates from the learner's artifact into the live
+    policy, owns the reject/quarantine/rollback counters, and surfaces
+    the staleness contract.  One swapper per runtime; the swap path is
+    serialized by construction (one candidate in flight)."""
+
+    def __init__(self, runtime, gate: Optional[ParamGate] = None,
+                 stale_after_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._rt = runtime
+        self.gate = gate or ParamGate()
+        self.stale_after_s = float(stale_after_s)
+        self._clock = clock
+        self._last_seen_fingerprint: Optional[str] = None
+        # The learner is considered alive as of swapper birth: serving
+        # just started with vetted initial params.
+        self._last_candidate_t = clock()
+        self.installs = 0
+        self.rejections = 0
+        self.quarantined = 0
+        self.rollbacks = 0
+
+    # -- the swap ----------------------------------------------------------
+
+    def offer(self, candidate: Dict[str, Any],
+              canary: Optional[Callable[..., float]] = None,
+              baseline_nll: Optional[float] = None) -> Dict[str, Any]:
+        """Gate one candidate and, on pass, install it.  Returns a
+        result dict; never raises on a rejected fit (rejection is an
+        accounted outcome, not an error)."""
+        with _telemetry.span("serving.paramswap.offer",
+                             fingerprint=str(candidate.get(
+                                 "fingerprint", "?"))) as sp:
+            self._last_candidate_t = self._clock()
+            prev = self._rt.live_params()
+            res = self.gate.validate(candidate, current_q=prev["q"],
+                                     canary=canary,
+                                     baseline_nll=baseline_nll)
+            if not res.ok:
+                self.rejections += 1
+                _telemetry.counter("serving.paramswap.rejected")
+                sp.set(outcome="rejected", reason=res.reason)
+                return {"installed": False, "rolled_back": False,
+                        "reason": res.reason, "epoch": prev["epoch"],
+                        "measurements": res.measurements}
+            epoch = self._rt.install_params(res.params)
+            self.installs += 1
+            _telemetry.counter("serving.paramswap.installed")
+            sp.event("swap", epoch=epoch,
+                     fingerprint=res.params.fingerprint,
+                     digest=res.params.digest)
+            out = {"installed": True, "rolled_back": False, "reason": "",
+                   "epoch": epoch, "measurements": res.measurements}
+            sf = _faultinject.swap_fault()
+            regressed = sf is not None and sf.mode == "rollback"
+            if regressed:
+                out.update(self.rollback(
+                    "forced post-install canary regression "
+                    "(swap:rollback fault)", previous=prev))
+                out["rolled_back"] = True
+            sp.set(outcome="rolled_back" if regressed else "installed",
+                   epoch=out["epoch"])
+            return out
+
+    def rollback(self, reason: str,
+                 previous: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        """Re-install the previous params as a NEW epoch (rollback is
+        an install, never a mutation).  ``previous`` defaults to the
+        runtime's retained previous slot."""
+        prev = previous if previous is not None \
+            else self._rt.previous_params()
+        if prev is None:
+            raise RuntimeError("no previous parameter slot to roll "
+                               "back to")
+        vp = self.gate.revalidate(prev["s_sink"], prev["q"],
+                                  prev["fingerprint"])
+        epoch = self._rt.install_params(vp)
+        self.rollbacks += 1
+        _telemetry.counter("serving.paramswap.rollback")
+        _telemetry.event("swap", epoch=epoch, fingerprint=vp.fingerprint,
+                         digest=vp.digest, rollback=True, reason=reason)
+        return {"epoch": epoch, "rollback_reason": reason}
+
+    # -- the artifact poll loop --------------------------------------------
+
+    def poll_artifact(self, path: str,
+                      canary: Optional[Callable[..., float]] = None,
+                      baseline_nll: Optional[float] = None
+                      ) -> Optional[Dict[str, Any]]:
+        """Check the learner's hand-off path; offer a NEW candidate
+        (unseen fingerprint), return None when there is nothing new.
+        The ``swap:corrupt`` fault scribbles the artifact here, before
+        the read — the integrity envelope catches it, the file is
+        quarantined (moved aside), and serving stays on last-good."""
+        if not os.path.exists(path):
+            return None
+        sf = _faultinject.swap_fault()
+        if sf is not None and sf.mode == "corrupt":
+            _faultinject.corrupt_file(path, "bitflip")
+        try:
+            candidate = read_candidate(path)
+        except _integrity.CorruptArtifactError as e:
+            self.quarantined += 1
+            _telemetry.counter("serving.paramswap.quarantined")
+            return {"installed": False, "rolled_back": False,
+                    "reason": f"quarantined candidate artifact: {e}",
+                    "epoch": self._rt.live_params()["epoch"],
+                    "measurements": {}}
+        fp = str(candidate.get("fingerprint", ""))
+        if fp and fp == self._last_seen_fingerprint:
+            self._last_candidate_t = self._clock()  # learner is alive
+            return None
+        self._last_seen_fingerprint = fp
+        return self.offer(candidate, canary=canary,
+                          baseline_nll=baseline_nll)
+
+    # -- the staleness contract --------------------------------------------
+
+    def status(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The surfaced learner/params state: ``fresh`` while
+        candidates keep arriving, ``stale_params`` once the learner has
+        been silent past the deadline.  Never an error — serving keeps
+        answering on last-good either way."""
+        t = self._clock() if now is None else now
+        age = max(0.0, t - self._last_candidate_t)
+        live = self._rt.live_params()
+        return {
+            "state": ("stale_params" if age > self.stale_after_s
+                      else "fresh"),
+            "age_s": age,
+            "stale_after_s": self.stale_after_s,
+            "epoch": live["epoch"],
+            "fingerprint": live["fingerprint"],
+            "installs": self.installs,
+            "rejections": self.rejections,
+            "quarantined": self.quarantined,
+            "rollbacks": self.rollbacks,
+        }

@@ -344,10 +344,27 @@ class ReplicatedJournal:
             seq = int(payload["seq"])
         body = json.dumps(payload,
                           separators=(",", ":")).encode("utf-8")
+        self._append_body(body, payload, seq)
+
+    def append_raw(self, body: bytes, seq: Optional[int] = None) -> None:
+        """Replicate one PRE-SERIALIZED record body (compact JSON or a
+        :func:`journal.pack_group_body` packed group) — same contract
+        as :meth:`Journal.append_raw`, same quorum/degraded tiers as
+        :meth:`append`.  The zero-copy group path: the leader packs the
+        flat arrays once and the identical bytes land locally, on the
+        wire, and in every replica."""
+        self._append_body(body, None, None if seq is None else int(seq))
+
+    def _append_body(self, body: bytes, payload: Optional[Dict[str, Any]],
+                     seq: Optional[int]) -> None:
         if self.fmt == "binary":
             self._local.append_raw(body, seq=seq)
-        else:
+        elif payload is not None:
             self._local.append(payload, seq=seq)
+        else:
+            # JSONL local journal still pays its envelope; append_raw
+            # parses the body back (packed groups included).
+            self._local.append_raw(body, seq=seq)
         self._n += 1
         n = self._n
         self._apply_leader_faults()

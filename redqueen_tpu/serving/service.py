@@ -55,6 +55,8 @@ from .ingest import Sequencer
 from .journal import (FLUSH_MODES, JOURNAL_FILENAME, Journal,
                       replay as journal_replay)
 from .metrics import ServingMetrics
+from .paramswap import (PARAMS_LOG_FILENAME, PARAMS_LOG_SCHEMA,
+                        ValidatedParams, params_digest)
 from .state import (Decision, FeedState, init_feed_state, make_apply_fn,
                     make_coalesced_apply_fn, poison_edge, state_digest)
 
@@ -214,6 +216,16 @@ class ServingRuntime:
         self._clock = clock
         self._s_sink = jnp.asarray(s, jnp.float32)
         self._q = jnp.asarray(self.q, jnp.float32)
+        # Two-slot epoch state for the guarded hot-swap (serving.
+        # paramswap): epoch 0 is the constructor's vetted params; every
+        # install bumps the epoch and retains the outgoing slot as the
+        # rollback target.  The jnp param arrays are immutable and the
+        # jitted applies take them as ARGUMENTS, so an in-flight apply
+        # that captured the old arrays finishes on the old epoch with
+        # no lock on the decision path.
+        self._param_epoch = 0
+        self._param_fingerprint = "initial"
+        self._param_prev: Optional[Dict[str, Any]] = None
         self._apply = make_apply_fn()
         self._apply_many = (make_coalesced_apply_fn()
                             if self.coalesce > 1 else None)
@@ -400,6 +412,113 @@ class ServingRuntime:
                 f"runtime serves {self.n_feeds}")
         self._state = state
         self._seq.next_seq = int(np.asarray(state.seq)) + 1
+
+    # ---- live-parameter epoch swap (serving.paramswap is the gate) ----
+
+    def live_params(self) -> Dict[str, Any]:
+        """The policy parameters currently deciding, as host arrays —
+        what the swapper snapshots before an install (the rollback
+        target) and what ``status`` surfaces."""
+        return {
+            "s_sink": np.asarray(self._s_sink, np.float64).copy(),
+            "q": float(np.asarray(self._q)),
+            "epoch": self._param_epoch,
+            "fingerprint": self._param_fingerprint,
+        }
+
+    def previous_params(self) -> Optional[Dict[str, Any]]:
+        """The retained previous slot (last-good before the newest
+        install); None before any install."""
+        return None if self._param_prev is None else dict(self._param_prev)
+
+    def install_params(self, vp: ValidatedParams) -> int:
+        """Atomically install gate-validated parameters as a new epoch.
+
+        Takes ONLY a :class:`serving.paramswap.ValidatedParams` token
+        (minted by ``ParamGate`` — the validation gate is the one road
+        into the live policy; rqlint RQ1006 flags raw-assignment
+        bypasses).  The token's digest is re-derived from the arrays
+        immediately before the flip — a mismatch means tampering
+        between gate and install and refuses loudly.  Returns the new
+        epoch."""
+        if not isinstance(vp, ValidatedParams):
+            raise TypeError(
+                f"install_params takes a ValidatedParams token minted "
+                f"by serving.paramswap.ParamGate, got "
+                f"{type(vp).__name__} — raw parameters cannot be "
+                f"installed into the live policy")
+        s = np.ascontiguousarray(np.asarray(vp.s_sink, np.float64))
+        if s.shape != (self.n_feeds,):
+            raise ValueError(
+                f"candidate s_sink has shape {s.shape}, this runtime "
+                f"serves {self.n_feeds} feeds")
+        q = float(vp.q)
+        got = params_digest(s, q)
+        if got != vp.digest:
+            raise RuntimeError(
+                f"params digest mismatch at install: token says "
+                f"{vp.digest}, arrays hash to {got} — the token was "
+                f"altered after validation; refusing to install")
+        return self._install_validated(s, q, vp.fingerprint, vp.digest)
+
+    def _install_validated(self, s64: np.ndarray, q: float,
+                           fingerprint: str, digest: str,
+                           journal: bool = True) -> int:
+        """The ONE sanctioned assignment site for the live policy
+        params outside ``__init__`` (RQ1006's allowlist).  Journals the
+        install (digest-asserted epoch record, fsynced — never inside
+        the group-commit loss window) and mirrors it into the
+        ``params_log.json`` sidecar so recovery replays every batch
+        under the epoch that decided it even after segment pruning;
+        ``journal=False`` is recovery re-installing an epoch the
+        journal already carries."""
+        import jax.numpy as jnp
+
+        self._param_prev = self.live_params()
+        self._param_epoch += 1
+        self._param_fingerprint = str(fingerprint)
+        self._s_sink = jnp.asarray(s64, jnp.float32)
+        self._q = jnp.asarray(q, jnp.float32)
+        self.q = float(q)
+        if journal and self._journal is not None:
+            rec = {
+                "epoch": self._param_epoch,
+                "seq": self.applied_seq,
+                "s_sink": [float(x) for x in s64],
+                "q": float(q),
+                "fingerprint": str(fingerprint),
+                "digest": str(digest),
+                "state_digest": state_digest(self._state),
+            }
+            try:
+                self._journal.append(rec, seq=self.applied_seq)
+                # The install record must never sit in the async loss
+                # window: a crash right after an install has to replay
+                # under the installed epoch, so force it to media (and
+                # to the replicas' checkpoint path) before returning.
+                self._journal.sync()
+            except OSError as e:
+                raise RuntimeError(
+                    f"journal append failed for epoch "
+                    f"{self._param_epoch} install: {e} — parameter "
+                    f"installs must be durable; restart and recover "
+                    f"from {self.dir}") from e
+            self._append_params_log(rec)
+        return self._param_epoch
+
+    def _append_params_log(self, rec: Dict[str, Any]) -> None:
+        """Mirror one install into the sidecar log (full history,
+        atomic rewrite — installs are rare; the journal's epoch record
+        is the hot-path write, this is the prune-survivable index)."""
+        path = os.path.join(self.dir, PARAMS_LOG_FILENAME)
+        try:
+            log = _integrity.read_json(path, schema=PARAMS_LOG_SCHEMA)
+        except FileNotFoundError:
+            log = {"installs": []}
+        log["installs"].append(
+            {k: rec[k] for k in ("epoch", "seq", "s_sink", "q",
+                                 "fingerprint", "digest")})
+        _integrity.write_json(path, log, schema=PARAMS_LOG_SCHEMA)
 
     def submit(self, batch: EventBatch,
                _validated: bool = False) -> Admission:
@@ -684,22 +803,44 @@ class ServingRuntime:
                      stale_batches=stale)
             for j, (b, _at) in enumerate(group)]
         if self._journal is not None:
-            rec = {
-                "seqs": [int(b.seq) for b, _ in group],
-                "counts": [int(b.n_events) for b, _ in group],
-                "times": [float(t) for b, _ in group for t in b.times],
-                "feeds": [int(f) for b, _ in group for f in b.feeds],
-                "decisions": [{"post": d.post, "post_time": d.post_time,
-                               "intensity": d.intensity}
-                              for d in decisions],
-                "state_digest": state_digest(new_state),
-            }
+            seqs_l = [int(b.seq) for b, _ in group]
+            dec_l = [{"post": d.post, "post_time": d.post_time,
+                      "intensity": d.intensity} for d in decisions]
+            digest = state_digest(new_state)
             try:
-                self._journal.append(rec, seq=int(group[-1][0].seq))
+                if self.journal_format == "binary":
+                    # Zero-copy group record: the validated batch
+                    # arrays land in the binary slot as raw bytes
+                    # (journal.pack_group_body) — no per-event JSON
+                    # float walk on the leader (ROADMAP residue 1(a)).
+                    from .journal import pack_group_body
+                    body = pack_group_body(
+                        seqs_l,
+                        [int(b.n_events) for b, _ in group],
+                        np.concatenate(
+                            [np.asarray(b.times, np.float64)
+                             for b, _ in group]),
+                        np.concatenate(
+                            [np.asarray(b.feeds, np.int64)
+                             for b, _ in group]),
+                        dec_l, digest)
+                    self._journal.append_raw(body, seq=seqs_l[-1])
+                else:
+                    rec = {
+                        "seqs": seqs_l,
+                        "counts": [int(b.n_events) for b, _ in group],
+                        "times": [float(t) for b, _ in group
+                                  for t in b.times],
+                        "feeds": [int(f) for b, _ in group
+                                  for f in b.feeds],
+                        "decisions": dec_l,
+                        "state_digest": digest,
+                    }
+                    self._journal.append(rec, seq=seqs_l[-1])
             except OSError as e:
                 raise RuntimeError(
                     f"journal append failed for batches "
-                    f"{rec['seqs'][0]}..{rec['seqs'][-1]}: {e} — serving "
+                    f"{seqs_l[0]}..{seqs_l[-1]}: {e} — serving "
                     f"state can no longer be made durable; restart and "
                     f"recover from {self.dir}") from e
             self._post_append_faults(int(group[-1][0].seq))
@@ -852,6 +993,8 @@ class ServingRuntime:
             path, pending=self.pending,
             extra={"n_feeds": self.n_feeds, "q": self.q,
                    "applied_seq": self.applied_seq,
+                   "param_epoch": self._param_epoch,
+                   "param_fingerprint": self._param_fingerprint,
                    "durability": self.durability(),
                    # The journal-health block (flush_errors, fsync
                    # attempts, checkpoint-lag watermark, replication
@@ -902,6 +1045,10 @@ def _record_batches(rec: Dict[str, Any]
     tuples, for BOTH record shapes: a /1 record is one batch, a /2 group
     record (flat concatenated events + per-batch ``counts``) is several.
     The single flat-record parser every journal reader shares."""
+    if "epoch" in rec:
+        # A parameter-install record (serving.paramswap): positional
+        # metadata for replay, not a batch — contributes no decisions.
+        return []
     if "seqs" not in rec:
         return [(int(rec["seq"]), rec["times"], rec["feeds"],
                  rec["decision"])]
@@ -991,7 +1138,67 @@ def recover(dir: str, clock=time.monotonic,
     replayed = skipped = 0
     last_decision: Optional[Decision] = None
     start_seq_state = int(jax.device_get(state.seq))
+    # Parameter-epoch base for the replay (serving.paramswap): installs
+    # made BEFORE the restored snapshot may live in pruned segments, so
+    # the params that were live at the snapshot come from the sidecar
+    # install log — the newest entry with seq <= the restored seq
+    # (pruning only drops segments covered by the OLDEST retained
+    # snapshot, so any install past that point still has its journal
+    # record and is replayed in stream order below).
+    live_install: Optional[Dict[str, Any]] = None
+    try:
+        plog = _integrity.read_json(
+            os.path.join(dir, PARAMS_LOG_FILENAME),
+            schema=PARAMS_LOG_SCHEMA)
+    except FileNotFoundError:
+        plog = None
+    if plog:
+        base = [e for e in plog["installs"]
+                if int(e["seq"]) <= start_seq_state]
+        if base:
+            live_install = dict(base[-1])
+    if live_install is not None:
+        s64 = np.asarray(live_install["s_sink"], np.float64)
+        if params_digest(s64, float(live_install["q"])) \
+                != live_install["digest"]:
+            raise RuntimeError(
+                f"params_log epoch {live_install['epoch']} digest "
+                f"mismatch — the sidecar install log is corrupt; "
+                f"refusing to replay under unverified parameters")
+        s_sink = jnp.asarray(s64, jnp.float32)
+        qv = jnp.asarray(float(live_install["q"]), jnp.float32)
     for rec in records:
+        if "epoch" in rec:
+            # A journaled install: switch the replay params from this
+            # stream position on — every batch replays under the epoch
+            # that decided it.  Digest-asserted twice: the params
+            # against the record's own digest, and (when the install
+            # falls inside the replayed range) the carry against the
+            # journaled state digest at the install point.
+            s64 = np.asarray(rec["s_sink"], np.float64)
+            if params_digest(s64, float(rec["q"])) != rec["digest"]:
+                raise RuntimeError(
+                    f"journaled epoch {rec['epoch']} params digest "
+                    f"mismatch — refusing to replay under unverified "
+                    f"parameters")
+            if int(rec["seq"]) > start_seq_state:
+                raise RuntimeError(
+                    f"journal epoch record {rec['epoch']} claims "
+                    f"install at seq {rec['seq']} but replay is at "
+                    f"{start_seq_state} — out-of-order install record")
+            if int(rec["seq"]) == start_seq_state:
+                got = state_digest(state)
+                if got != rec["state_digest"]:
+                    raise RuntimeError(
+                        f"journal replay diverged at epoch "
+                        f"{rec['epoch']} install (seq {rec['seq']}): "
+                        f"recomputed carry digest {got[:12]}.. != "
+                        f"journaled "
+                        f"{str(rec['state_digest'])[:12]}..")
+            s_sink = jnp.asarray(s64, jnp.float32)
+            qv = jnp.asarray(float(rec["q"]), jnp.float32)
+            live_install = dict(rec)
+            continue
         batches = _record_batches(rec)
         last_seq = batches[-1][0]
         if last_seq <= start_seq_state:
@@ -1073,6 +1280,17 @@ def recover(dir: str, clock=time.monotonic,
         replication_mode=str(cfg.get("replication_mode", "thread")),
         clock=clock, _state=state)
     rt._last_decision = last_decision
+    if live_install is not None and int(live_install["epoch"]) > 0:
+        # Re-install the last-good live parameters without re-journaling
+        # (the install record is already durable); then pin the epoch
+        # counter to the journaled value so post-recovery installs
+        # continue the sequence instead of restarting it.
+        rt._install_validated(
+            np.asarray(live_install["s_sink"], np.float64),
+            float(live_install["q"]),
+            str(live_install["fingerprint"]),
+            str(live_install["digest"]), journal=False)
+        rt._param_epoch = int(live_install["epoch"])
     recovered_seq = int(jax.device_get(state.seq))
     lost: Tuple[int, ...] = ()
     if acked_seq is not None and int(acked_seq) > recovered_seq:

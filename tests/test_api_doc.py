@@ -27,7 +27,7 @@ MODULES = [
     "redqueen_tpu.serving.state", "redqueen_tpu.serving.stream",
     "redqueen_tpu.serving.cluster", "redqueen_tpu.serving.corpus",
     "redqueen_tpu.serving.worker", "redqueen_tpu.serving.transport",
-    "redqueen_tpu.serving.replication",
+    "redqueen_tpu.serving.replication", "redqueen_tpu.serving.paramswap",
     "redqueen_tpu.runtime", "redqueen_tpu.runtime.faultinject",
     "redqueen_tpu.runtime.preempt", "redqueen_tpu.runtime.artifacts",
     "redqueen_tpu.runtime.integrity", "redqueen_tpu.runtime.watchdog",
@@ -35,6 +35,7 @@ MODULES = [
     "redqueen_tpu.learn", "redqueen_tpu.learn.ingest",
     "redqueen_tpu.learn.loglik", "redqueen_tpu.learn.hawkes_mle",
     "redqueen_tpu.learn.control", "redqueen_tpu.learn.ckpt",
+    "redqueen_tpu.learn.streaming",
 ]
 
 

@@ -1,0 +1,665 @@
+"""Fit-while-serving: streaming EM + guarded live parameter hot-swap.
+
+The PR 17 acceptance surface (docs/DESIGN.md "Fit-while-serving &
+guarded hot-swap"):
+
+- ``learn.streaming.StreamingEM`` tails a serving journal (JSONL and
+  PR 16 binary segments through the SAME reader), folds events into
+  exponentially-forgotten sufficient statistics, checkpoints through
+  ``learn.ckpt``, and emits candidate fits.
+- ``serving.paramswap`` gates every candidate (finiteness,
+  non-negativity, subcriticality, held-back-window NLL canary) before
+  a digest-asserted atomic install; the epoch + fingerprint land in
+  the journal so recovery is bit-identical; rejected fits keep
+  last-good; a silent learner surfaces ``stale_params``.
+- Fault kinds ``learn:kill|hang|badfit|stale[@stepN]`` and
+  ``swap:corrupt|reject|rollback`` drive the failure drills here and
+  in ``tools/chaos_soak.py``.
+- The slow test runs ``experiments/live_swap.py --quick``: regime
+  shift mid-stream, learner SIGKILLed mid-fit, measured control-cost
+  recovery through the hot-swap, and the closed-loop latency number
+  (journal write -> parameters live) beside ``CLOSED_LOOP.json``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from redqueen_tpu.learn.control import (fit_s_sink,
+                                        simulate_cross_exciting,
+                                        stationary_rates)
+from redqueen_tpu.learn.ingest import from_journal, make_stream
+from redqueen_tpu.learn.streaming import StreamingEM, holdout_nll
+from redqueen_tpu.runtime import faultinject
+from redqueen_tpu.runtime import telemetry as _telemetry
+from redqueen_tpu.serving.events import EventBatch
+from redqueen_tpu.serving.journal import (GROUP_BODY_MAGIC, Journal,
+                                          JOURNAL_FILENAME,
+                                          pack_group_body, replay,
+                                          unpack_group_body)
+from redqueen_tpu.serving.paramswap import (ParamGate, ParamSwapper,
+                                            ValidatedParams,
+                                            params_digest,
+                                            read_candidate,
+                                            write_candidate)
+from redqueen_tpu.serving.service import ServingRuntime, recover
+
+D = 3
+
+
+def _runtime(dir, **kw):
+    kw.setdefault("n_feeds", D)
+    kw.setdefault("q", 1.0)
+    kw.setdefault("s_sink", [1.0] * D)
+    kw.setdefault("seed", 0)
+    kw.setdefault("snapshot_every", 1000)
+    return ServingRuntime(dir=str(dir), **kw)
+
+
+def _feed(rt, n_batches=8, seq0=0, events_per_batch=4, t0=0.0, rate=2.0,
+          seed=1):
+    """Deterministic strictly-ordered traffic through submit/poll."""
+    rng = np.random.default_rng(seed)
+    t = t0
+    for i in range(n_batches):
+        ts, fs = [], []
+        for _ in range(events_per_batch):
+            t += rng.exponential(1.0 / rate)
+            ts.append(t)
+            fs.append(int(rng.integers(0, rt.n_feeds)))
+        adm = rt.submit(EventBatch(seq0 + i, np.asarray(ts, np.float64),
+                                   np.asarray(fs, np.int32)))
+        assert adm.status == "accepted", adm
+    rt.poll()
+    return seq0 + n_batches, t
+
+
+def _healthy_candidate(path, fingerprint="fp-test-1", step=1, q=None):
+    mu = np.full(D, 0.4)
+    alpha = 0.2 * np.eye(D)
+    beta = np.ones(D) * 2.0
+    write_candidate(path, mu=mu, alpha=alpha, beta=beta,
+                    s_sink=fit_s_sink((mu, alpha, beta)),
+                    fingerprint=fingerprint, step=step, q=q)
+    return read_candidate(path)
+
+
+# ---------------------------------------------------------------------------
+# fault-spec parsing
+
+
+class TestFaultSpecs:
+    @pytest.mark.parametrize("spec,mode,step", [
+        ("kill", "kill", None), ("hang@step2", "hang", 2),
+        ("badfit@step3", "badfit", 3), ("stale@step1", "stale", 1),
+        ("STALE", "stale", None)])
+    def test_parse_learn(self, spec, mode, step):
+        f = faultinject.parse_learn(spec)
+        assert (f.mode, f.step) == (mode, step)
+
+    @pytest.mark.parametrize("bad", ["", "explode", "kill@3",
+                                     "kill@stepX", "kill@step0"])
+    def test_parse_learn_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faultinject.parse_learn(bad)
+
+    @pytest.mark.parametrize("spec,mode", [
+        ("corrupt", "corrupt"), ("reject", "reject"),
+        ("ROLLBACK", "rollback")])
+    def test_parse_swap(self, spec, mode):
+        assert faultinject.parse_swap(spec).mode == mode
+
+    @pytest.mark.parametrize("bad", ["", "reject@step1", "nope"])
+    def test_parse_swap_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faultinject.parse_swap(bad)
+
+    def test_env_routing(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_FAULT, "learn:badfit@step2")
+        assert faultinject.learn_fault() == faultinject.LearnFault(
+            "badfit", 2)
+        assert faultinject.swap_fault() is None
+        monkeypatch.setenv(faultinject.ENV_FAULT, "swap:corrupt")
+        assert faultinject.swap_fault().mode == "corrupt"
+        assert faultinject.learn_fault() is None
+
+
+# ---------------------------------------------------------------------------
+# packed group bodies (the zero-copy binary slot)
+
+
+class TestGroupBody:
+    def _body(self):
+        decisions = [{"seq": 0, "post": True, "post_time": 0.5,
+                      "intensity": 1.25}]
+        return pack_group_body([0, 1], [2, 1], [0.125, 0.5, 0.75],
+                               [0, 2, 1], decisions, "ab" * 8)
+
+    def test_roundtrip_bit_exact(self):
+        body = self._body()
+        assert body.startswith(GROUP_BODY_MAGIC)
+        p = unpack_group_body(body)
+        assert p["seqs"] == [0, 1] and p["counts"] == [2, 1]
+        assert p["times"] == [0.125, 0.5, 0.75]
+        assert p["feeds"] == [0, 2, 1]
+        assert p["state_digest"] == "ab" * 8
+        # float round-trip is exact: raw <f8 bytes, no text encode
+        assert unpack_group_body(pack_group_body(
+            [7], [1], [1 / 3], [0], [], "d" * 16))["times"] == [1 / 3]
+
+    def test_bad_magic_and_truncation(self):
+        body = self._body()
+        with pytest.raises(ValueError):
+            unpack_group_body(b"XXXX" + body[4:])
+        with pytest.raises(ValueError):
+            unpack_group_body(body[:-3])
+
+    def test_shape_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            pack_group_body([0], [2], [0.1, 0.2], [0], [], "e" * 16)
+
+    def test_append_raw_equivalence(self, tmp_path):
+        """The same packed bytes replay identically from a JSONL journal
+        (parsed back) and a binary journal (framed verbatim)."""
+        body = self._body()
+        recs = {}
+        for fmt in ("jsonl", "binary"):
+            path = str(tmp_path / f"j-{fmt}" / JOURNAL_FILENAME)
+            os.makedirs(os.path.dirname(path))
+            with Journal(path, fmt=fmt) as j:
+                j.append_raw(body, seq=1)
+            recs[fmt], torn = replay(path)
+            assert torn is None
+        assert recs["jsonl"] == recs["binary"]
+
+
+# ---------------------------------------------------------------------------
+# journal-format parity for the learner (satellite 1)
+
+
+class TestFromJournalParity:
+    def test_binary_and_jsonl_same_stream(self, tmp_path):
+        streams = {}
+        for fmt in ("jsonl", "binary"):
+            d = tmp_path / fmt
+            rt = _runtime(d, journal_format=fmt, coalesce=3)
+            _feed(rt, n_batches=9, events_per_batch=5)
+            rt.close()
+            streams[fmt] = from_journal(str(d), n_dims=D)
+        a, b = streams["jsonl"], streams["binary"]
+        np.testing.assert_array_equal(np.asarray(a.times),
+                                      np.asarray(b.times))
+        np.testing.assert_array_equal(np.asarray(a.dims),
+                                      np.asarray(b.dims))
+        assert a.n_events == b.n_events == 45
+
+    def test_epoch_records_skipped(self, tmp_path):
+        """Parameter-install records share the journal; the learner's
+        ingest must pass over them without miscounting events."""
+        rt = _runtime(tmp_path)
+        _feed(rt, n_batches=4)
+        n_before = from_journal(str(tmp_path), n_dims=D).n_events
+        cand = _healthy_candidate(str(tmp_path / "cand.json"))
+        assert ParamSwapper(rt).offer(cand)["installed"]
+        _feed(rt, n_batches=2, seq0=4, t0=100.0)
+        st = from_journal(str(tmp_path), n_dims=D)
+        assert st.n_events == n_before + 8
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# the validation gate
+
+
+class TestParamGate:
+    def _cand(self, **over):
+        c = {"mu": [0.4] * D, "alpha": (0.2 * np.eye(D)).tolist(),
+             "beta": [2.0] * D, "s_sink": [1.0] * D, "q": None,
+             "fingerprint": "fp-gate", "step": 1, "meta": {}}
+        c.update(over)
+        return c
+
+    def test_accepts_healthy_and_mints_token(self):
+        res = ParamGate().validate(self._cand(), current_q=1.5)
+        assert res.ok and isinstance(res.params, ValidatedParams)
+        assert res.params.q == 1.5  # candidate q=None echoes serving q
+        assert res.params.digest == params_digest(res.params.s_sink, 1.5)
+        assert res.measurements["rho"] == pytest.approx(0.1)
+
+    @pytest.mark.parametrize("over,reason", [
+        ({"mu": [0.4, float("nan"), 0.4]}, "non-finite"),
+        ({"alpha": (-0.2 * np.eye(D)).tolist()}, "negative"),
+        ({"alpha": (3.0 * np.eye(D)).tolist()}, "supercritical"),
+        ({"beta": [2.0] * (D - 1)}, "shapes"),
+        ({"s_sink": [0.0] * D}, "s_sink sums to 0"),
+        ({"mu": "junk"}, "malformed")])
+    def test_structural_rejections(self, over, reason):
+        res = ParamGate().validate(self._cand(**over), current_q=1.0)
+        assert not res.ok and res.params is None
+        assert reason in res.reason
+
+    def test_canary_regression_rejected(self):
+        gate = ParamGate(nll_bound=0.1)
+        res = gate.validate(self._cand(), current_q=1.0,
+                            canary=lambda mu, a, b: 200.0,
+                            baseline_nll=100.0)
+        assert not res.ok and "canary NLL regression" in res.reason
+        ok = gate.validate(self._cand(), current_q=1.0,
+                           canary=lambda mu, a, b: 104.0,
+                           baseline_nll=100.0)
+        assert ok.ok and ok.measurements["nll_candidate"] == 104.0
+
+    def test_revalidate_rollback_path(self):
+        vp = ParamGate().revalidate([1.0, 2.0, 3.0], 1.0, "fp-old")
+        assert vp.meta == {"rollback": True}
+        with pytest.raises(ValueError):
+            ParamGate().revalidate([1.0, -1.0, 1.0], 1.0, "fp")
+        with pytest.raises(ValueError):
+            ParamGate().revalidate([1.0] * D, 0.0, "fp")
+
+
+# ---------------------------------------------------------------------------
+# install path: token-only, digest-asserted, epoch-journaled
+
+
+class TestInstallGuard:
+    def test_install_requires_gate_token(self, tmp_path):
+        rt = _runtime(tmp_path)
+        with pytest.raises(TypeError):
+            rt.install_params({"s_sink": [2.0] * D, "q": 1.0})
+        rt.close()
+
+    def test_tampered_digest_refused(self, tmp_path):
+        rt = _runtime(tmp_path)
+        res = ParamGate().validate(
+            {"mu": [0.4] * D, "alpha": (0.2 * np.eye(D)).tolist(),
+             "beta": [2.0] * D, "s_sink": [1.0] * D, "q": None,
+             "fingerprint": "fp", "step": 1}, current_q=1.0)
+        bad = res.params._replace(s_sink=np.full(D, 9.0))
+        with pytest.raises(RuntimeError):
+            rt.install_params(bad)
+        assert rt.live_params()["epoch"] == 0
+        rt.close()
+
+    def test_install_swaps_and_journals_epoch(self, tmp_path):
+        rt = _runtime(tmp_path)
+        _feed(rt, n_batches=3)
+        cand = _healthy_candidate(str(tmp_path / "c.json"))
+        sw = ParamSwapper(rt)
+        out = sw.offer(cand)
+        assert out["installed"] and out["epoch"] == 1
+        live = rt.live_params()
+        np.testing.assert_allclose(live["s_sink"], cand["s_sink"])
+        assert live["fingerprint"] == "fp-test-1"
+        prev = rt.previous_params()
+        assert prev is not None and prev["epoch"] == 0
+        np.testing.assert_array_equal(prev["s_sink"], np.ones(D))
+        # the epoch record is durable in the shared journal
+        recs, _ = replay(str(tmp_path / JOURNAL_FILENAME))
+        epochs = [r for r in recs if "param_epoch" in r or "epoch" in r]
+        assert epochs, f"no epoch record in {recs!r}"
+        m = rt.write_metrics()
+        assert m["param_epoch"] == 1
+        assert m["param_fingerprint"] == "fp-test-1"
+        rt.close()
+
+    def test_inflight_decision_keeps_old_epoch(self, tmp_path):
+        """Queued-but-unapplied batches decide under whatever params are
+        live when they APPLY; a decision already made is never
+        retroactively changed by an install."""
+        rt = _runtime(tmp_path)
+        _feed(rt, n_batches=2)
+        before = rt.decide()
+        ParamSwapper(rt).offer(_healthy_candidate(
+            str(tmp_path / "c.json")))
+        after = rt.decide()
+        assert after.post == before.post
+        assert after.post_time == before.post_time
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# epoch recovery: bit-identical params after crash
+
+
+class TestEpochRecovery:
+    def test_recover_restores_live_params(self, tmp_path):
+        rt = _runtime(tmp_path)
+        seq, _ = _feed(rt, n_batches=5)
+        sw = ParamSwapper(rt)
+        assert sw.offer(_healthy_candidate(
+            str(tmp_path / "c1.json"), fingerprint="fp-A"))["installed"]
+        _feed(rt, n_batches=3, seq0=seq, t0=50.0)
+        live = rt.live_params()
+        # no close(): the kill -9 shape — everything below must come
+        # from the durable journal + sidecar alone.
+        rt2, info = recover(str(tmp_path))
+        got = rt2.live_params()
+        assert got["epoch"] == live["epoch"] == 1
+        assert got["fingerprint"] == "fp-A"
+        np.testing.assert_array_equal(np.asarray(got["s_sink"]),
+                                      np.asarray(live["s_sink"]))
+        assert got["q"] == live["q"]
+        assert not info.lost_acked_seqs
+        rt2.close()
+
+    def test_recover_continues_epoch_sequence(self, tmp_path):
+        rt = _runtime(tmp_path)
+        seq, _ = _feed(rt, n_batches=3)
+        sw = ParamSwapper(rt)
+        sw.offer(_healthy_candidate(str(tmp_path / "c1.json"),
+                                    fingerprint="fp-A"))
+        sw.offer(_healthy_candidate(str(tmp_path / "c2.json"),
+                                    fingerprint="fp-B", step=2))
+        rt2, _ = recover(str(tmp_path))
+        assert rt2.live_params()["epoch"] == 2
+        out = ParamSwapper(rt2).offer(_healthy_candidate(
+            str(tmp_path / "c3.json"), fingerprint="fp-C", step=3))
+        assert out["epoch"] == 3  # continues, never restarts at 1
+        rt2.close()
+
+    def test_recover_through_snapshot_prune(self, tmp_path):
+        """Snapshots rotate + prune journal segments; the params-log
+        sidecar must still anchor the install that predates the
+        retained window."""
+        rt = _runtime(tmp_path, snapshot_every=2)
+        seq, t = _feed(rt, n_batches=4)
+        ParamSwapper(rt).offer(_healthy_candidate(
+            str(tmp_path / "c.json"), fingerprint="fp-old"))
+        for k in range(3):
+            seq, t = _feed(rt, n_batches=4, seq0=seq, t0=t + 1.0,
+                           seed=k + 10)
+            rt.snapshot()
+        live = rt.live_params()
+        rt2, _ = recover(str(tmp_path))
+        got = rt2.live_params()
+        assert got["epoch"] == 1 and got["fingerprint"] == "fp-old"
+        np.testing.assert_array_equal(np.asarray(got["s_sink"]),
+                                      np.asarray(live["s_sink"]))
+        rt2.close()
+
+
+# ---------------------------------------------------------------------------
+# swapper policy: rollback, faults, staleness
+
+
+class TestSwapperPolicy:
+    def test_rollback_reinstalls_previous_as_new_epoch(self, tmp_path):
+        rt = _runtime(tmp_path)
+        sw = ParamSwapper(rt)
+        sw.offer(_healthy_candidate(str(tmp_path / "c.json"),
+                                    fingerprint="fp-A"))
+        out = sw.rollback("post-install canary regression")
+        assert out["epoch"] == 2 and sw.rollbacks == 1
+        live = rt.live_params()
+        np.testing.assert_array_equal(np.asarray(live["s_sink"]),
+                                      np.ones(D))  # the epoch-0 params
+        assert live["epoch"] == 2  # rollback is an install, not a rewind
+        rt.close()
+
+    def test_swap_reject_fault(self, tmp_path, monkeypatch):
+        rt = _runtime(tmp_path)
+        sw = ParamSwapper(rt)
+        monkeypatch.setenv(faultinject.ENV_FAULT, "swap:reject")
+        out = sw.offer(_healthy_candidate(str(tmp_path / "c.json")))
+        assert not out["installed"] and sw.rejections == 1
+        assert rt.live_params()["epoch"] == 0
+        monkeypatch.delenv(faultinject.ENV_FAULT)
+        assert sw.offer(_healthy_candidate(
+            str(tmp_path / "c.json"), fingerprint="fp-2"))["installed"]
+        rt.close()
+
+    def test_swap_rollback_fault(self, tmp_path, monkeypatch):
+        rt = _runtime(tmp_path)
+        sw = ParamSwapper(rt)
+        monkeypatch.setenv(faultinject.ENV_FAULT, "swap:rollback")
+        out = sw.offer(_healthy_candidate(str(tmp_path / "c.json")))
+        assert out["installed"] and out["rolled_back"]
+        assert "canary regression" in out["rollback_reason"]
+        live = rt.live_params()
+        assert live["epoch"] == 2  # install (1) + rollback install (2)
+        np.testing.assert_array_equal(np.asarray(live["s_sink"]),
+                                      np.ones(D))
+        rt.close()
+
+    def test_swap_corrupt_quarantines_artifact(self, tmp_path,
+                                               monkeypatch):
+        rt = _runtime(tmp_path)
+        sw = ParamSwapper(rt)
+        path = str(tmp_path / "cand.json")
+        _healthy_candidate(path)
+        monkeypatch.setenv(faultinject.ENV_FAULT, "swap:corrupt")
+        out = sw.poll_artifact(path)
+        assert out is not None and not out["installed"]
+        assert sw.quarantined == 1
+        assert not os.path.exists(path)  # moved aside, won't re-poll
+        rt.close()
+
+    def test_fingerprint_dedup_refreshes_liveness(self, tmp_path):
+        now = [0.0]
+        rt = _runtime(tmp_path)
+        sw = ParamSwapper(rt, stale_after_s=10.0, clock=lambda: now[0])
+        path = str(tmp_path / "cand.json")
+        _healthy_candidate(path, fingerprint="fp-same")
+        assert sw.poll_artifact(path)["installed"]
+        now[0] = 8.0  # same artifact re-polled: no reinstall, but alive
+        assert sw.poll_artifact(path) is None
+        assert rt.live_params()["epoch"] == 1
+        now[0] = 15.0
+        assert sw.status()["state"] == "fresh"  # refreshed at t=8
+        now[0] = 19.0
+        st = sw.status()
+        assert st["state"] == "stale_params"  # silent past deadline
+        assert st["installs"] == 1
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# streaming EM (the learner)
+
+
+def _journal_dir(tmp_path, n_batches=12, rate=3.0, seed=4):
+    rt = _runtime(tmp_path)
+    _feed(rt, n_batches=n_batches, events_per_batch=5, rate=rate,
+          seed=seed)
+    rt.close()
+    return str(tmp_path)
+
+
+class TestStreamingEM:
+    def test_fit_checkpoint_resume(self, tmp_path):
+        d = _journal_dir(tmp_path)
+        ck = str(tmp_path / "learn.ckpt.npz")
+        em = StreamingEM(d, n_feeds=D, ckpt_path=ck, chunk_size=256)
+        upd = em.run_once()
+        assert upd.step == 1 and upd.n_events == 60
+        assert upd.candidate and os.path.exists(upd.candidate)
+        assert np.isfinite(upd.loglik)
+        # a NEW learner (fresh process shape) resumes, not restarts
+        em2 = StreamingEM(d, n_feeds=D, ckpt_path=ck, chunk_size=256)
+        assert em2.step == 1
+        np.testing.assert_array_equal(em2.mu, em.mu)
+        np.testing.assert_array_equal(em2.alpha, em.alpha)
+        assert em2.last_t == em.last_t
+        assert em2.run_once().n_events == 0  # nothing new to ingest
+
+    def test_config_change_invalidates_checkpoint(self, tmp_path):
+        d = _journal_dir(tmp_path)
+        ck = str(tmp_path / "learn.ckpt.npz")
+        StreamingEM(d, n_feeds=D, ckpt_path=ck, gamma=0.9,
+                    chunk_size=256).run_once()
+        em2 = StreamingEM(d, n_feeds=D, ckpt_path=ck, gamma=0.5,
+                          chunk_size=256)
+        assert em2.step == 0  # fingerprint mismatch -> fresh start
+
+    def test_badfit_fault_never_installs(self, tmp_path, monkeypatch):
+        d = _journal_dir(tmp_path)
+        rt, _ = recover(d)
+        sw = ParamSwapper(rt)
+        em = StreamingEM(d, n_feeds=D, chunk_size=256)
+        monkeypatch.setenv(faultinject.ENV_FAULT, "learn:badfit@step1")
+        upd = em.run_once()
+        assert upd.candidate  # the poisoned fit IS emitted ...
+        out = sw.poll_artifact(upd.candidate)
+        assert out is not None and not out["installed"]  # ... and shot
+        assert sw.rejections == 1
+        assert rt.live_params()["epoch"] == 0  # last-good kept
+        rt.close()
+
+    def test_stale_fault_silences_candidates(self, tmp_path,
+                                             monkeypatch):
+        d = _journal_dir(tmp_path)
+        em = StreamingEM(d, n_feeds=D, chunk_size=256)
+        monkeypatch.setenv(faultinject.ENV_FAULT, "learn:stale@step1")
+        upd = em.run_once()
+        assert upd.step == 1 and upd.candidate is None
+        assert not os.path.exists(em.candidate_path)
+
+    def test_holdout_is_canary_window(self, tmp_path):
+        d = _journal_dir(tmp_path)
+        em = StreamingEM(d, n_feeds=D, chunk_size=256,
+                         holdout_frac=0.25)
+        em.run_once()
+        assert em.holdout is not None and em.holdout.n_events == 15
+        # the watermark covers the canary window: consumed, not re-fit
+        assert em.last_t == pytest.approx(float(em.holdout.t_end))
+        nll = holdout_nll(em.holdout, em.mu, em.alpha, em.beta)
+        assert np.isfinite(nll)
+
+    def test_cross_excitation_recovered(self, tmp_path):
+        """End-to-end: simulate a KNOWN off-diagonal model, journal it
+        through a real runtime, fit with the streaming learner, and
+        check the learned branching mass and stationary structure."""
+        mu = np.array([0.6, 0.3, 0.45])
+        alpha = np.array([[0.5, 0.0, 0.0],
+                          [0.6, 0.3, 0.0],
+                          [0.0, 0.0, 0.4]])
+        beta = np.full(D, 2.0)
+        t, dims = simulate_cross_exciting(mu, alpha, beta, t_end=400.0,
+                                          seed=3)
+        rt = _runtime(tmp_path)
+        seq = 0
+        for i in range(0, len(t), 16):
+            rt.submit(EventBatch(seq, t[i:i + 16],
+                                 dims[i:i + 16].astype(np.int32)))
+            seq += 1
+            if seq % 32 == 0:
+                rt.poll()
+        rt.poll()
+        rt.close()
+        em = StreamingEM(str(tmp_path), n_feeds=D, gamma=1.0,
+                         chunk_size=1024, holdout_frac=0.0)
+        em.run_once()
+        B_true = alpha / beta[None, :]
+        B_fit = em.alpha / em.beta[None, :]
+        off_true = B_true.sum() - np.trace(B_true)
+        off_fit = B_fit.sum() - np.trace(B_fit)
+        assert off_fit == pytest.approx(off_true, rel=0.5)
+        assert B_fit[1, 0] > B_fit[0, 1]  # direction of the coupling
+        lam_fit = stationary_rates(em.mu, em.alpha, em.beta)
+        lam_true = stationary_rates(mu, alpha, beta)
+        np.testing.assert_allclose(lam_fit, lam_true, rtol=0.35)
+
+
+# ---------------------------------------------------------------------------
+# control helpers
+
+
+class TestControlHelpers:
+    def test_stationary_rates_closed_form(self):
+        mu = np.array([1.0, 2.0])
+        lam = stationary_rates(mu, 0.5 * np.eye(2), np.ones(2))
+        np.testing.assert_allclose(lam, mu / 0.5)  # (1 - 0.5)^-1
+
+    def test_stationary_rates_fallbacks(self):
+        mu = np.array([1.0, 2.0])
+        # supercritical -> mu itself
+        np.testing.assert_array_equal(
+            stationary_rates(mu, 3.0 * np.eye(2), np.ones(2)), mu)
+
+    def test_fit_s_sink_normalized(self):
+        s = fit_s_sink((np.array([1.0, 3.0]), np.zeros((2, 2)),
+                        np.ones(2)))
+        assert s.mean() == pytest.approx(1.0)
+        np.testing.assert_allclose(s, [0.5, 1.5])
+        # dead stream degrades to uniform ones, never zero
+        np.testing.assert_array_equal(
+            fit_s_sink((np.zeros(2), np.zeros((2, 2)), np.ones(2))),
+            np.ones(2))
+
+    def test_simulate_cross_exciting_contract(self):
+        t, d = simulate_cross_exciting([0.5, 0.5], 0.3 * np.eye(2),
+                                       [2.0, 2.0], t_end=50.0, seed=0)
+        assert t.dtype == np.float64 and d.dtype == np.int32
+        assert (np.diff(t) > 0).all() and len(t) == len(d)
+        t2, d2 = simulate_cross_exciting([0.5, 0.5], 0.3 * np.eye(2),
+                                         [2.0, 2.0], t_end=50.0, seed=0)
+        np.testing.assert_array_equal(t, t2)  # seeded determinism
+        with pytest.raises(ValueError):
+            simulate_cross_exciting([0.5], [[3.0]], [1.0], t_end=1.0)
+
+
+# ---------------------------------------------------------------------------
+# telemetry (satellite 4)
+
+
+class TestTelemetry:
+    def test_stream_spans_and_swap_event(self, tmp_path):
+        d = _journal_dir(tmp_path)
+        _telemetry.configure(reset=True, enabled=True, sample=1.0)
+        try:
+            rt, _ = recover(d)
+            em = StreamingEM(d, n_feeds=D, chunk_size=256)
+            upd = em.run_once()
+            out = ParamSwapper(rt).poll_artifact(upd.candidate)
+            assert out["installed"]
+            rt.close()
+            spans = _telemetry.get().drain_spans()
+            names = {s["name"] for s in spans}
+            assert {"learn.stream.ingest", "learn.stream.update",
+                    "learn.stream.swap",
+                    "serving.paramswap.offer"} <= names
+            offer = next(s for s in spans
+                         if s["name"] == "serving.paramswap.offer")
+            swaps = [e for e in offer.get("events") or []
+                     if e[0] == "swap"]
+            assert swaps and swaps[0][2]["epoch"] == 1
+            assert swaps[0][2]["fingerprint"] == upd.fingerprint
+        finally:
+            _telemetry.configure(reset=True)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario (slow): regime shift + kill + measured recovery
+
+
+@pytest.mark.slow
+def test_live_swap_acceptance(tmp_path):
+    """``experiments/live_swap.py --quick``: the full fit-while-serving
+    drill — regime shift mid-stream, learner SIGKILLed mid-fit without
+    touching serving, guarded hot-swap recovery scored against the
+    documented bounds, closed-loop latency measured."""
+    out = str(tmp_path / "LIVE_SWAP.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RQ_FAULT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "experiments",
+                                      "live_swap.py"),
+         "--quick", "--out", out],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(out) as fh:
+        payload = json.load(fh)["payload"]
+    assert payload["pass"]
+    assert payload["learner_kill"]["rc"] == -9
+    assert payload["learner_kill"]["journal_untouched"]
+    assert payload["recovery"]["canary_nll"]["pass"]
+    assert payload["audit"]["params_bit_identical"]
+    lat = payload["latency"]["journal_write_to_params_live_s"]
+    assert 0.0 < lat <= payload["latency"]["bound_s"]

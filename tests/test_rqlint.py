@@ -890,3 +890,72 @@ class TestRQ1005:
                 journal.append(rec)
         """
         assert lint(src, "tools/some_tool.py", ["RQ1005"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RQ1006 — live parameters installed without the gate
+# ---------------------------------------------------------------------------
+
+
+class TestRQ1006:
+    def test_fires_on_raw_s_sink_assignment(self):
+        src = """\
+            def hot_swap(self, params):
+                self._s_sink = params["s_sink"]
+        """
+        fs = lint(src, "redqueen_tpu/serving/service.py", ["RQ1006"])
+        assert ids(fs) == ["RQ1006"] and fs[0].line == 2
+        assert "install_params" in fs[0].message
+
+    def test_fires_on_raw_q_assignment(self):
+        src = """\
+            def tune(self, q):
+                self._q = q
+        """
+        assert ids(lint(src, "redqueen_tpu/serving/service.py",
+                        ["RQ1006"])) == ["RQ1006"]
+
+    def test_fires_on_augmented_assignment(self):
+        src = """\
+            def nudge(self):
+                self._q += 0.1
+        """
+        assert ids(lint(src, "redqueen_tpu/serving/service.py",
+                        ["RQ1006"])) == ["RQ1006"]
+
+    def test_init_is_allowlisted(self):
+        src = """\
+            class ServingRuntime:
+                def __init__(self, s_sink, q):
+                    self._s_sink = s_sink
+                    self._q = q
+        """
+        assert lint(src, "redqueen_tpu/serving/service.py",
+                    ["RQ1006"]) == []
+
+    def test_install_validated_is_the_sanctioned_site(self):
+        src = """\
+            class ServingRuntime:
+                def _install_validated(self, s64, q, fp, digest):
+                    self._s_sink = jnp.asarray(s64, jnp.float32)
+                    self._q = jnp.asarray(q, jnp.float32)
+        """
+        assert lint(src, "redqueen_tpu/serving/service.py",
+                    ["RQ1006"]) == []
+
+    def test_unrelated_private_attrs_are_legal(self):
+        src = """\
+            def reset(self):
+                self._state = None
+                self._queue = []
+        """
+        assert lint(src, "redqueen_tpu/serving/service.py",
+                    ["RQ1006"]) == []
+
+    def test_scoped_to_serving(self):
+        src = """\
+            def set_params(self, s):
+                self._s_sink = s
+        """
+        assert lint(src, "redqueen_tpu/learn/streaming.py",
+                    ["RQ1006"]) == []

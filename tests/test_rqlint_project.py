@@ -717,25 +717,26 @@ class TestNoProjectEquivalence:
         assert "RQ701" in rule_ids(proj)
         assert engine.check_source(src, "tools/u.py") == []
 
-    def test_cli_no_project_runs_ten_tier1_rules(self, tmp_path,
-                                                 capsys):
-        # 9 original tier-1 rules + RQ1005 (ack/durability ordering is
-        # single-file analysis, so it rides the tier-1 set).
+    def test_cli_no_project_runs_eleven_tier1_rules(self, tmp_path,
+                                                    capsys):
+        # 9 original tier-1 rules + RQ1005 (ack/durability ordering) and
+        # RQ1006 (parameter-install gate bypass) — both single-file
+        # analyses, so they ride the tier-1 set.
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path), "--no-project",
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
         out = capsys.readouterr().out
-        assert "10 rules active" in out
+        assert "11 rules active" in out
 
-    def test_project_mode_runs_twentyone_rules(self, tmp_path, capsys):
-        # 14 tier-1/2 rules (incl. RQ1005) + the 7 tier-3
+    def test_project_mode_runs_twentytwo_rules(self, tmp_path, capsys):
+        # 15 tier-1/2 rules (incl. RQ1005/RQ1006) + the 7 tier-3
         # RQ10xx/RQ11xx rules
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path),
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
-        assert "21 rules active" in capsys.readouterr().out
+        assert "22 rules active" in capsys.readouterr().out
 
 
 # ---------------------------------------------------------------------------

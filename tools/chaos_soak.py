@@ -45,6 +45,8 @@ os.environ.setdefault("RQ_SERVING_WORKER", "1")
 from redqueen_tpu.runtime import integrity as _integrity  # noqa: E402
 from redqueen_tpu.serving.journal import (  # noqa: E402
     JOURNAL_FILENAME, Journal, replay)
+from redqueen_tpu.serving.paramswap import (  # noqa: E402
+    CANDIDATE_FILENAME, ParamGate, ParamSwapper, write_candidate)
 from redqueen_tpu.serving.replication import (  # noqa: E402
     ReplicatedJournal, heal_from_replicas)
 
@@ -181,6 +183,191 @@ def _disk_enospc_sync_scenario() -> Dict[str, Any]:
         shutil.rmtree(d, ignore_errors=True)
 
 
+class _StubRuntime:
+    """The minimal install surface ``ParamSwapper`` needs (jax-free —
+    the REAL runtime's epoch/journal mechanics are covered by the
+    pytest acceptance suite; the soak drills the gate itself)."""
+
+    def __init__(self, n_feeds: int):
+        import numpy as np
+
+        self._params = {"s_sink": np.ones(n_feeds), "q": 1.0,
+                        "epoch": 0, "fingerprint": "initial"}
+        self._prev: Optional[Dict[str, Any]] = None
+        self.installed: List[Any] = []
+
+    def live_params(self) -> Dict[str, Any]:
+        return dict(self._params)
+
+    def previous_params(self) -> Optional[Dict[str, Any]]:
+        return None if self._prev is None else dict(self._prev)
+
+    def install_params(self, vp) -> int:
+        self._prev = dict(self._params)
+        self._params = {"s_sink": vp.s_sink, "q": vp.q,
+                        "epoch": int(self._params["epoch"]) + 1,
+                        "fingerprint": vp.fingerprint}
+        self.installed.append(vp)
+        return int(self._params["epoch"])
+
+
+def _healthy_candidate(path: str, n_feeds: int = 3,
+                       fingerprint: str = "soak-fp-1") -> None:
+    import numpy as np
+
+    write_candidate(
+        path, mu=[0.5] * n_feeds,
+        alpha=(0.1 * np.eye(n_feeds)).tolist(), beta=[1.0] * n_feeds,
+        s_sink=[1.0] * n_feeds, fingerprint=fingerprint, step=1)
+
+
+def _swap_reject_scenario() -> Dict[str, Any]:
+    """``swap:reject`` — a structurally healthy candidate is force-
+    vetoed at the gate: serving must keep last-good (epoch 0), count
+    the rejection, and the SAME candidate must install cleanly once the
+    fault lifts (the veto quarantines nothing)."""
+    name = "swap:reject forced gate veto"
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    path = os.path.join(d, CANDIDATE_FILENAME)
+    try:
+        _healthy_candidate(path)
+        rt = _StubRuntime(3)
+        sw = ParamSwapper(rt, gate=ParamGate())
+        os.environ["RQ_FAULT"] = "swap:reject"
+        res = sw.poll_artifact(path)
+        if res is None or res["installed"] or sw.rejections != 1:
+            raise SoakFailure(
+                f"{name}: forced veto did not reject cleanly "
+                f"(result={res!r}, rejections={sw.rejections})")
+        if rt.live_params()["epoch"] != 0 or rt.installed:
+            raise SoakFailure(
+                f"{name}: rejected candidate reached the live params")
+        os.environ.pop("RQ_FAULT", None)
+        # Fault lifted: the same artifact must now pass (new swapper —
+        # the fingerprint dedup is per-swapper state).
+        sw2 = ParamSwapper(rt, gate=ParamGate())
+        res2 = sw2.poll_artifact(path)
+        if res2 is None or not res2["installed"] \
+                or rt.live_params()["epoch"] != 1:
+            raise SoakFailure(
+                f"{name}: candidate did not install after the fault "
+                f"lifted (result={res2!r})")
+        return {"scenario": name, "acked": 1, "lost": [],
+                "rejections": 1, "exact": True}
+    finally:
+        os.environ.pop("RQ_FAULT", None)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _swap_corrupt_scenario() -> Dict[str, Any]:
+    """``swap:corrupt`` — the candidate artifact is scribbled before
+    the gate reads it: the integrity envelope must catch it, the file
+    must be quarantined aside, and last-good must survive."""
+    name = "swap:corrupt quarantined artifact"
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    path = os.path.join(d, CANDIDATE_FILENAME)
+    os.environ["RQ_FAULT"] = "swap:corrupt"
+    try:
+        _healthy_candidate(path)
+        rt = _StubRuntime(3)
+        sw = ParamSwapper(rt, gate=ParamGate())
+        res = sw.poll_artifact(path)
+        if res is None or res["installed"] or sw.quarantined != 1:
+            raise SoakFailure(
+                f"{name}: corrupt artifact was not quarantined "
+                f"(result={res!r}, quarantined={sw.quarantined})")
+        if rt.live_params()["epoch"] != 0:
+            raise SoakFailure(
+                f"{name}: corrupt candidate reached the live params")
+        if os.path.exists(path):
+            raise SoakFailure(
+                f"{name}: corrupt artifact still in the hand-off slot "
+                f"— the learner's next write would collide with it")
+        return {"scenario": name, "acked": 0, "lost": [],
+                "quarantined": 1, "exact": True}
+    finally:
+        os.environ.pop("RQ_FAULT", None)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _learner_kill_scenario() -> Dict[str, Any]:
+    """``learn:kill@step1`` against a REAL learner process: the sidecar
+    is SIGKILLed mid-update (statistics computed, checkpoint not yet
+    landed).  The journal it was tailing must replay untouched, and a
+    fault-free rerun must complete the step and emit a candidate — the
+    crash cost the learner its in-flight step, nothing else."""
+    name = "learn:kill@step1 sidecar process"
+    import signal
+    import subprocess
+
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    path = os.path.join(d, JOURNAL_FILENAME)
+    try:
+        # A serving-shaped journal (group records) for the learner to
+        # tail — written jax-free, exactly what a runtime would land.
+        recs = []
+        t = 0.0
+        with Journal(path) as j:
+            for i in range(12):
+                times = [t + 0.1, t + 0.2, t + 0.3]
+                t += 0.3
+                p = {"seqs": [i], "counts": [3], "times": times,
+                     "feeds": [i % 3, (i + 1) % 3, (i + 2) % 3],
+                     "decisions": [{"seq": i, "post": False,
+                                    "post_time": t, "intensity": 0.0}],
+                     "state_digest": "soak"}
+                j.append(p, seq=i)
+                recs.append(p)
+        before, _torn = replay(path)
+        child_src = (
+            "import os, sys\n"
+            "from redqueen_tpu.learn.streaming import StreamingEM\n"
+            "em = StreamingEM(sys.argv[1], n_feeds=3,\n"
+            "                 ckpt_path=sys.argv[2])\n"
+            "upd = em.run_once()\n"
+            "print('STEP', upd.step, upd.n_events,\n"
+            "      upd.candidate or '-')\n")
+        ck = os.path.join(d, "learn.ckpt.npz")
+        env = {k: v for k, v in os.environ.items()
+               if k != "RQ_SERVING_WORKER"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["RQ_FAULT"] = "learn:kill@step1"
+        proc = subprocess.run(
+            [sys.executable, "-c", child_src, d, ck],
+            env=env, capture_output=True, text=True, timeout=300)
+        if proc.returncode != -signal.SIGKILL:
+            raise SoakFailure(
+                f"{name}: expected the learner to die by SIGKILL, got "
+                f"rc={proc.returncode} (stderr tail: "
+                f"{proc.stderr[-300:]!r})")
+        after, _torn = replay(path)
+        if after != before:
+            raise SoakFailure(
+                f"{name}: learner death changed the serving journal")
+        if os.path.exists(os.path.join(d, CANDIDATE_FILENAME)):
+            raise SoakFailure(
+                f"{name}: a candidate landed from a killed step")
+        env.pop("RQ_FAULT")
+        proc2 = subprocess.run(
+            [sys.executable, "-c", child_src, d, ck],
+            env=env, capture_output=True, text=True, timeout=300)
+        if proc2.returncode != 0 or "STEP 1" not in proc2.stdout:
+            raise SoakFailure(
+                f"{name}: fault-free rerun did not complete the step "
+                f"(rc={proc2.returncode}, out={proc2.stdout!r}, "
+                f"stderr tail: {proc2.stderr[-300:]!r})")
+        if not os.path.exists(os.path.join(d, CANDIDATE_FILENAME)):
+            raise SoakFailure(
+                f"{name}: rerun emitted no candidate")
+        kept = replay(path)[0]
+        lost = [] if kept == before else ["journal-diverged"]
+        return {"scenario": name, "acked": len(recs), "lost": lost,
+                "exact": not lost}
+    finally:
+        os.environ.pop("RQ_FAULT", None)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def scenario_matrix() -> List[Any]:
     """One entry per (fault kind x placement x format) cell; each is a
     zero-arg callable returning the scenario's result dict."""
@@ -211,6 +398,13 @@ def scenario_matrix() -> List[Any]:
             mode="thread", fmt=None, n=5, ack_timeout_s=0.15),
         _disk_eio_group_scenario,
         _disk_enospc_sync_scenario,
+        # Fit-while-serving: the gate's forced-veto and corrupt-artifact
+        # drills (jax-free) plus a REAL learner process SIGKILLed
+        # mid-fit — serving state must be untouchable from the learner
+        # side no matter how it dies.
+        _swap_reject_scenario,
+        _swap_corrupt_scenario,
+        _learner_kill_scenario,
     ]
 
 

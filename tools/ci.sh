@@ -17,7 +17,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" == "chaos-soak" ]]; then
-    echo "== chaos soak: repl:*/disk:* fault matrix =="
+    echo "== chaos soak: repl:*/disk:*/learn:*/swap:* fault matrix =="
     exec python tools/chaos_soak.py --rounds "${2:-10}" \
         --json CHAOS_SOAK.json
 fi
@@ -102,11 +102,13 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
     tests/test_rqlint.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-echo "== durability chaos soak (repl:*/disk:* matrix) =="
+echo "== durability chaos soak (repl:*/disk:*/learn:*/swap:* matrix) =="
 # Every quorum/disk degradation path under injected faults, 3 rounds:
 # follower SIGKILL (real process kill), leader-quorum partition, slow
 # follower forcing demotion to the fsync tier, checkpoint-path
-# EIO/ENOSPC.  Fails on ANY non-exact loss report (reported lost seqs
+# EIO/ENOSPC — plus the fit-while-serving drills: forced gate veto,
+# corrupt candidate artifact (quarantine), and a real learner process
+# SIGKILLed mid-fit (serving journal untouched, checkpoint resume).  Fails on ANY non-exact loss report (reported lost seqs
 # != actually lost) or non-bit-identical replay of a kept record.
 # Nightly runs loop harder: `bash tools/ci.sh chaos-soak 50`.
 python tools/chaos_soak.py --rounds 3

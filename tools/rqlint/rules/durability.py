@@ -1,4 +1,6 @@
-"""RQ1005 — ack emitted before the durability point.
+"""RQ1005/RQ1006 — durability-contract ordering and guarded installs.
+
+RQ1005 — ack emitted before the durability point.
 
 The serving ack contract (docs/DESIGN.md "Durability modes & the ack
 contract") is positional: an admission/ack frame may only leave a
@@ -17,6 +19,21 @@ durability call fires when the first ack emission precedes the first
 durability call in source order.  Functions that only relay acks
 (routers, metrics) contain no durability call and are out of scope by
 construction — the rule polices ordering, not architecture.
+
+RQ1006 — live parameters installed without the gate.
+
+The hot-swap contract (docs/DESIGN.md "Fit-while-serving & guarded
+hot-swap") has exactly ONE sanctioned write path for the live decision
+parameters: ``ServingRuntime._install_validated``, reached only through
+``install_params`` with a gate-minted ``ValidatedParams`` token.  Every
+other assignment to the live slots (``._s_sink``/``._q`` attributes) is
+a gate bypass — the candidate never passed finiteness/subcriticality/
+canary validation, no epoch record lands in the journal, and recovery
+replays decisions under different parameters than the ones that made
+them.  The rule fires on any attribute assignment (plain or augmented)
+to those slots in ``serving/`` outside the allowlisted methods
+(``__init__`` constructs the initial params; ``_install_validated`` IS
+the install site).
 """
 
 from __future__ import annotations
@@ -105,3 +122,48 @@ class AckBeforeDurabilityRule(Rule):
                     f"{first_durable[0]} — an ack must never precede "
                     f"the call that makes it true",
                     line=first_ack[0], col=first_ack[1])
+
+
+#: The live decision-parameter slots — the only mutable state the
+#: hot-swap gate protects.
+_LIVE_PARAM_ATTRS = {"_s_sink", "_q"}
+
+#: Methods allowed to assign them: construction and THE install site.
+_INSTALL_ALLOWLIST = {"__init__", "_install_validated"}
+
+
+class UngatedParamInstallRule(Rule):
+    id = "RQ1006"
+    name = "ungated-param-install"
+    description = ("live decision parameters (._s_sink/._q) assigned "
+                   "outside __init__/_install_validated — a parameter "
+                   "install that bypasses the validation gate and the "
+                   "epoch journal")
+    paths = ("redqueen_tpu/serving/*.py",)
+
+    def check(self, ctx):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name in _INSTALL_ALLOWLIST:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                else:
+                    continue
+                for tgt in targets:
+                    for sub in ast.walk(tgt):
+                        if (isinstance(sub, ast.Attribute)
+                                and sub.attr in _LIVE_PARAM_ATTRS):
+                            yield finding_at(
+                                self.id, ctx, None,
+                                f"{fn.name}() assigns .{sub.attr} "
+                                f"directly — live parameters must "
+                                f"route through install_params() so "
+                                f"the gate validates and the epoch "
+                                f"record lands in the journal",
+                                line=sub.lineno, col=sub.col_offset)
