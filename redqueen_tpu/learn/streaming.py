@@ -266,16 +266,25 @@ class StreamingEM:
         with _telemetry.span("learn.stream.update") as sp:
             sp.set(step=self.step, n_events=stream.n_events)
             n = stream.n_events
+            # The watermark must advance to the FULL ingested window's
+            # end even when no holdout is carved below (small window,
+            # or a timestamp tie at the cut): self.holdout can be a
+            # PREVIOUS window's stream, and its stale t_end would
+            # rewind last_t — re-ingesting events and double-counting
+            # them into acc_* on every later poll.
+            window_t_end = float(stream.t_end)
             n_hold = int(n * self.holdout_frac)
             if n_hold and n - n_hold >= 1:
                 cut = n - n_hold
                 t_cut = float(stream.times[cut - 1])
-                self.holdout = make_stream(
-                    stream.times[cut:], stream.dims[cut:], self.n_feeds,
-                    t_end=stream.t_end, t_start=t_cut)
-                stream = make_stream(
-                    stream.times[:cut], stream.dims[:cut], self.n_feeds,
-                    t_end=t_cut, t_start=stream.t_start)
+                if t_cut < stream.t_end:
+                    self.holdout = make_stream(
+                        stream.times[cut:], stream.dims[cut:],
+                        self.n_feeds, t_end=stream.t_end, t_start=t_cut)
+                    stream = make_stream(
+                        stream.times[:cut], stream.dims[:cut],
+                        self.n_feeds, t_end=t_cut,
+                        t_start=stream.t_start)
             data = chunk_events(stream, chunk_size=self.chunk_size)
             D = self.n_feeds
             if self.acc_span == 0.0 and not self.mu.any():
@@ -345,8 +354,7 @@ class StreamingEM:
                     mu_n, alpha_n, beta_n, self.acc_counts, span,
                     scan_bits)
             ll = float(ll_h) - float(comp_h)
-            self.last_t = float(stream.t_end if self.holdout is None
-                                else self.holdout.t_end)
+            self.last_t = window_t_end
             sp.set(loglik=ll)
             return ll
 

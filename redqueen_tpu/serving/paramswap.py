@@ -245,8 +245,20 @@ class ParamGate:
                     False, f"canary NLL regression: {cand_nll:.6g} > "
                            f"bound {bound:.6g} (baseline "
                            f"{baseline_nll:.6g})", None, meas)
-        q = float(current_q if candidate.get("q") is None
-                  else candidate["q"])
+        try:
+            q = float(current_q if candidate.get("q") is None
+                      else candidate["q"])
+        except (TypeError, ValueError) as e:
+            return GateResult(False, f"malformed candidate q: {e}",
+                              None, meas)
+        # q feeds the live sqrt(s/q) control law directly — a NaN or
+        # non-positive q through the gate is exactly the silent outage
+        # it exists to stop (and revalidate() would then refuse to
+        # roll it back).  Mirror revalidate()'s check.
+        if not (np.isfinite(q) and q > 0.0):
+            return GateResult(False,
+                              f"q must be finite and > 0, got {q}",
+                              None, meas)
         s64 = np.ascontiguousarray(s_sink, dtype=np.float64)
         vp = ValidatedParams(s_sink=s64, q=q, fingerprint=fingerprint,
                              digest=params_digest(s64, q), step=step,

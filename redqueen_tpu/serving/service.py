@@ -53,7 +53,7 @@ from ..runtime import telemetry as _telemetry
 from .events import EventBatch, IngestError, validate_batch
 from .ingest import Sequencer
 from .journal import (FLUSH_MODES, JOURNAL_FILENAME, Journal,
-                      replay as journal_replay)
+                      JournalError, replay as journal_replay)
 from .metrics import ServingMetrics
 from .paramswap import (PARAMS_LOG_FILENAME, PARAMS_LOG_SCHEMA,
                         ValidatedParams, params_digest)
@@ -515,10 +515,40 @@ class ServingRuntime:
             log = _integrity.read_json(path, schema=PARAMS_LOG_SCHEMA)
         except FileNotFoundError:
             log = {"installs": []}
+        except _integrity.CorruptArtifactError:
+            # The new params are already live and their epoch record is
+            # journaled + fsynced — a corrupt sidecar must not fail the
+            # install (it would raise post-install and then fail every
+            # future install too).  read_json quarantined the bad file;
+            # rebuild the index from the journal's own epoch records.
+            # Installs whose segments were pruned are unrecoverable
+            # here, degrading recovery to journal-reachable epochs.
+            log = {"installs": self._rebuild_params_log_installs(
+                before_epoch=int(rec["epoch"]))}
         log["installs"].append(
             {k: rec[k] for k in ("epoch", "seq", "s_sink", "q",
                                  "fingerprint", "digest")})
         _integrity.write_json(path, log, schema=PARAMS_LOG_SCHEMA)
+
+    def _rebuild_params_log_installs(
+            self, before_epoch: int) -> List[Dict[str, Any]]:
+        """Reconstruct the sidecar's install list from the journal's
+        epoch records (every install is appended + fsynced there before
+        the sidecar mirror, so all epochs < ``before_epoch`` that still
+        have their segments are on media).  Read-only: the live file's
+        tail is never quarantined from here.  A journal that cannot be
+        replayed yields an empty list — a fresh sidecar beats wedging
+        the install path."""
+        try:
+            records, _ = journal_replay(
+                os.path.join(self.dir, _JOURNAL),
+                quarantine_torn_tail=False)
+        except (OSError, JournalError):
+            return []
+        return [{k: r[k] for k in ("epoch", "seq", "s_sink", "q",
+                                   "fingerprint", "digest")}
+                for r in records
+                if "epoch" in r and int(r["epoch"]) < before_epoch]
 
     def submit(self, batch: EventBatch,
                _validated: bool = False) -> Admission:

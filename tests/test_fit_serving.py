@@ -242,6 +242,18 @@ class TestParamGate:
         assert not res.ok and res.params is None
         assert reason in res.reason
 
+    @pytest.mark.parametrize("q,reason", [
+        (float("nan"), "q must be finite"),
+        (-3.0, "q must be finite"),
+        (0.0, "q must be finite"),
+        ("junk", "malformed candidate q")])
+    def test_bad_candidate_q_rejected(self, q, reason):
+        """A candidate-supplied q feeds sqrt(s/q) directly: NaN or
+        non-positive must die at the gate, not in the control law."""
+        res = ParamGate().validate(self._cand(q=q), current_q=1.0)
+        assert not res.ok and res.params is None
+        assert reason in res.reason
+
     def test_canary_regression_rejected(self):
         gate = ParamGate(nll_bound=0.1)
         res = gate.validate(self._cand(), current_q=1.0,
@@ -305,6 +317,28 @@ class TestInstallGuard:
         m = rt.write_metrics()
         assert m["param_epoch"] == 1
         assert m["param_fingerprint"] == "fp-test-1"
+        rt.close()
+
+    def test_corrupt_params_log_rebuilt_not_fatal(self, tmp_path):
+        """A corrupt sidecar must not fail an install post-swap (the
+        params are already live, the epoch record already journaled);
+        it is rebuilt from the journal's epoch records instead."""
+        from redqueen_tpu.runtime import integrity as _integrity
+        from redqueen_tpu.serving.paramswap import (PARAMS_LOG_FILENAME,
+                                                    PARAMS_LOG_SCHEMA)
+        rt = _runtime(tmp_path)
+        _feed(rt, n_batches=3)
+        sw = ParamSwapper(rt)
+        assert sw.offer(_healthy_candidate(
+            str(tmp_path / "c1.json"), fingerprint="fp-a"))["installed"]
+        path = tmp_path / PARAMS_LOG_FILENAME
+        path.write_text("{ not json")
+        out = sw.offer(_healthy_candidate(
+            str(tmp_path / "c2.json"), fingerprint="fp-b", step=2))
+        assert out["installed"] and out["epoch"] == 2
+        log = _integrity.read_json(str(path), schema=PARAMS_LOG_SCHEMA)
+        assert [e["epoch"] for e in log["installs"]] == [1, 2]
+        assert log["installs"][0]["fingerprint"] == "fp-a"
         rt.close()
 
     def test_inflight_decision_keeps_old_epoch(self, tmp_path):
@@ -529,6 +563,44 @@ class TestStreamingEM:
         assert em.last_t == pytest.approx(float(em.holdout.t_end))
         nll = holdout_nll(em.holdout, em.mu, em.alpha, em.beta)
         assert np.isfinite(nll)
+
+    def test_small_window_advances_watermark(self, tmp_path):
+        """A trickle window too small to carve a holdout (n_hold == 0)
+        must still advance last_t to ITS end — a stale holdout from an
+        earlier window must never rewind the watermark, or the trickle
+        events re-ingest and double-count into acc_* every poll."""
+        rt = _runtime(tmp_path)
+        seq, t = _feed(rt, n_batches=10, events_per_batch=5)
+        rt.close()
+        em = StreamingEM(str(tmp_path), n_feeds=D, chunk_size=256,
+                         holdout_frac=0.2)
+        em.run_once()
+        assert em.holdout is not None  # big window carved a canary
+        rt, _ = recover(str(tmp_path))
+        _, t = _feed(rt, n_batches=1, events_per_batch=3, seq0=seq,
+                     t0=t)
+        rt.close()
+        upd = em.run_once()
+        assert upd.n_events == 3
+        assert em.last_t == pytest.approx(t)  # NOT the stale holdout
+        assert em.run_once().n_events == 0  # nothing re-ingests
+
+    def test_tied_cut_timestamp_skips_holdout(self, tmp_path):
+        """Tied event times at the holdout cut (t_cut == t_end) skip
+        the carve instead of crashing make_stream with an empty span."""
+        rt = _runtime(tmp_path)
+        t = np.array([1., 2., 3., 4., 5., 6., 7., 8., 8., 8.])
+        adm = rt.submit(EventBatch(0, t, np.zeros(10, np.int32)))
+        assert adm.status == "accepted", adm
+        rt.poll()
+        rt.close()
+        em = StreamingEM(str(tmp_path), n_feeds=D, chunk_size=256,
+                         holdout_frac=0.2)
+        upd = em.run_once()
+        assert upd.n_events == 10 and np.isfinite(upd.loglik)
+        assert em.holdout is None
+        assert em.last_t == pytest.approx(8.0)
+        assert em.run_once().n_events == 0
 
     def test_cross_excitation_recovered(self, tmp_path):
         """End-to-end: simulate a KNOWN off-diagonal model, journal it
