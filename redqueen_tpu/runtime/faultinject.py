@@ -213,6 +213,32 @@ Two ways in:
                             cluster's :class:`ShardRouter` via
                             :func:`shard_fault` — healthy shards keep
                             serving throughout
+      reshard:mode@rangeK   deterministic fault in the LIVE RESHARDING
+                            protocol (:mod:`redqueen_tpu.serving.topology`),
+                            fired when the migration driver reaches feed
+                            range K of its plan.  ``kill_src`` SIGKILLs
+                            the range's source shard right after the
+                            fence record lands (the fenced digest must
+                            survive the outage and the resumed step must
+                            re-extract bit-identically); ``kill_dst``
+                            SIGKILLs the destination right after its
+                            digest-asserted install+snapshot but BEFORE
+                            the ownership flip (resume re-installs
+                            idempotently, flips once); ``kill_router``
+                            hard-exits the router process itself with
+                            the fence durable and the flip unwritten
+                            (``ServingCluster.recover`` + ``resume_
+                            migration`` must continue from the fenced
+                            range); ``wedge`` stalls the driver for one
+                            counted no-progress step (the stalled-
+                            migration visibility shape); ``torn_plan``
+                            tears the topology log's tail mid-fence (the
+                            power-loss-during-append shape — recovery
+                            quarantines the torn record by truncation
+                            and the range re-fences).  Data-plane kind:
+                            validated at :func:`maybe_inject`, APPLIED
+                            by the migration driver via
+                            :func:`reshard_fault`
 
   ``RQ_FAULT_POINT`` (optional) restricts injection to the matching
   ``maybe_inject(point)`` call site.
@@ -276,6 +302,10 @@ __all__ = [
     "SWAP_MODES",
     "parse_swap",
     "swap_fault",
+    "ReshardFault",
+    "RESHARD_MODES",
+    "parse_reshard",
+    "reshard_fault",
     "hang_forever",
     "crash_with",
     "flaky",
@@ -317,7 +347,7 @@ def parse_fault(spec: str) -> FaultSpec:
     kind = kind.strip().lower()
     if kind not in ("hang", "crash", "transient", "oom", "corrupt",
                     "numeric", "ingest", "shard", "worker", "net",
-                    "repl", "disk", "learn", "swap"):
+                    "repl", "disk", "learn", "swap", "reshard"):
         raise ValueError(f"unknown fault spec {spec!r} "
                          f"(want hang|crash|transient|oom[:arg], "
                          f"corrupt:mode@path, "
@@ -328,8 +358,9 @@ def parse_fault(spec: str) -> FaultSpec:
                          f"net:mode@shardK[,batchN], "
                          f"repl:mode@peerK[,batchN], "
                          f"disk:mode@fsyncN, "
-                         f"learn:mode[@stepN], or "
-                         f"swap:mode)")
+                         f"learn:mode[@stepN], "
+                         f"swap:mode, or "
+                         f"reshard:mode@rangeK[,batchN])")
     return FaultSpec(kind, arg.strip() or None)
 
 
@@ -417,6 +448,10 @@ def inject(spec: FaultSpec) -> None:
         # Same data-plane contract: validated here, applied by the
         # parameter gate/swapper via swap_fault().
         parse_swap(spec.arg)
+    elif spec.kind == "reshard":
+        # Same data-plane contract: validated here, applied by the
+        # live-resharding migration driver via reshard_fault().
+        parse_reshard(spec.arg)
 
 
 def maybe_inject(point: str = "start") -> None:
@@ -923,6 +958,44 @@ def swap_fault() -> Optional[SwapFault]:
     if parsed.kind != "swap":
         return None
     return parse_swap(parsed.arg)
+
+
+# --- reshard (live topology migration) faults: mid-handoff failures -------
+
+RESHARD_MODES = ("kill_src", "kill_dst", "kill_router", "wedge",
+                 "torn_plan")
+
+
+class ReshardFault(NamedTuple):
+    """Parsed ``reshard:mode@rangeK[,batchN]`` spec.  ``range`` is the
+    migration plan's feed-range index at which the fault fires — the
+    same spec hits the same protocol point in an uninterrupted run and
+    in a recover-and-resume run, because range ids are journaled in the
+    plan record.  ``batch`` is accepted for spec-shape uniformity with
+    the other shard-addressed kinds (unused by the driver)."""
+
+    mode: str   # kill_src | kill_dst | kill_router | wedge | torn_plan
+    range: int
+    batch: Optional[int]
+
+
+def parse_reshard(arg: Optional[str]) -> ReshardFault:
+    """Parse the argument of a ``reshard`` fault spec."""
+    return ReshardFault(*_parse_shard_addressed(arg, "reshard",
+                                                RESHARD_MODES,
+                                                prefix="range"))
+
+
+def reshard_fault() -> Optional[ReshardFault]:
+    """The env-configured reshard fault, or None when ``RQ_FAULT`` is
+    unset or names a different kind."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    parsed = parse_fault(spec)
+    if parsed.kind != "reshard":
+        return None
+    return parse_reshard(parsed.arg)
 
 
 # --- picklable callable faults (spawned-child targets for tests) ---------

@@ -45,6 +45,15 @@ deterministic fault injection) made load-bearing:
   (healthy→degraded→quarantined), in-place crash recovery while
   healthy shards keep serving, and the digest-asserted
   :func:`reshard` N→M state migration;
+- :mod:`~redqueen_tpu.serving.topology` — crash-safe LIVE resharding
+  and follow-graph churn (:class:`Migration` /
+  :class:`TopologyState`): two-phase per-range handoff (fence →
+  digest-asserted install → journaled ownership flip) driven by
+  ``ServingCluster.begin_reshard`` while traffic keeps flowing, the
+  journaled/resumable migration plan (``topology.log``, replayed on
+  recovery like param epochs), and journaled ``add_edges`` /
+  ``drop_edges`` graph churn — with the ``RQ_FAULT=reshard:*`` fault
+  kinds (docs/DESIGN.md "Elastic topology & live resharding");
 - :mod:`~redqueen_tpu.serving.corpus`   — corpus replay: native-loader
   rows merged into one time-ordered stream and served as sequenced
   micro-batches (``python -m redqueen_tpu.serving.corpus``);
@@ -119,8 +128,20 @@ __all__ = [
     "partition",
     "shard_seed",
     "reshard",
+    "RETIRED",
     "CLUSTER_SCHEMA",
     "RESHARD_SCHEMA",
+    "Migration",
+    "TopologyState",
+    "TopologyError",
+    "MigrationInterrupted",
+    "MigrationStalled",
+    "TopologyLog",
+    "TOPOLOGY_LOG",
+    "read_topology_log",
+    "range_digest",
+    "churn_assign",
+    "plan_moves",
     "PLACEMENTS",
     "WORKER_PLACEMENTS",
     "FeedState",
@@ -170,7 +191,15 @@ _LAZY_ATTRS = {
     "ServingCluster": ".cluster", "ShardRouter": ".cluster",
     "partition": ".cluster", "reshard": ".cluster",
     "shard_seed": ".cluster", "PLACEMENTS": ".cluster",
-    "WORKER_PLACEMENTS": ".cluster",
+    "WORKER_PLACEMENTS": ".cluster", "RETIRED": ".cluster",
+    "topology": None,
+    "Migration": ".topology", "TopologyState": ".topology",
+    "TopologyError": ".topology",
+    "MigrationInterrupted": ".topology",
+    "MigrationStalled": ".topology",
+    "TopologyLog": ".topology", "TOPOLOGY_LOG": ".topology",
+    "read_topology_log": ".topology", "range_digest": ".topology",
+    "churn_assign": ".topology", "plan_moves": ".topology",
     "EventBatch": ".events", "IngestError": ".events",
     "synthetic_stream": ".events", "validate_batch": ".events",
     "Sequencer": ".ingest",

@@ -116,6 +116,24 @@ state.  Per-shard lifetime counters (``n_events``/``n_posts``) reset at
 a reshard (they are fault-domain metrics, not stream state); the stream
 position (``seq``/``n_batches``) migrates.
 
+**Elastic topology (live resharding + graph churn).**
+:meth:`begin_reshard` grows the cluster N→M shards UNDER TRAFFIC via
+:mod:`serving.topology`: a journaled, resumable migration plan moves
+feed ranges one at a time through a two-phase fence→install→flip
+handoff — the source shard is fenced (admissions touching it refuse
+with status ``"fenced"`` and retransmit after the flip), the carry
+slice streams to a pre-sized fresh destination as a digest-asserted
+``install_range`` journal record, and the router flips ownership via a
+fsynced topology-epoch record in ``topology.log``.  SIGKILL of source,
+destination, or router mid-migration resumes from the last fenced
+range (``resume_migration``), with the per-range digest asserted
+bit-identical across the outage; :meth:`ServingCluster.recover`
+replays the topology log exactly like param epochs.  ``add_edges`` /
+``drop_edges`` are journaled live graph churn on the same substrate;
+``reshard:kill_src|kill_dst|kill_router|wedge|torn_plan@rangeK``
+fault kinds drive every interruption deterministically in CI.  See
+docs/DESIGN.md "Elastic topology & live resharding".
+
 See docs/DESIGN.md "Sharded serving & fault domains".
 """
 
@@ -125,7 +143,8 @@ import hashlib
 import os
 import shutil
 import time
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+from typing import (Any, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -133,10 +152,13 @@ from ..runtime import faultinject as _faultinject
 from ..runtime import integrity as _integrity
 from ..runtime import telemetry as _telemetry
 from ..runtime.supervisor import RetryPolicy
+from . import topology as _topology
 from .events import EventBatch, IngestError, validate_batch
 from .metrics import ClusterMetrics
 from .service import (RecoveryInfo, ServingRuntime, SNAPSHOTS_DIRNAME,
+                      _CONFIG as _SHARD_CONFIG,
                       recover as _recover_runtime)
+from .topology import TopologyError
 from .transport import TransportEOF, TransportError, TransportTimeout
 
 # NOTE: serving.worker is imported lazily (in _spawn_worker) — it
@@ -148,7 +170,7 @@ __all__ = ["ServingCluster", "ShardRouter", "ClusterAdmission",
            "ClusterDecision", "partition", "shard_seed", "reshard",
            "CLUSTER_SCHEMA", "RESHARD_SCHEMA", "PARTITION_VERSION",
            "PLACEMENTS", "WORKER_PLACEMENTS", "HEALTHY", "DEGRADED",
-           "QUARANTINED", "HEAL_AFTER", "QUARANTINE_AFTER",
+           "QUARANTINED", "RETIRED", "HEAL_AFTER", "QUARANTINE_AFTER",
            "WEDGE_FIRES", "MAX_BACKOFF_ROUNDS",
            "DEFAULT_RESTART_POLICY"]
 
@@ -165,6 +187,11 @@ PARTITION_VERSION = 1
 HEALTHY = "healthy"
 DEGRADED = "degraded"
 QUARANTINED = "quarantined"
+# A migration source that shed its last feed: its directory and journal
+# stay on disk (history), but it owns no edges, receives no traffic,
+# and is never auto-recovered.  Terminal — distinct from QUARANTINED so
+# reads don't count a retired slot as degraded serving.
+RETIRED = "retired"
 HEAL_AFTER = 3          # consecutive clean applies: degraded -> healthy
 QUARANTINE_AFTER = 3    # consecutive timeouts: degraded -> quarantined
 WEDGE_FIRES = 2         # injected-wedge timeouts before the stall clears
@@ -272,14 +299,25 @@ class _ShardSlot:
     __slots__ = ("k", "dir", "feeds", "s_slice", "runtime", "health",
                  "fail_streak", "clean_streak", "skip_rounds",
                  "recover_failures", "crash_streak", "restart_at",
-                 "outstanding", "listener", "acked_seq")
+                 "outstanding", "listener", "acked_seq", "retired",
+                 "start_seq")
 
     def __init__(self, k: int, dir: Optional[str], feeds: np.ndarray,
-                 s_slice: np.ndarray):
+                 s_slice: np.ndarray, start_seq: int = 0):
         self.k = k
         self.dir = dir
-        self.feeds = feeds          # global feed ids owned (ascending)
+        # Global feed ids this slot's RUNTIME carries (ascending) — the
+        # shard geometry.  Ownership can be narrower: a migration
+        # source keeps its geometry until it retires, but the router's
+        # ``_owner`` map (flipped per range) decides routing.
+        self.feeds = feeds
         self.s_slice = s_slice
+        # The stream position this slot's runtime was born at — genesis
+        # slots share the cluster start_seq; a migration destination
+        # starts at the fence watermark + 1.
+        self.start_seq = int(start_seq)
+        # Terminal migrated-away state (see RETIRED).
+        self.retired = False
         # Socket placement: the per-shard accept point (survives worker
         # restarts — the replacement dials the same address).
         self.listener: Optional[Any] = None
@@ -402,9 +440,16 @@ class ServingCluster:
         if s.shape != (self.n_feeds,):
             raise ValueError(
                 f"s_sink must have shape ({n_feeds},), got {s.shape}")
-        self._s_sink = s
+        # Router-side copy of the global baseline sink vector (each
+        # runtime holds its own live slice) — grows with add_edges.
+        self._sink = s
 
         self._assign = partition(self.n_feeds, self.n_shards)
+        # Live ownership map: assign is the GENESIS partition (part of
+        # the directory identity, immutable); _owner is what routing
+        # uses, rewritten by journaled topology flips (-1 = dropped
+        # edge, -2 = added edge awaiting its slot attach).
+        self._owner = self._assign.copy()
         # local index of each global feed within its owning shard
         self._local_index = np.empty(self.n_feeds, np.int32)
         self._slots: List[_ShardSlot] = []
@@ -414,11 +459,24 @@ class ServingCluster:
                                                  dtype=np.int32)
             sdir = (None if dir is None
                     else os.path.join(dir, f"shard-{k:04d}"))
-            self._slots.append(_ShardSlot(k, sdir, feeds, s[feeds]))
+            self._slots.append(_ShardSlot(k, sdir, feeds, s[feeds],
+                                          start_seq=self.start_seq))
+        # Elastic-topology protocol state + journal (serving.topology);
+        # the log opens lazily on the first topology mutation.
+        self._topo = _topology.TopologyState()
+        self._topo_log: Optional[_topology.TopologyLog] = None
 
         if dir is not None:
             os.makedirs(dir, exist_ok=True)
             self._check_or_write_config()
+            tlog = os.path.join(dir, _topology.TOPOLOGY_LOG)
+            if _open_runtimes and os.path.exists(tlog) \
+                    and os.path.getsize(tlog) > 0:
+                raise ValueError(
+                    f"cluster dir {dir} carries topology records — its "
+                    f"shard layout evolved past the genesis config "
+                    f"this constructor would build; use "
+                    f"ServingCluster.recover({dir!r}) instead")
 
         self.metrics = ClusterMetrics(self.n_shards, clock=clock)
         self._fault = _faultinject.shard_fault()
@@ -493,7 +551,7 @@ class ServingCluster:
     def _config(self) -> Dict[str, Any]:
         return {
             "n_feeds": self.n_feeds, "n_shards": self.n_shards,
-            "q": self.q, "s_sink": [float(x) for x in self._s_sink],
+            "q": self.q, "s_sink": [float(x) for x in self._sink],
             "seed": self.seed, "start_seq": self.start_seq,
             "snapshot_every": self.snapshot_every,
             "reorder_window": self.reorder_window,
@@ -547,7 +605,7 @@ class ServingCluster:
         return ServingRuntime(
             n_feeds=len(slot.feeds), q=self.q, s_sink=slot.s_slice,
             seed=shard_seed(self.seed, slot.k), dir=slot.dir,
-            start_seq=self.start_seq, snapshot_every=self.snapshot_every,
+            start_seq=slot.start_seq, snapshot_every=self.snapshot_every,
             reorder_window=self.reorder_window,
             queue_capacity=self.queue_capacity,
             max_batch_events=self.max_batch_events,
@@ -570,7 +628,7 @@ class ServingCluster:
         return {"n_feeds": int(len(slot.feeds)), "q": self.q,
                 "s_sink": [float(x) for x in slot.s_slice],
                 "seed": shard_seed(self.seed, slot.k),
-                "start_seq": self.start_seq,
+                "start_seq": slot.start_seq,
                 "snapshot_every": self.snapshot_every,
                 "reorder_window": self.reorder_window,
                 "queue_capacity": self.queue_capacity,
@@ -653,14 +711,15 @@ class ServingCluster:
                 from .transport import Listener
                 from .worker import SocketWorkerHandle
 
-                for slot in self._slots:
+                live = [s for s in self._slots if not s.retired]
+                for slot in live:
                     if slot.listener is None:
                         slot.listener = Listener(host=self.listen_host,
                                                  clock=self._clock)
                     procs.append(SocketWorkerHandle.launch(
                         slot.dir, slot.k, slot.listener, self.token,
                         heartbeat_every_s=self.worker_heartbeat_every_s))
-                for slot, proc in zip(self._slots, procs):
+                for slot, proc in zip(live, procs):
                     slot.runtime = SocketWorkerHandle.from_child(
                         proc, slot.k, slot.listener, self.token,
                         request_timeout_s=self.worker_request_timeout_s,
@@ -669,15 +728,27 @@ class ServingCluster:
                         clock=self._clock)
             else:
                 for slot in self._slots:
+                    if slot.retired:
+                        continue
                     slot.runtime = self._spawn_worker(slot)
             pending = []
             for slot in self._slots:
+                if slot.retired:
+                    continue
                 h = slot.runtime
-                pending.append((slot, h.start_recover() if recover
+                # A slot journaled into existence mid-migration whose
+                # process died before the runtime wrote config.json has
+                # nothing on disk to recover — it opens fresh and the
+                # resumed migration re-streams its ranges.
+                use_rec = recover and slot.dir is not None \
+                    and os.path.exists(
+                        os.path.join(slot.dir, _SHARD_CONFIG))
+                pending.append((slot, use_rec,
+                                h.start_recover() if use_rec
                                 else h.start_open(
                                     self._worker_config(slot))))
-            for slot, rid in pending:
-                if recover:
+            for slot, use_rec, rid in pending:
+                if use_rec:
                     infos.append(slot.runtime.finish_recover(rid))
                 else:
                     slot.runtime.finish_open(rid)
@@ -755,13 +826,29 @@ class ServingCluster:
                  worker_read_timeout_s=worker_read_timeout_s,
                  clock=clock, auto_recover=auto_recover,
                  _open_runtimes=False)
+        # Replay the topology log BEFORE opening runtimes: every slot
+        # added / ownership flip / retirement since genesis re-applies
+        # in journal order (the param-epoch replay pattern, lifted to
+        # the shard layout itself).
+        records, _torn = _topology.read_topology_log(
+            os.path.join(dir, _topology.TOPOLOGY_LOG))
+        for rec in records:
+            cl._apply_topo_record(rec, recovering=True)
         if placement in WORKER_PLACEMENTS:
             return cl, cl._open_workers(recover=True)
         infos: List[RecoveryInfo] = []
         for slot in cl._slots:
-            rt, info = _recover_runtime(slot.dir, clock=clock)
-            slot.runtime = rt
-            infos.append(info)
+            if slot.retired:
+                continue
+            if os.path.exists(os.path.join(slot.dir, _SHARD_CONFIG)):
+                rt, info = _recover_runtime(slot.dir, clock=clock)
+                slot.runtime = rt
+                infos.append(info)
+            else:
+                # Journaled into existence but crashed before its
+                # runtime persisted anything — open fresh; the resumed
+                # migration re-streams whatever it was owed.
+                slot.runtime = cl._fresh_runtime(slot)
         return cl, infos
 
     # ---- routing: the ingest path ----
@@ -777,7 +864,7 @@ class ServingCluster:
             empty = EventBatch(seq, np.empty(0, np.float64),
                                np.empty(0, np.int32))
             return [empty] * self.n_shards
-        assign = self._assign[batch.feeds]
+        assign = self._owner[batch.feeds]
         order = np.argsort(assign, kind="stable")
         times_s = batch.times[order]
         local_s = self._local_index[batch.feeds[order]]
@@ -813,6 +900,23 @@ class ServingCluster:
             return ClusterAdmission(
                 "rejected", seq=e.seq, reason=str(e),
                 per_shard=("rejected",) * self.n_shards)
+        reason = self._route_block(batch)
+        if reason is not None:
+            if reason.startswith("fenced"):
+                # Refused BEFORE fan-out: nothing entered any shard
+                # ledger, so the closed accounting identity is
+                # untouched — the source just retransmits after the
+                # flip lands.
+                self.metrics.observe_fenced_retry()
+                return ClusterAdmission("fenced", seq=int(batch.seq),
+                                        reason=reason)
+            self.metrics.global_rejected += 1
+            for k in range(self.n_shards):
+                self.metrics.observe_submitted(k)
+                self.metrics.observe_rejected(k)
+            return ClusterAdmission(
+                "rejected", seq=int(batch.seq), reason=reason,
+                per_shard=("rejected",) * self.n_shards)
         seq = int(batch.seq)
         subs = self._split_batch(batch)
         now = self._clock()
@@ -828,6 +932,9 @@ class ServingCluster:
             # back "duplicate" — an ack, absorbed).
             sent: List[Tuple[_ShardSlot, int]] = []
             for slot in self._slots:
+                if slot.retired:
+                    statuses[slot.k] = "retired"
+                    continue
                 self.metrics.observe_submitted(slot.k)
                 if slot.runtime is None:
                     statuses[slot.k] = "unavailable"
@@ -875,6 +982,9 @@ class ServingCluster:
                     slot, adm, subs[slot.k].n_events, seq, now)
         else:
             for slot in self._slots:
+                if slot.retired:
+                    statuses[slot.k] = "retired"
+                    continue
                 self.metrics.observe_submitted(slot.k)
                 if slot.runtime is None:
                     statuses[slot.k] = "unavailable"
@@ -886,9 +996,10 @@ class ServingCluster:
                 statuses[slot.k] = adm.status
                 backpressure |= self._note_admission(
                     slot, adm, sub.n_events, seq, now)
-        if all(st in ("accepted", "duplicate") for st in statuses):
+        live = [st for st in statuses if st != "retired"]
+        if all(st in ("accepted", "duplicate") for st in live):
             status = "accepted"
-        elif all(st in ("shed", "unavailable") for st in statuses):
+        elif all(st in ("shed", "unavailable") for st in live):
             status = "shed"
         else:
             status = "partial"
@@ -929,6 +1040,21 @@ class ServingCluster:
                     "rejected", seq=e.seq, reason=str(e),
                     per_shard=("rejected",) * self.n_shards)))
                 continue
+            reason = self._route_block(v)
+            if reason is not None:
+                if reason.startswith("fenced"):
+                    self.metrics.observe_fenced_retry()
+                    prepared.append((None, None, ClusterAdmission(
+                        "fenced", seq=int(v.seq), reason=reason)))
+                else:
+                    self.metrics.global_rejected += 1
+                    for k in range(self.n_shards):
+                        self.metrics.observe_submitted(k)
+                        self.metrics.observe_rejected(k)
+                    prepared.append((None, None, ClusterAdmission(
+                        "rejected", seq=int(v.seq), reason=reason,
+                        per_shard=("rejected",) * self.n_shards)))
+                continue
             prepared.append((v, self._split_batch(v), None))
         now = self._clock()
         n_valid = sum(1 for b, _, _ in prepared if b is not None)
@@ -948,6 +1074,10 @@ class ServingCluster:
                         slot.k, int(b.seq))
 
         for slot in self._slots:
+            if slot.retired:
+                statuses[slot.k] = ["retired"] * n_valid
+                bps[slot.k] = [False] * n_valid
+                continue
             for _ in range(n_valid):
                 self.metrics.observe_submitted(slot.k)
             if slot.runtime is None:
@@ -1001,9 +1131,10 @@ class ServingCluster:
             # not over-throttle a whole round for one shed slice.
             bp = any(bps[k][vi] for k in range(self.n_shards))
             vi += 1
-            if all(st in ("accepted", "duplicate") for st in per):
+            live = [st for st in per if st != "retired"]
+            if all(st in ("accepted", "duplicate") for st in live):
                 status = "accepted"
-            elif all(st in ("shed", "unavailable") for st in per):
+            elif all(st in ("shed", "unavailable") for st in live):
                 status = "shed"
             else:
                 status = "partial"
@@ -1056,6 +1187,9 @@ class ServingCluster:
             return self._poll_workers(max_batches_per_shard)
         out: Dict[int, List[Any]] = {}
         for slot in self._slots:
+            if slot.retired:
+                out[slot.k] = []
+                continue
             if slot.runtime is None:
                 if self.auto_recover and slot.dir is not None \
                         and slot.skip_rounds == 0:
@@ -1086,6 +1220,8 @@ class ServingCluster:
                                      range(self.n_shards)}
         dispatch: List[Tuple[_ShardSlot, int]] = []
         for slot in self._slots:
+            if slot.retired:
+                continue
             if slot.runtime is None:
                 if self.auto_recover \
                         and self._clock() >= slot.restart_at:
@@ -1650,6 +1786,374 @@ class ServingCluster:
             self.metrics.observe_lost_in_window(k, seq)
         return info
 
+    # ---- elastic topology (live resharding + graph churn) ----
+
+    def _uniform_applied_seq(self, why: str) -> int:
+        """Every active shard's applied seq, asserted equal — the
+        watermark a topology mutation anchors to."""
+        seqs: Dict[int, int] = {}
+        for slot in self._slots:
+            if slot.retired:
+                continue
+            if slot.runtime is None:
+                raise TopologyError(
+                    f"shard {slot.k} is quarantined — "
+                    f"recover_shard({slot.k}) first: {why}")
+            seqs[slot.k] = int(slot.runtime.applied_seq)
+        if len(set(seqs.values())) != 1:
+            raise TopologyError(
+                f"shards disagree on applied seq ({seqs}) — {why}; "
+                f"retransmit the gap seqs and poll until uniform")
+        return next(iter(seqs.values()))
+
+    def _drain_for_topology(self, drain_rounds: int) -> int:
+        for _ in range(int(drain_rounds)):
+            if self.pending == 0:
+                break
+            self.poll()
+        if self.pending:
+            raise TopologyError(
+                f"cluster will not drain ({self.pending} sub-batches "
+                f"still pending after {drain_rounds} poll rounds) — "
+                f"retransmit the gap seqs first")
+        return self._uniform_applied_seq(
+            "a topology mutation anchors to one uniform watermark")
+
+    def _append_topo(self, rec: Dict[str, Any]) -> None:
+        """Journal one topology record (durable BEFORE it takes
+        effect — the flip the router acts on must be the flip recovery
+        will replay), then apply it to the live routing state.  A
+        dirless cluster keeps the topology in memory only."""
+        if self._topo_log is None and self.dir is not None:
+            self._topo_log = _topology.TopologyLog(
+                os.path.join(self.dir, _topology.TOPOLOGY_LOG))
+        if self._topo_log is not None:
+            self._topo_log.append(rec)
+        self._apply_topo_record(rec, recovering=False)
+
+    def _apply_topo_record(self, rec: Dict[str, Any],
+                           recovering: bool) -> None:
+        """The ONE place topology records mutate router state — the
+        live path and the recovery replay run the same transitions, so
+        a recovered router's ownership map is bit-identical to the one
+        that journaled the records.  ``recovering`` suppresses only the
+        COUNTING observers (the ledger is per-router-process); the
+        structural ones (``add_shard``, the epoch) always run."""
+        t = self._topo
+        kind = rec["kind"]
+        t.note_epoch(int(rec["epoch"]))
+        if kind == "add_edges":
+            first, count = int(rec["first"]), int(rec["count"])
+            if first != self.n_feeds:
+                raise ValueError(
+                    f"topology log corrupt: add_edges starts at feed "
+                    f"{first} but the cluster holds {self.n_feeds}")
+            self.n_feeds += count
+            self._owner = np.concatenate(
+                [self._owner,
+                 np.full(count, -2, self._owner.dtype)])
+            self._local_index = np.concatenate(
+                [self._local_index, np.zeros(count, np.int32)])
+            self._sink = np.concatenate(
+                [self._sink, np.asarray(rec["s_sink"], np.float64)])
+            if not recovering:
+                self.metrics.observe_edges_added(count)
+        elif kind == "add_slot":
+            k = int(rec["k"])
+            if k != len(self._slots):
+                raise ValueError(
+                    f"topology log corrupt: add_slot k={k} but the "
+                    f"cluster holds {len(self._slots)} slots")
+            feeds = np.asarray(rec["feeds"], np.int64)
+            sdir = (None if self.dir is None
+                    else os.path.join(self.dir, f"shard-{k:04d}"))
+            self._slots.append(_ShardSlot(
+                k, sdir, feeds, self._sink[feeds],
+                start_seq=int(rec["start_seq"])))
+            self.n_shards = len(self._slots)
+            self.metrics.add_shard()
+            # Pending-attach feeds (added by the add_edges record this
+            # slot was created to serve) become live here.
+            pend = feeds[self._owner[feeds] == -2]
+            if len(pend):
+                self._owner[pend] = k
+                self._local_index[pend] = np.searchsorted(
+                    feeds, pend).astype(np.int32)
+        elif kind == "plan":
+            t.plan = dict(rec)
+            t.fences = {}
+            t.flipped = set()
+        elif kind == "fence":
+            t.fences[int(rec["range"])] = dict(rec)
+        elif kind == "flip":
+            feeds = np.asarray(rec["feeds"], np.int64)
+            dst = self._slots[int(rec["dst"])]
+            self._owner[feeds] = dst.k
+            self._local_index[feeds] = np.searchsorted(
+                dst.feeds, feeds).astype(np.int32)
+            t.fences.pop(int(rec["range"]), None)
+            t.flipped.add(int(rec["range"]))
+            if not recovering:
+                self.metrics.observe_range_migrated()
+        elif kind == "retire":
+            slot = self._slots[int(rec["k"])]
+            slot.retired = True
+            slot.health = RETIRED
+            if slot.runtime is not None:
+                try:
+                    slot.runtime.close()
+                except (TransportError, OSError):
+                    pass
+                slot.runtime = None
+            if slot.listener is not None:
+                slot.listener.close()
+                slot.listener = None
+            slot.outstanding.clear()
+        elif kind == "complete":
+            t.plan = None
+            t.fences = {}
+            t.flipped = set()
+            t.plans_completed += 1
+            if not recovering:
+                self.metrics.observe_plan_complete()
+        elif kind == "drop_edges":
+            feeds = np.asarray(rec["feeds"], np.int64)
+            self._owner[feeds] = -1
+            if not recovering:
+                self.metrics.observe_edges_dropped(len(feeds))
+        else:
+            raise ValueError(
+                f"unknown topology record kind {kind!r}")
+        self.metrics.set_topology_epoch(t.epoch)
+
+    def _open_slot_runtime(self, slot: _ShardSlot) -> None:
+        """Bring a just-journaled slot's runtime up (fresh, pre-sized).
+        Separate from :meth:`_apply_topo_record` because the RECOVERY
+        replay must not open runtimes mid-replay — it rebuilds the slot
+        table first and opens everything afterwards."""
+        if self._worker_mode:
+            h = self._spawn_worker(slot)
+            try:
+                h.finish_open(h.start_open(self._worker_config(slot)))
+            except TransportError as e:
+                h.kill()
+                raise RuntimeError(
+                    f"worker for new shard {slot.k} failed to open: "
+                    f"{type(e).__name__}: {e}") from e
+            slot.runtime = h
+        else:
+            slot.runtime = self._fresh_runtime(slot)
+
+    def _route_block(self, batch: EventBatch) -> Optional[str]:
+        """The admission-time topology gate: dropped feeds reject;
+        a batch past the fence watermark touching a FENCED source shard
+        is refused ("fenced" — the source retransmits after the flip).
+        Seqs at or below ``max(watermark, source acked seq)`` pass:
+        the source already applied them, so re-admission is a pure
+        duplicate there — and it is exactly what lets a recovered,
+        lagging destination catch up to the watermark mid-migration."""
+        if len(batch.feeds) == 0:
+            return None
+        owners = self._owner[batch.feeds]
+        if (owners < 0).any():
+            bad = np.unique(batch.feeds[owners < 0])
+            return (f"batch {int(batch.seq)} touches dropped feeds "
+                    f"{[int(f) for f in bad[:8]]} — removed by "
+                    f"drop_edges, no longer routable")
+        t = self._topo
+        if not t.fences:
+            return None
+        seq = int(batch.seq)
+        for rec in t.fences.values():
+            src = self._slots[int(rec["src"])]
+            if seq <= max(int(rec["watermark"]), src.acked_seq):
+                continue
+            if (owners == src.k).any():
+                return (f"fenced: seq {seq} touches shard {src.k}, "
+                        f"paused for range {int(rec['range'])} handoff "
+                        f"(watermark {int(rec['watermark'])}) — "
+                        f"retransmit after the flip")
+        return None
+
+    @property
+    def migration_pending(self) -> bool:
+        return self._topo.plan is not None
+
+    @property
+    def topology_epoch(self) -> int:
+        return self._topo.epoch
+
+    def begin_reshard(self, n_shards: int,
+                      range_size: Optional[int] = None,
+                      drain_rounds: int = 64) -> "_topology.Migration":
+        """Start a LIVE N→M grow-migration: journal the new pre-sized
+        slots and the range plan, then return the resumable
+        :class:`serving.topology.Migration` driver — the caller
+        interleaves ``step()`` with traffic.  Only grows (existing
+        runtimes never receive into their live arrays — that would
+        invalidate their journaled digests); shrink via the drained,
+        offline :func:`reshard`."""
+        if self.migration_pending:
+            raise TopologyError(
+                f"plan {self._topo.plan.get('plan')!r} is still "
+                f"migrating — resume_migration() and finish it first")
+        if self.external_workers:
+            raise TopologyError(
+                "this router does not own its workers "
+                "(external_workers=True) — it cannot spawn new shard "
+                "processes; reshard offline instead")
+        active = [s for s in self._slots if not s.retired]
+        n_new = int(n_shards) - len(active)
+        if n_new < 1:
+            raise TopologyError(
+                f"live resharding only grows ({len(active)} active "
+                f"shards, asked for {int(n_shards)}) — use reshard() "
+                f"(drained, offline) to shrink")
+        w = self._drain_for_topology(drain_rounds)
+        owned = {s.k: np.flatnonzero(self._owner == s.k)
+                 for s in active}
+        new_ids = [len(self._slots) + i for i in range(n_new)]
+        new_feeds, ranges = _topology.plan_moves(owned, new_ids,
+                                                 range_size)
+        for k in new_ids:
+            # A fresh runtime at start_seq=w+1 sits at applied_seq=w —
+            # already level with the drained cluster, so the next
+            # drain stays uniform while the new slot rides the stream.
+            self._append_topo({"kind": "add_slot",
+                               "epoch": self._topo.next_epoch(),
+                               "k": int(k),
+                               "feeds": [int(f) for f in new_feeds[k]],
+                               "start_seq": int(w) + 1})
+            self._open_slot_runtime(self._slots[k])
+        plan_id = f"plan-{self._topo.next_epoch():06d}"
+        plan = {"kind": "plan", "epoch": self._topo.next_epoch(),
+                "plan": plan_id, "ranges": ranges,
+                "watermark": int(w),
+                "new_slots": [int(k) for k in new_ids]}
+        self._append_topo(plan)
+        return _topology.Migration(self, plan,
+                                   fault=_faultinject.reshard_fault())
+
+    def resume_migration(self) -> "_topology.Migration":
+        """Re-arm the driver for the journaled in-flight plan (after a
+        crash + recovery, or just a new driver object) — it continues
+        from the first unflipped range, re-asserting the fenced
+        digest."""
+        if self._topo.plan is None:
+            raise TopologyError("no migration is pending")
+        return _topology.Migration(self, self._topo.plan,
+                                   fault=_faultinject.reshard_fault())
+
+    def add_edges(self, n: int,
+                  s_sink: Optional[np.ndarray] = None,
+                  drain_rounds: int = 64) -> List[int]:
+        """Grow the follow graph by ``n`` new feeds under traffic:
+        journal the new feed block, assign it to the least-loaded
+        shards (:func:`serving.topology.churn_assign`), and materialize
+        each receiving shard as a mini-migration into a fresh pre-sized
+        slot (growth IS resharding — a live runtime's arrays never grow
+        in place).  Returns the new feed ids."""
+        if self.migration_pending:
+            raise TopologyError(
+                f"plan {self._topo.plan.get('plan')!r} is still "
+                f"migrating — finish it before churning the graph")
+        if self.external_workers:
+            raise TopologyError(
+                "this router does not own its workers — it cannot "
+                "spawn the replacement shard add_edges needs")
+        n = int(n)
+        if n < 1:
+            raise TopologyError(f"add_edges needs n >= 1, got {n}")
+        if s_sink is None:
+            s_new = np.ones(n, np.float64)
+        else:
+            s_new = np.asarray(s_sink, np.float64)
+            if s_new.shape != (n,):
+                raise TopologyError(
+                    f"s_sink must have shape ({n},), got "
+                    f"{s_new.shape}")
+        w = self._drain_for_topology(drain_rounds)
+        active = [s for s in self._slots if not s.retired]
+        counts = {s.k: int((self._owner == s.k).sum())
+                  for s in active}
+        choice = _topology.churn_assign(counts, n)
+        first = self.n_feeds
+        new_ids = list(range(first, first + n))
+        self._append_topo({"kind": "add_edges",
+                           "epoch": self._topo.next_epoch(),
+                           "first": int(first), "count": n,
+                           "s_sink": [float(x) for x in s_new]})
+        ranges: List[Dict[str, Any]] = []
+        for old_k in sorted(set(choice)):
+            new_k = len(self._slots)
+            owned_old = np.flatnonzero(self._owner == old_k)
+            attach = [f for f, c in zip(new_ids, choice)
+                      if c == old_k]
+            feeds = sorted([int(f) for f in owned_old] + attach)
+            self._append_topo({"kind": "add_slot",
+                               "epoch": self._topo.next_epoch(),
+                               "k": int(new_k), "feeds": feeds,
+                               "start_seq": int(w) + 1})
+            self._open_slot_runtime(self._slots[new_k])
+            if len(owned_old):
+                ranges.append({"id": len(ranges), "src": int(old_k),
+                               "dst": int(new_k),
+                               "feeds": [int(f) for f in owned_old]})
+        if ranges:
+            plan_id = f"plan-{self._topo.next_epoch():06d}"
+            plan = {"kind": "plan",
+                    "epoch": self._topo.next_epoch(),
+                    "plan": plan_id, "ranges": ranges,
+                    "watermark": int(w), "new_slots": []}
+            self._append_topo(plan)
+            _topology.Migration(
+                self, plan,
+                fault=_faultinject.reshard_fault()).run()
+        return new_ids
+
+    def drop_edges(self, feeds: Sequence[int],
+                   drain_rounds: int = 64) -> None:
+        """Remove feeds from the live graph: poison their carry on the
+        owning shard (rank 0, health bit set — no intensity
+        contribution, journaled in the shard's OWN journal so recovery
+        replays it) and journal the routing drop (owner -1: future
+        batches touching them reject, and they leave
+        :meth:`edge_digest`).  The poison lands before the drop record
+        — a crash between the two re-runs ``drop_edges`` idempotently."""
+        if self.migration_pending:
+            raise TopologyError(
+                f"plan {self._topo.plan.get('plan')!r} is still "
+                f"migrating — finish it before churning the graph")
+        feeds = np.unique(np.asarray(feeds, np.int64))
+        if len(feeds) == 0:
+            return
+        if feeds.min() < 0 or feeds.max() >= self.n_feeds:
+            raise TopologyError(
+                f"drop_edges feed ids out of range 0..{self.n_feeds - 1}")
+        owners = self._owner[feeds]
+        if (owners < 0).any():
+            bad = [int(f) for f, o in zip(feeds, owners) if o < 0]
+            raise TopologyError(
+                f"feeds {bad[:8]} are already dropped")
+        self._drain_for_topology(drain_rounds)
+        for k in sorted(set(int(o) for o in owners)):
+            slot = self._slots[k]
+            sel = feeds[owners == k]
+            local = self._local_index[sel]
+            r0 = np.zeros(len(sel), np.float32)
+            h1 = np.ones(len(sel), np.uint32)
+            dg = _topology.range_digest(sel, r0, h1)
+            self._topo.assert_owner(self._owner[sel], k, sel)
+            slot.runtime.install_range(
+                [int(i) for i in local], r0, h1,
+                feeds=[int(f) for f in sel],
+                topo_epoch=self._topo.next_epoch(), digest=dg,
+                plan_id="drop", range_id=-1)
+            slot.runtime.snapshot()
+        self._append_topo({"kind": "drop_edges",
+                           "epoch": self._topo.next_epoch(),
+                           "feeds": [int(f) for f in feeds]})
+
     # ---- read / inspection paths ----
 
     def _slot_pending(self, slot: _ShardSlot) -> int:
@@ -1691,7 +2195,9 @@ class ServingCluster:
 
     @property
     def edges_per_shard(self) -> List[int]:
-        return [int(len(s.feeds)) for s in self._slots]
+        # Ownership, not geometry: a slot's array can still HOLD a
+        # range that migrated off it (frozen, excluded from reads).
+        return [int((self._owner == s.k).sum()) for s in self._slots]
 
     @property
     def applied_seq(self) -> int:
@@ -1700,6 +2206,8 @@ class ServingCluster:
         must be retransmitted until it recovers and reports)."""
         seqs = []
         for s in self._slots:
+            if s.retired:
+                continue
             if s.runtime is None:
                 seqs.append(-1)
                 continue
@@ -1757,11 +2265,14 @@ class ServingCluster:
             stale_batches=stale,
             shards_reporting=len(per),
             shards_quarantined=sum(1 for s in self._slots
-                                   if s.runtime is None))
+                                   if s.runtime is None
+                                   and not s.retired))
 
     def shard_digests(self) -> Dict[int, Optional[str]]:
         out: Dict[int, Optional[str]] = {}
         for s in self._slots:
+            if s.retired:
+                continue
             if s.runtime is None:
                 out[s.k] = None
                 continue
@@ -1808,13 +2319,21 @@ class ServingCluster:
         health = np.zeros(self.n_feeds, np.uint32)
         seqs, ts, nbs = [], [], []
         for slot in self._slots:
+            if slot.retired:
+                continue
             if slot.runtime is None:
                 raise ValueError(
                     f"shard {slot.k} is quarantined — recover before "
                     f"gathering edge state")
             r, h, sq, t, nb = slot.runtime.gather()
-            rank[slot.feeds] = r
-            health[slot.feeds] = h
+            # Ownership-masked: a migrated-off range still sits frozen
+            # in the source's arrays (and a dropped edge sits poisoned
+            # in its old owner's) — only the feeds this slot OWNS
+            # contribute to the global view.
+            own = self._owner[slot.feeds] == slot.k
+            sel = slot.feeds[own]
+            rank[sel] = r[own]
+            health[sel] = h[own]
             seqs.append(int(sq))
             ts.append(float(t))
             nbs.append(int(nb))
@@ -1830,12 +2349,18 @@ class ServingCluster:
         cluster clock — independent of the partition, so it is THE
         reshard witness: an N→M migration must preserve it bitwise."""
         rank, health, seq, t_max, _ = self._gather_edges()
+        live = np.flatnonzero(self._owner >= 0)
         h = hashlib.sha256()
-        h.update(np.int64(self.n_feeds).tobytes())
+        h.update(np.int64(len(live)).tobytes())
         h.update(np.int64(seq).tobytes())
         h.update(np.float32(t_max).tobytes())
-        h.update(rank.tobytes())
-        h.update(health.tobytes())
+        if len(live) != self.n_feeds:
+            # Dropped edges leave holes: the surviving feed ids become
+            # part of the witness.  When nothing was ever dropped the
+            # digest stays byte-identical to the pre-elastic format.
+            h.update(live.astype(np.int64).tobytes())
+        h.update(rank[live].tobytes())
+        h.update(health[live].tobytes())
         return h.hexdigest()
 
     # ---- durability / artifacts ----
@@ -1895,6 +2420,9 @@ class ServingCluster:
             if slot.listener is not None:
                 slot.listener.close()
                 slot.listener = None
+        if self._topo_log is not None:
+            self._topo_log.close()
+            self._topo_log = None
 
     def reset_metrics(self) -> None:
         """Fresh router ledger (bench warm-up exclusion); refused while
@@ -1916,6 +2444,8 @@ class ServingCluster:
                         slot, e, f"worker died on reset_metrics: {e}")
             slot.outstanding.clear()
         self.metrics = ClusterMetrics(self.n_shards, clock=self._clock)
+        # Counters restart; the epoch is structural state, not a count.
+        self.metrics.set_topology_epoch(self._topo.epoch)
 
     def __enter__(self):
         return self
@@ -1969,20 +2499,29 @@ def reshard(src_dir: str, dst_dir: str, n_shards: int,
         raise ValueError(
             f"reshard destination {dst_dir} is not empty — refusing to "
             f"mix with existing serving state")
-    dst = ServingCluster(
-        n_feeds=int(cfg["n_feeds"]), n_shards=int(n_shards), dir=dst_dir,
-        q=float(cfg["q"]), s_sink=np.asarray(cfg["s_sink"], np.float64),
-        seed=int(cfg["seed"]), start_seq=int(cfg["start_seq"]),
-        snapshot_every=int(cfg["snapshot_every"]),
-        reorder_window=int(cfg["reorder_window"]),
-        queue_capacity=int(cfg["queue_capacity"]),
-        max_batch_events=int(cfg["max_batch_events"]),
-        fsync_every_n=int(cfg.get("fsync_every_n", 1)),
-        flush_mode=str(cfg.get("flush_mode", "sync")),
-        max_unflushed_records=int(cfg.get("max_unflushed_records", 64)),
-        max_flush_delay_ms=float(cfg.get("max_flush_delay_ms", 50.0)),
-        coalesce=int(cfg.get("coalesce", 1)), clock=clock)
+    dst = None
     try:
+        # Construction INSIDE the cleanup scope: a shard runtime that
+        # fails to open mid-constructor has already written the cluster
+        # config and the earlier shards' directories — that partial,
+        # unverified destination must die with the failure too, not
+        # just failures past this point.
+        dst = ServingCluster(
+            n_feeds=int(cfg["n_feeds"]), n_shards=int(n_shards),
+            dir=dst_dir, q=float(cfg["q"]),
+            s_sink=np.asarray(cfg["s_sink"], np.float64),
+            seed=int(cfg["seed"]), start_seq=int(cfg["start_seq"]),
+            snapshot_every=int(cfg["snapshot_every"]),
+            reorder_window=int(cfg["reorder_window"]),
+            queue_capacity=int(cfg["queue_capacity"]),
+            max_batch_events=int(cfg["max_batch_events"]),
+            fsync_every_n=int(cfg.get("fsync_every_n", 1)),
+            flush_mode=str(cfg.get("flush_mode", "sync")),
+            max_unflushed_records=int(
+                cfg.get("max_unflushed_records", 64)),
+            max_flush_delay_ms=float(
+                cfg.get("max_flush_delay_ms", 50.0)),
+            coalesce=int(cfg.get("coalesce", 1)), clock=clock)
         for slot in dst._slots:
             st = slot.runtime.carry
             migrated = st.replace(
@@ -2022,7 +2561,8 @@ def reshard(src_dir: str, dst_dir: str, n_shards: int,
         # silently-wrong state the digest assert refuses, so the
         # destination (created by us: it was empty at entry) dies with
         # the failure.
-        dst.close()
+        if dst is not None:
+            dst.close()
         shutil.rmtree(dst_dir, ignore_errors=True)
         raise
     dst.close()

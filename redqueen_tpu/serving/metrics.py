@@ -300,10 +300,51 @@ class ClusterMetrics:
         self.global_rejected = 0   # rejected before fan-out (bad batch)
         self.decisions_served = 0
         self.stale_decisions = 0
+        # The elastic-topology block (serving.topology): epoch is the
+        # current journaled topology epoch; the counters are THIS
+        # router process's observations.  ``fenced_retried`` counts
+        # admissions refused at the router because a pending range
+        # fence covered their feeds — refused BEFORE fan-out, so they
+        # never enter any per-shard ledger and the closed sub-batch
+        # identity holds unchanged across a mid-migration window (the
+        # source's retransmit after the flip enters as a normal
+        # submission).
+        self.topology: Dict[str, int] = {
+            "epoch": 0, "plans_completed": 0, "ranges_migrated": 0,
+            "fenced_retried": 0, "edges_added": 0, "edges_dropped": 0,
+            "migration_stalls": 0}
         self._latencies: collections.deque = collections.deque(
             maxlen=LATENCY_WINDOW)
 
     # -- observers (the router calls exactly one per sub-batch outcome) --
+
+    def add_shard(self) -> None:
+        """A migration destination joined the cluster (topology
+        ``add_slot``): one more fault domain in the ledger, zeroed —
+        the per-shard identity holds from its first sub-batch."""
+        self.shards.append(_ShardStats())
+        self.n_shards = len(self.shards)
+
+    def set_topology_epoch(self, epoch: int) -> None:
+        self.topology["epoch"] = max(self.topology["epoch"], int(epoch))
+
+    def observe_fenced_retry(self) -> None:
+        self.topology["fenced_retried"] += 1
+
+    def observe_range_migrated(self) -> None:
+        self.topology["ranges_migrated"] += 1
+
+    def observe_edges_added(self, n: int) -> None:
+        self.topology["edges_added"] += int(n)
+
+    def observe_edges_dropped(self, n: int) -> None:
+        self.topology["edges_dropped"] += int(n)
+
+    def observe_migration_stall(self) -> None:
+        self.topology["migration_stalls"] += 1
+
+    def observe_plan_complete(self) -> None:
+        self.topology["plans_completed"] += 1
 
     def observe_submitted(self, shard: int) -> None:
         self.shards[shard].submitted += 1
@@ -437,6 +478,7 @@ class ClusterMetrics:
             "reattaches": agg["reattaches"],
             "resyncs": agg["resyncs"],
             "global_rejected_batches": self.global_rejected,
+            "topology": dict(self.topology),
             "decisions_served": self.decisions_served,
             "stale_decisions": self.stale_decisions,
             "busy_s": round(busy_s, 6),

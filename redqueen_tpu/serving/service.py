@@ -43,7 +43,8 @@ from __future__ import annotations
 import collections
 import os
 import time
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+from typing import (Any, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -412,6 +413,104 @@ class ServingRuntime:
                 f"runtime serves {self.n_feeds}")
         self._state = state
         self._seq.next_seq = int(np.asarray(state.seq)) + 1
+
+    # ---- live resharding range handoff (serving.topology drives) ----
+
+    def extract_range(self, idx: Sequence[int]
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """The carry slice for local feed indices ``idx`` as host
+        arrays ``(rank f32, health u32)`` — what the migration fence
+        streams to the destination.  Read-only, but only meaningful on
+        a drained runtime (a pending batch could still mutate the
+        slice)."""
+        if self.pending:
+            raise ValueError(
+                f"extract_range with {self.pending} batches pending — "
+                f"drain (poll) first; a queued apply could mutate the "
+                f"fenced slice")
+        idx = np.asarray(idx, np.int64)
+        if idx.size and (idx.min() < 0 or idx.max() >= self.n_feeds):
+            raise ValueError(
+                f"extract_range indices out of range for {self.n_feeds}"
+                f" feeds")
+        r, h, _sq, _t, _nb = self.gather()
+        return r[idx].copy(), h[idx].copy()
+
+    def install_range(self, idx: Sequence[int], rank: np.ndarray,
+                      health: np.ndarray, *, feeds: Sequence[int],
+                      topo_epoch: int, digest: str, plan_id: str,
+                      range_id: int) -> None:
+        """Install one migrated range into the carry — the journaled,
+        digest-asserted, IDEMPOTENT scatter-set the live-reshard flip
+        depends on (``serving.topology.Migration`` calls this only
+        after ``assert_fenced`` — rqlint RQ1007 flags unguarded call
+        sites).
+
+        The record lands in this shard's own journal (fsynced, like a
+        parameter-epoch record) BEFORE the in-memory flip, keyed by
+        ``topo_epoch`` and pinned to the current applied seq, so
+        recovery re-applies it at exactly the same stream position —
+        and because it is a pure set of journaled values, replaying it
+        twice (a resumed migration re-installs after a crash) is
+        bit-identical to once."""
+        import jax.numpy as jnp
+
+        if self.pending:
+            raise ValueError(
+                f"install_range with {self.pending} batches pending — "
+                f"drain (poll) first; the install must land at a "
+                f"well-defined stream position")
+        idx = np.asarray(idx, np.int32)
+        r = np.ascontiguousarray(np.asarray(rank, np.float32))
+        h = np.ascontiguousarray(np.asarray(health, np.uint32))
+        feeds = [int(f) for f in feeds]
+        if not (idx.shape == r.shape == h.shape
+                and len(feeds) == idx.shape[0]):
+            raise ValueError(
+                f"install_range arrays disagree: {idx.shape[0]} "
+                f"indices, {r.shape[0]} ranks, {h.shape[0]} health "
+                f"words, {len(feeds)} feeds")
+        if idx.size and (idx.min() < 0 or idx.max() >= self.n_feeds):
+            raise ValueError(
+                f"install_range indices out of range for "
+                f"{self.n_feeds} feeds")
+        from .topology import range_digest
+        got = range_digest(feeds, r, h)
+        if got != digest:
+            raise RuntimeError(
+                f"range payload digest mismatch at install: fence "
+                f"says {str(digest)[:12]}.., arrays hash to "
+                f"{got[:12]}.. — the slice was altered between fence "
+                f"and install; refusing")
+        new = self._state.replace(
+            rank=self._state.rank.at[idx].set(jnp.asarray(r)),
+            health=self._state.health.at[idx].set(jnp.asarray(h)))
+        rec = {
+            "topo_epoch": int(topo_epoch),
+            "plan": str(plan_id),
+            "range": int(range_id),
+            "seq": self.applied_seq,
+            "idx": [int(i) for i in idx],
+            "feeds": feeds,
+            "rank": [float(x) for x in r],
+            "health": [int(x) for x in h],
+            "digest": str(digest),
+            "state_digest": state_digest(new),
+        }
+        if self._journal is not None:
+            try:
+                self._journal.append(rec, seq=self.applied_seq)
+                # Same durability contract as a param install: the
+                # flip the router is about to journal must never
+                # outlive this record in a crash.
+                self._journal.sync()
+            except OSError as e:
+                raise RuntimeError(
+                    f"journal append failed for topology epoch "
+                    f"{topo_epoch} range install: {e} — range "
+                    f"installs must be durable; restart and recover "
+                    f"from {self.dir}") from e
+        self._state = new
 
     # ---- live-parameter epoch swap (serving.paramswap is the gate) ----
 
@@ -1075,9 +1174,10 @@ def _record_batches(rec: Dict[str, Any]
     tuples, for BOTH record shapes: a /1 record is one batch, a /2 group
     record (flat concatenated events + per-batch ``counts``) is several.
     The single flat-record parser every journal reader shares."""
-    if "epoch" in rec:
-        # A parameter-install record (serving.paramswap): positional
-        # metadata for replay, not a batch — contributes no decisions.
+    if "epoch" in rec or "topo_epoch" in rec:
+        # A parameter-install record (serving.paramswap) or a migrated
+        # range install (serving.topology): positional metadata for
+        # replay, not a batch — contributes no decisions.
         return []
     if "seqs" not in rec:
         return [(int(rec["seq"]), rec["times"], rec["feeds"],
@@ -1198,6 +1298,37 @@ def recover(dir: str, clock=time.monotonic,
         s_sink = jnp.asarray(s64, jnp.float32)
         qv = jnp.asarray(float(live_install["q"]), jnp.float32)
     for rec in records:
+        if "topo_epoch" in rec:
+            # A migrated-range install (serving.topology): re-apply
+            # the journaled scatter-set at its stream position — the
+            # values come from the record itself (f32/u32 round-trip
+            # exactly through JSON), so replaying it is bit-identical
+            # to the live install, and re-applying an already-
+            # snapshotted install would be too (pure set); we skip
+            # those only because later batch records may since have
+            # re-ranked the installed edges.
+            if int(rec["seq"]) > start_seq_state:
+                raise RuntimeError(
+                    f"journal topology record (epoch "
+                    f"{rec['topo_epoch']}) claims install at seq "
+                    f"{rec['seq']} but replay is at {start_seq_state} "
+                    f"— out-of-order install record")
+            if int(rec["seq"]) == start_seq_state:
+                t_idx = np.asarray(rec["idx"], np.int32)
+                state = state.replace(
+                    rank=state.rank.at[t_idx].set(jnp.asarray(
+                        np.asarray(rec["rank"], np.float32))),
+                    health=state.health.at[t_idx].set(jnp.asarray(
+                        np.asarray(rec["health"], np.uint32))))
+                got = state_digest(state)
+                if got != rec["state_digest"]:
+                    raise RuntimeError(
+                        f"journal replay diverged at topology epoch "
+                        f"{rec['topo_epoch']} range install (seq "
+                        f"{rec['seq']}): recomputed carry digest "
+                        f"{got[:12]}.. != journaled "
+                        f"{str(rec['state_digest'])[:12]}..")
+            continue
         if "epoch" in rec:
             # A journaled install: switch the replay params from this
             # stream position on — every batch replays under the epoch

@@ -489,6 +489,25 @@ class _Worker:
         return {"decisions": decisions, "pending": self.rt.pending,
                 "applied_seq": self.rt.applied_seq}
 
+    def _handle_install_range(self, req: Dict[str, Any]
+                              ) -> Dict[str, Any]:
+        """The worker-side mirror of the migrated-range install.  The
+        topology-epoch ownership fence is asserted ROUTER-side
+        (``serving.topology.Migration`` guards before sending this
+        frame — the worker has no topology view), so this handler is
+        on rqlint RQ1007's allowlist; the payload digest is still
+        re-asserted here against the fence digest in the frame."""
+        self.rt.install_range(
+            [int(i) for i in req["idx"]],
+            np.asarray(req["rank"], np.float32),
+            np.asarray(req["health"], np.uint32),
+            feeds=[int(f) for f in req["feeds"]],
+            topo_epoch=int(req["topo_epoch"]),
+            digest=str(req["digest"]),
+            plan_id=str(req["plan"]),
+            range_id=int(req["range"]))
+        return {}
+
     def _handle(self, req: Dict[str, Any]) -> Tuple[bool, Any]:
         """Dispatch one request; returns ``(respond, value)``."""
         op = req.get("op")
@@ -523,6 +542,13 @@ class _Worker:
             return True, {"rank": [float(x) for x in r],
                           "health": [int(x) for x in h],
                           "seq": sq, "t": t, "n_batches": nb}
+        if op == "extract_range":
+            r, h = self.rt.extract_range(
+                [int(i) for i in req["idx"]])
+            return True, {"rank": [float(x) for x in r],
+                          "health": [int(x) for x in h]}
+        if op == "install_range":
+            return True, self._handle_install_range(req)
         if op == "reset_metrics":
             self.rt.reset_metrics()
             return True, {}
@@ -986,6 +1012,30 @@ class WorkerHandle:
         return (np.asarray(v["rank"], np.float32),
                 np.asarray(v["health"], np.uint32),
                 int(v["seq"]), float(v["t"]), int(v["n_batches"]))
+
+    def extract_range(self, idx) -> Tuple[np.ndarray, np.ndarray]:
+        """The fenced carry slice over the frame protocol — same f32-
+        exact JSON round-trip as :meth:`gather`, so the range digest
+        the router computes matches an in-process extract bitwise."""
+        v = self.request("extract_range",
+                         idx=[int(i) for i in idx])
+        return (np.asarray(v["rank"], np.float32),
+                np.asarray(v["health"], np.uint32))
+
+    def install_range(self, idx, rank, health, *, feeds, topo_epoch,
+                      digest, plan_id, range_id) -> None:
+        """Stream one fenced range into the worker's carry (journaled
+        + fsynced in the worker before the reply frame — the reply IS
+        the durable-receipt ack the router's flip waits on)."""
+        self.request("install_range",
+                     idx=[int(i) for i in idx],
+                     rank=[float(x) for x in np.asarray(rank,
+                                                        np.float32)],
+                     health=[int(x) for x in np.asarray(health,
+                                                        np.uint32)],
+                     feeds=[int(f) for f in feeds],
+                     topo_epoch=int(topo_epoch), digest=str(digest),
+                     plan=str(plan_id), range=int(range_id))
 
     @property
     def journal_path(self) -> Optional[str]:

@@ -28,6 +28,7 @@ MODULES = [
     "redqueen_tpu.serving.cluster", "redqueen_tpu.serving.corpus",
     "redqueen_tpu.serving.worker", "redqueen_tpu.serving.transport",
     "redqueen_tpu.serving.replication", "redqueen_tpu.serving.paramswap",
+    "redqueen_tpu.serving.topology",
     "redqueen_tpu.runtime", "redqueen_tpu.runtime.faultinject",
     "redqueen_tpu.runtime.preempt", "redqueen_tpu.runtime.artifacts",
     "redqueen_tpu.runtime.integrity", "redqueen_tpu.runtime.watchdog",

@@ -1,5 +1,5 @@
-"""Chaos soak: loop the ``repl:*`` / ``disk:*`` fault matrix and fail
-on any non-exact loss report.
+"""Chaos soak: loop the ``repl:*`` / ``disk:*`` / ``reshard:*`` fault
+matrix and fail on any non-exact loss report.
 
 Every scenario drives a real journal (or quorum-replicated journal
 group) under one injected fault, simulates the crash with
@@ -16,11 +16,22 @@ The matrix crosses fault kinds (follower SIGKILL, leader partition,
 slow follower forcing quorum demotion, fsync EIO/ENOSPC) with both
 journal formats and both follower placements, and ``--rounds N`` loops
 it N times — the soak exists to catch the rare interleavings a single
-pass gets lucky on.  Deterministic CPU-only; no accelerator, no jax.
+pass gets lucky on.  Deterministic CPU-only; the durability matrix is
+jax-free, the elastic-topology matrix drives real (CPU) clusters.
+
+The **reshard matrix** (``--reshard-rounds``, report in
+``RESHARD_CHAOS.json``) holds ISSUE 18's acceptance bar: a live N→M
+migration under traffic survives SIGKILL of the source shard, the
+destination shard, and the whole router process (``os._exit`` mid-
+plan), plus a wedged handoff and a torn topology-log tail — each run
+must resume from the last fenced range, lose zero acked records,
+keep the fenced/replayed counts EXACT, reconcile the accounting
+identity through the outage, and recover bit-identically afterwards.
 
 Usage::
 
     python tools/chaos_soak.py [--rounds N] [--json PATH]
+                               [--reshard-rounds N] [--reshard-json PATH]
     bash tools/ci.sh chaos-soak [N]
 """
 
@@ -368,6 +379,285 @@ def _learner_kill_scenario() -> Dict[str, Any]:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Elastic topology: the reshard:* fault matrix (ISSUE 18 acceptance)
+# ---------------------------------------------------------------------------
+
+_RESHARD_PARAMS = dict(n_feeds=16, n_shards=2, q=1.0, seed=0,
+                       snapshot_every=3, reorder_window=8,
+                       queue_capacity=64)
+_RESHARD_BATCHES = 12  # 6 before the plan, 6 riding the migration
+
+
+def _reshard_feed(cl, batches) -> None:
+    """Submit + retransmit-to-convergence (the source model)."""
+    for b in batches:
+        cl.submit(b)
+        cl.poll()
+    for _ in range(8):
+        cl.poll()
+        missing = [b for b in batches if int(b.seq) > cl.applied_seq]
+        if not missing:
+            break
+        for b in missing:
+            cl.submit(b)
+            cl.poll()
+    cl.poll()
+
+
+def _reshard_scenario(mode: str, rng: int) -> Dict[str, Any]:
+    """One live 2→4 migration under traffic with ``reshard:{mode}`` at
+    range ``rng``: heal, resume from the journaled fence (digest
+    re-asserted bit-identically by the driver), and hold the bar —
+    zero acked-record loss, EXACT fenced/replayed counts, accounting
+    reconciled through the outage, bit-identical directory recovery."""
+    name = f"reshard:{mode}@range{rng} live 2->4 migration"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from redqueen_tpu import serving
+    from redqueen_tpu.serving import topology
+
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    try:
+        stream = serving.synthetic_stream(
+            0, _RESHARD_BATCHES, _RESHARD_PARAMS["n_feeds"],
+            events_per_batch=6)
+        pre, post = stream[:6], stream[6:]
+        cl = serving.ServingCluster(dir=d, **_RESHARD_PARAMS)
+        _reshard_feed(cl, pre)
+        os.environ["RQ_FAULT"] = f"reshard:{mode}@range{rng}"
+        mig = cl.begin_reshard(4)
+        t0 = time.monotonic()
+        infos: List[Any] = []
+        fenced_probes = 0
+        mttr_s = 0.0
+        i = 0
+        try:
+            while not mig.done:
+                mig.step()
+                # traffic keeps flowing BETWEEN handoffs
+                if i < len(post):
+                    cl.submit(post[i])
+                    cl.poll()
+                    i += 1
+        except topology.MigrationInterrupted:
+            t_int = time.monotonic()
+            os.environ.pop("RQ_FAULT", None)
+            if mode == "torn_plan":
+                cl.close()
+                cl, infos = serving.ServingCluster.recover(d)
+                if not cl.migration_pending:
+                    raise SoakFailure(
+                        f"{name}: torn tail lost the journaled plan")
+            else:
+                # the fenced window, observed: a probe on a feed the
+                # fenced SOURCE still owns must refuse (never acked,
+                # never in the ledgers) and retransmit after the flip
+                f = int(mig.ranges[rng]["feeds"][0])
+                probe = serving.EventBatch(
+                    _RESHARD_BATCHES,
+                    np.asarray([_RESHARD_BATCHES + 0.5], np.float64),
+                    np.asarray([f], np.int32))
+                adm = cl.submit(probe)
+                if adm.status != "fenced":
+                    raise SoakFailure(
+                        f"{name}: expected a fenced refusal for feed "
+                        f"{f}, got {adm.status!r} ({adm.reason!r})")
+                fenced_probes = 1
+                infos = [cl.recover_shard(k)
+                         for k, h in enumerate(cl.health_by_shard)
+                         if h == "quarantined"]
+                if not infos:
+                    raise SoakFailure(
+                        f"{name}: the injected kill quarantined no "
+                        f"shard")
+            cl.resume_migration().run()
+            mttr_s = time.monotonic() - t_int
+        except topology.MigrationStalled:
+            t_int = time.monotonic()
+            os.environ.pop("RQ_FAULT", None)
+            mig.run()  # same driver — the wedge is spent
+            mttr_s = time.monotonic() - t_int
+        os.environ.pop("RQ_FAULT", None)
+        migration_wall_s = time.monotonic() - t0
+        _reshard_feed(cl, post)
+        if cl.migration_pending:
+            raise SoakFailure(f"{name}: the plan never completed")
+        if cl.applied_seq != _RESHARD_BATCHES - 1:
+            raise SoakFailure(
+                f"{name}: acked-record loss — applied_seq "
+                f"{cl.applied_seq} != {_RESHARD_BATCHES - 1} after "
+                f"retransmit convergence")
+        if not cl.metrics.reconciles(cl.pending_by_shard):
+            raise SoakFailure(
+                f"{name}: accounting identity broke across the outage")
+        topo = cl.metrics.report(cl.pending_by_shard,
+                                 cl.health_by_shard)["topology"]
+        if mode != "torn_plan" and topo["fenced_retried"] != fenced_probes:
+            raise SoakFailure(
+                f"{name}: fenced count non-exact — counted "
+                f"{topo['fenced_retried']}, probed {fenced_probes}")
+        dig = cl.edge_digest()
+        cl.close()
+        rec, _ = serving.ServingCluster.recover(d)
+        rec_dig = rec.edge_digest()
+        rec.close()
+        if rec_dig != dig:
+            raise SoakFailure(
+                f"{name}: post-migration recovery is not bit-identical "
+                f"({rec_dig} != {dig})")
+        return {"scenario": name, "acked": _RESHARD_BATCHES, "lost": [],
+                "exact": True, "fenced": int(topo["fenced_retried"]),
+                "replayed": int(sum(x.replayed for x in infos)),
+                "ranges_migrated": int(topo["ranges_migrated"]),
+                "topology_epoch": int(topo["epoch"]),
+                "mttr_s": round(mttr_s, 3),
+                "migration_wall_s": round(migration_wall_s, 3),
+                "throughput_during_migration_bps": round(
+                    i / migration_wall_s, 2) if migration_wall_s else 0.0}
+    finally:
+        os.environ.pop("RQ_FAULT", None)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+_RESHARD_CHILD_COMMON = """
+import json, os, sys
+import numpy as np
+from redqueen_tpu import serving
+
+PARAMS = dict(n_feeds=16, n_shards=2, q=1.0, seed=0, snapshot_every=3,
+              reorder_window=8, queue_capacity=64)
+
+
+def feed(cl, batches):
+    for b in batches:
+        cl.submit(b)
+        cl.poll()
+    for _ in range(8):
+        cl.poll()
+        missing = [b for b in batches if int(b.seq) > cl.applied_seq]
+        if not missing:
+            break
+        for b in missing:
+            cl.submit(b)
+            cl.poll()
+    cl.poll()
+
+
+stream = serving.synthetic_stream(0, 12, 16, events_per_batch=6)
+d = sys.argv[1]
+"""
+
+_RESHARD_CHILD_STAGE1 = _RESHARD_CHILD_COMMON + """
+cl = serving.ServingCluster(dir=d, **PARAMS)
+feed(cl, stream[:6])
+os.environ["RQ_FAULT"] = "reshard:kill_router@range1"
+mig = cl.begin_reshard(4)
+mig.run()
+print("UNREACHABLE: the router survived its own kill")
+"""
+
+_RESHARD_CHILD_STAGE2 = _RESHARD_CHILD_COMMON + """
+cl, infos = serving.ServingCluster.recover(d)
+assert cl.migration_pending, "the journaled plan died with the router"
+cl.resume_migration().run()
+feed(cl, stream[6:])
+out = {"applied": int(cl.applied_seq),
+       "digest": cl.edge_digest(),
+       "epoch": int(cl.topology_epoch),
+       "replayed": int(sum(i.replayed for i in infos)),
+       "pending": cl.migration_pending,
+       "reconciles": bool(cl.metrics.reconciles(cl.pending_by_shard))}
+cl.close()
+print("MIG_DONE " + json.dumps(out))
+"""
+
+
+def _reshard_router_kill_scenario() -> Dict[str, Any]:
+    """``reshard:kill_router@range1`` against a REAL process: the
+    router ``os._exit(21)``s with range 0 flipped and range 1's fence
+    on disk.  A fresh process must recover the directory, find the plan
+    still pending, resume from the fenced range, and converge with zero
+    acked-record loss."""
+    name = "reshard:kill_router@range1 whole-process kill"
+    import json
+    import subprocess
+
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    try:
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("RQ_SERVING_WORKER", "RQ_FAULT")}
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        p1 = subprocess.run(
+            [sys.executable, "-c", _RESHARD_CHILD_STAGE1, d],
+            env=env, capture_output=True, text=True, timeout=600)
+        if p1.returncode != 21 or "UNREACHABLE" in p1.stdout:
+            raise SoakFailure(
+                f"{name}: expected the router to _exit(21) mid-plan, "
+                f"got rc={p1.returncode} (stderr tail: "
+                f"{p1.stderr[-300:]!r})")
+        t0 = time.monotonic()
+        p2 = subprocess.run(
+            [sys.executable, "-c", _RESHARD_CHILD_STAGE2, d],
+            env=env, capture_output=True, text=True, timeout=600)
+        mttr_s = time.monotonic() - t0
+        if p2.returncode != 0:
+            raise SoakFailure(
+                f"{name}: resume process failed rc={p2.returncode} "
+                f"(stderr tail: {p2.stderr[-300:]!r})")
+        lines = [ln for ln in p2.stdout.splitlines()
+                 if ln.startswith("MIG_DONE ")]
+        if not lines:
+            raise SoakFailure(
+                f"{name}: resume printed no MIG_DONE report "
+                f"(out={p2.stdout!r})")
+        rep = json.loads(lines[0][len("MIG_DONE "):])
+        if rep["applied"] != _RESHARD_BATCHES - 1 or rep["pending"] \
+                or not rep["reconciles"]:
+            raise SoakFailure(
+                f"{name}: resumed migration did not converge exactly "
+                f"({rep!r})")
+        return {"scenario": name, "acked": _RESHARD_BATCHES, "lost": [],
+                "exact": True, "fenced": 0,
+                "replayed": int(rep["replayed"]),
+                "topology_epoch": int(rep["epoch"]),
+                "mttr_s": round(mttr_s, 3)}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def reshard_matrix() -> List[Any]:
+    """One entry per reshard:* fault kind; heavier than the durability
+    matrix (real CPU clusters + real process kills), so it loops under
+    its own ``--reshard-rounds``."""
+    return [
+        lambda: _reshard_scenario("kill_src", 1),
+        lambda: _reshard_scenario("kill_dst", 0),
+        lambda: _reshard_scenario("wedge", 0),
+        lambda: _reshard_scenario("torn_plan", 1),
+        _reshard_router_kill_scenario,
+    ]
+
+
+def run_reshard_soak(rounds: int) -> Dict[str, Any]:
+    results: List[Dict[str, Any]] = []
+    t0 = time.monotonic()
+    for r in range(rounds):
+        for fn in reshard_matrix():
+            res = fn()
+            res["round"] = r
+            results.append(res)
+            print(f"  round {r} {res['scenario']}: acked "
+                  f"{res['acked']}, lost {res['lost']}, fenced "
+                  f"{res['fenced']}, replayed {res['replayed']}, "
+                  f"mttr {res['mttr_s']}s — exact")
+    return {"rounds": rounds, "scenarios": len(reshard_matrix()),
+            "runs": len(results),
+            "wall_s": round(time.monotonic() - t0, 3),
+            "results": results}
+
+
 def scenario_matrix() -> List[Any]:
     """One entry per (fault kind x placement x format) cell; each is a
     zero-arg callable returning the scenario's result dict."""
@@ -429,9 +719,18 @@ def main(argv=None) -> int:
                     help="times to loop the full fault matrix")
     ap.add_argument("--json", default=None,
                     help="write the structured soak report here")
+    ap.add_argument("--reshard-rounds", type=int, default=1,
+                    help="times to loop the reshard:* elastic-topology "
+                         "matrix (0 skips it)")
+    ap.add_argument("--reshard-json", default=None,
+                    help="write the reshard soak report here "
+                         "(RESHARD_CHAOS.json in CI)")
     args = ap.parse_args(argv)
     if args.rounds < 1:
         ap.error(f"--rounds must be >= 1, got {args.rounds}")
+    if args.reshard_rounds < 0:
+        ap.error(f"--reshard-rounds must be >= 0, got "
+                 f"{args.reshard_rounds}")
     try:
         report = run_soak(args.rounds)
     except SoakFailure as e:
@@ -443,6 +742,19 @@ def main(argv=None) -> int:
     print(f"chaos soak OK: {report['runs']} scenario runs "
           f"({report['rounds']}x{report['scenarios']}), every loss "
           f"report exact, {report['wall_s']}s")
+    if args.reshard_rounds:
+        try:
+            rreport = run_reshard_soak(args.reshard_rounds)
+        except SoakFailure as e:
+            print(f"RESHARD CHAOS SOAK FAILED: {e}", file=sys.stderr)
+            return 1
+        if args.reshard_json:
+            _integrity.write_json(args.reshard_json, rreport,
+                                  schema="rq.chaos.reshard/1")
+        print(f"reshard chaos soak OK: {rreport['runs']} scenario runs "
+              f"({rreport['rounds']}x{rreport['scenarios']}), zero "
+              f"acked-record loss, every fenced/replayed count exact, "
+              f"{rreport['wall_s']}s")
     return 0
 
 

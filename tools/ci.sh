@@ -17,9 +17,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" == "chaos-soak" ]]; then
-    echo "== chaos soak: repl:*/disk:*/learn:*/swap:* fault matrix =="
+    echo "== chaos soak: repl:*/disk:*/learn:*/swap:*/reshard:* matrix =="
     exec python tools/chaos_soak.py --rounds "${2:-10}" \
-        --json CHAOS_SOAK.json
+        --json CHAOS_SOAK.json \
+        --reshard-rounds "${3:-1}" --reshard-json RESHARD_CHAOS.json
 fi
 
 echo "== rqlint static pass =="
@@ -110,8 +111,14 @@ echo "== durability chaos soak (repl:*/disk:*/learn:*/swap:* matrix) =="
 # corrupt candidate artifact (quarantine), and a real learner process
 # SIGKILLed mid-fit (serving journal untouched, checkpoint resume).  Fails on ANY non-exact loss report (reported lost seqs
 # != actually lost) or non-bit-identical replay of a kept record.
+# The reshard:* matrix rides the same gate (one round): live 2->4
+# migration under traffic surviving source/destination/router SIGKILL,
+# a wedged handoff, and a torn topology-log tail — resumed from the
+# journaled fence with exact fenced/replayed counts and zero
+# acked-record loss (report: RESHARD_CHAOS.json).
 # Nightly runs loop harder: `bash tools/ci.sh chaos-soak 50`.
-python tools/chaos_soak.py --rounds 3
+python tools/chaos_soak.py --rounds 3 \
+    --reshard-json RESHARD_CHAOS.json
 
 echo "== telemetry suite + overhead smoke =="
 # The unified-telemetry contracts, UNFILTERED (tier-1 runs the fast

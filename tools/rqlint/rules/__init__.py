@@ -4,8 +4,8 @@ Stable ID bands: RQ1xx resilience, RQ2xx artifacts, RQ3xx numerics,
 RQ4xx trace-safety, RQ5xx PRNG discipline, RQ6xx benchmark honesty,
 RQ7xx hidden host-sync (tier-2), RQ8xx recompilation hazards (tier-2),
 RQ9xx telemetry discipline, RQ10xx shared-memory concurrency
-(RQ1001-1004, tier-3) and ack/durability ordering + gated parameter installs (RQ1005-1006,
-tier-1),
+(RQ1001-1004, tier-3) and ack/durability ordering + gated parameter /
+edge-state installs (RQ1005-1007, tier-1),
 RQ11xx mesh/collective correctness (tier-3).
 RQ000 (unparseable file) is emitted by the engine itself, not a rule.
 Tier-2/3 rules carry ``needs_project`` and are skipped under
@@ -25,7 +25,9 @@ from .base import FileContext, Rule  # noqa: F401 (re-export)
 from .bench import HardCodedSlabRule, UnsyncedTimingRule
 from .concurrency import (FdLeakRule, LockOrderCycleRule,
                           UnguardedSharedStateRule, UnstoppableThreadRule)
-from .durability import AckBeforeDurabilityRule, UngatedParamInstallRule
+from .durability import (AckBeforeDurabilityRule,
+                         TopologyUnfencedInstallRule,
+                         UngatedParamInstallRule)
 from .hostsync import HiddenSyncRule, HotLoopTransferRule
 from .mesh import (AxisUnboundCollectiveRule, DonationAfterUseRule,
                    ShardMapSpecArityRule)
@@ -56,6 +58,7 @@ REGISTRY = (
     FdLeakRule,
     AckBeforeDurabilityRule,
     UngatedParamInstallRule,
+    TopologyUnfencedInstallRule,
     AxisUnboundCollectiveRule,
     DonationAfterUseRule,
     ShardMapSpecArityRule,

@@ -1,4 +1,4 @@
-"""RQ1005/RQ1006 — durability-contract ordering and guarded installs.
+"""RQ1005-RQ1007 — durability-contract ordering and guarded installs.
 
 RQ1005 — ack emitted before the durability point.
 
@@ -34,6 +34,24 @@ them.  The rule fires on any attribute assignment (plain or augmented)
 to those slots in ``serving/`` outside the allowlisted methods
 (``__init__`` constructs the initial params; ``_install_validated`` IS
 the install site).
+
+RQ1007 — edge state installed without the topology-ownership check.
+
+The elastic-topology contract (docs/DESIGN.md "Elastic topology & live
+resharding") is RQ1006's shape lifted from parameters to EDGE STATE:
+``install_range``/``install_carry`` scatter rank/health directly into a
+live shard, so every call site in ``serving/`` must first assert the
+mutation is sanctioned under the current topology epoch — the fence
+check (``assert_fenced``: the range is held fenced by the current plan)
+or the ownership check (``assert_owner``: every touched feed is owned
+by the target shard and no fence is pending).  A call without a
+source-order-preceding guard in the same function is a stale-owner
+hazard: a pre-crash driver object, or a churn path racing a migration,
+scatters into a shard that no longer owns the feeds.  Allowlisted:
+``reshard`` (the offline path — the whole cluster is drained and
+recovered under an exclusive directory, there is no live topology to
+race) and ``_handle_install_range`` (the worker-side half of a handoff
+whose fence the ROUTER already asserted before sending the frame).
 """
 
 from __future__ import annotations
@@ -167,3 +185,54 @@ class UngatedParamInstallRule(Rule):
                                 f"the gate validates and the epoch "
                                 f"record lands in the journal",
                                 line=sub.lineno, col=sub.col_offset)
+
+
+#: Call tails that scatter carry state directly into a live shard.
+_EDGE_INSTALL_TAILS = {"install_range", "install_carry"}
+
+#: Call tails that ARE the topology-ownership check.
+_TOPOLOGY_GUARD_TAILS = {"assert_fenced", "assert_owner"}
+
+#: Functions sanctioned to install without an inline guard: the offline
+#: reshard (exclusive drained directory — no live topology to race) and
+#: the worker-side handoff handler (the router asserted the fence
+#: before sending the install frame).
+_TOPOLOGY_ALLOWLIST = {"reshard", "_handle_install_range"}
+
+
+class TopologyUnfencedInstallRule(Rule):
+    id = "RQ1007"
+    name = "unfenced-edge-install"
+    description = ("edge state installed (install_range/install_carry) "
+                   "without a preceding topology-ownership check "
+                   "(assert_fenced/assert_owner) — a stale-owner "
+                   "scatter into a live shard")
+    paths = ("redqueen_tpu/serving/*.py",)
+
+    def check(self, ctx):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name in _TOPOLOGY_ALLOWLIST:
+                continue
+            guards = []
+            installs = []
+            for call in walk_calls(fn):
+                tail = chain_tail(call.func)
+                pos = (call.lineno, call.col_offset)
+                if tail in _TOPOLOGY_GUARD_TAILS:
+                    guards.append(pos)
+                elif tail in _EDGE_INSTALL_TAILS:
+                    installs.append((pos, tail))
+            for pos, tail in sorted(installs):
+                if any(g < pos for g in guards):
+                    continue
+                yield finding_at(
+                    self.id, ctx, None,
+                    f"{fn.name}() calls {tail}() at line {pos[0]} "
+                    f"without a preceding topology-ownership check — "
+                    f"assert the fence (assert_fenced) or the owner "
+                    f"(assert_owner) under the current epoch before "
+                    f"scattering edge state into a live shard",
+                    line=pos[0], col=pos[1])
