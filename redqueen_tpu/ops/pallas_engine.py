@@ -258,7 +258,7 @@ def _build_kernel(spec: KernelSpec):
         def _seed_carry():
             # Static unroll over the ref TUPLE (its length is a compile-
             # time fact of the policy mix), not a traced operand.
-            for a, b in zip(cin, cout):  # rqlint: disable=RQ401 static refs
+            for a, b in zip(cin, cout):
                 b[:] = a[:]
 
         c = prepare_consts(spec, {nm: params[nm][:] for nm in in_names})

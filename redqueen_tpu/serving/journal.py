@@ -845,7 +845,8 @@ def _quarantine_tail(path: str, offset: int, reason: str,
     import time as _time
 
     ts = _dt.datetime.fromtimestamp(
-        _time.time(), _dt.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+        _time.time(), _dt.timezone.utc).strftime(  # rqlint: disable=RQ1201 sidecar naming only — quarantined bytes are evidence, never replayed; collision loop below absorbs clock ties
+            "%Y%m%dT%H%M%SZ")
     sidecar = f"{path}.torn-{ts}"
     n = 0
     while os.path.exists(sidecar):
@@ -993,7 +994,7 @@ def segment_paths(path: str) -> List[str]:
     import glob as _glob
 
     out = []
-    for p in _glob.glob(path + ".*"):
+    for p in sorted(_glob.glob(path + ".*")):
         suffix = p[len(path) + 1:]
         if suffix.isdigit():
             out.append((int(suffix), p))

@@ -497,20 +497,22 @@ class ServingRuntime:
             "digest": str(digest),
             "state_digest": state_digest(new),
         }
-        if self._journal is not None:
-            try:
-                self._journal.append(rec, seq=self.applied_seq)
-                # Same durability contract as a param install: the
-                # flip the router is about to journal must never
-                # outlive this record in a crash.
-                self._journal.sync()
-            except OSError as e:
-                raise RuntimeError(
-                    f"journal append failed for topology epoch "
-                    f"{topo_epoch} range install: {e} — range "
-                    f"installs must be durable; restart and recover "
-                    f"from {self.dir}") from e
-        self._state = new
+        with _telemetry.span("serving.topo.install_range",
+                             plan=str(plan_id), range=int(range_id)):
+            if self._journal is not None:
+                try:
+                    self._journal.append(rec, seq=self.applied_seq)
+                    # Same durability contract as a param install: the
+                    # flip the router is about to journal must never
+                    # outlive this record in a crash.
+                    self._journal.sync()
+                except OSError as e:
+                    raise RuntimeError(
+                        f"journal append failed for topology epoch "
+                        f"{topo_epoch} range install: {e} — range "
+                        f"installs must be durable; restart and recover "
+                        f"from {self.dir}") from e
+            self._state = new
 
     # ---- live-parameter epoch swap (serving.paramswap is the gate) ----
 
@@ -570,18 +572,21 @@ class ServingRuntime:
         ``params_log.json`` sidecar so recovery replays every batch
         under the epoch that decided it even after segment pruning;
         ``journal=False`` is recovery re-installing an epoch the
-        journal already carries."""
+        journal already carries.
+
+        Durability-before-swap (RQ1302): the epoch record reaches the
+        journal (append + sync) BEFORE the in-memory slots flip, so a
+        crash anywhere in the gap either replays the old epoch (record
+        never landed, swap never happened) or the new one (record is
+        durable) — never serves parameters the journal cannot
+        reproduce.  A failed append leaves the previous epoch serving
+        untouched."""
         import jax.numpy as jnp
 
-        self._param_prev = self.live_params()
-        self._param_epoch += 1
-        self._param_fingerprint = str(fingerprint)
-        self._s_sink = jnp.asarray(s64, jnp.float32)
-        self._q = jnp.asarray(q, jnp.float32)
-        self.q = float(q)
+        epoch = self._param_epoch + 1
         if journal and self._journal is not None:
             rec = {
-                "epoch": self._param_epoch,
+                "epoch": epoch,
                 "seq": self.applied_seq,
                 "s_sink": [float(x) for x in s64],
                 "q": float(q),
@@ -594,15 +599,26 @@ class ServingRuntime:
                 # The install record must never sit in the async loss
                 # window: a crash right after an install has to replay
                 # under the installed epoch, so force it to media (and
-                # to the replicas' checkpoint path) before returning.
+                # to the replicas' checkpoint path) before the swap
+                # below makes it live.
                 self._journal.sync()
             except OSError as e:
                 raise RuntimeError(
                     f"journal append failed for epoch "
-                    f"{self._param_epoch} install: {e} — parameter "
+                    f"{epoch} install: {e} — parameter "
                     f"installs must be durable; restart and recover "
                     f"from {self.dir}") from e
             self._append_params_log(rec)
+        # the guarded swap: by here the epoch record is on media, so
+        # the span's start strictly follows the durability spans — the
+        # ordering --calibrate replays a chaos trace against (RQ1302)
+        with _telemetry.span("serving.params.install", epoch=epoch):
+            self._param_prev = self.live_params()
+            self._param_epoch = epoch
+            self._param_fingerprint = str(fingerprint)
+            self._s_sink = jnp.asarray(s64, jnp.float32)
+            self._q = jnp.asarray(q, jnp.float32)
+            self.q = float(q)
         return self._param_epoch
 
     def _append_params_log(self, rec: Dict[str, Any]) -> None:

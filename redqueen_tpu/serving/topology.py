@@ -71,6 +71,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..runtime import telemetry as _telemetry
+
 __all__ = ["TopologyLog", "TopologyState", "Migration", "TopologyError",
            "MigrationInterrupted", "MigrationStalled", "read_topology_log",
            "tear_topology_tail", "range_digest", "plan_moves",
@@ -151,6 +153,12 @@ def read_topology_log(path: str, quarantine_torn_tail: bool = True
     """Read + verify every record; a torn/corrupt tail is TRUNCATED
     (when ``quarantine_torn_tail``) so the next append continues from
     the last provable record.  Returns ``(records, torn)``."""
+    with _telemetry.span("serving.topo.log.verify"):
+        return _read_topology_log(path, quarantine_torn_tail)
+
+
+def _read_topology_log(path: str, quarantine_torn_tail: bool
+                       ) -> Tuple[List[Dict[str, Any]], bool]:
     if not os.path.exists(path):
         return [], False
     records: List[Dict[str, Any]] = []
@@ -331,14 +339,17 @@ class TopologyState:
         sanctioned for a range the CURRENT plan holds fenced — a stale
         driver (pre-crash object, wrong plan) fails here instead of
         scattering into a live shard."""
-        rec = self.fences.get(int(range_id))
-        if rec is None or self.plan is None \
-                or rec.get("plan") != plan_id \
-                or self.plan.get("plan") != plan_id:
-            raise TopologyError(
-                f"range {range_id} of plan {plan_id!r} is not fenced "
-                f"under the current topology epoch {self.epoch} — "
-                f"refusing an unfenced edge-state install")
+        with _telemetry.span("serving.topo.assert", kind="fenced",
+                             plan=str(plan_id), range=int(range_id)):
+            rec = self.fences.get(int(range_id))
+            if rec is None or self.plan is None \
+                    or rec.get("plan") != plan_id \
+                    or self.plan.get("plan") != plan_id:
+                raise TopologyError(
+                    f"range {range_id} of plan {plan_id!r} is not "
+                    f"fenced under the current topology epoch "
+                    f"{self.epoch} — refusing an unfenced edge-state "
+                    f"install")
 
     def assert_owner(self, owners: np.ndarray, k: int,
                      feeds: Sequence[int]) -> None:
@@ -346,17 +357,20 @@ class TopologyState:
         being mutated must be owned by shard ``k`` under the current
         epoch, and no fence may be pending (a fenced source's slice is
         frozen)."""
-        owners = np.asarray(owners)
-        if self.fences:
-            raise TopologyError(
-                f"ranges {sorted(self.fences)} are fenced — finish the "
-                f"pending migration before mutating edge state")
-        if (owners != int(k)).any():
-            bad = [int(f) for f, o in zip(feeds, owners)
-                   if int(o) != int(k)]
-            raise TopologyError(
-                f"feeds {bad} are not owned by shard {k} under epoch "
-                f"{self.epoch} — refusing a stale-owner mutation")
+        with _telemetry.span("serving.topo.assert", kind="owner",
+                             shard=int(k)):
+            owners = np.asarray(owners)
+            if self.fences:
+                raise TopologyError(
+                    f"ranges {sorted(self.fences)} are fenced — finish "
+                    f"the pending migration before mutating edge state")
+            if (owners != int(k)).any():
+                bad = [int(f) for f, o in zip(feeds, owners)
+                       if int(o) != int(k)]
+                raise TopologyError(
+                    f"feeds {bad} are not owned by shard {k} under "
+                    f"epoch {self.epoch} — refusing a stale-owner "
+                    f"mutation")
 
 
 class Migration:

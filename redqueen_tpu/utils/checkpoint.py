@@ -91,7 +91,7 @@ def _step_dirs(path: str):
     (orbax tmp dirs and quarantined ``N.corrupt-*`` entries are not
     steps)."""
     steps = []
-    for name in os.listdir(path):
+    for name in sorted(os.listdir(path)):
         if name.isdigit() and os.path.isdir(os.path.join(path, name)):
             steps.append(int(name))
     return sorted(steps, reverse=True)

@@ -1,0 +1,10 @@
+"""Golden negative: RQ1201 — replay time comes from the journal.
+
+The timestamp is read off the last journaled record, so it is pinned
+by the bytes being replayed: bit-identical across replays.
+"""
+
+
+def recover_index(journal):
+    built_at = journal[-1]["t"] if journal else 0.0
+    return {"built_at": built_at, "n": len(journal)}

@@ -1,0 +1,13 @@
+"""Golden positive: RQ1201 — wall-clock read on a replay path.
+
+``recover_index`` is a replay entry point (qualname matches the
+recover/replay/rebuild/digest vocabulary); stamping its result with
+``time.time()`` makes two replays of the same journal diverge.
+"""
+
+import time
+
+
+def recover_index(journal):
+    built_at = time.time()
+    return {"built_at": built_at, "n": len(journal)}

@@ -1,0 +1,13 @@
+"""Golden negative: RQ1202 — seeded, locally-owned RNG.
+
+``random.Random(1234)`` is constructed with an explicit seed, so every
+replay draws the identical stream.
+"""
+
+import random
+
+
+def replay_tiebreak(records):
+    rng = random.Random(1234)
+    jitter = rng.random()
+    return [r["seq"] + jitter for r in records]

@@ -1,0 +1,12 @@
+"""Golden positive: RQ1202 — unseeded RNG on a replay path.
+
+``random.random()`` draws from the module-global generator, whose
+state the journal does not pin: replayed tiebreaks differ run to run.
+"""
+
+import random
+
+
+def replay_tiebreak(records):
+    jitter = random.random()
+    return [r["seq"] + jitter for r in records]

@@ -1,0 +1,14 @@
+"""Golden negative: RQ1203 — the repo's order-normalizing idiom.
+
+Wrapping the enumeration in ``sorted(...)`` in the same expression
+erases the filesystem's order before anything observes it.
+"""
+
+import os
+
+
+def rebuild_segments(d):
+    out = []
+    for name in sorted(os.listdir(d)):
+        out.append(name)
+    return out

@@ -1,0 +1,16 @@
+"""Golden positive: RQ1203 — unsorted filesystem enumeration on a
+replay path.
+
+``os.listdir`` order is filesystem-dependent; rebuilding state by
+walking it unsorted replays differently on a different filesystem (or
+after a restore).
+"""
+
+import os
+
+
+def rebuild_segments(d):
+    out = []
+    for name in os.listdir(d):
+        out.append(name)
+    return out

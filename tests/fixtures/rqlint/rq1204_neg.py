@@ -1,0 +1,11 @@
+"""Golden negative: RQ1204 — the set is sorted before iteration.
+
+``sorted(...)`` pins the fold order regardless of the hash seed.
+"""
+
+
+def digest_feeds(feeds):
+    acc = 0.0
+    for fid in sorted({f["id"] for f in feeds}):
+        acc += fid * 0.5
+    return acc

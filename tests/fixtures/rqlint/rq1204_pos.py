@@ -1,0 +1,13 @@
+"""Golden positive: RQ1204 — set iteration order on a replay path.
+
+Set order varies with the per-process hash seed: folding over a set
+comprehension replays in a different order — and a float fold is not
+associative, so the digest differs bit-for-bit.
+"""
+
+
+def digest_feeds(feeds):
+    acc = 0.0
+    for fid in {f["id"] for f in feeds}:
+        acc += fid * 0.5
+    return acc

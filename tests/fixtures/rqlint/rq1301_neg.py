@@ -1,0 +1,12 @@
+"""Golden negative: RQ1301 — the sanctioned verifying reader.
+
+``read_topology_log`` IS the allowlisted site: the raw read is legal
+here because this is the one function that checks the per-record sha.
+"""
+
+TOPOLOGY_LOG = "topology.log"
+
+
+def read_topology_log(d):
+    with open(d + "/" + TOPOLOGY_LOG, encoding="utf-8") as f:
+        return f.read()

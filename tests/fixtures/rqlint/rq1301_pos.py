@@ -1,0 +1,13 @@
+"""Golden positive: RQ1301 — checksummed protocol log read raw.
+
+Opening ``topology.log`` directly trusts bytes no per-record sha
+vouched for: a torn tail replays as a wrong topology instead of
+failing loudly.
+"""
+
+import json
+
+
+def load_plan(d):
+    with open(d + "/topology.log", encoding="utf-8") as f:
+        return [json.loads(line) for line in f]

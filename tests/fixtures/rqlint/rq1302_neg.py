@@ -1,0 +1,15 @@
+"""Golden negative: RQ1302 — journal-before-swap, the crash-safe
+ordering.
+
+The epoch record is appended and fsynced BEFORE the in-memory slots
+flip, so a crash anywhere in this function replays to a consistent
+epoch.
+"""
+
+
+class Runtime:
+    def _install_validated(self, vp, journal):
+        journal.append({"kind": "params", "epoch": 1})
+        journal.sync()
+        self._s_sink = vp.s_sink
+        self._q = vp.q

@@ -1,0 +1,14 @@
+"""Golden positive: RQ1302 — live slots swapped before the epoch
+record's durability point.
+
+A crash between the swap and the ``sync`` serves parameters recovery
+cannot replay: the journal never learned the epoch.
+"""
+
+
+class Runtime:
+    def _install_validated(self, vp, journal):
+        self._s_sink = vp.s_sink
+        self._q = vp.q
+        journal.append({"kind": "params", "epoch": 1})
+        journal.sync()

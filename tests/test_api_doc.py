@@ -56,3 +56,35 @@ def test_api_index_covers_all_exports():
         "public names absent from docs/API.md (add a table row): "
         + ", ".join(missing)
     )
+
+
+def test_api_doc_covers_rqlint_surface():
+    """Same drift guard for the tooling surface: every registered
+    rqlint rule ID, the tier-4 CLI flags, and the tier-4 artifact
+    schemas must appear in docs/API.md — a new rule or flag without
+    its doc row fails here, not in review."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(DOC))
+    sys.path.insert(0, repo)
+    from tools.rqlint import calibrate as calibrate_mod
+    from tools.rqlint import cache as cache_mod
+    from tools.rqlint.engine import RQ998, RQ999
+    from tools.rqlint.rules import REGISTRY
+
+    doc = open(DOC).read()
+    surface = sorted({r.id for r in REGISTRY} | {RQ998, RQ999}) + [
+        "--cache", "--fix-pragmas", "--calibrate",
+        calibrate_mod.COVERAGE_SCHEMA, calibrate_mod.COVERAGE_FILENAME,
+        cache_mod.SCHEMA,
+    ]
+    # band rows use range spellings (RQ1001-RQ1004): expand them
+    import re
+    in_range = set()
+    for a, b in re.findall(r"RQ(\d+)-RQ(\d+)", doc):
+        in_range |= {f"RQ{i}" for i in range(int(a), int(b) + 1)}
+    missing = [s for s in surface if s not in doc and s not in in_range]
+    assert not missing, (
+        "rqlint surface absent from docs/API.md (add a table row): "
+        + ", ".join(missing)
+    )

@@ -520,9 +520,9 @@ class TestEngine:
         assert ids(fs) == ["RQ000"]
         assert "unparseable" in fs[0].message and fs[0].fails
 
-    def test_crashing_rule_reports_rq000_and_others_still_run(self):
+    def test_crashing_rule_reports_rq999_and_others_still_run(self):
         class Bomb(Rule):
-            id = "RQ999"
+            id = "RQ777"
             name = "bomb"
             paths = ("*.py",)
 
@@ -532,8 +532,14 @@ class TestEngine:
         fs = engine.check_source(textwrap.dedent(UNSYNCED_BENCH),
                                  "bench.py",
                                  [Bomb()] + select_rules(["RQ601"]))
-        assert ids(fs) == ["RQ000", "RQ601"]
-        assert "RQ999" in fs[0].message
+        assert ids(fs) == ["RQ999", "RQ601"]
+        crash = [f for f in fs if f.rule == "RQ999"][0]
+        # the internal-error finding names the rule, the file and the
+        # traceback, and FAILS the run (unchecked files are not clean)
+        assert "RQ777" in crash.message
+        assert "bench.py" in crash.message
+        assert "RuntimeError" in crash.message
+        assert crash.fails
 
     def test_one_file_multiple_bands_single_parse(self):
         src = """\

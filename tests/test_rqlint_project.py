@@ -717,27 +717,27 @@ class TestNoProjectEquivalence:
         assert "RQ701" in rule_ids(proj)
         assert engine.check_source(src, "tools/u.py") == []
 
-    def test_cli_no_project_runs_twelve_tier1_rules(self, tmp_path,
-                                                    capsys):
-        # 9 original tier-1 rules + RQ1005 (ack/durability ordering),
-        # RQ1006 (parameter-install gate bypass) and RQ1007 (unfenced
-        # topology install) — all single-file analyses, so they ride
-        # the tier-1 set.
+    def test_cli_no_project_runs_eighteen_tier1_rules(self, tmp_path,
+                                                      capsys):
+        # 9 original tier-1 rules + the spec-generated protocol rules
+        # RQ1005/RQ1006/RQ1007 (ported) and RQ1301/RQ1302 (new) + the
+        # 4 replay rules RQ1201-RQ1204 (intra-file degradation) — all
+        # tier-1-capable single-file analyses.
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path), "--no-project",
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
         out = capsys.readouterr().out
-        assert "12 rules active" in out
+        assert "18 rules active" in out
 
-    def test_project_mode_runs_twentythree_rules(self, tmp_path, capsys):
-        # 16 tier-1/2 rules (incl. RQ1005-RQ1007) + the 7 tier-3
-        # RQ10xx/RQ11xx rules
+    def test_project_mode_runs_twentynine_rules(self, tmp_path, capsys):
+        # 18 tier-1/2 rules (incl. the 5 protocol specs) + the 7 tier-3
+        # RQ10xx/RQ11xx rules + the 4 tier-4 replay rules (RQ12xx)
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path),
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
-        assert "23 rules active" in capsys.readouterr().out
+        assert "29 rules active" in capsys.readouterr().out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,403 @@
+"""rqlint tier-4 tests: the golden fixture corpus for the RQ12xx
+(replay-determinism) and RQ13xx (protocol-spec) bands, the trace
+calibrator (``--calibrate``) against both synthetic span sets and a
+RECORDED chaos-run trace committed under ``tests/fixtures/rqlint/``,
+the incremental scan cache (hit/miss accounting, transitive import
+invalidation, byte-identity with a cold scan), and the pragma-hygiene
+satellite (RQ998 stale-pragma findings, ``strip_ids`` rewrites, the
+``--fix-pragmas`` CLI loop).
+
+Like the other rqlint suites this file never imports jax: every layer
+under test must stay usable in watchdog/driver contexts where jax is
+absent.  The recorded trace fixture was produced by a real
+``tools/chaos_soak.py`` scenario run (the ``swap:live`` install drill)
+with telemetry at full sampling — it is data here, not code.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.rqlint import calibrate as calibrate_mod  # noqa: E402
+from tools.rqlint import cli, engine  # noqa: E402
+from tools.rqlint import pragmas as pragmas_mod  # noqa: E402
+from tools.rqlint.protocols import all_specs  # noqa: E402
+from tools.rqlint.rules import select_rules  # noqa: E402
+
+FIXDIR = os.path.join(REPO, "tests", "fixtures", "rqlint")
+TRACE_FIXTURE = os.path.join(FIXDIR, "chaos_trace_small.json")
+
+#: The tier-4 cohort: every rule the golden corpus must cover, one
+#: positive + one negative fixture each.
+TIER4_RULES = ("RQ1201", "RQ1202", "RQ1203", "RQ1204",
+               "RQ1301", "RQ1302")
+
+
+def scan_fixture(stem: str):
+    """Lint one fixture file as if it lived in the serving tree (the
+    RQ12xx/RQ13xx scope), under exactly the tier-4 bands."""
+    with open(os.path.join(FIXDIR, stem + ".py"), encoding="utf-8") as f:
+        src = f.read()
+    rel = f"redqueen_tpu/serving/{stem}.py"
+    rules = select_rules(["RQ12", "RQ13"])
+    out = engine.check_sources({rel: src}, rules)[rel]
+    return [f for f in out if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# Golden fixtures: one positive + one negative per tier-4 rule
+# ---------------------------------------------------------------------------
+
+
+class TestGoldenFixtures:
+    @pytest.mark.parametrize("rid", TIER4_RULES)
+    def test_positive_fires_exactly_its_rule(self, rid):
+        fs = scan_fixture(rid.lower() + "_pos")
+        assert fs, f"{rid} positive fixture fired nothing"
+        assert {f.rule for f in fs} == {rid}
+
+    @pytest.mark.parametrize("rid", TIER4_RULES)
+    def test_negative_is_clean(self, rid):
+        assert scan_fixture(rid.lower() + "_neg") == []
+
+    def test_corpus_is_complete(self):
+        # a new tier-4 rule without its fixture pair fails HERE, not in
+        # a code-review comment
+        have = {n[:-3] for n in os.listdir(FIXDIR) if n.endswith(".py")}
+        want = {rid.lower() + suf for rid in TIER4_RULES
+                for suf in ("_pos", "_neg")}
+        assert want <= have, f"missing fixtures: {sorted(want - have)}"
+
+
+# ---------------------------------------------------------------------------
+# Calibration: synthetic spans
+# ---------------------------------------------------------------------------
+
+
+def span(name, t, tid="t1", dur=0.0, sid=None):
+    return {"name": name, "t": t, "tid": tid, "dur": dur,
+            "sid": sid if sid is not None else int(t * 1e6) + hash(name) % 997}
+
+
+def spec_row(report, rid):
+    return next(s for s in report["specs"] if s["rule_id"] == rid)
+
+
+class TestCalibrateClassification:
+    def test_guard_before_guarded_same_thread_is_modeled(self):
+        report = calibrate_mod.calibrate([
+            span("serving.journal.append", 1.0),
+            span("serving.ack", 2.0),
+        ])
+        row = spec_row(report, "RQ1005")
+        assert (row["occurrences"], row["modeled"]) == (1, 1)
+        assert report["runtime_violations"] == 0
+        assert report["statically_missing_edges"] == 0
+
+    def test_unguarded_occurrence_is_a_runtime_violation(self):
+        report = calibrate_mod.calibrate([span("serving.ack", 2.0)])
+        row = spec_row(report, "RQ1005")
+        assert row["runtime_violations"] == [
+            {"span": "serving.ack", "tid": "t1", "t": 2.0}]
+        assert report["runtime_violations"] == 1
+
+    def test_foreign_guard_is_a_statically_missing_edge(self):
+        # the ack WAS protected at runtime — but only by RQ1007's
+        # topology fence, an edge the RQ1005 spec does not model
+        report = calibrate_mod.calibrate([
+            span("serving.topo.assert", 1.0),
+            span("serving.ack", 2.0),
+        ])
+        row = spec_row(report, "RQ1005")
+        assert row["statically_missing_edges"] == [
+            {"guarded": "serving.ack",
+             "observed_guard": "serving.topo.assert", "count": 1}]
+        assert report["statically_missing_edges"] == 1
+        assert report["runtime_violations"] == 0
+
+    def test_cross_thread_guard_must_complete_first(self):
+        # the group-commit flusher fsyncs on its own thread: it guards
+        # an ack only once the fsync span has COMPLETED
+        fsync = span("serving.journal.fsync", 1.0, tid="flusher",
+                     dur=0.5)
+        ok = calibrate_mod.calibrate([fsync, span("serving.ack", 2.0)])
+        assert spec_row(ok, "RQ1005")["modeled"] == 1
+        racing = calibrate_mod.calibrate(
+            [fsync, span("serving.ack", 1.2)])
+        assert spec_row(racing, "RQ1005")["modeled"] == 0
+        assert racing["runtime_violations"] == 1
+
+    def test_exclusive_site_occurrence_is_modeled_not_an_edge(self):
+        # RQ1006 models a site allowlist, not a happens-before edge:
+        # its span occurring (always from inside the sanctioned site)
+        # must never be booked as a missing edge against whatever guard
+        # happened to precede it
+        report = calibrate_mod.calibrate([
+            span("serving.journal.append", 1.0),
+            span("serving.params.install", 2.0),
+        ])
+        row = spec_row(report, "RQ1006")
+        assert (row["occurrences"], row["modeled"]) == (1, 1)
+        assert report["statically_missing_edges"] == 0
+
+    def test_unobserved_specs_and_dead_guards_are_reported(self):
+        report = calibrate_mod.calibrate([span("serving.ack", 2.0)])
+        assert "RQ1007" in report["unobserved_specs"]
+        row = spec_row(report, "RQ1005")
+        assert "serving.journal.append" in row["unexercised_guard_spans"]
+        assert not spec_row(report, "RQ1007")["observed"]
+
+    def test_every_spec_span_name_is_unique_to_one_vocabulary(self):
+        # a span name serving as one spec's guard and another's guarded
+        # would make the classification ambiguous — pin the invariant
+        guards, guarded = set(), set()
+        for spec in all_specs():
+            if spec.guard is not None:
+                guards |= set(spec.guard.spans)
+            guarded |= set(spec.guarded.spans)
+        assert not (guards & guarded)
+
+
+# ---------------------------------------------------------------------------
+# Calibration: the recorded chaos trace + the CLI entry point
+# ---------------------------------------------------------------------------
+
+
+def _reseal(doc):
+    """Recompute the envelope sha after editing the payload."""
+    body = {"schema": doc["schema"], "writer": doc["writer"],
+            "payload": doc["payload"]}
+    doc["sha256"] = hashlib.sha256(json.dumps(
+        body, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
+    return doc
+
+
+class TestCalibrateMain:
+    def test_recorded_chaos_trace_calibrates_clean(self, tmp_path):
+        out = str(tmp_path / "coverage.json")
+        rc = calibrate_mod.calibrate_main(
+            TRACE_FIXTURE, root=str(tmp_path), quiet=True, out_path=out)
+        assert rc == 0
+        doc = json.load(open(out))
+        assert doc["schema"] == calibrate_mod.COVERAGE_SCHEMA
+        assert doc["statically_missing_edges"] == 0
+        assert doc["runtime_violations"] == 0
+        # the swap:live drill journals the epoch before both installs
+        row = spec_row(doc, "RQ1302")
+        assert row["observed"] and row["modeled"] == row["occurrences"] > 0
+
+    def test_corrupt_trace_refuses_with_exit_2(self, tmp_path):
+        doc = json.load(open(TRACE_FIXTURE))
+        doc["payload"]["spans"][0]["name"] = "tampered"  # sha now stale
+        bad = tmp_path / "trace.json"
+        bad.write_text(json.dumps(doc))
+        assert calibrate_mod.calibrate_main(
+            str(bad), root=str(tmp_path), quiet=True) == 2
+        assert not (tmp_path / calibrate_mod.COVERAGE_FILENAME).exists()
+
+    def test_dropped_spans_fail_rather_than_certify(self, tmp_path):
+        doc = json.load(open(TRACE_FIXTURE))
+        doc["payload"]["spans_dropped"] = 7
+        trace = tmp_path / "trace.json"
+        trace.write_text(json.dumps(_reseal(doc)))
+        assert calibrate_mod.calibrate_main(
+            str(trace), root=str(tmp_path), quiet=True) == 2
+
+    def test_missing_edge_exits_1(self, tmp_path):
+        doc = json.load(open(TRACE_FIXTURE))
+        doc["payload"]["spans"] = [
+            span("serving.topo.assert", 1.0), span("serving.ack", 2.0)]
+        doc["payload"]["spans_dropped"] = 0
+        trace = tmp_path / "trace.json"
+        trace.write_text(json.dumps(_reseal(doc)))
+        assert calibrate_mod.calibrate_main(
+            str(trace), root=str(tmp_path), quiet=True) == 1
+
+    def test_cli_flag_routes_to_calibrate(self, tmp_path, capsys):
+        rc = cli.main(["--root", str(tmp_path),
+                       "--calibrate", TRACE_FIXTURE, "-q"])
+        assert rc == 0
+        assert (tmp_path / calibrate_mod.COVERAGE_FILENAME).exists()
+        assert "0 statically-missing" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Incremental scan cache
+# ---------------------------------------------------------------------------
+
+
+CACHED_TREE = {
+    "pipeline.py": """\
+        import segments
+
+
+        def drive(d):
+            return segments.newest(d)
+        """,
+    "segments.py": """\
+        import os
+
+
+        def newest(d):
+            return sorted(os.listdir(d))[-1]
+        """,
+    "standalone.py": "VALUE = 3\n",
+}
+
+
+def _write_tree(tmp_path, files=CACHED_TREE):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _normalized(findings):
+    return [(f.path, f.line, f.col, f.rule, f.message, f.severity,
+             f.suppressed, f.baselined) for f in findings]
+
+
+class TestScanCache:
+    def test_cold_then_warm_is_byte_identical(self, tmp_path):
+        root = _write_tree(tmp_path)
+        cold = engine.run(root=root, use_baseline=False, cache=True)
+        assert cold["cache"] == {"hits": 0,
+                                 "misses": cold["files_scanned"]}
+        assert os.path.exists(os.path.join(
+            root, ".rqlint_cache", "findings.json"))
+        warm = engine.run(root=root, use_baseline=False, cache=True)
+        assert warm["cache"] == {"hits": warm["files_scanned"],
+                                 "misses": 0}
+        assert _normalized(warm["findings"]) == _normalized(
+            cold["findings"])
+        # and both match an uncached scan exactly
+        plain = engine.run(root=root, use_baseline=False)
+        assert _normalized(plain["findings"]) == _normalized(
+            cold["findings"])
+
+    def test_import_neighborhood_invalidates_transitively(self, tmp_path):
+        root = _write_tree(tmp_path)
+        engine.run(root=root, use_baseline=False, cache=True)
+        # touching segments.py must re-scan its importer pipeline.py
+        # too (cross-file summaries feed its verdicts) — but NOT the
+        # import-disconnected standalone.py
+        seg = tmp_path / "segments.py"
+        seg.write_text(seg.read_text() + "\n# drift\n")
+        again = engine.run(root=root, use_baseline=False, cache=True)
+        assert again["cache"]["misses"] == 2
+        assert again["cache"]["hits"] == again["files_scanned"] - 2
+
+    def test_rule_selection_keys_the_cache(self, tmp_path):
+        root = _write_tree(tmp_path)
+        engine.run(root=root, use_baseline=False, cache=True)
+        narrowed = engine.run(root=root, use_baseline=False, cache=True,
+                              rules=select_rules(["RQ12"]))
+        # a different band signature must MISS, not serve stale verdicts
+        assert narrowed["cache"]["hits"] == 0
+
+    def test_corrupt_cache_file_degrades_to_cold(self, tmp_path):
+        root = _write_tree(tmp_path)
+        ref = engine.run(root=root, use_baseline=False, cache=True)
+        path = os.path.join(root, ".rqlint_cache", "findings.json")
+        with open(path, "w") as f:
+            f.write("{ not json")
+        redo = engine.run(root=root, use_baseline=False, cache=True)
+        assert redo["cache"]["misses"] == redo["files_scanned"]
+        assert _normalized(redo["findings"]) == _normalized(
+            ref["findings"])
+
+
+# ---------------------------------------------------------------------------
+# Pragma hygiene: RQ998, strip_ids, --fix-pragmas
+# ---------------------------------------------------------------------------
+
+
+USED_PRAGMA = """\
+    import time
+
+
+    def bench(fn):
+        t0 = time.perf_counter()  # rqlint: disable=RQ601 oracle loop
+        result = fn()
+        secs = time.perf_counter() - t0
+        return result, secs
+"""
+
+STALE_PRAGMA = "x = 1  # rqlint: disable=RQ601 nothing fires here\n"
+
+
+class TestUnusedPragmas:
+    def test_stale_pragma_warns_used_pragma_does_not(self, tmp_path):
+        root = _write_tree(tmp_path, {"bench.py": USED_PRAGMA,
+                                      "quiet.py": STALE_PRAGMA})
+        res = engine.run(root=root, use_baseline=False)
+        stale = [f for f in res["findings"]
+                 if f.rule == engine.RQ998]
+        assert [(f.path, f.line) for f in stale] == [("quiet.py", 1)]
+        assert stale[0].severity == "warn"
+        assert "RQ601" in stale[0].message
+
+    def test_warn_severity_never_fails_the_run(self, tmp_path):
+        root = _write_tree(tmp_path, {"quiet.py": STALE_PRAGMA})
+        assert cli.main(["--root", root, "--no-baseline", "-q"]) == 0
+
+    def test_band_scoped_runs_skip_the_judgement(self, tmp_path):
+        # under --select RQ12 the RQ601 checker never ran: calling its
+        # pragma stale would be a false positive by construction
+        root = _write_tree(tmp_path, {"quiet.py": STALE_PRAGMA})
+        res = engine.run(root=root, use_baseline=False,
+                         rules=select_rules(["RQ12"]))
+        assert not [f for f in res["findings"]
+                    if f.rule == engine.RQ998]
+
+
+class TestStripIds:
+    def test_full_drop_removes_comment_and_justification(self):
+        out, n = pragmas_mod.strip_ids(STALE_PRAGMA, {1: {"RQ601"}})
+        assert out == "x = 1\n" and n == 1
+
+    def test_partial_drop_keeps_survivors_and_justification(self):
+        src = "t0 = f()  # rqlint: disable=RQ601,RQ101 host view\n"
+        out, n = pragmas_mod.strip_ids(src, {1: {"RQ101"}})
+        assert out == "t0 = f()  # rqlint: disable=RQ601 host view\n"
+        assert n == 1
+
+    def test_own_line_pragma_drops_the_whole_line(self):
+        src = "# rqlint: disable-file=RQ601 legacy debt\nx = 1\n"
+        out, n = pragmas_mod.strip_ids(src, {1: {"RQ601"}})
+        assert out == "x = 1\n" and n == 1
+
+    def test_untouched_ids_leave_source_alone(self):
+        src = "t0 = f()  # rqlint: disable=RQ601\n"
+        assert pragmas_mod.strip_ids(src, {1: {"RQ101"}}) == (src, 0)
+
+
+class TestFixPragmasCli:
+    def test_rewrites_stale_and_keeps_used(self, tmp_path):
+        root = _write_tree(tmp_path, {"bench.py": USED_PRAGMA,
+                                      "quiet.py": STALE_PRAGMA})
+        assert cli.main(["--root", root, "--no-baseline",
+                         "--fix-pragmas", "-q"]) == 0
+        assert (tmp_path / "quiet.py").read_text() == "x = 1\n"
+        # the used pragma is load-bearing: it must survive verbatim
+        assert "disable=RQ601 oracle loop" in (
+            tmp_path / "bench.py").read_text()
+        # and the tree is stable: a second pass rewrites nothing
+        assert cli.main(["--root", root, "--no-baseline",
+                         "--fix-pragmas", "-q"]) == 0
+        assert (tmp_path / "quiet.py").read_text() == "x = 1\n"
+
+    def test_refused_under_no_project(self, tmp_path):
+        root = _write_tree(tmp_path, {"quiet.py": STALE_PRAGMA})
+        assert cli.main(["--root", root, "--no-baseline",
+                         "--fix-pragmas", "--no-project", "-q"]) == 2

@@ -301,6 +301,48 @@ def _swap_corrupt_scenario() -> Dict[str, Any]:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _swap_live_install_scenario() -> Dict[str, Any]:
+    """``swap:live`` — a healthy candidate installs into a REAL
+    ``ServingRuntime``: the epoch record must be journaled and fsynced
+    before the live slots flip (the RQ1302 ordering — this scenario is
+    what puts ``serving.params.install`` and its preceding journal
+    spans into the calibration trace), and a cold recovery of the
+    directory must come back serving the installed epoch."""
+    name = "swap:live journaled install + recovery"
+    from redqueen_tpu import serving
+
+    d = tempfile.mkdtemp(prefix="rq-soak-")
+    path = os.path.join(d, CANDIDATE_FILENAME)
+    try:
+        _healthy_candidate(path)
+        rt = serving.ServingRuntime(n_feeds=3, seed=0, dir=d)
+        try:
+            sw = ParamSwapper(rt, gate=ParamGate())
+            res = sw.poll_artifact(path)
+            if res is None or not res["installed"] \
+                    or rt.live_params()["epoch"] != 1:
+                raise SoakFailure(
+                    f"{name}: healthy candidate did not install "
+                    f"(result={res!r})")
+        finally:
+            rt.close()
+        rt2, _info = serving.recover(d)
+        try:
+            live = rt2.live_params()
+            if live["epoch"] != 1 \
+                    or live["fingerprint"] != "soak-fp-1":
+                raise SoakFailure(
+                    f"{name}: recovery lost the installed params "
+                    f"(live epoch={live['epoch']!r}, "
+                    f"fingerprint={live['fingerprint']!r})")
+        finally:
+            rt2.close()
+        return {"scenario": name, "acked": 0, "lost": [],
+                "installed": 1, "exact": True}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _learner_kill_scenario() -> Dict[str, Any]:
     """``learn:kill@step1`` against a REAL learner process: the sidecar
     is SIGKILLed mid-update (statistics computed, checkpoint not yet
@@ -694,6 +736,10 @@ def scenario_matrix() -> List[Any]:
         # side no matter how it dies.
         _swap_reject_scenario,
         _swap_corrupt_scenario,
+        # A REAL runtime taking the install: exercises the journal-
+        # before-swap ordering end-to-end (and feeds the
+        # serving.params.install span to --calibrate).
+        _swap_live_install_scenario,
         _learner_kill_scenario,
     ]
 
@@ -725,7 +771,19 @@ def main(argv=None) -> int:
     ap.add_argument("--reshard-json", default=None,
                     help="write the reshard soak report here "
                          "(RESHARD_CHAOS.json in CI)")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="record a full telemetry trace of the soak "
+                         "(rq.telemetry.trace/1) — the input "
+                         "`python -m tools.rqlint --calibrate` replays "
+                         "against the protocol specs")
     args = ap.parse_args(argv)
+    if args.trace:
+        from redqueen_tpu.runtime import telemetry as _telemetry
+        # sample=1.0: calibration needs EVERY ordering edge, and the
+        # span cap must hold a full soak (guard spans dropped by the
+        # export bound would read as runtime violations)
+        _telemetry.configure(enabled=True, sample=1.0,
+                             max_spans=2_000_000, reset=True)
     if args.rounds < 1:
         ap.error(f"--rounds must be >= 1, got {args.rounds}")
     if args.reshard_rounds < 0:
@@ -755,6 +813,11 @@ def main(argv=None) -> int:
               f"({rreport['rounds']}x{rreport['scenarios']}), zero "
               f"acked-record loss, every fenced/replayed count exact, "
               f"{rreport['wall_s']}s")
+    if args.trace:
+        from redqueen_tpu.runtime import telemetry as _telemetry
+        payload = _telemetry.export_trace(args.trace)
+        print(f"trace: {payload['n_spans']} spans "
+              f"({payload['spans_dropped']} dropped) -> {args.trace}")
     return 0
 
 

@@ -18,9 +18,12 @@ cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" == "chaos-soak" ]]; then
     echo "== chaos soak: repl:*/disk:*/learn:*/swap:*/reshard:* matrix =="
-    exec python tools/chaos_soak.py --rounds "${2:-10}" \
+    python tools/chaos_soak.py --rounds "${2:-10}" \
         --json CHAOS_SOAK.json \
-        --reshard-rounds "${3:-1}" --reshard-json RESHARD_CHAOS.json
+        --reshard-rounds "${3:-1}" --reshard-json RESHARD_CHAOS.json \
+        --trace CHAOS_TRACE.json
+    echo "== protocol trace calibration (static model vs chaos run) =="
+    exec python -m tools.rqlint --calibrate CHAOS_TRACE.json
 fi
 
 echo "== rqlint static pass =="
@@ -61,6 +64,28 @@ echo "rqlint parallel (--jobs $(nproc)): $((SECONDS - t0))s"
 t0=$SECONDS
 python -m tools.rqlint --jobs 1 -q > /dev/null || true
 echo "rqlint serial reference (--jobs 1): $((SECONDS - t0))s"
+
+echo "== rqlint tier-4: new-band SARIF artifact + incremental cache =="
+# The RQ12xx (replay-determinism) and RQ13xx (protocol-spec) bands in
+# tier-1 mode (--no-project: per-file spec checking, usable on any box
+# with no project view) with the SARIF artifact saved for a
+# code-scanning upload; the --jobs parallel path stays byte-identical
+# to serial for these bands (pinned by tests/test_rqlint_concurrency.py
+# over the full registry).
+python -m tools.rqlint --no-project --select RQ12,RQ13 \
+    --format sarif -q > RQLINT_TIER4.sarif
+# Incremental scan cache: cold vs warm wall logged side by side, and
+# the two findings artifacts asserted byte-identical — the artifact
+# embeds no timestamps, so cmp(1) is the strongest possible check.
+rm -rf .rqlint_cache
+t0=$SECONDS
+python -m tools.rqlint --cache --json RQLINT_FINDINGS_COLD.json -q
+echo "rqlint cache cold: $((SECONDS - t0))s"
+t0=$SECONDS
+python -m tools.rqlint --cache --json RQLINT_FINDINGS_WARM.json -q
+echo "rqlint cache warm: $((SECONDS - t0))s"
+cmp RQLINT_FINDINGS_COLD.json RQLINT_FINDINGS_WARM.json
+rm -f RQLINT_FINDINGS_COLD.json RQLINT_FINDINGS_WARM.json
 
 echo "== resilience shim (legacy contract) =="
 # The delegating shim must keep the pre-rqlint CLI/exit-code contract
@@ -118,7 +143,17 @@ echo "== durability chaos soak (repl:*/disk:*/learn:*/swap:* matrix) =="
 # acked-record loss (report: RESHARD_CHAOS.json).
 # Nightly runs loop harder: `bash tools/ci.sh chaos-soak 50`.
 python tools/chaos_soak.py --rounds 3 \
-    --reshard-json RESHARD_CHAOS.json
+    --reshard-json RESHARD_CHAOS.json --trace CHAOS_TRACE.json
+
+echo "== protocol trace calibration (static model vs chaos run) =="
+# Replays the soak's span trace against the protocol specs (tier-4):
+# every runtime occurrence of a guarded span must be preceded by its
+# spec's own guard.  Fails on a statically-missing edge (the runtime
+# was protected by an edge the spec does not model — a soundness hole
+# in the SPEC) or a runtime ordering violation; dead guards are
+# surfaced non-fatally.  PROTOCOL_COVERAGE.json is the committed
+# coverage artifact beside RESHARD_CHAOS.json.
+python -m tools.rqlint --calibrate CHAOS_TRACE.json
 
 echo "== telemetry suite + overhead smoke =="
 # The unified-telemetry contracts, UNFILTERED (tier-1 runs the fast

@@ -1,0 +1,203 @@
+"""Incremental scan cache (``--cache``): skip the per-file rule pass
+for files whose analysis inputs provably did not change.
+
+One JSON document under ``<root>/.rqlint_cache/findings.json`` maps
+relpath -> (key, findings).  A cached entry is valid only when its key
+matches the key recomputed THIS run, where the key is a sha256 over
+every input the file's findings can depend on:
+
+- the rqlint version and the band signature (the sorted IDs of the
+  selected rules — a ``--select RQ5`` cache entry must never answer a
+  full-registry run);
+- the file's own source sha;
+- in project mode, the shas of the file's TRANSITIVE import
+  neighborhood — forward (modules it imports: their summaries feed its
+  interprocedural findings) **and** reverse (modules importing it: a
+  new replay entry point or protocol call site in a caller changes
+  which of THIS file's functions are reachable/closed over), computed
+  to a fixpoint over the union graph;
+- in project mode, a *global-analysis fingerprint*: the cross-file
+  facts per-file checks consume that the import closure does NOT bound
+  (cyclic lock pairs, thread entries, replay reachability, protocol
+  closures, wrapped-mesh closures).  These are derived from the
+  already-built view — cheap next to the rule pass — and hashing the
+  RESULTS instead of the whole tree keeps an unrelated edit from
+  invalidating every entry.
+
+The cache stores findings **pre-baseline** (suppressed flags included,
+``baselined`` always False) so a baseline edit never stales it; the
+engine re-applies the baseline after merging.  RQ998 is computed
+post-cache (it reads the merged findings).  A corrupt/alien cache file
+is discarded wholesale — the cache can only ever cost a rescan, never
+an unsound verdict."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence, Set
+
+from .findings import Finding
+
+SCHEMA = "rq.rqlint.cache/1"
+CACHE_DIRNAME = ".rqlint_cache"
+CACHE_FILENAME = "findings.json"
+
+
+def cache_path(root: str) -> str:
+    return os.path.join(root, CACHE_DIRNAME, CACHE_FILENAME)
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def source_shas(sources: Dict[str, str]) -> Dict[str, str]:
+    return {rel: _sha(src.encode("utf-8"))
+            for rel, src in sources.items()}
+
+
+def _closure(rel: str, view, rel_by_mod: Dict[str, str],
+             neighbors: Dict[str, Set[str]]) -> List[str]:
+    """Transitive neighborhood of ``rel`` over the undirected import
+    graph (forward ∪ reverse edges, to a fixpoint), as relpaths."""
+    mod = view.by_relpath.get(rel)
+    if mod is None:
+        return []
+    seen = {mod.name}
+    frontier = [mod.name]
+    while frontier:
+        name = frontier.pop()
+        for nxt in neighbors.get(name, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    seen.discard(mod.name)
+    return sorted(rel_by_mod[m] for m in seen if m in rel_by_mod)
+
+
+def _undirected_imports(view) -> Dict[str, Set[str]]:
+    graph = view.import_graph()
+    und: Dict[str, Set[str]] = {m: set(d) for m, d in graph.items()}
+    for m, deps in graph.items():
+        for d in deps:
+            und.setdefault(d, set()).add(m)
+    return und
+
+
+def global_fingerprint(view, rules) -> str:
+    """sha over the cross-file analysis RESULTS the per-file checks
+    read beyond their import closure — recomputed from the view each
+    run (the view build is already paid), so an edit anywhere that
+    changes one of these facts invalidates exactly the files that
+    consume it."""
+    if view is None:
+        return "tier1"
+    ids = {r.id for r in rules}
+    facts: Dict[str, object] = {}
+    if ids & {"RQ1001", "RQ1002", "RQ1003"}:
+        from .rules.concurrency import _cyclic_lock_pairs, thread_entry_fids
+        facts["thread_entries"] = sorted(thread_entry_fids(view))
+        facts["lock_cycles"] = sorted(
+            map(sorted, _cyclic_lock_pairs(view)))
+    if ids & {"RQ1101", "RQ1102"}:
+        from .rules.mesh import _wrapped_axis_names, wrapped_closure
+        facts["mesh_wrapped"] = sorted(wrapped_closure(view))
+        facts["mesh_axes"] = sorted(_wrapped_axis_names(view))
+    if any(i.startswith("RQ12") for i in ids):
+        from .rules.replay import replay_reachable
+        facts["replay_reachable"] = sorted(replay_reachable(view))
+        facts["replay_taints"] = sorted(
+            (fid, sorted(s.taints_replay))
+            for fid, s in view.summaries.items() if s.taints_replay)
+    from .protocol import performs_closure
+    for r in sorted(rules, key=lambda r: r.id):
+        spec = getattr(r, "protocol_spec", None)
+        if spec is None:
+            continue
+        facts[f"proto_{r.id}_guard"] = sorted(
+            performs_closure(view, spec, "guard"))
+        facts[f"proto_{r.id}_guarded"] = sorted(
+            performs_closure(view, spec, "guarded"))
+    blob = json.dumps(facts, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return _sha(blob)
+
+
+def file_key(rel: str, shas: Dict[str, str], view, rel_by_mod,
+             neighbors, band_sig: str, fingerprint: str,
+             version: str) -> str:
+    parts = [version, band_sig, rel, shas.get(rel, ""), fingerprint]
+    if view is not None:
+        for dep in _closure(rel, view, rel_by_mod, neighbors):
+            parts.append(f"{dep}={shas.get(dep, '')}")
+    return _sha("\n".join(parts).encode("utf-8"))
+
+
+def compute_keys(report: Sequence[str], sources: Dict[str, str],
+                 view, rules, version: str) -> Dict[str, str]:
+    shas = source_shas(sources)
+    band_sig = ",".join(sorted(r.id for r in rules))
+    fingerprint = global_fingerprint(view, rules)
+    rel_by_mod = {}
+    neighbors: Dict[str, Set[str]] = {}
+    if view is not None:
+        rel_by_mod = {m.name: m.relpath for m in view.modules.values()}
+        neighbors = _undirected_imports(view)
+    return {rel: file_key(rel, shas, view, rel_by_mod, neighbors,
+                          band_sig, fingerprint, version)
+            for rel in report}
+
+
+def load(root: str) -> Dict[str, dict]:
+    try:
+        with open(cache_path(root), encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("schema") != SCHEMA:
+            return {}
+        entries = doc.get("entries")
+        return entries if isinstance(entries, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def lookup(entries: Dict[str, dict], rel: str, key: str
+           ) -> Optional[List[Finding]]:
+    ent = entries.get(rel)
+    if not isinstance(ent, dict) or ent.get("key") != key:
+        return None
+    try:
+        return [Finding(**{**d, "baselined": False})
+                for d in ent["findings"]]
+    except (TypeError, KeyError):
+        return None  # field drift across versions: treat as a miss
+
+
+def store(root: str, entries: Dict[str, dict],
+          keys: Dict[str, str],
+          per_file: Dict[str, List[Finding]]) -> None:
+    """Merge this run's results and atomically rewrite the cache file.
+    Findings are stored pre-baseline (``baselined`` cleared)."""
+    for rel, fs in per_file.items():
+        entries[rel] = {
+            "key": keys[rel],
+            "findings": [dataclasses.asdict(
+                dataclasses.replace(f, baselined=False)) for f in fs],
+        }
+    path = cache_path(root)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {"schema": SCHEMA, "entries": entries}
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               prefix=".findings-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass

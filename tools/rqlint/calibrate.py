@@ -1,0 +1,253 @@
+"""Trace calibration (``--calibrate <trace>``): replay a recorded
+chaos-run telemetry trace against the static protocol model.
+
+The protocol specs are a *model* of the serving tier's happens-before
+contracts; the static checker can only be trusted as far as the model
+matches what the code actually does at runtime.  Calibration closes
+that loop with a recorded ``rq.telemetry.trace/1`` artifact (a
+``tools/chaos_soak.py --trace`` run): every runtime occurrence of a
+spec's *guarded* span is checked for a preceding *guard* span, and the
+mismatches split into the two failure classes that matter:
+
+- **statically-missing edge** — the runtime occurrence WAS protected,
+  but by a guard span the owning spec does not model (it belongs to
+  some other spec's guard vocabulary).  The static rule would not
+  credit this protection at a call site, so it is a soundness hole in
+  the SPEC — fix the spec, not the code.  Nonzero missing edges fail
+  the calibration.
+- **runtime violation** — no guard span of any spec preceded the
+  guarded occurrence.  Either the ordering contract was actually
+  violated under chaos (a real bug the static layer missed — e.g. an
+  effect behind a dynamic dispatch the call graph cannot resolve), or
+  the serving code performs the guard without emitting its span
+  (instrumentation drift).  Both demand a look; nonzero fails.
+
+Dead-guard coverage is the complement: a spec guard span with ZERO
+trace occurrences means the chaos run never exercised that protection
+(or the span was renamed) — reported as ``unexercised_guard_spans``,
+surfaced but non-fatal, because a short soak legitimately skips paths.
+
+"Precedes" means: same thread and started no later (the nested-guard
+case is excluded by span identity), or — any thread — COMPLETED before
+the guarded span started.  Cross-thread completion covers the group-
+commit flusher fsyncing on its own thread before an ack.
+
+The module is stdlib-only and imports nothing from ``redqueen_tpu``:
+the trace envelope is verified against the documented canonical-JSON
+sha256 (``runtime.integrity`` writes it, this re-derives it), so the
+linter stays importable — and calibration stays runnable — with no jax
+on the machine.  The report lands in ``PROTOCOL_COVERAGE.json`` at the
+repo root, beside RESHARD_CHAOS.json.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+TRACE_SCHEMA = "rq.telemetry.trace/1"
+COVERAGE_SCHEMA = "rq.rqlint.protocol_coverage/1"
+COVERAGE_FILENAME = "PROTOCOL_COVERAGE.json"
+
+
+class TraceError(ValueError):
+    """The trace file is unreadable, corrupt, or the wrong schema."""
+
+
+def _canonical(obj: Any) -> bytes:
+    return json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    """Read + integrity-verify a trace artifact without importing
+    ``redqueen_tpu`` — the digest definition is re-derived here
+    (sha256 over the canonical ``{"schema", "writer", "payload"}``
+    JSON, exactly ``runtime.integrity._json_digest``)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)
+    except OSError as e:
+        raise TraceError(f"cannot read trace {path}: {e}") from e
+    except ValueError as e:
+        raise TraceError(f"trace {path} is not JSON: {e}") from e
+    if not (isinstance(obj, dict) and "__rq_envelope__" in obj
+            and "payload" in obj):
+        raise TraceError(f"trace {path} has no integrity envelope")
+    got = hashlib.sha256(_canonical(
+        {"schema": obj.get("schema"), "writer": obj.get("writer"),
+         "payload": obj["payload"]})).hexdigest()
+    if got != obj.get("sha256"):
+        raise TraceError(f"trace {path} failed its integrity check "
+                         f"(sha256 mismatch) — refusing to calibrate "
+                         f"against bytes that cannot be proven whole")
+    if obj.get("schema") != TRACE_SCHEMA:
+        raise TraceError(f"trace {path} has schema "
+                         f"{obj.get('schema')!r}, expected "
+                         f"{TRACE_SCHEMA!r}")
+    return obj["payload"]
+
+
+def _happens_before(p: Dict[str, Any], g: Dict[str, Any]) -> bool:
+    """Did span ``p`` start (same thread) or complete (any thread)
+    before guarded span ``g`` started?"""
+    if p is g or (p.get("tid") == g.get("tid")
+                  and p.get("sid") == g.get("sid")):
+        return False
+    pt = float(p.get("t", 0.0))
+    gt = float(g.get("t", 0.0))
+    if p.get("tid") == g.get("tid"):
+        return pt <= gt
+    return pt + float(p.get("dur", 0.0)) <= gt
+
+
+def calibrate(spans: List[Dict[str, Any]], specs=None) -> Dict[str, Any]:
+    """Classify every guarded-span occurrence; returns the coverage
+    report body (no I/O)."""
+    if specs is None:
+        from .protocols import all_specs
+        specs = all_specs()
+    # the global guard vocabulary: every span name ANY spec accepts as
+    # a guard — a guarded occurrence protected by an out-of-spec guard
+    # is a modeling hole, not a runtime violation
+    vocab: Dict[str, List[str]] = {}
+    for spec in specs:
+        if spec.guard is not None:
+            for name in spec.guard.spans:
+                vocab.setdefault(name, []).append(spec.rule_id)
+    guard_spans = [s for s in spans if s.get("name") in vocab]
+    seen_names = {s.get("name") for s in spans}
+    per_spec: List[Dict[str, Any]] = []
+    total_missing = total_violations = 0
+    for spec in specs:
+        own_guards = set(spec.guard.spans) if spec.guard is not None \
+            else set()
+        guarded_names = set(spec.guarded.spans)
+        occurrences = [s for s in spans
+                       if s.get("name") in guarded_names]
+        modeled = 0
+        missing: Dict[Tuple[str, str], int] = {}
+        violations: List[Dict[str, Any]] = []
+        for occ in occurrences:
+            if not own_guards:
+                # EXCLUSIVE_SITE specs model a static site allowlist,
+                # not a happens-before edge: the guarded span is only
+                # ever emitted from inside the sanctioned site, so its
+                # occurrence IS the modeled behaviour — crediting it to
+                # some other spec's guard would fabricate an edge
+                modeled += 1
+                continue
+            prior = [p for p in guard_spans if _happens_before(p, occ)]
+            if any(p.get("name") in own_guards for p in prior):
+                modeled += 1
+            elif prior:
+                # protected at runtime — by an edge the spec lacks
+                nearest = max(prior, key=lambda p: float(p.get("t", 0)))
+                key = (str(occ.get("name")), str(nearest.get("name")))
+                missing[key] = missing.get(key, 0) + 1
+            elif own_guards:
+                violations.append({
+                    "span": str(occ.get("name")),
+                    "tid": occ.get("tid"),
+                    "t": occ.get("t"),
+                })
+        unexercised = sorted(n for n in own_guards
+                             if n not in seen_names)
+        total_missing += sum(missing.values())
+        total_violations += len(violations)
+        per_spec.append({
+            "rule_id": spec.rule_id,
+            "name": spec.name,
+            "mode": spec.mode,
+            "guarded_spans": sorted(guarded_names),
+            "guard_spans": sorted(own_guards),
+            "occurrences": len(occurrences),
+            "modeled": modeled,
+            "statically_missing_edges": [
+                {"guarded": g, "observed_guard": og, "count": n}
+                for (g, og), n in sorted(missing.items())],
+            "runtime_violations": violations,
+            "unexercised_guard_spans": unexercised,
+            # a spec whose guarded spans never occur is edge-
+            # unobservable in THIS trace (RQ1301's raw-read ban, or a
+            # path the soak skipped) — static-only coverage, flagged
+            # so nobody reads "0 violations" as "exercised and clean"
+            "observed": bool(occurrences),
+        })
+    return {
+        "specs": per_spec,
+        "n_spans": len(spans),
+        "statically_missing_edges": total_missing,
+        "runtime_violations": total_violations,
+        "unexercised_guard_spans": sum(
+            len(s["unexercised_guard_spans"]) for s in per_spec),
+        "unobserved_specs": sorted(s["rule_id"] for s in per_spec
+                                   if not s["observed"]),
+    }
+
+
+def _atomic_write(path: str, doc: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def calibrate_main(trace_path: str, root: str,
+                   quiet: bool = False,
+                   out_path: Optional[str] = None) -> int:
+    """The ``--calibrate`` entry point: load + verify the trace, run
+    the classification, write ``PROTOCOL_COVERAGE.json`` (repo root,
+    beside RESHARD_CHAOS.json), exit nonzero on missing edges or
+    runtime violations."""
+    try:
+        payload = load_trace(trace_path)
+    except TraceError as e:
+        print(f"rqlint: --calibrate: {e}", file=sys.stderr)
+        return 2
+    spans = payload.get("spans") or []
+    dropped = int(payload.get("spans_dropped") or 0)
+    report = calibrate(spans)
+    doc = {
+        "schema": COVERAGE_SCHEMA,
+        "trace": os.path.basename(trace_path),
+        "trace_spans_dropped": dropped,
+        **report,
+    }
+    out = out_path or os.path.join(root, COVERAGE_FILENAME)
+    _atomic_write(out, doc)
+    if not quiet:
+        for s in report["specs"]:
+            state = "static-only" if not s["observed"] else (
+                f"{s['modeled']}/{s['occurrences']} modeled")
+            extras = []
+            if s["statically_missing_edges"]:
+                extras.append(f"{sum(e['count'] for e in s['statically_missing_edges'])} missing edge(s)")
+            if s["runtime_violations"]:
+                extras.append(f"{len(s['runtime_violations'])} violation(s)")
+            if s["unexercised_guard_spans"]:
+                extras.append(f"guards unexercised: "
+                              f"{','.join(s['unexercised_guard_spans'])}")
+            line = f"  {s['rule_id']} {s['name']}: {state}"
+            if extras:
+                line += " — " + "; ".join(extras)
+            print(line)
+    ok = (report["statically_missing_edges"] == 0
+          and report["runtime_violations"] == 0)
+    print(f"rqlint: calibrate: {len(spans)} spans"
+          + (f" ({dropped} DROPPED — coverage incomplete)" if dropped
+             else "")
+          + f", {report['statically_missing_edges']} statically-missing"
+          f" edge(s), {report['runtime_violations']} runtime "
+          f"violation(s) -> {os.path.relpath(out, root)}")
+    if dropped:
+        # a truncated trace can hide the guard that would have modeled
+        # an edge — fail loudly rather than certify partial coverage
+        print("rqlint: calibrate: trace dropped spans; rerun the soak "
+              "with a larger span budget", file=sys.stderr)
+        return 2
+    return 0 if ok else 1

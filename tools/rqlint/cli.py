@@ -145,6 +145,23 @@ def main(argv=None) -> int:
                          "workers (default: os.cpu_count(); findings "
                          "and exit codes are byte-identical to --jobs "
                          "1)")
+    ap.add_argument("--cache", action="store_true",
+                    help="reuse per-file findings from "
+                         ".rqlint_cache/ when a file's analysis "
+                         "inputs (source, rule band, import "
+                         "neighborhood, cross-file facts) are "
+                         "unchanged — byte-identical to a cold scan")
+    ap.add_argument("--fix-pragmas", action="store_true",
+                    help="rewrite files dropping the pragma IDs RQ998 "
+                         "proves unused (whole pragma comment when "
+                         "every ID is unused); project mode only")
+    ap.add_argument("--calibrate", default=None, metavar="TRACE",
+                    help="replay a recorded telemetry trace (chaos "
+                         "run) against the protocol specs: report "
+                         "runtime-observed-but-statically-missing "
+                         "ordering edges and dead guards, write "
+                         "PROTOCOL_COVERAGE.json next to the trace, "
+                         "exit nonzero on missing edges")
     ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-q", "--quiet", action="store_true",
@@ -170,6 +187,18 @@ def main(argv=None) -> int:
     root = args.root or engine.repo_root()
     baseline_path = args.baseline or os.path.join(
         root, baseline_mod.DEFAULT_RELPATH)
+
+    if args.calibrate is not None:
+        from .calibrate import calibrate_main
+        return calibrate_main(args.calibrate, root=root,
+                              quiet=args.quiet)
+    if args.fix_pragmas and args.no_project:
+        # RQ998 (the unused-pragma proof --fix-pragmas rewrites from)
+        # only exists in project mode: a tier-1 run skips the
+        # needs_project rules, so "nothing fired" proves nothing
+        print("rqlint: --fix-pragmas needs project mode (drop "
+              "--no-project)", file=sys.stderr)
+        return 2
 
     paths = args.paths or None
     if (args.prune_baseline or args.update_baseline) and (
@@ -214,12 +243,47 @@ def main(argv=None) -> int:
                             use_baseline=not (args.no_baseline
                                               or args.update_baseline),
                             project=not args.no_project,
-                            jobs=jobs)
+                            jobs=jobs,
+                            cache=args.cache)
     except Exception as e:  # engine bugs must not look like a clean tree
         print(f"rqlint: internal error: {e!r}", file=sys.stderr)
         return 2
 
     findings: List[Finding] = result["findings"]
+    if result.get("cache") is not None:
+        st = result["cache"]
+        print(f"rqlint: cache: {st['hits']} hit(s), {st['misses']} "
+              f"miss(es)", file=sys.stderr)
+
+    if args.fix_pragmas:
+        import re as _re
+
+        unused: dict = {}
+        for f in findings:
+            if f.rule != engine.RQ998 or f.baselined or f.suppressed:
+                continue
+            m = _re.search(r"pragma disables (RQ\d+|all)\b", f.message)
+            if m:
+                unused.setdefault(f.path, {}).setdefault(
+                    f.line, set()).add(m.group(1))
+        from . import pragmas as pragmas_mod
+        n_files = n_pragmas = 0
+        for rel, per_line in sorted(unused.items()):
+            ap_path = os.path.join(root, rel)
+            try:
+                with open(ap_path, encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError:
+                continue
+            new_src, changed = pragmas_mod.strip_ids(src, per_line)
+            if changed:
+                with open(ap_path, "w", encoding="utf-8") as fh:
+                    fh.write(new_src)
+                n_files += 1
+                n_pragmas += changed
+        print(f"rqlint: --fix-pragmas: {n_pragmas} pragma(s) rewritten "
+              f"in {n_files} file(s)")
+        return 0
 
     if args.update_baseline:
         # A --select'ed update must not erase the debt of rules that

@@ -4,8 +4,13 @@ against the shared tree, pragma suppression, baseline absorption.
 Contrast with the pre-rqlint monolith, which re-read and re-walked every
 file once PER PASS: here a file is read once, parsed once, and every
 applicable rule runs over the same tree.  An unparseable file yields an
-RQ000 finding (never a crash); a crashing RULE yields an RQ000 finding
-naming the rule, so one buggy rule cannot mask the others' verdicts.
+RQ000 finding (never a crash); a crashing RULE yields an RQ999
+internal-error finding naming the rule, the file and the traceback —
+the scan continues (one buggy rule cannot mask the others' verdicts)
+but the run fails, because a crash means some files went unchecked.
+RQ998 (project mode) warns on pragma IDs that no longer suppress
+anything — stale suppressions would silently hide future regressions;
+``--fix-pragmas`` rewrites them away.
 
 Tier-2 adds a TWO-PASS project mode (the default): pass one parses the
 whole tree and builds the read-only :class:`~tools.rqlint.project.
@@ -44,6 +49,8 @@ SCAN_GLOBS = (
 )
 
 RQ000 = "RQ000"
+RQ998 = "RQ998"
+RQ999 = "RQ999"
 
 
 def repo_root() -> str:
@@ -107,8 +114,10 @@ def check_source(source: str, relpath: str,
         except Exception:
             tb = traceback.format_exc(limit=2).strip().replace("\n", " | ")
             findings.append(finding_at(
-                RQ000, ctx, None,
-                f"rule {rule.id} crashed on this file ({tb})", line=0))
+                RQ999, ctx, None,
+                f"internal error: rule {rule.id} crashed on "
+                f"{ctx.relpath} ({tb}) — this file is UNCHECKED by "
+                f"{rule.id}; fix the rule", line=0))
             continue
         findings.extend(found)
     out = []
@@ -214,6 +223,15 @@ def _scan_files(report: Sequence[str], sources, trees, view, rules,
                 wrapped_closure(view)
                 _wrapped_axis_names(view)
                 _donating_simple_names(view)
+            if any(i.startswith("RQ12") for i in ids):
+                from .rules.replay import replay_reachable
+                replay_reachable(view)
+            from .protocol import performs_closure
+            for r in rules:
+                spec = getattr(r, "protocol_spec", None)
+                if spec is not None:
+                    performs_closure(view, spec, "guard")
+                    performs_closure(view, spec, "guarded")
         _PAR_STATE = (sources, trees, view, rules)
         try:
             ctx = multiprocessing.get_context("fork")
@@ -236,13 +254,79 @@ def _scan_files(report: Sequence[str], sources, trees, view, rules,
     return findings
 
 
+def unused_pragmas(report: Sequence[str], sources: Dict[str, str],
+                   view: Optional[ProjectView],
+                   rules: Sequence[Rule],
+                   findings: Sequence[Finding]) -> List[Finding]:
+    """RQ998: pragma IDs that neither suppressed a finding nor
+    sanctioned a summary fact this run — stale suppressions that would
+    silently swallow a future regression.  Project mode only (a tier-1
+    run skips ``needs_project`` rules, so "nothing fired" proves
+    nothing), and only for rule IDs that actually RAN: under
+    ``--select`` an out-of-selection pragma is unprovable, and ``all``
+    pragmas are only judged when the full registry ran.  Warnings —
+    they never fail the run, but ``--fix-pragmas`` rewrites them away.
+    Computed post-scan in the main process, so ``--jobs`` output stays
+    byte-identical to serial."""
+    if view is None:
+        return []
+    from .rules import REGISTRY
+    ran = {r.id for r in rules}
+    full = ran >= {cls.id for cls in REGISTRY}
+    suppressed_by_file: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if f.suppressed:
+            suppressed_by_file.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for rel in report:
+        src = sources.get(rel)
+        if src is None:
+            continue
+        sites = pragmas.extract_detailed(src)
+        if not sites:
+            continue
+        per_line, file_wide = pragmas.extract(src)
+        mod = view.by_relpath.get(rel)
+        used = set(mod.sanction_hits) if mod is not None else set()
+        for f in suppressed_by_file.get(rel, ()):
+            ids = per_line.get(f.line, set())
+            if f.rule in ids:
+                used.add((f.line, f.rule))
+            if pragmas.ALL in ids:
+                used.add((f.line, pragmas.ALL))
+            if f.rule in file_wide:
+                used.add((0, f.rule))
+            if pragmas.ALL in file_wide:
+                used.add((0, pragmas.ALL))
+        ctx = FileContext(rel, src, None)
+        for site in sites:
+            key = 0 if site.kind == "disable-file" else site.line
+            for pid in site.ids:
+                if pid == pragmas.ALL:
+                    if not full:
+                        continue
+                elif pid not in ran:
+                    continue
+                if (key, pid) in used:
+                    continue
+                out.append(finding_at(
+                    RQ998, ctx, None,
+                    f"pragma disables {pid} but nothing here fires it "
+                    f"— a stale suppression hides the next real "
+                    f"finding; drop the ID (--fix-pragmas rewrites "
+                    f"this line)", severity=Severity.WARN,
+                    line=site.line))
+    return out
+
+
 def run(root: Optional[str] = None,
         rules: Optional[Sequence[Rule]] = None,
         paths: Optional[Sequence[str]] = None,
         baseline_path: Optional[str] = None,
         use_baseline: bool = True,
         project: bool = True,
-        jobs: int = 1) -> dict:
+        jobs: int = 1,
+        cache: bool = False) -> dict:
     """Lint the tree.  Returns ``{"findings", "files_scanned", "rules",
     "root", "project"}`` — findings carry their suppressed/baselined
     state; the caller decides presentation and exit code.
@@ -253,7 +337,15 @@ def run(root: Optional[str] = None,
     ``needs_project`` rules skipped.  ``jobs > 1`` fans the per-file
     rule pass over a fork-based worker pool (the parse + view build
     stay in-process); findings and exit codes are byte-identical to
-    serial — asserted by tests/test_rqlint_concurrency.py."""
+    serial — asserted by tests/test_rqlint_concurrency.py.
+
+    ``cache=True`` reuses per-file findings from
+    ``.rqlint_cache/findings.json`` when a file's analysis inputs
+    (source sha, rule band, import neighborhood, global cross-file
+    facts — see :mod:`tools.rqlint.cache`) are unchanged; cached and
+    fresh findings are byte-identical by construction (the cache stores
+    exactly what ``check_source`` returned).  RQ998 and the baseline
+    run post-cache."""
     root = root or repo_root()
     rules = list(rules) if rules is not None else all_rules()
     report = iter_files(root, paths)
@@ -265,8 +357,37 @@ def run(root: Optional[str] = None,
     view = ProjectView.build(trees, sources) if project else None
     findings: List[Finding] = [f for f in io_findings
                                if f.path in set(report)]
-    findings.extend(_scan_files(report, sources, trees, view, rules,
-                                int(jobs)))
+    cache_stats = None
+    if cache:
+        from . import __version__
+        from . import cache as cache_mod
+        keys = cache_mod.compute_keys(report, sources, view, rules,
+                                      __version__)
+        entries = cache_mod.load(root)
+        fresh: List[str] = []
+        hits = 0
+        for rel in report:
+            if rel not in sources:
+                continue
+            got = cache_mod.lookup(entries, rel, keys[rel])
+            if got is not None:
+                findings.extend(got)
+                hits += 1
+            else:
+                fresh.append(rel)
+        fresh_findings = _scan_files(fresh, sources, trees, view,
+                                     rules, int(jobs))
+        findings.extend(fresh_findings)
+        per_file: Dict[str, List[Finding]] = {rel: [] for rel in fresh}
+        for f in fresh_findings:
+            per_file.setdefault(f.path, []).append(f)
+        cache_mod.store(root, entries, keys, per_file)
+        cache_stats = {"hits": hits, "misses": len(fresh)}
+    else:
+        findings.extend(_scan_files(report, sources, trees, view,
+                                    rules, int(jobs)))
+    findings.extend(unused_pragmas(report, sources, view, rules,
+                                   findings))
     if use_baseline:
         bp = baseline_path or os.path.join(root,
                                            baseline_mod.DEFAULT_RELPATH)
@@ -278,6 +399,7 @@ def run(root: Optional[str] = None,
         "rules": rules,
         "root": root,
         "project": view,
+        "cache": cache_stats,
     }
 
 

@@ -1,0 +1,198 @@
+"""Nondeterminism-source matchers for the replay band (RQ12xx) — the
+single source of truth shared by :mod:`summaries` (the ``taints_replay``
+bit) and :mod:`rules.replay` (the finding anchors), so the two can
+never drift.
+
+Replay determinism is the recovery contract's substrate: SIGKILL ->
+journal replay -> bit-identical decisions only holds when nothing on a
+recover/replay/digest path reads state the journal does not pin.  Four
+source classes:
+
+- ``RQ1201`` wall-clock reads (``time.time``/``monotonic``/
+  ``datetime.now`` families) — two replays of the same journal see two
+  different clocks.
+- ``RQ1202`` unseeded RNG (``random.*`` module-globals, legacy
+  ``np.random.*`` globals, ``default_rng()``/``Random()`` with no seed,
+  ``uuid4``, ``os.urandom``, ``secrets``) — jax's keyed PRNG is
+  deterministic by construction and exempt.
+- ``RQ1203`` unsorted filesystem enumeration (``os.listdir``/``glob``/
+  ``scandir``/``iterdir``) — directory order is filesystem-dependent;
+  an order-normalizing consumer wrapping the call in the SAME
+  expression (``sorted``/``min``/``max``/``set``/``len``/``sum``/...)
+  sanctions it, matching the repo idiom ``sorted(os.listdir(d))``.
+- ``RQ1204`` set-iteration-order dependence (iterating a ``set``/
+  ``frozenset`` value, or materializing one via ``list(set(..))``) —
+  set order varies with the per-process hash seed; dict order is
+  insertion-stable and deliberately NOT flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .astutil import attr_chain, chain_tail
+
+RQ1201 = "RQ1201"
+RQ1202 = "RQ1202"
+RQ1203 = "RQ1203"
+RQ1204 = "RQ1204"
+
+REPLAY_RULE_IDS = frozenset({RQ1201, RQ1202, RQ1203, RQ1204})
+
+_CLOCK_TAILS = {"time", "time_ns", "monotonic", "monotonic_ns",
+                "perf_counter", "perf_counter_ns", "clock_gettime"}
+_DATETIME_TAILS = {"now", "utcnow", "today"}
+
+_RNG_TAILS = {"random", "randint", "randrange", "randbytes", "choice",
+              "choices", "shuffle", "sample", "uniform", "gauss",
+              "normal", "rand", "randn", "standard_normal", "integers",
+              "permutation", "bytes"}
+
+_FS_ENUM_TAILS = {"listdir", "scandir", "iterdir", "glob", "iglob",
+                  "rglob", "walk"}
+
+#: consumers that erase enumeration order when they wrap the call in
+#: the same expression (the repo idiom: ``sorted(os.listdir(d))``)
+ORDER_NORMALIZERS = {"sorted", "min", "max", "set", "frozenset", "len",
+                     "sum", "any", "all", "Counter"}
+
+
+def _wall_clock(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    if len(chain) < 2:
+        return False
+    tail = chain[-1]
+    if tail in _CLOCK_TAILS and "time" in chain[-2].lower():
+        return True
+    return tail in _DATETIME_TAILS and any(
+        "date" in part.lower() for part in chain[:-1])
+
+
+def _keyed_first_arg(call: ast.Call) -> bool:
+    """jax.random-style keyed call: first arg is a key — deterministic."""
+    if not call.args:
+        return False
+    a = call.args[0]
+    names = {n.id.lower() for n in ast.walk(a) if isinstance(n, ast.Name)}
+    names |= {n.attr.lower() for n in ast.walk(a)
+              if isinstance(n, ast.Attribute)}
+    return any("key" in n or "rng" in n for n in names)
+
+
+def _unseeded_rng(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    if not chain:
+        return False
+    tail = chain[-1]
+    if "jax" in chain:
+        return False  # keyed PRNG: deterministic by construction
+    if tail == "default_rng" or (tail == "Random" and len(chain) <= 2):
+        return not call.args and not call.keywords  # unseeded only
+    if tail == "urandom" and chain[-2:-1] == ("os",):
+        return True
+    if tail in {"uuid4", "uuid1"}:
+        return True
+    if chain[0] == "secrets":
+        return True
+    if tail in _RNG_TAILS and any("random" in part.lower()
+                                  for part in chain[:-1]):
+        return not _keyed_first_arg(call)
+    return False
+
+
+def _fs_enumeration(call: ast.Call) -> bool:
+    tail = chain_tail(call.func)
+    if tail not in _FS_ENUM_TAILS:
+        return False
+    chain = attr_chain(call.func)
+    if tail in {"glob", "iglob", "rglob"}:
+        return True  # glob.glob / pathlib .glob family
+    if tail in {"listdir", "scandir", "walk"}:
+        return len(chain) >= 2  # os./module-aliased spellings
+    return True  # iterdir
+
+
+def parent_map(root: ast.AST) -> Dict[int, ast.AST]:
+    return {id(child): node for node in ast.walk(root)
+            for child in ast.iter_child_nodes(node)}
+
+
+def _order_normalized(call: ast.Call,
+                      parents: Dict[int, ast.AST]) -> bool:
+    """True when an enclosing node of the SAME expression erases the
+    enumeration order: a normalizing call (``sorted(...)``), a
+    membership test, or an aggregate that ignores order."""
+    node: ast.AST = call
+    while True:
+        parent = parents.get(id(node))
+        if parent is None or isinstance(parent, ast.stmt):
+            return False
+        if isinstance(parent, ast.Call) and node is not parent.func \
+                and chain_tail(parent.func) in ORDER_NORMALIZERS:
+            return True
+        if isinstance(parent, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn))
+                for op in parent.ops):
+            return True
+        node = parent
+
+
+def _is_set_expr(e: ast.AST) -> bool:
+    if isinstance(e, (ast.Set, ast.SetComp)):
+        return True
+    return (isinstance(e, ast.Call)
+            and chain_tail(e.func) in {"set", "frozenset"}
+            and len(attr_chain(e.func)) == 1)
+
+
+def _set_iteration_sites(nodes: Iterable[ast.AST]
+                         ) -> List[Tuple[ast.AST, str]]:
+    out: List[Tuple[ast.AST, str]] = []
+    for node in nodes:
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if _is_set_expr(node.iter):
+                out.append((node.iter, "for-loop over a set"))
+        elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                               ast.DictComp)):
+            for gen in node.generators:
+                if _is_set_expr(gen.iter):
+                    out.append((gen.iter, "comprehension over a set"))
+        elif isinstance(node, ast.Call):
+            tail = chain_tail(node.func)
+            if tail in {"list", "tuple"} and node.args \
+                    and _is_set_expr(node.args[0]):
+                out.append((node, f"{tail}() of a set"))
+    return out
+
+
+def replay_sources(fn: ast.AST,
+                   parents: Optional[Dict[int, ast.AST]] = None
+                   ) -> List[Tuple[str, Tuple[int, int], str]]:
+    """All nondeterminism sources in one function body:
+    ``(rule_id, (line, col), label)`` triples, sorted by position.
+    ``parents`` reuses an already-built parent map (the normalizer check
+    for RQ1203 needs ancestors).  Nested defs/lambdas/classes are
+    skipped — separate (or deferred) execution scopes, consistent with
+    the summary layer."""
+    from .callgraph import body_nodes
+    if parents is None:
+        parents = parent_map(fn)
+    nodes = body_nodes(fn)
+    out: List[Tuple[str, Tuple[int, int], str]] = []
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        pos = (node.lineno, node.col_offset)
+        label = chain_tail(node.func) or "<call>"
+        if _wall_clock(node):
+            out.append((RQ1201, pos, label))
+        elif _unseeded_rng(node):
+            out.append((RQ1202, pos, label))
+        elif _fs_enumeration(node) and not _order_normalized(node,
+                                                             parents):
+            out.append((RQ1203, pos, label))
+    for node, label in _set_iteration_sites(nodes):
+        out.append((RQ1204, (node.lineno, node.col_offset), label))
+    out.sort(key=lambda t: (t[1], t[0]))
+    return out

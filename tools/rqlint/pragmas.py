@@ -79,3 +79,102 @@ def suppresses(rule_id: str, line: int, per_line: Dict[int, Set[str]],
         return True
     ids = per_line.get(line, ())
     return ALL in ids or rule_id in ids
+
+
+class PragmaSite:
+    """One pragma comment, positionally: enough to audit it (RQ998) and
+    to rewrite it (``--fix-pragmas``)."""
+
+    __slots__ = ("line", "kind", "ids", "comment")
+
+    def __init__(self, line: int, kind: str, ids, comment: str) -> None:
+        self.line = int(line)      # physical line of the comment token
+        self.kind = kind           # "disable" | "disable-file"
+        self.ids = tuple(ids)      # normalized IDs, source order
+        self.comment = comment     # full comment token text
+
+    def __repr__(self) -> str:  # debugging/test ergonomics
+        return (f"PragmaSite(line={self.line}, kind={self.kind!r}, "
+                f"ids={self.ids!r})")
+
+
+def extract_detailed(source: str):
+    """Every pragma comment as a :class:`PragmaSite`, in file order —
+    the audit-grade view ``extract`` flattens away.  IDs keep their
+    source order (normalized to upper-case / ``all``) so a rewrite can
+    drop one ID without reshuffling the rest."""
+    sites = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA.search(tok.string)
+            if not m:
+                continue
+            ids = []
+            for raw in re.split(r"[,\s]+", m.group(2).strip()):
+                if not raw:
+                    continue
+                if _ID.match(raw):
+                    ids.append(raw.upper())
+                elif raw.lower() == ALL:
+                    ids.append(ALL)
+                else:
+                    break
+            if ids:
+                sites.append(PragmaSite(tok.start[0], m.group(1), ids,
+                                        tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return sites
+
+
+def strip_ids(source: str, unused) -> Tuple[str, int]:
+    """Rewrite ``source`` with the ``unused`` pragma IDs removed:
+    ``unused`` maps pragma line -> set of IDs to drop.  A pragma whose
+    IDs are ALL dropped loses the whole comment (plus its trailing
+    justification — a justification with nothing to justify is noise);
+    a partial drop keeps the survivors in source order.  Returns
+    ``(new_source, pragmas_rewritten)``."""
+    if not unused:
+        return source, 0
+    lines = source.splitlines(keepends=True)
+    changed = 0
+    for site in extract_detailed(source):
+        drop = {i.upper() if i != ALL else i
+                for i in unused.get(site.line, ())}
+        if not drop or not (set(site.ids) & drop):
+            continue
+        keep = [i for i in site.ids if i not in drop]
+        idx = site.line - 1
+        text = lines[idx]
+        at = text.find(site.comment)
+        if at < 0:  # comment text not found verbatim: leave untouched
+            continue
+        if keep:
+            m = _PRAGMA.search(site.comment)
+            raw = m.group(2)
+            # group(2) greedily swallows a word-only justification
+            # ("RQ701 host float"); splice the surviving IDs over just
+            # the leading ID run so the justification stays put.
+            idrun_end = 0
+            for tm in re.finditer(r"[^,\s]+", raw):
+                t = tm.group(0)
+                if _ID.match(t) or t.lower() == ALL:
+                    idrun_end = tm.end()
+                else:
+                    break
+            new_comment = (site.comment[:m.start(2)] + ",".join(keep)
+                           + raw[idrun_end:])
+            lines[idx] = (text[:at] + new_comment
+                          + text[at + len(site.comment):])
+        else:
+            head = text[:at].rstrip()
+            rest = text[at + len(site.comment):]
+            if not head:  # own-line pragma: drop the whole line
+                lines[idx] = "" if rest.strip() == "" else rest
+            else:
+                lines[idx] = head + rest
+        changed += 1
+    return "".join(lines), changed

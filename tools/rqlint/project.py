@@ -49,7 +49,8 @@ class ModuleInfo:
     sync site — see :mod:`summaries`)."""
 
     __slots__ = ("name", "relpath", "tree", "is_package", "imports",
-                 "defs", "classes", "_pragma_lines", "_pragma_file")
+                 "defs", "classes", "_pragma_lines", "_pragma_file",
+                 "sanction_hits")
 
     def __init__(self, name: str, relpath: str, tree: ast.AST,
                  source: Optional[str] = None) -> None:
@@ -60,6 +61,13 @@ class ModuleInfo:
         self.imports: Dict[str, str] = {}
         self.defs: Dict[str, ast.AST] = {}
         self.classes: Dict[str, ast.ClassDef] = {}
+        #: (line, pragma-id) pairs whose pragma kept a fact out of a
+        #: summary (line 0 = file-wide) — a sanction "uses" the pragma
+        #: even though it never suppresses a rendered finding, so the
+        #: RQ998 unused-pragma pass must not flag it.  Recorded during
+        #: view build (main process), so ``--jobs`` workers inherit a
+        #: complete set copy-on-write.
+        self.sanction_hits: set = set()
         if source is not None:
             from . import pragmas
             self._pragma_lines, self._pragma_file = pragmas.extract(
@@ -79,9 +87,16 @@ class ModuleInfo:
         in ``ids`` (``ALL`` included) — the audited-boundary sanction
         the summary layer honors."""
         ids = set(ids)
-        if self._pragma_file & ids:
-            return True
-        return bool(self._pragma_lines.get(line, set()) & ids)
+        hit = False
+        got = self._pragma_file & ids
+        if got:
+            self.sanction_hits.update((0, pid) for pid in got)
+            hit = True
+        got = self._pragma_lines.get(line, set()) & ids
+        if got:
+            self.sanction_hits.update((line, pid) for pid in got)
+            hit = True
+        return hit
 
     # -- construction ------------------------------------------------------
 

@@ -1,0 +1,310 @@
+"""Tier-4: the declarative happens-before protocol engine.
+
+The ordering contracts this repo's recovery story rests on
+(journal-before-ack, gate-before-install, fence-before-scatter,
+checksum-before-trust, journal-epoch-before-swap) used to live as
+hand-coded point rules, one ~80-line checker per contract.  Tier-4
+replaces the checkers with ONE engine over declarative specs: a
+:class:`ProtocolSpec` names a *guarded* effect (the dangerous thing),
+optionally a *guard* effect (the thing that must come first), a path
+scope, and escape hatches — and the engine derives the rule.  New
+protocols are a spec entry in ``tools/rqlint/protocols/``, not a new
+rule module.
+
+Three ordering semantics cover every contract shipped so far:
+
+- ``ORDER`` — fires when a function performs BOTH effects and the
+  guarded one comes first in source order (RQ1005: an ack emitted above
+  the journal append).  Functions without the guard effect are out of
+  scope by construction — the mode polices ordering, not architecture.
+- ``REQUIRE_GUARD`` — every guarded occurrence must have a
+  source-order-preceding guard occurrence in the same function
+  (RQ1007: ``install_range`` without ``assert_fenced``).  No guard
+  anywhere means every occurrence fires.
+- ``EXCLUSIVE_SITE`` — the guarded effect is banned outside the
+  allowlisted functions, full stop (RQ1006: assigning the live param
+  slots anywhere but ``_install_validated``).
+
+In project mode the ORDER/REQUIRE_GUARD modes go *interprocedural*: a
+resolved intra-repo call to a function whose transitive closure performs
+an effect counts as an occurrence of that effect at the call site.  The
+closure is a boolean fixpoint over the existing call-graph SCCs (same
+discipline as :mod:`summaries`), cached per view.  A call that performs
+BOTH effects (a helper that journals and then acks, correctly) lands
+both occurrences at the same position — ties never fire, so correct
+composition stays silent.  Allowlisted functions are excluded from the
+guarded closure: calling a sanctioned installer is sanctioned (the
+escape hatch would be re-litigated at every caller otherwise).  Under
+``--no-project`` the engine degrades to exactly the old intra-procedural
+behavior — the ported rules are verdict-identical with their hand-coded
+ancestors (pinned by tests/test_rqlint.py).
+
+Each effect also declares the runtime *span names* the serving code
+emits when it executes — the hook :mod:`calibrate` uses to replay a
+recorded chaos trace against this static model (soundness holes and
+dead-guard coverage; see ``--calibrate``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import (Callable, Dict, FrozenSet, List, Optional, Set,
+                    Tuple)
+
+from .astutil import attr_chain, chain_tail, walk_calls
+from .findings import finding_at
+
+#: ordering semantics (see module docstring)
+ORDER = "order"
+REQUIRE_GUARD = "require_guard"
+EXCLUSIVE_SITE = "exclusive_site"
+
+MODES = (ORDER, REQUIRE_GUARD, EXCLUSIVE_SITE)
+
+#: (line, col) source position
+Pos = Tuple[int, int]
+
+
+@dataclasses.dataclass(frozen=True)
+class Effect:
+    """One recognizable program effect.
+
+    ``call_match`` is an AST predicate over ``ast.Call`` nodes;
+    ``attrs`` matches attribute-assignment targets (``self._q = ...``,
+    plain or augmented).  Either or both may be set.  ``spans`` names
+    the runtime telemetry spans the serving code emits when this effect
+    executes — the trace-calibration hook, unused by the static check.
+    """
+
+    label: str
+    call_match: Optional[Callable[[ast.Call], bool]] = None
+    attrs: FrozenSet[str] = frozenset()
+    spans: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    """One happens-before contract: ``guard`` must precede ``guarded``
+    (mode-dependent) inside ``scope``, except in ``allow_functions``.
+    ``message`` renders the finding text:
+    ``message(fn_name, label, pos, guard_pos)`` where ``guard_pos`` is
+    the first guard occurrence (None when absent / irrelevant)."""
+
+    rule_id: str
+    name: str
+    description: str
+    mode: str
+    guarded: Effect
+    guard: Optional[Effect] = None
+    scope: Tuple[str, ...] = ("redqueen_tpu/serving/*.py",)
+    allow_functions: FrozenSet[str] = frozenset()
+    message: Optional[Callable[[str, str, Pos, Optional[Pos]], str]] = None
+
+    def __post_init__(self) -> None:
+        if self.mode not in MODES:
+            raise ValueError(f"{self.rule_id}: unknown mode {self.mode!r}")
+        if self.mode in (ORDER, REQUIRE_GUARD) and self.guard is None:
+            raise ValueError(f"{self.rule_id}: mode {self.mode} needs a "
+                             f"guard effect")
+
+
+def direct_occurrences(effect: Optional[Effect],
+                       fn: ast.AST) -> List[Tuple[Pos, str]]:
+    """Positions where ``fn``'s own body performs ``effect`` (sorted).
+    The label is the call tail / assigned attribute — the spec message
+    interpolates it."""
+    out: List[Tuple[Pos, str]] = []
+    if effect is None:
+        return out
+    if effect.call_match is not None:
+        for call in walk_calls(fn):
+            if effect.call_match(call):
+                out.append(((call.lineno, call.col_offset),
+                            chain_tail(call.func) or effect.label))
+    if effect.attrs:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            else:
+                continue
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Attribute) \
+                            and sub.attr in effect.attrs:
+                        out.append(((sub.lineno, sub.col_offset),
+                                    sub.attr))
+    out.sort()
+    return out
+
+
+def _scope_matcher(spec: ProtocolSpec):
+    from .rules.base import _glob_to_re
+    pats = [_glob_to_re(p) for p in spec.scope]
+
+    def in_scope(relpath: str) -> bool:
+        relpath = relpath.replace("\\", "/")
+        return any(p.match(relpath) for p in pats)
+
+    return in_scope
+
+
+def performs_closure(view, spec: ProtocolSpec,
+                     which: str) -> FrozenSet[str]:
+    """fids whose transitive closure performs the spec's ``guard`` /
+    ``guarded`` effect — bottom-up over the view's call-graph SCCs,
+    cached on the view (same lifetime discipline as the tier-3
+    closures).  Direct detection is restricted to the spec's path scope
+    (the effect matchers are contract idioms, not global semantics);
+    allowlisted functions never enter the GUARDED closure — calling a
+    sanctioned installer is sanctioned."""
+    cache = view.__dict__.setdefault("_protocol_closures", {})
+    key = (spec.rule_id, which)
+    got = cache.get(key)
+    if got is not None:
+        return got
+    effect = spec.guard if which == "guard" else spec.guarded
+    in_scope = _scope_matcher(spec)
+    from .callgraph import sccs
+    blocked: Set[str] = set()
+    direct: Dict[str, bool] = {}
+    for fid, info in view.functions.items():
+        if which == "guarded" and \
+                info.qualname.split(".")[-1] in spec.allow_functions:
+            blocked.add(fid)
+            direct[fid] = False
+            continue
+        mod = view.modules.get(info.modname)
+        if mod is None or not in_scope(mod.relpath):
+            direct[fid] = False
+            continue
+        direct[fid] = bool(direct_occurrences(effect, info.node))
+    performs: Dict[str, bool] = {}
+    for comp in sccs(view.call_graph):
+        changed = True
+        while changed:
+            changed = False
+            for fid in comp:
+                if fid in blocked:
+                    performs[fid] = False
+                    continue
+                v = direct.get(fid, False) or any(
+                    performs.get(c, False)
+                    for c in view.call_graph.get(fid, ()))
+                if performs.get(fid) != v:
+                    performs[fid] = v
+                    changed = True
+    out = frozenset(f for f, v in performs.items() if v)
+    cache[key] = out
+    return out
+
+
+def _encl_class_map(mod) -> Dict[int, Optional[str]]:
+    """id(def node) -> enclosing class name, for the module's catalogued
+    defs (nested defs stay unmapped — their calls resolve without
+    ``self``, i.e. conservatively)."""
+    out: Dict[int, Optional[str]] = {}
+    for qual, node in mod.defs.items():
+        out[id(node)] = qual.split(".")[0] if "." in qual else None
+    return out
+
+
+def call_site_occurrences(view, mod, encl_class: Optional[str],
+                          fn: ast.AST, closure: FrozenSet[str]
+                          ) -> List[Tuple[Pos, str]]:
+    """Resolved intra-repo call sites in ``fn`` whose callee closure
+    performs an effect — the interprocedural upgrade."""
+    out: List[Tuple[Pos, str]] = []
+    for call in walk_calls(fn):
+        chain = attr_chain(call.func)
+        if not chain:
+            continue
+        fid = view.resolve_func(mod.name, chain, encl_class)
+        if fid is not None and fid in closure:
+            out.append(((call.lineno, call.col_offset), chain[-1]))
+    return out
+
+
+def check_spec(spec: ProtocolSpec, ctx):
+    """Run one spec against one file — the body of the generated rule.
+    Intra-procedural always; interprocedural occurrences are added in
+    project mode for the ORDER (both effects) and REQUIRE_GUARD (guard
+    only) modes."""
+    view = ctx.project
+    mod = view.by_relpath.get(ctx.relpath) if view is not None else None
+    encl_map = _encl_class_map(mod) if mod is not None else {}
+    guard_clo = guarded_clo = None
+    if mod is not None and spec.mode in (ORDER, REQUIRE_GUARD):
+        guard_clo = performs_closure(view, spec, "guard")
+        if spec.mode == ORDER:
+            guarded_clo = performs_closure(view, spec, "guarded")
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name in spec.allow_functions:
+            continue
+        guarded = direct_occurrences(spec.guarded, fn)
+        guards = direct_occurrences(spec.guard, fn)
+        if mod is not None:
+            encl = encl_map.get(id(fn))
+            if guard_clo is not None:
+                guards += call_site_occurrences(view, mod, encl, fn,
+                                                guard_clo)
+            if guarded_clo is not None:
+                guarded += call_site_occurrences(view, mod, encl, fn,
+                                                 guarded_clo)
+            guards.sort()
+            guarded.sort()
+        if spec.mode == ORDER:
+            if not guarded or not guards:
+                continue
+            pos, label = guarded[0]
+            gpos = guards[0][0]
+            if pos < gpos:
+                yield finding_at(
+                    spec.rule_id, ctx, None,
+                    spec.message(fn.name, label, pos, gpos),
+                    line=pos[0], col=pos[1])
+        elif spec.mode == REQUIRE_GUARD:
+            for pos, label in guarded:
+                if any(g < pos for g, _ in guards):
+                    continue
+                yield finding_at(
+                    spec.rule_id, ctx, None,
+                    spec.message(fn.name, label, pos, None),
+                    line=pos[0], col=pos[1])
+        else:  # EXCLUSIVE_SITE
+            for pos, label in guarded:
+                yield finding_at(
+                    spec.rule_id, ctx, None,
+                    spec.message(fn.name, label, pos, None),
+                    line=pos[0], col=pos[1])
+
+
+def span_sites(view) -> Dict[str, List[Tuple[str, int, str]]]:
+    """Static span-emission map: constant-string ``span("name")`` call
+    sites across the tree — ``{span name: [(relpath, line, qualname)]}``.
+    Dynamic span names (``span(self._stage)``) are invisible here;
+    :mod:`calibrate` treats the spec's declared span lists as the model
+    and this map as the best-effort site anchor."""
+    out: Dict[str, List[Tuple[str, int, str]]] = {}
+    for modname, mod in view.modules.items():
+        owner: Dict[int, str] = {}
+        for qual, node in mod.defs.items():
+            for sub in ast.walk(node):
+                owner.setdefault(id(sub), qual)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if chain_tail(node.func) != "span" or not node.args:
+                continue
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                out.setdefault(a.value, []).append(
+                    (mod.relpath, node.lineno,
+                     owner.get(id(node), "<module>")))
+    for sites in out.values():
+        sites.sort()
+    return out

@@ -1,0 +1,25 @@
+"""Declarative protocol specs (tier-4).
+
+One module per subsystem contract family; each exports ``SPECS``, a
+tuple of :class:`~tools.rqlint.protocol.ProtocolSpec`.  ``all_specs()``
+is the registry the rule factory (:mod:`tools.rqlint.rules.protocol`)
+and the trace calibrator (:mod:`tools.rqlint.calibrate`) both consume —
+adding a protocol is adding a spec entry here, nothing else.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..protocol import ProtocolSpec
+from . import durability, integrity
+
+ALL_SPECS: Tuple[ProtocolSpec, ...] = durability.SPECS + integrity.SPECS
+
+_ids = [s.rule_id for s in ALL_SPECS]
+if len(_ids) != len(set(_ids)):  # a duplicate spec ID is a packaging bug
+    raise ValueError(f"duplicate protocol spec rule IDs: {_ids}")
+
+
+def all_specs() -> Tuple[ProtocolSpec, ...]:
+    return ALL_SPECS

@@ -1,0 +1,182 @@
+"""Specs for the durability / guarded-install contracts — the ports of
+the hand-coded RQ1005/RQ1006/RQ1007 rules (IDs, scopes, anchors, and
+messages preserved byte-for-byte; pinned by tests/test_rqlint.py).
+
+RQ1005 — ack emitted before the durability point.
+
+The serving ack contract (docs/DESIGN.md "Durability modes & the ack
+contract") is positional: an admission/ack frame may only leave a
+function AFTER the statement that makes the acked record durable — the
+journal ``append`` (whose flush mode embeds the fsync/window contract),
+an explicit ``sync``/fsync, or the replication quorum wait.  A refactor
+that hoists the ack above the durability call keeps every test green on
+the happy path and silently converts "acked" into "acked unless we
+crash in the next microsecond".  ORDER mode: functions that only relay
+acks (routers, metrics) contain no durability call and are out of scope
+by construction.
+
+RQ1006 — live parameters installed without the gate.
+
+The hot-swap contract (docs/DESIGN.md "Fit-while-serving & guarded
+hot-swap") has exactly ONE sanctioned write path for the live decision
+parameters: ``ServingRuntime._install_validated``, reached only through
+``install_params`` with a gate-minted ``ValidatedParams`` token.  Every
+other assignment to the live slots is a gate bypass.  EXCLUSIVE_SITE
+mode: ``__init__`` constructs the initial params; ``_install_validated``
+IS the install site.
+
+RQ1007 — edge state installed without the topology-ownership check.
+
+RQ1006's shape lifted from parameters to EDGE STATE (docs/DESIGN.md
+"Elastic topology & live resharding"): ``install_range`` /
+``install_carry`` scatter rank/health directly into a live shard, so
+every call site must first assert the mutation is sanctioned under the
+current topology epoch (``assert_fenced`` / ``assert_owner``).
+REQUIRE_GUARD mode.  Allowlisted: ``reshard`` (offline path — the whole
+cluster is drained and recovered under an exclusive directory) and
+``_handle_install_range`` (the worker-side half of a handoff whose
+fence the ROUTER already asserted before sending the frame).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import attr_chain, call_args, chain_tail
+from ..protocol import (EXCLUSIVE_SITE, ORDER, REQUIRE_GUARD, Effect,
+                        ProtocolSpec)
+
+#: Call tails that ARE a durability point on any path that reaches the
+#: media or the quorum: the journal append (its flush mode embeds the
+#: contract), explicit syncs, and the replication quorum wait.
+DURABILITY_TAILS = {"sync", "fsync", "_fsync_locked", "_do_fsync",
+                    "_await_quorum"}
+
+#: Receiver names that make a bare ``.append(...)`` a JOURNAL append
+#: (list.append is not a durability point).
+_JOURNALISH = {"j", "jr", "_local", "local"}
+
+
+def is_durability_call(call: ast.Call) -> bool:
+    tail = chain_tail(call.func)
+    if tail in DURABILITY_TAILS:
+        return True
+    if tail == "append":
+        chain = attr_chain(call.func)
+        if len(chain) >= 2:
+            recv = chain[-2].lower()
+            return "journal" in recv or recv in _JOURNALISH
+    return False
+
+
+def _mentions_ack(node: ast.AST) -> bool:
+    """True when the expression subtree names an ack: a string constant
+    containing "ack" or an identifier containing it (``_KIND_ACK``,
+    ``repl.ack`` — the constant-name spelling must count or hoisting the
+    kind into a module constant would blind the rule)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and "ack" in sub.value.lower():
+            return True
+        if isinstance(sub, ast.Name) and "ack" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "ack" in sub.attr.lower():
+            return True
+    return False
+
+
+def is_ack_emission(call: ast.Call) -> bool:
+    tail = chain_tail(call.func)
+    if tail == "write_frame":
+        return any(_mentions_ack(a) for a in call_args(call))
+    if tail == "Admission":
+        return any(isinstance(a, ast.Constant) and a.value == "accepted"
+                   for a in call_args(call))
+    return False
+
+
+#: The durability-point effect, shared by RQ1005 and RQ1302.  Span
+#: names: every spelling the serving runtime emits around a call that
+#: makes a record durable (journal append incl. binary/raw, the forced
+#: fsync, the replication quorum wait).
+DURABILITY = Effect(
+    label="durability point",
+    call_match=is_durability_call,
+    spans=("serving.journal.append", "serving.journal.fsync",
+           "serving.repl.quorum"),
+)
+
+ACK = Effect(
+    label="ack emission",
+    call_match=is_ack_emission,
+    spans=("serving.ack",),
+)
+
+SPEC_RQ1005 = ProtocolSpec(
+    rule_id="RQ1005",
+    name="ack-before-durability",
+    description=("serving path emits an admission/ack before the "
+                 "durability point (journal append / fsync / quorum "
+                 "wait) that makes the ack true"),
+    mode=ORDER,
+    guard=DURABILITY,
+    guarded=ACK,
+    message=lambda fn, label, pos, gpos: (
+        f"{fn}() emits an ack at line {pos[0]} before its durability "
+        f"point at line {gpos[0]} — an ack must never precede the call "
+        f"that makes it true"),
+)
+
+#: The live decision-parameter slots — the only mutable state the
+#: hot-swap gate protects.
+LIVE_PARAM_ATTRS = frozenset({"_s_sink", "_q"})
+
+SPEC_RQ1006 = ProtocolSpec(
+    rule_id="RQ1006",
+    name="ungated-param-install",
+    description=("live decision parameters (._s_sink/._q) assigned "
+                 "outside __init__/_install_validated — a parameter "
+                 "install that bypasses the validation gate and the "
+                 "epoch journal"),
+    mode=EXCLUSIVE_SITE,
+    guarded=Effect(label="live param slot assignment",
+                   attrs=LIVE_PARAM_ATTRS,
+                   spans=("serving.params.install",)),
+    allow_functions=frozenset({"__init__", "_install_validated"}),
+    message=lambda fn, label, pos, gpos: (
+        f"{fn}() assigns .{label} directly — live parameters must "
+        f"route through install_params() so the gate validates and the "
+        f"epoch record lands in the journal"),
+)
+
+#: Call tails that scatter carry state directly into a live shard.
+EDGE_INSTALL_TAILS = {"install_range", "install_carry"}
+
+#: Call tails that ARE the topology-ownership check.
+TOPOLOGY_GUARD_TAILS = {"assert_fenced", "assert_owner"}
+
+SPEC_RQ1007 = ProtocolSpec(
+    rule_id="RQ1007",
+    name="unfenced-edge-install",
+    description=("edge state installed (install_range/install_carry) "
+                 "without a preceding topology-ownership check "
+                 "(assert_fenced/assert_owner) — a stale-owner "
+                 "scatter into a live shard"),
+    mode=REQUIRE_GUARD,
+    guard=Effect(label="topology-ownership check",
+                 call_match=lambda c:
+                     chain_tail(c.func) in TOPOLOGY_GUARD_TAILS,
+                 spans=("serving.topo.assert",)),
+    guarded=Effect(label="edge-state install",
+                   call_match=lambda c:
+                       chain_tail(c.func) in EDGE_INSTALL_TAILS,
+                   spans=("serving.topo.install_range",)),
+    allow_functions=frozenset({"reshard", "_handle_install_range"}),
+    message=lambda fn, label, pos, gpos: (
+        f"{fn}() calls {label}() at line {pos[0]} without a preceding "
+        f"topology-ownership check — assert the fence (assert_fenced) "
+        f"or the owner (assert_owner) under the current epoch before "
+        f"scattering edge state into a live shard"),
+)
+
+SPECS = (SPEC_RQ1005, SPEC_RQ1006, SPEC_RQ1007)

@@ -1,0 +1,109 @@
+"""Specs for the integrity / recovery-identity contracts (the RQ13xx
+band — protocols born declarative, with no hand-coded ancestor).
+
+RQ1301 — checksum-before-trust for the protocol logs.
+
+``topology.log`` and ``params_log.json`` are the two checksummed
+protocol logs recovery replays: the topology log carries a per-record
+sha (verified, torn-tail-quarantining reader: ``read_topology_log``),
+the params log an integrity envelope (``integrity.read_json``).  A raw
+read — ``open()``/``json.load()`` on a path naming either log — trusts
+bytes no checksum vouched for: a torn tail or a flipped bit replays as
+a wrong topology or wrong params instead of failing loudly.
+EXCLUSIVE_SITE mode: the raw-read effect is banned everywhere but the
+sanctioned verifying reader (``read_topology_log`` — the one place the
+per-record sha is actually checked).  The matcher keys on the path
+EXPRESSION naming the log (the constant or its symbolic name), so
+generic helpers taking an opaque ``path`` parameter stay out of scope —
+the rule polices call sites that know which file they are opening.
+
+RQ1302 — journal the epoch record before the in-memory swap.
+
+The param hot-swap's crash contract: recovery rebuilds the live params
+from the journal, so the epoch record must be durable BEFORE the
+in-memory slots flip.  Swap-then-journal serves decisions under
+parameters that a crash in the gap makes unrecoverable — replay
+produces a bit-different decision stream, the exact regression class
+PR 17 closed.  ORDER mode over the same durability effect as RQ1005,
+guarding the live-slot assignment: a function that both journals and
+swaps must journal first.  Functions that only swap (``__init__``) or
+only journal are out of scope by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_args, chain_tail
+from ..protocol import ORDER, EXCLUSIVE_SITE, Effect, ProtocolSpec
+from .durability import DURABILITY, LIVE_PARAM_ATTRS
+
+#: Tails that read bytes/objects without any checksum verification.
+RAW_READ_TAILS = {"open", "load", "loads", "read_text", "read_bytes",
+                  "readlines"}
+
+#: The protocol-log spellings: string fragments and the symbolic
+#: constants (``TOPOLOGY_LOG`` / ``PARAMS_LOG_FILENAME``) — the
+#: constant-name spelling must count or routing the filename through
+#: the module constant would blind the rule.
+_LOG_FRAGMENTS = ("topology.log", "params_log")
+_LOG_NAMES = {"TOPOLOGY_LOG", "PARAMS_LOG_FILENAME"}
+
+
+def _names_protocol_log(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and any(t in sub.value for t in _LOG_FRAGMENTS):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _LOG_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in _LOG_NAMES:
+            return True
+    return False
+
+
+def is_raw_log_read(call: ast.Call) -> bool:
+    if chain_tail(call.func) not in RAW_READ_TAILS:
+        return False
+    return any(_names_protocol_log(a) for a in call_args(call))
+
+
+SPEC_RQ1301 = ProtocolSpec(
+    rule_id="RQ1301",
+    name="unverified-protocol-log-read",
+    description=("topology.log / params_log read raw (open/json.load) "
+                 "instead of through the checksum-verifying reader — "
+                 "a torn or corrupt record would be trusted, not "
+                 "detected"),
+    mode=EXCLUSIVE_SITE,
+    guarded=Effect(label="raw protocol-log read",
+                   call_match=is_raw_log_read),
+    guard=Effect(label="checksum verification",
+                 spans=("serving.topo.log.verify",)),
+    allow_functions=frozenset({"read_topology_log"}),
+    message=lambda fn, label, pos, gpos: (
+        f"{fn}() reads a checksummed protocol log raw via {label}() — "
+        f"route topology.log through read_topology_log() and "
+        f"params_log through integrity.read_json() so a torn or "
+        f"corrupt record fails loudly instead of replaying wrong"),
+)
+
+SPEC_RQ1302 = ProtocolSpec(
+    rule_id="RQ1302",
+    name="swap-before-epoch-journal",
+    description=("live parameter slots swapped in-memory before the "
+                 "epoch record's durability point — a crash in the gap "
+                 "serves params recovery cannot replay"),
+    mode=ORDER,
+    guard=DURABILITY,
+    guarded=Effect(label="in-memory param swap",
+                   attrs=LIVE_PARAM_ATTRS,
+                   spans=("serving.params.install",)),
+    message=lambda fn, label, pos, gpos: (
+        f"{fn}() swaps the live .{label} slot at line {pos[0]} before "
+        f"the epoch record's durability point at line {gpos[0]} — "
+        f"journal the epoch (append + sync) before the in-memory swap "
+        f"so a crash in the gap replays the same parameters"),
+)
+
+SPECS = (SPEC_RQ1301, SPEC_RQ1302)

@@ -5,9 +5,14 @@ RQ4xx trace-safety, RQ5xx PRNG discipline, RQ6xx benchmark honesty,
 RQ7xx hidden host-sync (tier-2), RQ8xx recompilation hazards (tier-2),
 RQ9xx telemetry discipline, RQ10xx shared-memory concurrency
 (RQ1001-1004, tier-3) and ack/durability ordering + gated parameter /
-edge-state installs (RQ1005-1007, tier-1),
-RQ11xx mesh/collective correctness (tier-3).
-RQ000 (unparseable file) is emitted by the engine itself, not a rule.
+edge-state installs (RQ1005-1007, tier-1, spec-generated — see
+``rules/protocol.py``), RQ11xx mesh/collective correctness (tier-3),
+RQ12xx replay determinism (tier-4, project-only — nondeterminism
+sources reachable from recover/replay/digest entry points), RQ13xx
+declarative protocol-ordering specs (tier-4, tier-1-capable —
+``tools/rqlint/protocols/``).
+RQ000 (unparseable file), RQ998 (unused suppression pragma) and RQ999
+(crashed rule) are emitted by the engine itself, not by rules.
 Tier-2/3 rules carry ``needs_project`` and are skipped under
 ``--no-project`` (which therefore reproduces the tier-1 rule set).
 
@@ -25,15 +30,15 @@ from .base import FileContext, Rule  # noqa: F401 (re-export)
 from .bench import HardCodedSlabRule, UnsyncedTimingRule
 from .concurrency import (FdLeakRule, LockOrderCycleRule,
                           UnguardedSharedStateRule, UnstoppableThreadRule)
-from .durability import (AckBeforeDurabilityRule,
-                         TopologyUnfencedInstallRule,
-                         UngatedParamInstallRule)
 from .hostsync import HiddenSyncRule, HotLoopTransferRule
 from .mesh import (AxisUnboundCollectiveRule, DonationAfterUseRule,
                    ShardMapSpecArityRule)
 from .numerics import RawNumericsRule
 from .prng import ConstantSeedRule, KeyReuseRule
+from .protocol import PROTOCOL_RULES
 from .recompile import RecompilationHazardRule, WeakTypeWideningRule
+from .replay import (SetIterationOrderRule, UnseededRngRule,
+                     UnsortedFsEnumerationRule, WallClockInReplayRule)
 from .resilience import BackendGuardRule
 from .telemetry import RawTimerPairRule
 from .trace_safety import TraceSafetyRule
@@ -56,13 +61,14 @@ REGISTRY = (
     LockOrderCycleRule,
     UnstoppableThreadRule,
     FdLeakRule,
-    AckBeforeDurabilityRule,
-    UngatedParamInstallRule,
-    TopologyUnfencedInstallRule,
     AxisUnboundCollectiveRule,
     DonationAfterUseRule,
     ShardMapSpecArityRule,
-)
+    WallClockInReplayRule,
+    UnseededRngRule,
+    UnsortedFsEnumerationRule,
+    SetIterationOrderRule,
+) + PROTOCOL_RULES
 
 
 def all_rules() -> List[Rule]:

@@ -1,0 +1,37 @@
+"""Spec-generated protocol rules (tier-4).
+
+Every :class:`~tools.rqlint.protocol.ProtocolSpec` in
+``tools/rqlint/protocols/`` becomes one Rule class here, carrying the
+spec's stable ID — the ported RQ1005/RQ1006/RQ1007 keep their IDs,
+messages, and tier-1 verdicts byte-for-byte, and the RQ13xx band is the
+first spec-native cohort.  The generated rules are tier-1 capable
+(``needs_project=False``): without a project view the engine checks the
+spec intra-procedurally, exactly like the hand-coded ancestors; with a
+view the ORDER/REQUIRE_GUARD modes pick up the interprocedural guard /
+effect closures (see :mod:`tools.rqlint.protocol`).
+"""
+
+from __future__ import annotations
+
+from ..protocol import ProtocolSpec, check_spec
+from ..protocols import all_specs
+from .base import Rule
+
+
+def rule_for_spec(spec: ProtocolSpec) -> type:
+    class _SpecRule(Rule):
+        id = spec.rule_id
+        name = spec.name
+        description = spec.description
+        paths = tuple(spec.scope)
+        protocol_spec = spec
+
+        def check(self, ctx):
+            yield from check_spec(self.protocol_spec, ctx)
+
+    _SpecRule.__name__ = f"Protocol_{spec.rule_id}"
+    _SpecRule.__qualname__ = _SpecRule.__name__
+    return _SpecRule
+
+
+PROTOCOL_RULES = tuple(rule_for_spec(s) for s in all_specs())

@@ -1,0 +1,221 @@
+"""RQ1201-RQ1204 — the replay-determinism band (tier-4).
+
+The recovery contract (SIGKILL -> snapshot + journal replay ->
+bit-identical carry and decisions) only holds when nothing on a replay
+path reads state the journal does not pin.  These rules flag the four
+nondeterminism-source classes (:mod:`tools.rqlint.nondet`) inside
+functions *reachable from a replay entry point* — any serving function
+whose name carries ``recover`` / ``replay`` / ``rebuild`` / ``digest``
+— via the resolved call graph's forward closure.  A wall-clock read in
+a metrics path is fine; the SAME read in something ``recover()`` calls
+replays differently every run.
+
+Two finding shapes per rule:
+
+- a **direct** source inside a reachable serving function, anchored at
+  the source line;
+- a **transitive** source behind a resolved call into a module OUTSIDE
+  this band's path scope (``runtime/``...), anchored at the call site —
+  carried by the ``taints_replay`` summary bit, so a sanctioned
+  (pragma'd) source never indicts its callers: the pragma at the
+  audited line keeps the taint out of the summary.
+
+Under ``--no-project`` (tier-1: no call graph, no summaries) the band
+degrades to its sound intra-file core: direct sources inside functions
+whose OWN name marks them a replay entry point.  Everything it reports
+there, project mode reports too (an entry point is reachable from
+itself) — so tier-1 verdicts never contradict the full scan.
+
+Audit policy (the committed tree): every finding is either FIXED
+(``sorted(os.listdir(..))``) or pragma'd with a one-line justification
+at the source — the baseline stays 0 for this band.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Set
+
+from .. import nondet
+from ..findings import finding_at
+from .base import Rule
+
+#: a function is a replay entry point when any name segment starts with
+#: one of these (``recover``, ``recover_shard``, ``params_digest``,
+#: ``_rebuild_params_log_installs``, ``replay``...)
+ENTRY_RE = re.compile(r"(?:^|_)(recover|replay|rebuild|digest)",
+                      re.IGNORECASE)
+
+#: files whose findings this band reports — the replay/recovery surface
+REPLAY_PATHS = ("redqueen_tpu/serving/*.py",)
+
+
+def replay_reachable(view) -> FrozenSet[str]:
+    """fids reachable (forward, over the resolved call graph) from a
+    replay entry point defined under the band's path scope — cached on
+    the view."""
+    got = view.__dict__.get("_replay_reachable")
+    if got is not None:
+        return got
+    from .base import _glob_to_re
+    pats = [_glob_to_re(p) for p in REPLAY_PATHS]
+    entries = []
+    for fid, info in view.functions.items():
+        mod = view.modules.get(info.modname)
+        if mod is None or not any(p.match(mod.relpath) for p in pats):
+            continue
+        base = info.qualname.split(".")[-1]
+        if ENTRY_RE.search(base):
+            entries.append(fid)
+    seen: Set[str] = set(entries)
+    frontier = list(entries)
+    while frontier:
+        fid = frontier.pop()
+        for callee in view.call_graph.get(fid, ()):
+            if callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    got = frozenset(seen)
+    view.__dict__["_replay_reachable"] = got
+    return got
+
+
+class _ReplayRule(Rule):
+    """Base for the band: subclasses pin ``id`` and the message stem."""
+
+    severity = "error"
+    paths = REPLAY_PATHS
+    needs_project = False
+    stem = ""
+
+    def check(self, ctx):
+        view = ctx.project
+        if view is None:
+            yield from self._tier1(ctx)
+            return
+        mod = view.by_relpath.get(ctx.relpath)
+        if mod is None:
+            return
+        reach = replay_reachable(view)
+        in_band = _band_matcher(view)
+        for qual, fn in sorted(mod.defs.items()):
+            fid = f"{mod.name}::{qual}"
+            if fid not in reach:
+                continue
+            parents = nondet.parent_map(fn)
+            for rid, pos, label in nondet.replay_sources(fn, parents):
+                if rid != self.id:
+                    continue
+                yield finding_at(
+                    self.id, ctx, None,
+                    f"{fn.name}() is on a replay path and {self.stem}: "
+                    f"{label} at line {pos[0]} — two replays of the "
+                    f"same journal diverge; pin it or justify with a "
+                    f"pragma", line=pos[0], col=pos[1])
+            yield from self._transitive(ctx, view, mod, fn, in_band)
+
+    def _tier1(self, ctx):
+        """``--no-project`` degradation: direct sources inside functions
+        whose own name matches the entry vocabulary — no call graph, so
+        reachable callees and transitive taints need the project view."""
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not ENTRY_RE.search(node.name):
+                continue
+            parents = nondet.parent_map(node)
+            for rid, pos, label in nondet.replay_sources(node, parents):
+                if rid != self.id:
+                    continue
+                yield finding_at(
+                    self.id, ctx, None,
+                    f"{node.name}() is on a replay path and "
+                    f"{self.stem}: {label} at line {pos[0]} — two "
+                    f"replays of the same journal diverge; pin it or "
+                    f"justify with a pragma", line=pos[0], col=pos[1])
+
+    def _transitive(self, ctx, view, mod, fn, in_band):
+        """Resolved calls into OUT-OF-SCOPE modules whose summary taints
+        replay with this rule's source class (in-scope sources are
+        reported at their own line instead)."""
+        from ..astutil import attr_chain, walk_calls
+        encl = fn.name if False else None  # resolved below per call
+        qual = next((q for q, n in mod.defs.items() if n is fn), None)
+        encl = qual.split(".")[0] if qual and "." in qual else None
+        for call in walk_calls(fn):
+            chain = attr_chain(call.func)
+            if not chain:
+                continue
+            fid = view.resolve_func(mod.name, chain, encl)
+            if fid is None:
+                continue
+            summ = view.summaries.get(fid)
+            if summ is None or self.id not in summ.taints_replay:
+                continue
+            if in_band(fid):
+                continue  # reported at the source line in its own file
+            yield finding_at(
+                self.id, ctx, None,
+                f"{fn.name}() is on a replay path and calls "
+                f"{chain[-1]}(), which reaches {self.stem_short} "
+                f"outside the serving tree — pin the source or justify "
+                f"it with a pragma at the call",
+                line=call.lineno, col=call.col_offset)
+
+
+def _band_matcher(view):
+    from .base import _glob_to_re
+    pats = [_glob_to_re(p) for p in REPLAY_PATHS]
+
+    def in_band(fid: str) -> bool:
+        info = view.functions.get(fid)
+        mod = view.modules.get(info.modname) if info else None
+        return mod is not None and any(p.match(mod.relpath)
+                                       for p in pats)
+
+    return in_band
+
+
+class WallClockInReplayRule(_ReplayRule):
+    id = "RQ1201"
+    name = "wall-clock-in-replay"
+    description = ("wall-clock read (time.time/monotonic/datetime.now) "
+                   "reachable from a recover/replay/digest entry point "
+                   "— replayed state must not depend on when the "
+                   "replay runs")
+    stem = "reads the wall clock"
+    stem_short = "a wall-clock read"
+
+
+class UnseededRngRule(_ReplayRule):
+    id = "RQ1202"
+    name = "unseeded-rng-in-replay"
+    description = ("unseeded RNG (random.* / np.random globals / "
+                   "default_rng() / uuid4) reachable from a replay "
+                   "entry point — keyed or explicitly-seeded "
+                   "generators only")
+    stem = "draws from an unseeded RNG"
+    stem_short = "an unseeded RNG draw"
+
+
+class UnsortedFsEnumerationRule(_ReplayRule):
+    id = "RQ1203"
+    name = "unsorted-fs-enumeration-in-replay"
+    description = ("os.listdir/glob/scandir without sorted() on a "
+                   "replay path — directory order is "
+                   "filesystem-dependent; wrap the enumeration in "
+                   "sorted() (or an order-erasing aggregate)")
+    stem = "enumerates the filesystem unsorted"
+    stem_short = "an unsorted directory enumeration"
+
+
+class SetIterationOrderRule(_ReplayRule):
+    id = "RQ1204"
+    name = "set-iteration-order-in-replay"
+    description = ("iteration over a set on a replay path — set order "
+                   "varies with the per-process hash seed; sort it (or "
+                   "keep insertion order in a list/dict)")
+    stem = "iterates a set in hash order"
+    stem_short = "set-order iteration"

@@ -125,6 +125,13 @@ class Summary:
     #: that it passes straight through to a donating callee — the buffer
     #: a caller must not read after the call (RQ1102).
     donates: FrozenSet[int] = frozenset()
+    # -- tier-4 bit (same SCC fixpoint) --------------------------------------
+    #: RQ12xx rule IDs of the replay-nondeterminism sources this function
+    #: reaches — its own unsanctioned sources plus every resolved
+    #: callee's.  A pragma at the source line (or at the call site) keeps
+    #: the taint out of the summary, same audited-boundary sanction as
+    #: ``concretizes``.
+    taints_replay: FrozenSet[str] = frozenset()
 
 
 EMPTY = Summary()
@@ -134,6 +141,10 @@ EMPTY = Summary()
 #: the pragmas module's spelling for a blanket disable
 _CONC_PRAGMAS = frozenset({"RQ701", "RQ702", "RQ401", "all"})
 _KEY_PRAGMAS = frozenset({"RQ501", "all"})
+#: replay-band sanction: a pragma naming the specific RQ12xx rule (or
+#: "all") at the nondeterminism source keeps ``taints_replay`` clean
+_REPLAY_PRAGMAS = frozenset({"RQ1201", "RQ1202", "RQ1203", "RQ1204",
+                             "all"})
 
 
 # ---------------------------------------------------------------------------
@@ -365,6 +376,28 @@ def lock_axis_walk(view, info, summaries: Dict[str, "Summary"],
             "axes": axes - st["guards"], "binds": st["binds"]}
 
 
+def _replay_direct(view, info) -> FrozenSet[str]:
+    """RQ12xx rule IDs of the function's OWN unsanctioned
+    nondeterminism sources — static per function per view, cached (the
+    SCC fixpoint re-runs the transfer; the AST scan must not re-run
+    with it)."""
+    cache = view.__dict__.setdefault("_replay_direct", {})
+    got = cache.get(info.fid)
+    if got is not None:
+        return got
+    from . import nondet
+    mod = view.modules.get(info.modname)
+    out: Set[str] = set()
+    for rid, pos, _label in nondet.replay_sources(info.node):
+        if mod is not None and mod.pragma_sanctions(
+                pos[0], frozenset({rid, "all"})):
+            continue
+        out.add(rid)
+    got = frozenset(out)
+    cache[info.fid] = got
+    return got
+
+
 def _is_tree_op(chain) -> bool:
     """jax.tree.map / jax.tree_util.tree_* / jax.tree_map — result
     mirrors the inputs."""
@@ -490,6 +523,7 @@ def _transfer(view, info, summaries: Dict[str, Summary]) -> Summary:
     concretizes: Set[int] = set()
     consumes: Set[int] = set()
     donates: Set[int] = set(jit_donate_info(info.node))
+    replay_taints: Set[str] = set(_replay_direct(view, info))
     returns_key = False
     returns_host = False
     returns_device = jit_decorated(info.node)
@@ -605,6 +639,9 @@ def _transfer(view, info, summaries: Dict[str, Summary]) -> Summary:
         fid = resolve_func(call) if chain else None
         if fid is not None:
             summ = summaries.get(fid, EMPTY)
+            if summ.taints_replay and not sanctioned(call,
+                                                     _REPLAY_PRAGMAS):
+                replay_taints.update(summ.taints_replay)
             for idx, arg in view.callee_arg_indices(fid, call):
                 p = pmap_of(arg)
                 if conc_ok and idx in summ.concretizes:
@@ -699,4 +736,5 @@ def _transfer(view, info, summaries: Dict[str, Summary]) -> Summary:
                    lock_edges=frozenset(la["edges"]),
                    uses_axes=frozenset(la["axes"]),
                    binds_axis=la["binds"],
-                   donates=frozenset(donates))
+                   donates=frozenset(donates),
+                   taints_replay=frozenset(replay_taints))
