@@ -88,3 +88,26 @@ def test_api_doc_covers_rqlint_surface():
         "rqlint surface absent from docs/API.md (add a table row): "
         + ", ".join(missing)
     )
+
+
+def test_api_doc_covers_rqcheck_surface():
+    """Drift guard for the tier-5 model-checking surface: the artifact
+    schema/filename, every model name, the CLI flags, and the RQ14xx
+    band must appear in docs/API.md."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(DOC))
+    sys.path.insert(0, repo)
+    from tools.rqcheck import MODEL_CHECK_FILENAME, MODEL_CHECK_SCHEMA
+    from tools.rqcheck.models import MODEL_CLASSES
+
+    doc = open(DOC).read()
+    surface = [MODEL_CHECK_SCHEMA, MODEL_CHECK_FILENAME,
+               "tools.rqcheck", "--mutations", "--conformance",
+               "--depth", "RQ1401", "RQ1402"]
+    surface += [cls.name for cls in MODEL_CLASSES]
+    missing = [s for s in surface if s not in doc]
+    assert not missing, (
+        "rqcheck surface absent from docs/API.md (add a table row): "
+        + ", ".join(missing)
+    )

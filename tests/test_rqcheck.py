@@ -1,0 +1,652 @@
+"""tools/rqcheck — bounded model checking of the serving protocols.
+
+Four contracts under test:
+
+1. **Checker core**: BFS minimality (the first violation found is the
+   shortest), canonical-state symmetry reduction, determinism (two
+   runs are equal object-for-object), and the bound/backstop
+   semantics.
+2. **Mutation kill**: every seeded protocol bug (ack before quorum,
+   install before journal, flip before fence, ...) is killed with a
+   minimal counterexample of the PINNED length, and the
+   counterexample pretty-prints in the rqtrace house style.
+3. **Honesty layers**: the committed MODEL_CHECK.json matches what
+   the models actually produce (a stale artifact fails here, not in
+   review), and the recorded chaos-trace fixture conformance-maps
+   100% of observed protocol events to enabled model transitions.
+4. **RQ14xx band + satellites**: RQ1401 spec drift / RQ1402 dead
+   spec fire on fixtures and stay silent on the real tree; the rqlint
+   cache's band signature folds the declarative spec bytes in
+   (editing a spec invalidates warm entries); SARIF carries rule
+   tiers and the engine pseudo-rules with correct levels.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.rqcheck import MODEL_CHECK_FILENAME, MODEL_CHECK_SCHEMA
+from tools.rqcheck.cli import main as rqcheck_main
+from tools.rqcheck.conformance import (TraceError, conformance,
+                                       conformance_from_trace,
+                                       is_protocol_span)
+from tools.rqcheck.core import Model, Transition, check
+from tools.rqcheck.models import MODEL_CLASSES, all_models
+from tools.rqcheck.models.replication import ReplicationModel
+from tools.rqcheck.pretty import render_counterexample, render_summary
+from tools.rqlint import cache as cache_mod
+from tools.rqlint import engine
+from tools.rqlint.findings import Finding, Severity
+from tools.rqlint.rules import modelmap, select_rules
+from tools.rqlint.rules.base import Rule
+from tools.rqlint.sarif import sarif_doc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "rqcheck")
+TRACE_FIXTURE = os.path.join(FIXTURES, "conformance_trace.json")
+
+#: (model, mutation) -> pinned minimal counterexample length.  These
+#: are properties of the protocols, not incidental: e.g. the shortest
+#: ack-before-quorum loss really is append -> early ack -> power loss.
+KILL_LENGTHS = {
+    ("replication", "ack_before_quorum"): 3,
+    ("replication", "degraded_skip_fsync"): 5,
+    ("paramswap", "install_before_journal"): 5,
+    ("paramswap", "install_unvalidated"): 2,
+    ("topology", "flip_before_fence"): 1,
+    ("topology", "drop_fenced"): 2,
+    ("topology", "resume_forgets_fence"): 3,
+}
+
+
+@pytest.fixture(scope="module")
+def all_results():
+    """Every (model, mutation-or-None) CheckResult, computed once —
+    the replication clean sweep is the expensive one (~190k states)."""
+    out = {}
+    for m in all_models():
+        out[(m.name, None)] = check(m)
+        for mut in sorted(m.mutations):
+            out[(m.name, mut)] = check(m, mutation=mut)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Checker core
+# ---------------------------------------------------------------------------
+
+
+class _Counter(Model):
+    """States 0..9 with step (+1) and skip (+2); the invariant breaks
+    at 5.  Shortest path to 5 takes 3 transitions (e.g. skip, skip,
+    step), so BFS minimality is observable."""
+
+    name = "counter"
+    depth = 10
+    mutations = {"noop": "changes nothing"}
+    transitions = (Transition("step", "n+1"),
+                   Transition("skip", "n+2"))
+
+    def initial(self):
+        return 0
+
+    def step(self, state, mutation=None):
+        if state < 9:
+            yield ("step", f"{state}->{state + 1}", state + 1)
+        if state < 8:
+            yield ("skip", f"{state}->{state + 2}", state + 2)
+
+    def invariant(self, state):
+        return "hit five" if state == 5 else None
+
+
+class TestCore:
+    def test_bfs_counterexample_is_minimal(self):
+        r = check(_Counter())
+        assert not r.ok
+        assert len(r.violation.trace) == 3
+        assert r.violation.state == 5
+
+    def test_unknown_mutation_raises(self):
+        with pytest.raises(KeyError, match="counter"):
+            check(_Counter(), mutation="no_such_bug")
+
+    def test_noop_mutation_explores_clean(self):
+        r = check(_Counter(), mutation="noop")
+        # the invariant still breaks — a mutation that changes nothing
+        # behaves exactly like the clean model
+        assert not r.ok and r.mutation == "noop"
+
+    def test_determinism(self, all_results):
+        for m in all_models():
+            again = check(m)
+            assert again == all_results[(m.name, None)]
+
+    def test_max_states_backstop_marks_incomplete(self):
+        r = check(ReplicationModel(), max_states=50)
+        assert not r.complete and r.states > 50
+
+    def test_depth_bound_marks_incomplete(self):
+        r = check(ReplicationModel(), depth=2)
+        assert not r.complete and r.depth_reached == 2
+
+    def test_canon_symmetry_reduces_followers(self):
+        # replication followers are interchangeable: the canon must
+        # fold a permutation of distinct bundles into one state
+        m = ReplicationModel()
+        (seq, has, dur, acked, fs, status, cu) = m.initial()
+        f0 = (frozenset({0}), False, False, frozenset(),
+              frozenset({0}), frozenset())
+        f1 = fs[1]
+        assert f0 != f1
+        a = (seq, has, dur, acked, (f0, f1), status, cu)
+        b = (seq, has, dur, acked, (f1, f0), status, cu)
+        assert m.canon(a) == m.canon(b)
+
+
+# ---------------------------------------------------------------------------
+# Clean models + committed artifact freshness
+# ---------------------------------------------------------------------------
+
+
+class TestCleanModels:
+    def test_every_model_clean_and_complete(self, all_results):
+        for m in all_models():
+            r = all_results[(m.name, None)]
+            assert r.ok, f"{m.name}: {r.violation}"
+            assert r.complete, (f"{m.name}: state space not drained "
+                                f"within depth {r.depth_bound}")
+            dead = [n for n, c in r.enabled.items() if c == 0]
+            assert not dead, (f"{m.name}: transitions never enabled "
+                              f"in any reachable state: {dead}")
+
+    def test_committed_model_check_artifact_is_fresh(self, all_results):
+        with open(os.path.join(REPO, MODEL_CHECK_FILENAME)) as f:
+            doc = json.load(f)
+        assert doc["schema"] == MODEL_CHECK_SCHEMA
+        for m in all_models():
+            got = all_results[(m.name, None)]
+            want = doc["models"][m.name]
+            assert want["states"] == got.states
+            assert want["depth_bound"] == got.depth_bound
+            assert want["complete"] == got.complete
+            assert want["violations"] == 0
+            assert want["transitions_enabled"] == dict(
+                sorted(got.enabled.items()))
+            assert want["mutations_killed"] == len(m.mutations)
+            for mut in m.mutations:
+                r = all_results[(m.name, mut)]
+                assert want["mutations"][mut]["killed"] is True
+                assert (want["mutations"][mut]["counterexample_length"]
+                        == len(r.violation.trace))
+
+    def test_committed_conformance_block_is_green(self):
+        with open(os.path.join(REPO, MODEL_CHECK_FILENAME)) as f:
+            doc = json.load(f)
+        conf = doc["conformance"]
+        assert conf["ok"] is True
+        assert conf["unmapped_spans"] == []
+        assert conf["protocol_events_observed"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Mutation kill
+# ---------------------------------------------------------------------------
+
+
+class TestMutationKill:
+    def test_every_seeded_mutation_is_killed(self, all_results):
+        seen = set()
+        for m in all_models():
+            for mut in m.mutations:
+                seen.add((m.name, mut))
+                r = all_results[(m.name, mut)]
+                assert not r.ok, (f"{m.name}: mutation {mut!r} "
+                                  f"survived the check")
+        assert seen == set(KILL_LENGTHS)
+
+    def test_counterexamples_are_minimal(self, all_results):
+        for (name, mut), want in KILL_LENGTHS.items():
+            r = all_results[(name, mut)]
+            assert len(r.violation.trace) == want, (
+                f"{name}/{mut}: counterexample length "
+                f"{len(r.violation.trace)}, pinned minimum {want}")
+
+    def test_counterexample_pretty_prints_rqtrace_style(self,
+                                                       all_results):
+        r = all_results[("replication", "ack_before_quorum")]
+        text = render_counterexample(r)
+        lines = text.splitlines()
+        assert lines[0] == ("-- counterexample (replication, "
+                            "mutation=ack_before_quorum) --")
+        assert lines[1].split() == ["#", "transition", "detail"]
+        assert lines[2].lstrip().startswith("1  append")
+        assert text.splitlines()[-1].startswith("INVARIANT VIOLATED: ")
+        assert "acked record is LOST" in text
+
+    def test_summary_renders_one_row_per_run(self, all_results):
+        results = list(all_results.values())
+        rows = render_summary(results).splitlines()
+        assert rows[0] == "-- rqcheck --"
+        assert rows[1].split()[:2] == ["model", "mutation"]
+        assert len(rows) == 2 + len(results)
+        for r, row in zip(results, rows[2:]):
+            assert row.startswith(r.model)
+            if r.mutation is None:
+                assert row.rstrip().endswith("ok")
+            else:
+                assert f"killed (trace {len(r.violation.trace)})" \
+                    in row
+
+
+# ---------------------------------------------------------------------------
+# Trace conformance
+# ---------------------------------------------------------------------------
+
+
+class TestConformance:
+    def test_fixture_trace_maps_every_protocol_event(self, all_results):
+        clean = {m.name: all_results[(m.name, None)]
+                 for m in all_models()}
+        rep = conformance_from_trace(TRACE_FIXTURE, all_models(),
+                                     clean)
+        assert rep["ok"], rep["unmapped_spans"]
+        assert rep["protocol_events_observed"] > 0
+        # the traced soak (repl/disk/swap matrix + the kill_dst
+        # reshard) must exercise every span-bearing transition of
+        # every model — the reason the kill_dst scenario rides every
+        # traced run
+        for name, pm in rep["models"].items():
+            assert pm["unexercised"] == [], (name, pm)
+
+    def test_unmodeled_span_is_a_conformance_gap(self, all_results):
+        clean = {m.name: all_results[(m.name, None)]
+                 for m in all_models()}
+        spans = [{"name": "serving.topo.mystery", "t": 0.0}]
+        rep = conformance(spans, all_models(), clean)
+        assert not rep["ok"]
+        assert rep["unmapped_spans"] == ["serving.topo.mystery"]
+
+    def test_non_protocol_spans_are_ignored(self, all_results):
+        clean = {m.name: all_results[(m.name, None)]
+                 for m in all_models()}
+        spans = [{"name": "serving.poll"}, {"name": "learn.fit.step"}]
+        rep = conformance(spans, all_models(), clean)
+        assert rep["ok"] and rep["protocol_events_observed"] == 0
+
+    def test_protocol_span_vocabulary(self):
+        assert is_protocol_span("serving.journal.fsync")
+        assert is_protocol_span("serving.ack")
+        assert is_protocol_span("serving.repl.replica.append")
+        assert not is_protocol_span("serving.poll")
+        assert not is_protocol_span("learn.fit.step")
+
+    def test_tampered_trace_is_refused(self, tmp_path, all_results):
+        with open(TRACE_FIXTURE) as f:
+            doc = json.load(f)
+        doc["payload"]["spans"][0]["name"] = "tampered"
+        bad = tmp_path / "bad_trace.json"
+        bad.write_text(json.dumps(doc))
+        clean = {m.name: all_results[(m.name, None)]
+                 for m in all_models()}
+        with pytest.raises(TraceError, match="integrity"):
+            conformance_from_trace(str(bad), all_models(), clean)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _rqcheck_subprocess(args):
+    """Run the CLI in a fresh interpreter: the --jobs fork pool must
+    not fork THIS process (JAX's threads are already running here and
+    fork + threads is a deadlock lottery)."""
+    import subprocess
+    import sys
+
+    return subprocess.run(
+        [sys.executable, "-m", "tools.rqcheck", *args], cwd=REPO,
+        capture_output=True, text=True, timeout=300)
+
+
+class TestCli:
+    def test_full_run_writes_artifact_and_exits_zero(self, tmp_path):
+        out = tmp_path / "mc.json"
+        proc = _rqcheck_subprocess(
+            ["--mutations", "--conformance", TRACE_FIXTURE,
+             "--json", str(out), "--jobs", "2"])
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "-- rqcheck --" in proc.stdout
+        assert "-- trace conformance --" in proc.stdout
+        doc = json.loads(out.read_text())
+        assert doc["schema"] == MODEL_CHECK_SCHEMA
+        assert set(doc["models"]) == {c.name for c in MODEL_CLASSES}
+        assert doc["conformance"]["ok"] is True
+
+    def test_single_model_quiet(self, capsys):
+        rc = rqcheck_main(["--model", "topology", "-q", "--jobs", "1"])
+        assert rc == 0
+        assert capsys.readouterr().out == ""
+
+    def test_unknown_model_is_usage_error(self, capsys):
+        assert rqcheck_main(["--model", "nope"]) == 2
+        assert "unknown model" in capsys.readouterr().err
+
+    def test_surviving_mutation_fails_the_run(self, monkeypatch,
+                                              capsys):
+        from tools.rqcheck.models.topology import TopologyModel
+        monkeypatch.setattr(
+            TopologyModel, "mutations",
+            dict(TopologyModel.mutations, harmless="changes nothing"))
+        rc = rqcheck_main(["--model", "topology", "--mutations",
+                           "--jobs", "1"])
+        assert rc == 1
+        assert "NOT killed" in capsys.readouterr().err
+
+    def test_parallel_equals_serial(self, tmp_path):
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        assert rqcheck_main(["--model", "paramswap", "--model",
+                             "topology", "--mutations", "--json",
+                             str(a), "--jobs", "1", "-q"]) == 0
+        proc = _rqcheck_subprocess(
+            ["--model", "paramswap", "--model", "topology",
+             "--mutations", "--json", str(b), "--jobs", "4", "-q"])
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert a.read_text() == b.read_text()
+
+
+# ---------------------------------------------------------------------------
+# RQ1401 / RQ1402 — the model/code mapping band
+# ---------------------------------------------------------------------------
+
+
+class TestModelMapRules:
+    def test_real_tree_has_no_drift(self):
+        rule = modelmap.ModelSpecDriftRule()
+        for rel in rule.paths:
+            with open(os.path.join(REPO, rel)) as f:
+                src = f.read()
+            fs = engine.check_source(src, rel, rules=[rule])
+            assert fs == [], [f.message for f in fs]
+
+    def test_unclaimed_protocol_mutation_fires_rq1401(self):
+        src = textwrap.dedent("""\
+            def sneaky_path(j, rec):
+                j.append(rec)
+        """)
+        fs = engine.check_source(
+            src, "redqueen_tpu/serving/replication.py",
+            rules=[modelmap.ModelSpecDriftRule()])
+        assert [f.rule for f in fs] == ["RQ1401"]
+        assert "sneaky_path" in fs[0].message
+        assert "durability point" in fs[0].message
+
+    def test_claimed_site_is_silent(self):
+        src = textwrap.dedent("""\
+            def heal_from_replicas(j, rec):
+                j.append(rec)
+        """)
+        fs = engine.check_source(
+            src, "redqueen_tpu/serving/replication.py",
+            rules=[modelmap.ModelSpecDriftRule()])
+        assert fs == []
+
+    def test_effectless_function_is_out_of_scope(self):
+        src = "def route(x):\n    return x + 1\n"
+        fs = engine.check_source(
+            src, "redqueen_tpu/serving/topology.py",
+            rules=[modelmap.ModelSpecDriftRule()])
+        assert fs == []
+
+    def _fake_model(self, transitions):
+        class FakeModel(Model):
+            name = "fake"
+
+        FakeModel.transitions = transitions
+        return FakeModel
+
+    def test_siteless_transition_fires_rq1402(self, monkeypatch):
+        fake = self._fake_model((
+            Transition("ghost_step", "mirrors nothing"),))
+        monkeypatch.setattr(modelmap, "_MODEL_RELPATHS",
+                            {"fake.py": fake})
+        model_src = ("from tools.rqcheck.core import Transition\n"
+                     "T = Transition('ghost_step', 'd')\n")
+        serving_src = "def real():\n    pass\n"
+        per_file = engine.check_sources(
+            {"tools/rqcheck/models/fake.py": model_src,
+             "redqueen_tpu/serving/replication.py": serving_src},
+            rules=[modelmap.DeadSpecRule()])
+        fs = per_file["tools/rqcheck/models/fake.py"]
+        assert [f.rule for f in fs] == ["RQ1402"]
+        assert "declares no code site" in fs[0].message
+        assert fs[0].line == 2  # anchored at the Transition() call
+
+    def test_ghost_site_fires_rq1402(self, monkeypatch):
+        fake = self._fake_model((
+            Transition("renamed", "mirrors a ghost",
+                       sites=("redqueen_tpu/serving/replication.py"
+                              "::old_name",)),))
+        monkeypatch.setattr(modelmap, "_MODEL_RELPATHS",
+                            {"fake.py": fake})
+        per_file = engine.check_sources(
+            {"tools/rqcheck/models/fake.py": "X = 1\n",
+             "redqueen_tpu/serving/replication.py":
+                 "def new_name():\n    pass\n"},
+            rules=[modelmap.DeadSpecRule()])
+        fs = per_file["tools/rqcheck/models/fake.py"]
+        assert [f.rule for f in fs] == ["RQ1402"]
+        assert "old_name" in fs[0].message
+
+    def test_env_transition_is_exempt(self, monkeypatch):
+        fake = self._fake_model((
+            Transition("world_acts", "environment", env=True),))
+        monkeypatch.setattr(modelmap, "_MODEL_RELPATHS",
+                            {"fake.py": fake})
+        per_file = engine.check_sources(
+            {"tools/rqcheck/models/fake.py": "X = 1\n"},
+            rules=[modelmap.DeadSpecRule()])
+        assert per_file["tools/rqcheck/models/fake.py"] == []
+
+    def test_real_models_declare_no_ghost_sites(self):
+        # RQ1402's site-existence check, run directly against the real
+        # tree (the full project-mode scan runs in CI): every declared
+        # site resolves to a module-level def or one-level method
+        import ast
+        defs_by_rel = {}
+        for m in all_models():
+            for t in m.transitions:
+                for site in t.sites:
+                    rel, _, qual = site.partition("::")
+                    if rel not in defs_by_rel:
+                        with open(os.path.join(REPO, rel)) as f:
+                            tree = ast.parse(f.read())
+                        defs_by_rel[rel] = {
+                            q for q, _n
+                            in modelmap._toplevel_functions(tree)}
+                    assert qual in defs_by_rel[rel], (
+                        f"{m.name}.{t.name} claims ghost site {site}")
+
+    def test_band_is_registered_and_tiered(self):
+        rules = select_rules(["RQ14"])
+        assert {r.id for r in rules} == {"RQ1401", "RQ1402"}
+        assert all(r.tier == 5 for r in rules)
+        assert not modelmap.ModelSpecDriftRule.needs_project
+        assert modelmap.DeadSpecRule.needs_project
+
+
+# ---------------------------------------------------------------------------
+# Satellite: spec bytes key the incremental cache
+# ---------------------------------------------------------------------------
+
+
+class _SpecDrivenRule(Rule):
+    """Stand-in for the spec-generated bands: its verdict depends on
+    the content of a spec file, not of the scanned source."""
+
+    id = "RQ1401"
+    name = "spec-driven-fixture"
+    description = "fires iff the fixture spec file contains BAN"
+    paths = ("*.py",)
+
+    def __init__(self, spec_file):
+        self._spec_file = spec_file
+
+    def check(self, ctx):
+        with open(self._spec_file) as f:
+            if "BAN" in f.read():
+                yield Finding(rule=self.id, path=ctx.relpath, line=1,
+                              col=0, message="spec says ban")
+
+
+class TestSpecSignatureCache:
+    def test_real_spec_dirs_cover_protocols_and_models(self):
+        dirs = [os.path.basename(d) for d in cache_mod._SPEC_DIRS]
+        assert dirs == ["protocols", "models"]
+        for d in cache_mod._SPEC_DIRS:
+            assert os.path.isdir(d), d
+        assert cache_mod.spec_signature() == cache_mod.spec_signature()
+
+    def test_editing_a_spec_invalidates_the_warm_cache(self, tmp_path,
+                                                       monkeypatch):
+        spec_dir = tmp_path / "specs"
+        spec_dir.mkdir()
+        spec = spec_dir / "fixture_spec.py"
+        spec.write_text("THRESHOLD = 1\n")
+        monkeypatch.setattr(cache_mod, "_SPEC_DIRS", (str(spec_dir),))
+        root = tmp_path / "tree"
+        root.mkdir()
+        (root / "mod.py").write_text("x = 1\n")
+        rule = _SpecDrivenRule(str(spec))
+
+        cold = engine.run(root=str(root), rules=[rule],
+                          use_baseline=False, project=False,
+                          cache=True)
+        assert cold["cache"]["misses"] == 1
+        assert cold["findings"] == []
+        warm = engine.run(root=str(root), rules=[rule],
+                          use_baseline=False, project=False,
+                          cache=True)
+        assert warm["cache"]["hits"] == 1
+
+        # the spec edit changes the rule's verdict with NO change to
+        # any scanned file — the warm cache must miss, not serve the
+        # stale empty finding set
+        spec.write_text("THRESHOLD = 1  # BAN\n")
+        edited = engine.run(root=str(root), rules=[rule],
+                            use_baseline=False, project=False,
+                            cache=True)
+        assert edited["cache"] == {"hits": 0, "misses": 1}
+        assert [f.rule for f in edited["findings"]] == ["RQ1401"]
+
+    def test_unreadable_spec_dir_degrades_gracefully(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setattr(cache_mod, "_SPEC_DIRS",
+                            (str(tmp_path / "nonexistent"),))
+        assert cache_mod.spec_signature()  # stable, no raise
+
+
+# ---------------------------------------------------------------------------
+# Satellite: SARIF rule metadata + engine pseudo-rule levels
+# ---------------------------------------------------------------------------
+
+
+class _CrashingRule(Rule):
+    id = "RQ9001"
+    name = "crasher"
+    description = "always raises"
+    paths = ("*.py",)
+
+    def check(self, ctx):
+        raise RuntimeError("boom")
+        yield  # pragma: no cover
+
+
+def _normalized_sarif(doc):
+    """Scrub the run-local bits (tool version, the RQ999 traceback
+    with its absolute paths and line numbers) so the golden pins the
+    SARIF *shape*, not the machine."""
+    doc["runs"][0]["tool"]["driver"]["version"] = "<version>"
+    for r in doc["runs"][0]["results"]:
+        if r["message"]["text"].startswith(
+                "internal error: rule RQ9001 crashed"):
+            r["message"]["text"] = ("internal error: rule RQ9001 "
+                                    "crashed on mod.py <traceback>")
+    return doc
+
+
+class TestSarifPolish:
+    def test_rules_array_carries_tier_metadata(self):
+        doc = sarif_doc({"findings": [],
+                         "rules": select_rules(["RQ14", "RQ13"])})
+        meta = {r["id"]: r
+                for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+        assert meta["RQ1401"]["properties"]["tier"] == 5
+        assert meta["RQ1402"]["properties"]["needsProject"] is True
+        assert meta["RQ1301"]["properties"]["tier"] == 4
+
+    def test_engine_pseudo_rules_have_correct_levels(self):
+        doc = sarif_doc({"findings": [], "rules": []})
+        meta = {r["id"]: r
+                for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+        assert meta["RQ998"]["defaultConfiguration"]["level"] == \
+            "warning"
+        assert meta["RQ999"]["defaultConfiguration"]["level"] == \
+            "error"
+        assert meta["RQ000"]["defaultConfiguration"]["level"] == \
+            "error"
+        assert all(m["properties"]["engineEmitted"]
+                   for m in meta.values())
+
+    def test_run_with_both_pseudo_findings_matches_golden(self,
+                                                          tmp_path):
+        # a real engine run producing BOTH: a crashed rule (RQ999,
+        # error) and a stale pragma (RQ998, warning — the pragma names
+        # a rule that RAN, so its staleness is provable)
+        (tmp_path / "mod.py").write_text(
+            "x = 1  # rqlint: disable=RQ9001\n")
+        res = engine.run(root=str(tmp_path), rules=[_CrashingRule()],
+                         use_baseline=False)
+        got = {f.rule: f.severity for f in res["findings"]}
+        assert got == {"RQ999": Severity.ERROR, "RQ998": Severity.WARN}
+
+        doc = _normalized_sarif(sarif_doc(res))
+        with open(os.path.join(FIXTURES, "sarif_golden.json")) as f:
+            golden = json.load(f)
+        assert doc == golden
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the traced soak exercises the kill_dst reshard scenario
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_traced_soak_covers_reshard_kill_dst(tmp_path):
+    """``chaos_soak.py --trace`` must run the destination-crash
+    reshard scenario even with the full reshard matrix skipped — the
+    trace that feeds conformance has to exercise the topology model's
+    resume path."""
+    import subprocess
+    import sys
+
+    trace = tmp_path / "CHAOS_TRACE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--rounds", "1", "--reshard-rounds", "0",
+         "--trace", str(trace)],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "traced reshard:kill_dst" in proc.stdout
+    assert trace.exists()
+    from tools.rqlint.calibrate import load_trace
+    payload = load_trace(str(trace))
+    names = {s.get("name") for s in payload["spans"]}
+    assert any(n and n.startswith("serving.topo.") for n in names)

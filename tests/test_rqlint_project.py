@@ -717,27 +717,29 @@ class TestNoProjectEquivalence:
         assert "RQ701" in rule_ids(proj)
         assert engine.check_source(src, "tools/u.py") == []
 
-    def test_cli_no_project_runs_eighteen_tier1_rules(self, tmp_path,
+    def test_cli_no_project_runs_nineteen_tier1_rules(self, tmp_path,
                                                       capsys):
         # 9 original tier-1 rules + the spec-generated protocol rules
         # RQ1005/RQ1006/RQ1007 (ported) and RQ1301/RQ1302 (new) + the
-        # 4 replay rules RQ1201-RQ1204 (intra-file degradation) — all
-        # tier-1-capable single-file analyses.
+        # 4 replay rules RQ1201-RQ1204 (intra-file degradation) + the
+        # tier-1-capable model-mapping rule RQ1401 — all single-file
+        # analyses.
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path), "--no-project",
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
         out = capsys.readouterr().out
-        assert "18 rules active" in out
+        assert "19 rules active" in out
 
-    def test_project_mode_runs_twentynine_rules(self, tmp_path, capsys):
-        # 18 tier-1/2 rules (incl. the 5 protocol specs) + the 7 tier-3
-        # RQ10xx/RQ11xx rules + the 4 tier-4 replay rules (RQ12xx)
+    def test_project_mode_runs_thirtyone_rules(self, tmp_path, capsys):
+        # 19 tier-1/2 rules (incl. the 5 protocol specs + RQ1401) + the
+        # 7 tier-3 RQ10xx/RQ11xx rules + the 4 tier-4 replay rules
+        # (RQ12xx) + the project-only dead-spec rule RQ1402
         (tmp_path / "bench.py").write_text("x = 1\n")
         assert cli.main(["--root", str(tmp_path),
                          "--baseline", str(tmp_path / "bl.json"),
                          "-q"]) == 0
-        assert "29 rules active" in capsys.readouterr().out
+        assert "31 rules active" in capsys.readouterr().out
 
 
 # ---------------------------------------------------------------------------

@@ -813,6 +813,19 @@ def main(argv=None) -> int:
               f"({rreport['rounds']}x{rreport['scenarios']}), zero "
               f"acked-record loss, every fenced/replayed count exact, "
               f"{rreport['wall_s']}s")
+    elif args.trace:
+        # a traced run must exercise a destination-crash resume even
+        # when the full reshard matrix is skipped: the topology
+        # model's conformance pass needs the fence/verify/install
+        # spans of a kill-and-resume, not just router death
+        try:
+            res = _reshard_scenario("kill_dst", 0)
+        except SoakFailure as e:
+            print(f"RESHARD CHAOS SOAK FAILED: {e}", file=sys.stderr)
+            return 1
+        print(f"  traced {res['scenario']}: acked {res['acked']}, "
+              f"lost {res['lost']}, fenced {res['fenced']}, replayed "
+              f"{res['replayed']} — exact")
     if args.trace:
         from redqueen_tpu.runtime import telemetry as _telemetry
         payload = _telemetry.export_trace(args.trace)

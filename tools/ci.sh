@@ -23,7 +23,10 @@ if [[ "${1:-}" == "chaos-soak" ]]; then
         --reshard-rounds "${3:-1}" --reshard-json RESHARD_CHAOS.json \
         --trace CHAOS_TRACE.json
     echo "== protocol trace calibration (static model vs chaos run) =="
-    exec python -m tools.rqlint --calibrate CHAOS_TRACE.json
+    python -m tools.rqlint --calibrate CHAOS_TRACE.json
+    echo "== rqcheck: bounded model check + trace conformance =="
+    exec python -m tools.rqcheck --mutations \
+        --conformance CHAOS_TRACE.json --json MODEL_CHECK.json
 fi
 
 echo "== rqlint static pass =="
@@ -65,14 +68,15 @@ t0=$SECONDS
 python -m tools.rqlint --jobs 1 -q > /dev/null || true
 echo "rqlint serial reference (--jobs 1): $((SECONDS - t0))s"
 
-echo "== rqlint tier-4: new-band SARIF artifact + incremental cache =="
-# The RQ12xx (replay-determinism) and RQ13xx (protocol-spec) bands in
-# tier-1 mode (--no-project: per-file spec checking, usable on any box
-# with no project view) with the SARIF artifact saved for a
-# code-scanning upload; the --jobs parallel path stays byte-identical
-# to serial for these bands (pinned by tests/test_rqlint_concurrency.py
-# over the full registry).
-python -m tools.rqlint --no-project --select RQ12,RQ13 \
+echo "== rqlint tier-4/5: new-band SARIF artifact + incremental cache =="
+# The RQ12xx (replay-determinism), RQ13xx (protocol-spec) and RQ14xx
+# (model/code mapping) bands in tier-1 mode (--no-project: per-file
+# spec checking, usable on any box with no project view; RQ1402 is
+# project-only and rides the main gate above) with the SARIF artifact
+# saved for a code-scanning upload; the --jobs parallel path stays
+# byte-identical to serial for these bands (pinned by
+# tests/test_rqlint_concurrency.py over the full registry).
+python -m tools.rqlint --no-project --select RQ12,RQ13,RQ14 \
     --format sarif -q > RQLINT_TIER4.sarif
 # Incremental scan cache: cold vs warm wall logged side by side, and
 # the two findings artifacts asserted byte-identical — the artifact
@@ -154,6 +158,20 @@ echo "== protocol trace calibration (static model vs chaos run) =="
 # surfaced non-fatally.  PROTOCOL_COVERAGE.json is the committed
 # coverage artifact beside RESHARD_CHAOS.json.
 python -m tools.rqlint --calibrate CHAOS_TRACE.json
+
+echo "== rqcheck: bounded model check + trace conformance (tier-5) =="
+# Explores every protocol model (replication / paramswap / topology)
+# breadth-first to its stated depth bound — 0 invariant violations
+# required, every seeded mutation must die with a minimal printed
+# counterexample — then replays the soak's trace for conformance:
+# every observed protocol span must map to a model transition the
+# clean check proved reachable.  Fails on a violation, a surviving
+# mutation, or a conformance gap.  The (model, mutation) runs fan
+# over --jobs fork workers (auto-detected cpu count, same policy as
+# the rqlint pass above); MODEL_CHECK.json is the committed artifact
+# beside PROTOCOL_COVERAGE.json and must be refreshed by this step.
+python -m tools.rqcheck --mutations --conformance CHAOS_TRACE.json \
+    --json MODEL_CHECK.json
 
 echo "== telemetry suite + overhead smoke =="
 # The unified-telemetry contracts, UNFILTERED (tier-1 runs the fast

@@ -1,0 +1,47 @@
+"""rqcheck — bounded explicit-state model checking for the serving
+protocols (tier-5).
+
+The repo's three crash-safety protocols — quorum-replicated group
+commit, gated parameter hot-swap, and the two-phase reshard
+fence/flip handoff — are exercised by chaos *sampling*
+(tools/chaos_soak.py hits a handful of scripted interleavings).
+rqcheck complements the soak with exhaustive, hardware-free
+verification: each protocol is a small declarative model
+(``tools/rqcheck/models/``) whose transitions mirror the shipped code,
+and a deterministic BFS explores EVERY interleaving of protocol steps,
+message loss/duplication/reorder, and single-node crash/recover up to
+a depth bound, checking the protocol invariants in every reached
+state.  A violation reconstructs the shortest event trace leading to
+it (BFS order makes counterexamples minimal by construction).
+
+Two layers keep the models honest rather than decorative:
+
+- the **conformance pass** (``--conformance TRACE``) replays a
+  recorded ``chaos_soak --trace`` telemetry trace through the models:
+  every observed protocol span must map to a model transition the BFS
+  proved enabled in some reachable state (reusing the trace loader of
+  ``tools/rqlint/calibrate``);
+- the **RQ14xx rqlint band** statically maps protocol-mutation sites
+  in ``serving/replication.py`` / ``serving/paramswap.py`` /
+  ``serving/topology.py`` to declared model transitions — an unmapped
+  effect site is spec drift (RQ1401), a declared site no code matches
+  is a dead spec (RQ1402).
+
+Each model also seeds named MUTATIONS (deliberate protocol bugs: ack
+before the quorum vote, install before the journal epoch, flip before
+the fence).  ``--mutations`` asserts the checker kills every one with
+a printed counterexample — the mutation-kill harness that proves the
+invariants are load-bearing.
+
+Stdlib-only and deterministic: no wall clock, no RNG, no jax — the
+whole pass runs on any box, like rqlint.  ``MODEL_CHECK.json``
+(schema ``rq.rqcheck.model_check/1``) is the committed artifact
+beside PROTOCOL_COVERAGE.json.
+"""
+
+from __future__ import annotations
+
+__version__ = "1.0"
+
+MODEL_CHECK_SCHEMA = "rq.rqcheck.model_check/1"
+MODEL_CHECK_FILENAME = "MODEL_CHECK.json"

@@ -1,0 +1,194 @@
+"""The ``python -m tools.rqcheck`` entry point.
+
+Runs the bounded check on every model (clean + every seeded
+mutation), optionally replays a recorded chaos trace for conformance,
+and writes the byte-stable ``MODEL_CHECK.json`` artifact.  Exit
+codes: 0 everything green; 1 a clean model violated its invariant, a
+seeded mutation survived, or the trace left a conformance gap; 2
+usage error or a bad trace artifact.
+
+The (model, mutation) runs are independent, so ``--jobs`` fans them
+over a fork pool (default ``os.cpu_count()``, same policy as
+rqlint's ``--jobs``); results merge in the deterministic job order
+regardless of completion order, and anything that cannot fork falls
+back to the serial path with identical output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import MODEL_CHECK_FILENAME, MODEL_CHECK_SCHEMA, __version__
+from .conformance import (TraceError, conformance_from_trace,
+                          render_conformance)
+from .core import CheckResult, check
+from .models import MODEL_CLASSES, all_models
+from .pretty import render_counterexample, render_summary
+
+#: a (model name, mutation-or-None, depth-override) work unit
+Job = Tuple[str, Optional[str], Optional[int]]
+
+
+def _run_job(job: Job) -> CheckResult:
+    name, mutation, depth = job
+    for cls in MODEL_CLASSES:
+        if cls.name == name:
+            return check(cls(), depth=depth, mutation=mutation)
+    raise KeyError(f"unknown model {name!r}")
+
+
+def _run_jobs(jobs: List[Job], n_jobs: int) -> List[CheckResult]:
+    """Run the work units, fork-parallel when possible; the returned
+    list is ALWAYS in job order (determinism contract)."""
+    if n_jobs > 1 and len(jobs) > 1 and hasattr(os, "fork"):
+        import multiprocessing
+
+        try:
+            ctx = multiprocessing.get_context("fork")
+            with ctx.Pool(min(n_jobs, len(jobs))) as pool:
+                return pool.map(_run_job, jobs)
+        except (OSError, ValueError):
+            pass  # fall through to the serial path
+    return [_run_job(j) for j in jobs]
+
+
+def _result_doc(r: CheckResult) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {
+        "states": r.states,
+        "depth_bound": r.depth_bound,
+        "depth_reached": r.depth_reached,
+        "complete": r.complete,
+    }
+    if r.mutation is None:
+        doc["transitions_enabled"] = dict(sorted(r.enabled.items()))
+        doc["violations"] = 0 if r.ok else 1
+        if not r.ok:
+            doc["violation"] = {
+                "message": r.violation.message,
+                "trace": [{"transition": n, "detail": d}
+                          for (n, d) in r.violation.trace],
+            }
+    else:
+        doc["killed"] = not r.ok
+        if not r.ok:
+            doc["counterexample_length"] = len(r.violation.trace)
+            doc["violation_message"] = r.violation.message
+    return doc
+
+
+def _atomic_write(path: str, doc: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rqcheck",
+        description="bounded model checking of the durability / "
+                    "hot-swap / reshard protocols")
+    ap.add_argument("--model", action="append", default=None,
+                    metavar="NAME",
+                    help="check only this model (repeatable; default "
+                         "all)")
+    ap.add_argument("--depth", type=int, default=None, metavar="N",
+                    help="override every model's stated depth bound")
+    ap.add_argument("--mutations", action="store_true",
+                    help="also run every seeded mutation and require "
+                         "each to be killed")
+    ap.add_argument("--conformance", metavar="TRACE", default=None,
+                    help="replay a recorded chaos trace and require "
+                         "every observed protocol span to map to an "
+                         "enabled model transition")
+    ap.add_argument("--json", dest="json_path", metavar="PATH",
+                    default=None,
+                    help=f"write the {MODEL_CHECK_FILENAME} artifact "
+                         f"here")
+    ap.add_argument("--jobs", type=int,
+                    default=max(1, os.cpu_count() or 1), metavar="N",
+                    help="parallel (model, mutation) runs "
+                         "(default: cpu count)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the summary on success")
+    args = ap.parse_args(argv)
+
+    models = all_models()
+    if args.model:
+        known = {m.name for m in models}
+        bad = [n for n in args.model if n not in known]
+        if bad:
+            print(f"rqcheck: unknown model(s) {', '.join(bad)}; "
+                  f"known: {', '.join(sorted(known))}",
+                  file=sys.stderr)
+            return 2
+        models = [m for m in models if m.name in set(args.model)]
+    if args.depth is not None and args.depth < 1:
+        print("rqcheck: --depth must be >= 1", file=sys.stderr)
+        return 2
+    if args.jobs < 1:
+        print("rqcheck: --jobs must be >= 1", file=sys.stderr)
+        return 2
+
+    jobs: List[Job] = [(m.name, None, args.depth) for m in models]
+    if args.mutations:
+        for m in models:
+            jobs.extend((m.name, mut, args.depth)
+                        for mut in sorted(m.mutations))
+    results = _run_jobs(jobs, args.jobs)
+
+    clean = {r.model: r for r in results if r.mutation is None}
+    failed = False
+    out: List[str] = [render_summary(results)]
+    for r in results:
+        if r.mutation is None and not r.ok:
+            failed = True
+            out.append(render_counterexample(r))
+        elif r.mutation is not None and r.ok:
+            failed = True
+            out.append(f"rqcheck: {r.model}: seeded mutation "
+                       f"{r.mutation!r} was NOT killed — the "
+                       f"invariant cannot see the bug it plants")
+        elif r.mutation is not None:
+            out.append(render_counterexample(r))
+
+    conf: Optional[Dict[str, Any]] = None
+    if args.conformance is not None:
+        try:
+            conf = conformance_from_trace(args.conformance, models,
+                                          clean)
+        except TraceError as e:
+            print(f"rqcheck: --conformance: {e}", file=sys.stderr)
+            return 2
+        out.append(render_conformance(conf))
+        if not conf["ok"]:
+            failed = True
+
+    if args.json_path:
+        doc: Dict[str, Any] = {
+            "schema": MODEL_CHECK_SCHEMA,
+            "rqcheck_version": __version__,
+            "models": {},
+        }
+        for m in models:
+            mdoc = _result_doc(clean[m.name])
+            muts = {r.mutation: _result_doc(r) for r in results
+                    if r.model == m.name and r.mutation is not None}
+            if muts:
+                mdoc["mutations"] = muts
+                mdoc["mutations_killed"] = sum(
+                    1 for d in muts.values() if d["killed"])
+            doc["models"][m.name] = mdoc
+        if conf is not None:
+            doc["conformance"] = conf
+        _atomic_write(args.json_path, doc)
+
+    if failed or not args.quiet:
+        print("\n\n".join(out),
+              file=sys.stderr if failed else sys.stdout)
+    return 1 if failed else 0

@@ -1,0 +1,138 @@
+"""Trace conformance: replay a recorded chaos trace's protocol events
+against the model transitions.
+
+The models are only worth committing if they describe the system that
+actually runs.  This pass closes that loop from the runtime side: it
+loads a ``tools/chaos_soak.py --trace`` artifact (integrity-verified
+through the same ``tools/rqlint/calibrate.load_trace`` the calibrator
+uses), extracts every span in the *protocol vocabulary* — the span
+names the serving tier emits while executing the replication /
+hot-swap / reshard protocols — and demands that each observed name is
+claimed by at least one model transition that the clean bounded check
+proved *reachable* (``enabled > 0``).  An observed protocol event with
+no enabled model transition is a conformance gap: the code does
+something the spec does not model, i.e. spec drift caught from the
+trace side (RQ1401 catches the same drift from the static side).
+
+The pass also reports, per model, which transitions the trace
+exercised — non-fatal (a short soak legitimately skips paths), the
+same stance ``unexercised_guard_spans`` takes in the calibrator.
+"""
+
+from __future__ import annotations
+
+import os
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..rqlint.calibrate import TraceError, load_trace  # noqa: F401
+from .core import CheckResult, Model
+
+#: the serving-tier span namespaces owned by the modeled protocols;
+#: any observed span under these MUST map to an enabled transition
+PROTOCOL_SPAN_PREFIXES = (
+    "serving.journal.",
+    "serving.repl.",
+    "serving.params.",
+    "serving.paramswap.",
+    "serving.topo.",
+)
+#: bare (un-prefixed) span names that belong to the protocols too
+PROTOCOL_SPAN_NAMES = frozenset({"serving.ack", "serving.sync"})
+
+
+def is_protocol_span(name: str) -> bool:
+    return (name in PROTOCOL_SPAN_NAMES
+            or any(name.startswith(p) for p in PROTOCOL_SPAN_PREFIXES))
+
+
+def conformance(spans: Sequence[Dict[str, Any]],
+                models: Sequence[Model],
+                clean: Dict[str, CheckResult]) -> Dict[str, Any]:
+    """Map every observed protocol span to enabled model transitions;
+    returns the conformance report body (no I/O).  ``clean`` maps
+    model name -> its clean (mutation=None) :class:`CheckResult`."""
+    observed: Dict[str, int] = {}
+    for s in spans:
+        name = s.get("name")
+        if isinstance(name, str) and is_protocol_span(name):
+            observed[name] = observed.get(name, 0) + 1
+
+    # span name -> [(model, transition)] over ENABLED transitions only
+    claims: Dict[str, List[Tuple[str, str]]] = {}
+    for m in models:
+        enabled = clean[m.name].enabled
+        for t in m.transitions:
+            if enabled.get(t.name, 0) <= 0:
+                continue
+            for span in t.spans:
+                claims.setdefault(span, []).append((m.name, t.name))
+
+    events = []
+    unmapped = []
+    for name in sorted(observed):
+        mapped = [{"model": mn, "transition": tn}
+                  for (mn, tn) in claims.get(name, [])]
+        events.append({"span": name, "count": observed[name],
+                       "transitions": mapped})
+        if not mapped:
+            unmapped.append(name)
+
+    per_model = {}
+    for m in models:
+        enabled = clean[m.name].enabled
+        declared = [t.name for t in m.transitions
+                    if t.spans and not t.env]
+        exercised = sorted(
+            t.name for t in m.transitions
+            if t.spans and not t.env and enabled.get(t.name, 0) > 0
+            and any(span in observed for span in t.spans))
+        per_model[m.name] = {
+            "span_transitions": sorted(declared),
+            "trace_exercised": exercised,
+            "unexercised": sorted(set(declared) - set(exercised)),
+        }
+
+    return {
+        "protocol_events_observed": sum(observed.values()),
+        "distinct_protocol_spans": len(observed),
+        "events": events,
+        "unmapped_spans": unmapped,
+        "ok": not unmapped,
+        "models": per_model,
+    }
+
+
+def conformance_from_trace(trace_path: str,
+                           models: Sequence[Model],
+                           clean: Dict[str, CheckResult]
+                           ) -> Dict[str, Any]:
+    """Load + verify the trace artifact, then run :func:`conformance`
+    over its spans.  Raises :class:`TraceError` on a bad artifact."""
+    payload = load_trace(trace_path)
+    spans = payload.get("spans") or []
+    report = conformance(spans, models, clean)
+    # basename only, like PROTOCOL_COVERAGE.json's "trace" field: the
+    # committed artifact must not embed a machine-local path
+    report["trace"] = {
+        "path": os.path.basename(trace_path),
+        "spans_total": len(spans),
+        "spans_dropped": int(payload.get("spans_dropped") or 0),
+    }
+    return report
+
+
+def render_conformance(report: Dict[str, Any]) -> str:
+    """rqtrace-style rendering of the conformance report."""
+    lines = ["-- trace conformance --",
+             f"{'span':<32} {'count':>7}  transitions"]
+    for ev in report["events"]:
+        names = ", ".join(f"{t['model']}.{t['transition']}"
+                          for t in ev["transitions"]) or "UNMAPPED"
+        lines.append(f"{ev['span']:<32} {ev['count']:>7}  {names}")
+    verdict = ("ok" if report["ok"] else
+               f"CONFORMANCE GAP: {len(report['unmapped_spans'])} "
+               f"observed protocol span(s) with no enabled model "
+               f"transition: {', '.join(report['unmapped_spans'])}")
+    lines.append(verdict)
+    return "\n".join(lines)

@@ -1,0 +1,184 @@
+"""The bounded explicit-state checker: deterministic BFS over a
+protocol model's state graph with canonical state hashing.
+
+A :class:`Model` supplies an initial state (any hashable value —
+models use nested tuples/frozensets), a ``step`` generator yielding
+``(transition_name, detail, next_state)`` for every enabled action,
+an ``invariant`` predicate returning a violation message or None, and
+an optional ``canon`` that maps a state to its symmetry-reduced
+canonical form (e.g. sorting interchangeable follower sub-states) so
+permutations hash to one visited entry.
+
+:func:`check` explores breadth-first to ``depth`` levels, checking
+the invariant in EVERY reached state and recording, per transition,
+how many distinct states enabled it (the reachable-enablement fact
+the conformance pass consumes).  Parent pointers over canonical
+states reconstruct the shortest trace to the first violation — BFS
+order makes counterexamples minimal by construction.
+
+Determinism is a hard contract (the committed MODEL_CHECK.json must
+be byte-stable): no wall clock, no RNG, no hash-order dependence —
+the frontier is a FIFO list, ``step`` yields in source order, and the
+visited set only gates membership, never iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Transition:
+    """One declared protocol action.
+
+    ``spans`` names the runtime telemetry spans the serving code
+    emits when this transition executes — the conformance hook.
+    ``sites`` anchors the transition in the shipped code as
+    ``"<relpath>::<qualname>"`` strings — the RQ14xx static-mapping
+    hook.  ``env=True`` marks an environment action (message loss,
+    crash, client traffic) that models the WORLD rather than a code
+    path; env transitions are exempt from the RQ1402 dead-spec check
+    and from conformance coverage accounting.
+    """
+
+    name: str
+    description: str
+    spans: Tuple[str, ...] = ()
+    sites: Tuple[str, ...] = ()
+    env: bool = False
+
+
+class Model:
+    """Base class for the protocol models.  Subclasses set ``name``,
+    ``transitions``, ``mutations`` (name -> description of the seeded
+    bug) and ``depth`` (the stated exploration bound), and implement
+    ``initial`` / ``step`` / ``invariant`` (+ optionally ``canon``)."""
+
+    name: str = ""
+    transitions: Tuple[Transition, ...] = ()
+    mutations: Dict[str, str] = {}
+    depth: int = 10
+
+    def initial(self) -> Any:
+        raise NotImplementedError
+
+    def step(self, state: Any, mutation: Optional[str] = None
+             ) -> Iterator[Tuple[str, str, Any]]:
+        raise NotImplementedError
+
+    def invariant(self, state: Any) -> Optional[str]:
+        raise NotImplementedError
+
+    def canon(self, state: Any) -> Any:
+        return state
+
+    def transition(self, name: str) -> Transition:
+        for t in self.transitions:
+            if t.name == name:
+                return t
+        raise KeyError(f"{self.name}: unknown transition {name!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """The first (therefore shortest) invariant violation found."""
+
+    message: str
+    #: the minimal event trace: (transition name, detail) per step
+    trace: Tuple[Tuple[str, str], ...]
+    state: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckResult:
+    model: str
+    mutation: Optional[str]
+    states: int
+    depth_bound: int
+    depth_reached: int
+    #: True when the frontier drained before the depth bound — the
+    #: ENTIRE reachable state space was explored, not a prefix
+    complete: bool
+    #: transition name -> number of distinct states that enabled it
+    enabled: Dict[str, int]
+    violation: Optional[Violation]
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+
+def check(model: Model, depth: Optional[int] = None,
+          mutation: Optional[str] = None,
+          max_states: int = 2_000_000) -> CheckResult:
+    """BFS ``model`` to ``depth`` levels (default: the model's own
+    stated bound); returns states explored, per-transition enablement
+    counts, and the shortest-trace violation if any state breaks the
+    invariant.  ``max_states`` is a runaway backstop, far above any
+    real model here — hitting it marks the result incomplete."""
+    if mutation is not None and mutation not in model.mutations:
+        raise KeyError(f"{model.name}: unknown mutation {mutation!r}; "
+                       f"known: {sorted(model.mutations)}")
+    bound = model.depth if depth is None else int(depth)
+    init = model.initial()
+    init_c = model.canon(init)
+    # canonical state -> (parent canonical state, transition, detail)
+    parents: Dict[Any, Optional[Tuple[Any, str, str]]] = {init_c: None}
+    enabled: Dict[str, int] = {t.name: 0 for t in model.transitions}
+
+    def trace_to(c: Any) -> Tuple[Tuple[str, str], ...]:
+        steps: List[Tuple[str, str]] = []
+        while parents[c] is not None:
+            c, name, detail = parents[c]
+            steps.append((name, detail))
+        steps.reverse()
+        return tuple(steps)
+
+    msg = model.invariant(init)
+    if msg is not None:
+        return CheckResult(model.name, mutation, 1, bound, 0, True,
+                           enabled, Violation(msg, (), init))
+    frontier: List[Tuple[Any, Any]] = [(init, init_c)]
+    depth_reached = 0
+    complete = True
+    for level in range(1, bound + 1):
+        if not frontier:
+            break
+        nxt: List[Tuple[Any, Any]] = []
+        for state, state_c in frontier:
+            fired = set()
+            for name, detail, succ in model.step(state, mutation):
+                fired.add(name)
+                succ_c = model.canon(succ)
+                if succ_c in parents:
+                    continue
+                parents[succ_c] = (state_c, name, detail)
+                msg = model.invariant(succ)
+                if msg is not None:
+                    return CheckResult(
+                        model.name, mutation, len(parents), bound,
+                        level, False, enabled,
+                        Violation(msg, trace_to(succ_c), succ))
+                nxt.append((succ, succ_c))
+            for name in fired:
+                enabled[name] += 1
+            if len(parents) > max_states:
+                return CheckResult(model.name, mutation, len(parents),
+                                   bound, level, False, enabled, None)
+        if nxt:
+            depth_reached = level
+        frontier = nxt
+    if frontier:
+        # the depth bound cut exploration short: count the last
+        # frontier's enablement too so conformance sees those states,
+        # but mark the result bounded-incomplete
+        complete = False
+        for state, _c in frontier:
+            fired = set()
+            for name, _detail, _succ in model.step(state, mutation):
+                fired.add(name)
+            for name in fired:
+                enabled[name] += 1
+    return CheckResult(model.name, mutation, len(parents), bound,
+                       depth_reached, complete, enabled, None)

@@ -1,0 +1,29 @@
+"""The protocol model registry.
+
+One module per shipped crash-safety protocol; each exports a single
+:class:`~tools.rqcheck.core.Model` subclass whose transitions carry
+the runtime span vocabulary (conformance hook) and the code-site map
+(RQ14xx hook).  ``all_models`` is the one enumeration every consumer
+uses — the CLI, the conformance pass, and the RQ1401/RQ1402 rules —
+so a new protocol model is automatically checked, calibrated, and
+drift-guarded the moment it lands here.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Model
+from .paramswap import ParamSwapModel
+from .replication import ReplicationModel
+from .topology import TopologyModel
+
+MODEL_CLASSES = (ReplicationModel, ParamSwapModel, TopologyModel)
+
+_ids = [cls.name for cls in MODEL_CLASSES]
+if len(set(_ids)) != len(_ids):  # pragma: no cover - build-time guard
+    raise RuntimeError(f"duplicate model names: {_ids}")
+
+
+def all_models() -> List[Model]:
+    return [cls() for cls in MODEL_CLASSES]

@@ -1,0 +1,220 @@
+"""The gated parameter hot-swap model (PR 17's protocol).
+
+Mirrors ``serving/paramswap.py`` + the install half of
+``serving/service.py``: the learner hands off a candidate artifact
+(``write_candidate``); the gate validates it and mints the
+``ValidatedParams`` token (``ParamGate.validate`` via
+``ParamSwapper.offer``/``poll_artifact``); ``install_params`` routes
+the token to ``_install_validated``, which journals the epoch record
+AND syncs it durable BEFORE flipping the in-memory slots; a vetoed
+candidate is quarantined, never installed; ``rollback`` re-journals
+the previous params under a fresh (still monotone) epoch.  A crash
+loses the unsynced journal tail and every in-memory token; recovery
+replays the journal and serves the highest durable epoch.
+
+Invariants: **the live policy never runs unvalidated params** (a
+nonzero live epoch is always gate-approved) and **the epoch is
+monotone through any crash** — the live epoch equals its own
+high-water mark, so a recovery that comes back serving an older epoch
+(the journal-after-install bug: the record wasn't durable when the
+slots flipped) is a violation, not a silent regression.
+
+Seeded mutations: ``install_before_journal`` (slots flip before the
+epoch record is journaled+synced — the exact RQ1302 ordering bug) and
+``install_unvalidated`` (the gate is bypassed; a written-but-never-
+validated candidate reaches the live slots — the RQ1006 bypass).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Tuple
+
+from ..core import Model, Transition
+
+#: candidate hand-off slot states
+_NONE, _WRITTEN, _VALIDATED = 0, 1, 2
+
+#: how many learner candidates the bound admits (epochs 1..N + one
+#: rollback epoch)
+MAX_CANDIDATES = 2
+
+_SWAP = "redqueen_tpu/serving/paramswap.py"
+_SVC = "redqueen_tpu/serving/service.py"
+
+
+class ParamSwapModel(Model):
+    name = "paramswap"
+    #: the full reachable space drains at depth 16 — 18 keeps the
+    #: clean run `complete` with headroom
+    depth = 18
+    mutations = {
+        "install_before_journal":
+            "the in-memory slots flip before the epoch record is "
+            "journaled and synced (swap-then-journal)",
+        "install_unvalidated":
+            "the gate is bypassed: a written candidate installs "
+            "without ParamGate.validate",
+    }
+    transitions = (
+        Transition(
+            "write_candidate",
+            "the learner lands a candidate artifact in the hand-off "
+            "slot",
+            sites=(f"{_SWAP}::write_candidate",)),
+        Transition(
+            "gate_validate",
+            "the gate validates the candidate and mints the "
+            "ValidatedParams token",
+            spans=("serving.paramswap.offer",),
+            sites=(f"{_SWAP}::ParamGate.validate",
+                   f"{_SWAP}::ParamSwapper.offer",
+                   f"{_SWAP}::ParamSwapper.poll_artifact",
+                   f"{_SWAP}::read_candidate")),
+        Transition(
+            "gate_veto",
+            "the gate vetoes the candidate; the artifact is "
+            "quarantined out of the hand-off slot",
+            sites=(f"{_SWAP}::ParamGate.validate",
+                   f"{_SWAP}::ParamSwapper.status")),
+        Transition(
+            "journal_epoch",
+            "the epoch record is appended to the serving journal",
+            spans=("serving.journal.append",),
+            sites=(f"{_SVC}::ServingRuntime._install_validated",
+                   f"{_SVC}::ServingRuntime._append_params_log")),
+        Transition(
+            "sync_epoch",
+            "the epoch record is fsynced durable",
+            spans=("serving.journal.fsync",),
+            sites=(f"{_SVC}::ServingRuntime._install_validated",)),
+        Transition(
+            "install",
+            "the live slots flip to the validated, journal-durable "
+            "epoch",
+            spans=("serving.params.install",),
+            sites=(f"{_SVC}::ServingRuntime.install_params",
+                   f"{_SVC}::ServingRuntime._install_validated",)),
+        Transition(
+            "rollback",
+            "the previous params re-install under a fresh epoch "
+            "(journaled + synced, still monotone)",
+            sites=(f"{_SWAP}::ParamSwapper.rollback",
+                   f"{_SWAP}::ParamGate.revalidate")),
+        Transition(
+            "crash",
+            "power loss: the unsynced journal tail and every "
+            "in-memory token are gone",
+            env=True),
+        Transition(
+            "recover",
+            "journal replay: the runtime comes back serving the "
+            "highest durable epoch",
+            sites=(f"{_SVC}::recover",
+                   f"{_SVC}::ServingRuntime."
+                   f"_rebuild_params_log_installs")),
+    )
+
+    def initial(self) -> Any:
+        # (cand, journaled, durable, pending, live, max_live,
+        #  validated, down, crash_used, cycles, rolled_back)
+        return (_NONE, 0, 0, 0, 0, 0, frozenset(), False, False, 0,
+                False)
+
+    def step(self, state: Any, mutation: Optional[str] = None
+             ) -> Iterator[Tuple[str, str, Any]]:
+        (cand, jrn, dur, pend, live, mx, val, down, crashed, cyc,
+         rolled) = state
+        up = not down
+        if up and cand == _NONE and cyc < MAX_CANDIDATES:
+            yield ("write_candidate",
+                   f"candidate {cyc + 1} lands in the hand-off slot",
+                   (_WRITTEN, jrn, dur, pend, live, mx, val, down,
+                    crashed, cyc + 1, rolled))
+        if up and cand == _WRITTEN:
+            yield ("gate_validate",
+                   f"gate validates candidate {cyc}",
+                   (_VALIDATED, jrn, dur, pend, live, mx, val, down,
+                    crashed, cyc, rolled))
+            yield ("gate_veto",
+                   f"gate vetoes candidate {cyc}; artifact "
+                   f"quarantined",
+                   (_NONE, jrn, dur, pend, live, mx, val, down,
+                    crashed, cyc, rolled))
+            if mutation == "install_unvalidated":
+                e = jrn + 1
+                yield ("install",
+                       f"MUTATED: unvalidated candidate {cyc} flips "
+                       f"the live slots as epoch {e}",
+                       (_NONE, e, e, 0, e, max(mx, e), val, down,
+                        crashed, cyc, rolled))
+        if up and cand == _VALIDATED:
+            if pend == 0:
+                e = jrn + 1
+                # the record is only ever journaled for a validated
+                # candidate, so the durable record IS the validation
+                # evidence recovery relies on — a crash between the
+                # sync and the flip legitimately recovers to epoch e
+                yield ("journal_epoch",
+                       f"epoch {e} record appended",
+                       (cand, e, dur, e, live, mx, val | {e}, down,
+                        crashed, cyc, rolled))
+            if mutation == "install_before_journal":
+                e = jrn + 1 if pend == 0 else pend
+                yield ("install",
+                       f"MUTATED: slots flip to epoch {e} before its "
+                       f"record is durable",
+                       (_NONE, jrn, dur, 0, e, max(mx, e),
+                        val | {e}, down, crashed, cyc, rolled))
+            elif pend > 0 and dur >= pend:
+                yield ("install",
+                       f"slots flip to validated, durable epoch "
+                       f"{pend}",
+                       (_NONE, jrn, dur, 0, pend, max(mx, pend),
+                        val | {pend}, down, crashed, cyc, rolled))
+        if up and dur < jrn:
+            yield ("sync_epoch",
+                   f"journal synced through epoch {jrn}",
+                   (cand, jrn, jrn, pend, live, mx, val, down,
+                    crashed, cyc, rolled))
+        # rollback serializes against the install critical section
+        # (same runtime lock), so it never interleaves while a
+        # journaled-but-uninstalled record is pending
+        if up and live > 0 and pend == 0 and not rolled:
+            e = jrn + 1
+            yield ("rollback",
+                   f"rollback re-journals the previous params as "
+                   f"epoch {e}",
+                   (cand, e, e, pend, e, max(mx, e), val | {e}, down,
+                    crashed, cyc, True))
+        if up and not crashed:
+            # the unsynced tail tears off; the ValidatedParams token
+            # and the pending-record memory die with the process
+            ncand = _WRITTEN if cand == _VALIDATED else cand
+            yield ("crash",
+                   f"power loss: journal cut to epoch {dur}, tokens "
+                   f"lost",
+                   (ncand, dur, dur, 0, live, mx, val, True, True,
+                    cyc, rolled))
+        if down:
+            # replay may land AHEAD of the pre-crash live epoch (the
+            # record was durable, the flip wasn't) — monotone either
+            # way, so the high-water mark advances with it
+            yield ("recover",
+                   f"journal replay -> live epoch {dur}",
+                   (cand, jrn, dur, 0, dur, max(mx, dur), val, False,
+                    crashed, cyc, rolled))
+
+    def invariant(self, state: Any) -> Optional[str]:
+        (cand, jrn, dur, pend, live, mx, val, down, _crashed, _cyc,
+         _rolled) = state
+        if down:
+            return None  # nothing serves while the process is gone
+        if live != 0 and live not in val:
+            return (f"live epoch {live} was never gate-validated — "
+                    f"the policy is serving unvalidated params")
+        if live != mx:
+            return (f"live epoch regressed: serving {live} after "
+                    f"epoch {mx} was live — a crash in the "
+                    f"swap-before-journal gap lost the installed "
+                    f"params")
+        return None

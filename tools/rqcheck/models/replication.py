@@ -1,0 +1,300 @@
+"""The quorum-replicated group-commit model (PR 16's protocol).
+
+Mirrors ``serving/replication.py``: the leader appends records in seq
+order and broadcasts each to R followers; a follower stores the blob
+in its local journal and sends an ack; the leader acks the client
+once Q of the R *voting* followers have receipted the record
+(``_await_quorum``).  Stragglers are demoted (the link stops voting),
+catch back up through the readmit stream, and rejoin the quorum; when
+fewer than Q voters remain the leader degrades to the inline-fsync
+tier — an ack then requires the record durable on the LEADER'S disk
+before it leaves.  ``power_loss`` models the leader's crash (volatile
+records cut to the fsync watermark); ``heal_from_replicas`` rebuilds
+the journal from surviving follower copies.
+
+The network is adversarial within the bound: replicate and ack
+messages are at-least-once sets — a ``deliver`` leaves the message in
+flight (so re-delivery IS duplication), an explicit ``drop`` loses
+it, and delivery order is unconstrained (reorder).  The crash budget
+is one node (the acceptance bar's "any single-node SIGKILL").
+
+Invariant: **no acked record is ever lost** — in every reachable
+state, every acked seq is held by the leader's journal (its durable
+set alone while crashed) or by a live follower copy.  The degraded
+fallback is covered by the same invariant: with the quorum demoted
+away, only the leader's fsync can make an ack crash-safe, so skipping
+it (the ``degraded_skip_fsync`` mutation) is caught by the crash
+reachable right after the ack.
+
+Seeded mutations: ``ack_before_quorum`` (the ack no longer waits for
+the Q-of-R vote or the degraded fsync) and ``degraded_skip_fsync``
+(the degraded tier acks without the inline fsync).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Tuple
+
+from ..core import Model, Transition
+
+N_RECORDS = 2
+N_FOLLOWERS = 2
+QUORUM = 1
+
+_REPL = "redqueen_tpu/serving/replication.py"
+
+#: follower bundle indices: (received, demoted, down, repl_in_flight,
+#: acks_in_flight, votes_received_by_leader)
+_F0 = (frozenset(), False, False, frozenset(), frozenset(), frozenset())
+
+#: leader status: 0 healthy, 1 crashed (power loss), 2 healed
+_UP, _DOWN, _HEALED = 0, 1, 2
+
+
+class ReplicationModel(Model):
+    name = "replication"
+    #: the full reachable space drains at depth 21 — 22 keeps the
+    #: clean run `complete` with headroom
+    depth = 22
+    mutations = {
+        "ack_before_quorum":
+            "ack emitted as soon as the record is appended — no Q-of-R "
+            "vote, no degraded fsync",
+        "degraded_skip_fsync":
+            "the degraded tier (voters < Q) acks without the inline "
+            "leader fsync",
+    }
+    transitions = (
+        Transition(
+            "append",
+            "leader appends the next seq and broadcasts to the voters",
+            spans=("serving.journal.append",),
+            sites=(f"{_REPL}::ReplicatedJournal.append",
+                   f"{_REPL}::ReplicatedJournal.append_raw",
+                   f"{_REPL}::ReplicatedJournal._append_body",
+                   f"{_REPL}::ReplicatedJournal._send_blob")),
+        Transition(
+            "fsync",
+            "leader checkpoints the volatile tail to its own disk",
+            spans=("serving.journal.fsync", "serving.sync"),
+            sites=(f"{_REPL}::ReplicatedJournal.sync",)),
+        Transition(
+            "store",
+            "a follower writes the replicated blob to its local "
+            "journal and sends the receipt",
+            spans=("serving.repl.replica.append",),
+            sites=(f"{_REPL}::_follower_serve",)),
+        Transition(
+            "vote",
+            "the leader pumps a follower receipt into the quorum count",
+            sites=(f"{_REPL}::ReplicatedJournal._pump_acks",
+                   f"{_REPL}::ReplicatedJournal._drain_acks")),
+        Transition(
+            "ack",
+            "the client ack leaves: Q-of-R receipts, or the degraded "
+            "inline-fsync fallback when the voters are gone",
+            spans=("serving.ack", "serving.repl.quorum"),
+            sites=(f"{_REPL}::ReplicatedJournal._await_quorum",)),
+        Transition(
+            "demote",
+            "a straggling follower is dropped from the voting set",
+            sites=(f"{_REPL}::ReplicatedJournal._demote_stragglers",
+                   f"{_REPL}::ReplicatedJournal._drop")),
+        Transition(
+            "catchup",
+            "a demoted follower streams a missing record",
+            sites=(f"{_REPL}::ReplicatedJournal._readmit",)),
+        Transition(
+            "readmit",
+            "a caught-up follower rejoins the voting set",
+            sites=(f"{_REPL}::ReplicatedJournal._readmit",)),
+        Transition(
+            "drop_replicate",
+            "the network loses an in-flight replicate message",
+            env=True),
+        Transition(
+            "drop_ack",
+            "the network loses an in-flight receipt",
+            env=True),
+        Transition(
+            "crash_leader",
+            "leader power loss: the volatile tail is cut to the fsync "
+            "watermark, quorum memory is lost",
+            sites=(f"{_REPL}::ReplicatedJournal.power_loss",),
+            env=True),
+        Transition(
+            "heal",
+            "the restarted leader rebuilds its journal from surviving "
+            "follower copies",
+            sites=(f"{_REPL}::heal_from_replicas",)),
+        Transition(
+            "crash_follower",
+            "a follower process dies; its local copy is offline",
+            env=True),
+    )
+
+    def initial(self) -> Any:
+        return (0, frozenset(), frozenset(), frozenset(),
+                (_F0,) * N_FOLLOWERS, _UP, False)
+
+    def canon(self, state: Any) -> Any:
+        # followers are interchangeable: sort their bundles so a
+        # permutation of identical follower states hashes once
+        (next_seq, has, dur, acked, fs, status, crash_used) = state
+        return (next_seq, has, dur, acked, tuple(sorted(fs)), status,
+                crash_used)
+
+    def step(self, state: Any, mutation: Optional[str] = None
+             ) -> Iterator[Tuple[str, str, Any]]:
+        (next_seq, has, dur, acked, fs, status, crash_used) = state
+
+        def with_f(i: int, bundle) -> tuple:
+            out = list(fs)
+            out[i] = bundle
+            return tuple(out)
+
+        up = status != _DOWN
+        if up and next_seq < N_RECORDS:
+            s = next_seq
+            nfs = tuple(
+                (rcv, dem, down, rep | ({s} if not dem and not down
+                                        else frozenset()), ackm, vot)
+                for (rcv, dem, down, rep, ackm, vot) in fs)
+            n_cast = sum(1 for (_r, dem, down, *_x) in fs
+                         if not dem and not down)
+            yield ("append",
+                   f"seq {s} appended, replicate sent to {n_cast} "
+                   f"voter(s)",
+                   (next_seq + 1, has | {s}, dur, acked, nfs, status,
+                    crash_used))
+        if up and dur != has:
+            yield ("fsync",
+                   f"leader fsync -> durable {sorted(has)}",
+                   (next_seq, has, has, acked, fs, status, crash_used))
+        for i, (rcv, dem, down, rep, ackm, vot) in enumerate(fs):
+            if down:
+                continue
+            for s in sorted(rep):
+                if s in rcv and s in ackm:
+                    continue  # redundant redelivery: no state change
+                yield ("store",
+                       f"follower {i} stores seq {s}, receipt in "
+                       f"flight",
+                       (next_seq, has, dur, acked,
+                        with_f(i, (rcv | {s}, dem, down, rep,
+                                   ackm | {s}, vot)),
+                        status, crash_used))
+            if up:
+                for s in sorted(ackm - vot):
+                    yield ("vote",
+                           f"leader counts follower {i}'s receipt for "
+                           f"seq {s}",
+                           (next_seq, has, dur, acked,
+                            with_f(i, (rcv, dem, down, rep, ackm,
+                                       vot | {s})),
+                            status, crash_used))
+            for s in sorted(rep):
+                yield ("drop_replicate",
+                       f"replicate(seq {s} -> follower {i}) lost",
+                       (next_seq, has, dur, acked,
+                        with_f(i, (rcv, dem, down, rep - {s}, ackm,
+                                   vot)),
+                        status, crash_used))
+            for s in sorted(ackm):
+                yield ("drop_ack",
+                       f"receipt(seq {s} <- follower {i}) lost",
+                       (next_seq, has, dur, acked,
+                        with_f(i, (rcv, dem, down, rep, ackm - {s},
+                                   vot)),
+                        status, crash_used))
+        if up:
+            voters = [i for i, (_r, dem, down, *_x) in enumerate(fs)
+                      if not dem and not down]
+            for s in sorted(has - acked):
+                if mutation == "ack_before_quorum":
+                    basis = "MUTATED: no quorum vote awaited"
+                elif len(voters) >= QUORUM:
+                    n_votes = sum(1 for i in voters if s in fs[i][5])
+                    if n_votes < QUORUM:
+                        continue
+                    basis = f"{n_votes}/{len(voters)} voter receipts"
+                else:
+                    if mutation == "degraded_skip_fsync":
+                        basis = ("degraded tier, MUTATED: inline fsync "
+                                 "skipped")
+                    elif s in dur:
+                        basis = "degraded tier, inline leader fsync"
+                    else:
+                        continue
+                yield ("ack", f"seq {s} acked ({basis})",
+                       (next_seq, has, dur, acked | {s}, fs, status,
+                        crash_used))
+        for i, (rcv, dem, down, rep, ackm, vot) in enumerate(fs):
+            if down:
+                continue
+            if up and not dem and rep:
+                # a straggler (outstanding replicate) missing the ack
+                # deadline: the link stops voting, its stream resets
+                yield ("demote",
+                       f"follower {i} demoted (straggler)",
+                       (next_seq, has, dur, acked,
+                        with_f(i, (rcv, True, down, frozenset(),
+                                   frozenset(), vot)),
+                        status, crash_used))
+            if up and dem:
+                missing = sorted(has - rcv)
+                if missing:
+                    s = missing[0]
+                    yield ("catchup",
+                           f"demoted follower {i} streams seq {s}",
+                           (next_seq, has, dur, acked,
+                            with_f(i, (rcv | {s}, dem, down, rep,
+                                       ackm, vot)),
+                            status, crash_used))
+                elif has <= rcv:
+                    yield ("readmit",
+                           f"follower {i} readmitted to the quorum",
+                           (next_seq, has, dur, acked,
+                            with_f(i, (rcv, False, down, rep, ackm,
+                                       vot)),
+                            status, crash_used))
+        if not crash_used:
+            if status == _UP:
+                nfs = tuple((rcv, dem, down, rep, ackm, frozenset())
+                            for (rcv, dem, down, rep, ackm, _v) in fs)
+                yield ("crash_leader",
+                       "leader power loss: volatile tail cut to the "
+                       "fsync watermark",
+                       (next_seq, dur, dur, acked, nfs, _DOWN, True))
+            for i, (rcv, dem, down, rep, ackm, vot) in enumerate(fs):
+                if not down:
+                    yield ("crash_follower",
+                           f"follower {i} SIGKILLed (copy offline)",
+                           (next_seq, has, dur, acked,
+                            with_f(i, (rcv, dem, True, frozenset(),
+                                       frozenset(), vot)),
+                            status, True))
+        if status == _DOWN:
+            copies = frozenset().union(
+                *(rcv for (rcv, _d, down, *_x) in fs if not down),
+                frozenset())
+            healed = dur | copies
+            yield ("heal",
+                   f"leader healed from replicas -> {sorted(healed)}",
+                   (next_seq, healed, healed, acked, fs, _HEALED,
+                    True))
+
+    def invariant(self, state: Any) -> Optional[str]:
+        (next_seq, has, dur, acked, fs, status, _crash_used) = state
+        holders = dur if status == _DOWN else has
+        live_copies = frozenset().union(
+            *(rcv for (rcv, _d, down, *_x) in fs if not down),
+            frozenset())
+        for s in sorted(acked):
+            if s not in holders and s not in live_copies:
+                where = ("leader durable set" if status == _DOWN
+                         else "leader journal")
+                return (f"acked seq {s} has no surviving copy: not in "
+                        f"the {where} and on no live follower — an "
+                        f"acked record is LOST")
+        return None

@@ -1,0 +1,278 @@
+"""The two-phase reshard fence/flip model (PR 18's protocol).
+
+Mirrors ``serving/topology.py`` + the install half of
+``serving/service.py``: ``Migration.step`` walks the planned ranges
+in order — drain the source, extract + digest the carry, journal the
+**fence** record (the source refuses the range from here on), assert
+the fence (``TopologyState.assert_fenced``), **install** the range
+into the destination (digest re-asserted, epoch record journaled +
+synced before the in-memory swap: ``ServingRuntime.install_range``),
+snapshot, journal the **flip** record (the destination owns the range
+from here on), and after the last range ``_complete`` journals the
+complete/retire records.  Every record is durable when written (the
+topology log syncs per record); a SIGKILL anywhere resumes by
+replaying the log through the checksum-verifying reader
+(``read_topology_log``) and continuing from the last fenced,
+un-flipped range — never from scratch, never past the fence.
+
+Client traffic rides the migration: a submit for a range lands at the
+source before the fence, is **refused** (status "fenced", counted,
+never acked, never in the ledgers) between fence and flip, and lands
+at the destination after the flip.
+
+Invariants: **no range is ever owned by two shards** (source and
+destination never both accept the same range), **fenced traffic is
+refused, not dropped** (per-range accounting identity: submitted ==
+accepted + refused in every state), and **any SIGKILL resumes from
+the last fenced range** (whenever the process is up, the in-memory
+phase of every range equals what the durable log derives — the fence
+is honored across the crash).
+
+Seeded mutations: ``flip_before_fence`` (install/flip proceed without
+the fence record, so the un-fenced source keeps accepting after the
+destination takes over — double ownership), ``drop_fenced`` (the
+fenced window discards instead of refusing — the accounting identity
+breaks), and ``resume_forgets_fence`` (recovery rebuilds every range
+as idle, un-fencing a journaled fence).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Tuple
+
+from ..core import Model, Transition
+
+N_RANGES = 2
+#: submits the bound admits per range (one to probe the fenced
+#: window, one to land after the flip)
+SUBMIT_CAP = 2
+
+#: per-range phase, derived from the durable log on resume
+_IDLE, _FENCED, _INSTALLED, _FLIPPED = 0, 1, 2, 3
+
+_TOPO = "redqueen_tpu/serving/topology.py"
+_SVC = "redqueen_tpu/serving/service.py"
+
+
+def _derived_phase(log_r: frozenset) -> int:
+    if "flip" in log_r:
+        return _FLIPPED
+    if "install" in log_r:
+        return _INSTALLED
+    if "fence" in log_r:
+        return _FENCED
+    return _IDLE
+
+
+class TopologyModel(Model):
+    name = "topology"
+    #: the full reachable space drains at depth 13 — 16 keeps the
+    #: clean run `complete` with headroom
+    depth = 16
+    mutations = {
+        "flip_before_fence":
+            "install/flip proceed without the fence record — the "
+            "un-fenced source keeps accepting a range the "
+            "destination now owns",
+        "drop_fenced":
+            "the fenced window discards submits instead of refusing "
+            "them — traffic silently vanishes from the accounting",
+        "resume_forgets_fence":
+            "crash recovery rebuilds every range as idle — a "
+            "journaled fence is forgotten and the source re-accepts",
+    }
+    transitions = (
+        Transition(
+            "fence",
+            "drain + extract + digest, then journal the fence record: "
+            "the source refuses the range from here on",
+            sites=(f"{_TOPO}::Migration.step",
+                   f"{_TOPO}::Migration._drain",
+                   f"{_TOPO}::TopologyLog.append",
+                   f"{_TOPO}::range_digest",
+                   f"{_SVC}::ServingRuntime.extract_range")),
+        Transition(
+            "install",
+            "assert the fence, then install the range into the "
+            "destination (digest re-asserted, epoch record journaled "
+            "+ synced before the swap)",
+            spans=("serving.topo.assert", "serving.topo.install_range",
+                   "serving.journal.append", "serving.journal.fsync"),
+            sites=(f"{_TOPO}::Migration.step",
+                   f"{_TOPO}::TopologyState.assert_fenced",
+                   f"{_TOPO}::TopologyState.assert_owner",
+                   f"{_SVC}::ServingRuntime.install_range",
+                   f"{_SVC}::ServingRuntime.install_carry")),
+        Transition(
+            "flip",
+            "journal the flip record: the destination owns the range",
+            sites=(f"{_TOPO}::Migration.step",
+                   f"{_TOPO}::TopologyState.note_epoch")),
+        Transition(
+            "retire",
+            "all ranges flipped: journal the complete + retire "
+            "records",
+            sites=(f"{_TOPO}::Migration._complete",)),
+        Transition(
+            "submit",
+            "a client submit for the range: accepted by the owner, "
+            "or refused (status fenced, counted) in the fenced "
+            "window",
+            env=True),
+        Transition(
+            "crash",
+            "SIGKILL: the in-memory topology is gone; the durable "
+            "log survives",
+            env=True),
+        Transition(
+            "resume",
+            "replay the topology log through the verifying reader "
+            "and continue from the last fenced, un-flipped range",
+            spans=("serving.topo.log.verify",),
+            sites=(f"{_TOPO}::read_topology_log",
+                   f"{_TOPO}::_read_topology_log",
+                   f"{_TOPO}::tear_topology_tail",
+                   f"{_TOPO}::Migration.run")),
+    )
+
+    def initial(self) -> Any:
+        # (phases, log, retired, src_acc, dst_acc, traffic, down,
+        #  crash_used) — traffic is (submitted, refused, accepted)
+        # per range
+        return ((_IDLE,) * N_RANGES,
+                (frozenset(),) * N_RANGES,
+                False,
+                (True,) * N_RANGES,
+                (False,) * N_RANGES,
+                ((0, 0, 0),) * N_RANGES,
+                False, False)
+
+    def step(self, state: Any, mutation: Optional[str] = None
+             ) -> Iterator[Tuple[str, str, Any]]:
+        (phases, log, retired, src, dst, traffic, down,
+         crash_used) = state
+
+        def rep(seq, i, v):
+            out = list(seq)
+            out[i] = v
+            return tuple(out)
+
+        up = not down
+        # ranges hand off in plan order: the migration cursor is the
+        # first un-flipped range and only it moves
+        cursor = next((r for r in range(N_RANGES)
+                       if phases[r] != _FLIPPED), None)
+        if up and cursor is not None:
+            r = cursor
+            if phases[r] == _IDLE and mutation != "flip_before_fence":
+                yield ("fence",
+                       f"range {r} fenced (source refuses it)",
+                       (rep(phases, r, _FENCED),
+                        rep(log, r, log[r] | {"fence"}),
+                        retired, rep(src, r, False), dst, traffic,
+                        down, crash_used))
+            want = (_IDLE if mutation == "flip_before_fence"
+                    else _FENCED)
+            if phases[r] == want:
+                detail = (f"MUTATED: range {r} installed with no "
+                          f"fence record"
+                          if mutation == "flip_before_fence"
+                          else f"range {r} installed into the "
+                               f"destination (fence asserted)")
+                yield ("install", detail,
+                       (rep(phases, r, _INSTALLED),
+                        rep(log, r, log[r] | {"install"}),
+                        retired, src, dst, traffic, down, crash_used))
+            if phases[r] == _INSTALLED:
+                yield ("flip",
+                       f"range {r} flipped: destination owns it",
+                       (rep(phases, r, _FLIPPED),
+                        rep(log, r, log[r] | {"flip"}),
+                        retired, rep(src, r, False),
+                        rep(dst, r, True), traffic, down, crash_used))
+        if up and not retired and all(p == _FLIPPED for p in phases):
+            yield ("retire",
+                   "complete + retire records journaled",
+                   (phases, log, True, src, dst, traffic, down,
+                    crash_used))
+        if up:
+            for r in range(N_RANGES):
+                sub, refused, acc = traffic[r]
+                if sub >= SUBMIT_CAP:
+                    continue
+                if src[r] or dst[r]:
+                    owner = "source" if src[r] else "destination"
+                    yield ("submit",
+                           f"submit(range {r}) accepted by the "
+                           f"{owner}",
+                           (phases, log, retired, src, dst,
+                            rep(traffic, r, (sub + 1, refused,
+                                             acc + 1)),
+                            down, crash_used))
+                elif mutation == "drop_fenced":
+                    yield ("submit",
+                           f"MUTATED: submit(range {r}) silently "
+                           f"dropped in the fenced window",
+                           (phases, log, retired, src, dst,
+                            rep(traffic, r, (sub + 1, refused, acc)),
+                            down, crash_used))
+                else:
+                    yield ("submit",
+                           f"submit(range {r}) refused "
+                           f"(status fenced, counted)",
+                           (phases, log, retired, src, dst,
+                            rep(traffic, r, (sub + 1, refused + 1,
+                                             acc)),
+                            down, crash_used))
+        if up and not crash_used:
+            yield ("crash",
+                   "SIGKILL mid-migration (durable log survives)",
+                   (phases, log, retired, src, dst, traffic, True,
+                    True))
+        if down:
+            if mutation == "resume_forgets_fence":
+                yield ("resume",
+                       "MUTATED: recovery rebuilds every range as "
+                       "idle, forgetting the journaled fences",
+                       ((_IDLE,) * N_RANGES, log, retired,
+                        (True,) * N_RANGES, (False,) * N_RANGES,
+                        traffic, False, crash_used))
+            else:
+                nphases = tuple(_derived_phase(lr) for lr in log)
+                nsrc = tuple("fence" not in lr for lr in log)
+                ndst = tuple("flip" in lr for lr in log)
+                cursor = next(
+                    (r for r in range(N_RANGES)
+                     if nphases[r] != _FLIPPED), N_RANGES)
+                yield ("resume",
+                       f"log replayed: resume at range {cursor}, "
+                       f"fences honored",
+                       (nphases, log, retired, nsrc, ndst, traffic,
+                        False, crash_used))
+
+    def invariant(self, state: Any) -> Optional[str]:
+        (phases, log, _retired, src, dst, traffic, down,
+         _crash_used) = state
+        for r in range(N_RANGES):
+            if src[r] and dst[r]:
+                return (f"range {r} is owned by two shards: the "
+                        f"source and the destination both accept it")
+            sub, refused, acc = traffic[r]
+            if sub != refused + acc:
+                return (f"range {r} accounting broke: {sub} "
+                        f"submitted != {refused} refused + {acc} "
+                        f"accepted — fenced traffic was dropped, not "
+                        f"refused")
+        if not down:
+            for r in range(N_RANGES):
+                want = _derived_phase(log[r])
+                if phases[r] != want:
+                    return (f"range {r} phase {phases[r]} disagrees "
+                            f"with its durable log (expects {want}) "
+                            f"— recovery did not resume from the "
+                            f"last fenced range")
+                if phases[r] in (_FENCED, _INSTALLED) and src[r]:
+                    return (f"range {r} is fenced but the source "
+                            f"still accepts it — the fence is not "
+                            f"honored")
+        return None

@@ -1,0 +1,46 @@
+"""Counterexample and result rendering, in the rqtrace house style:
+``-- section --`` headers and aligned fixed-width columns."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .core import CheckResult
+
+
+def render_counterexample(result: CheckResult) -> str:
+    """The minimal violating trace as an rqtrace-style table."""
+    v = result.violation
+    if v is None:
+        raise ValueError(f"{result.model}: no violation to render")
+    mut = (f", mutation={result.mutation}" if result.mutation
+           else "")
+    lines: List[str] = [f"-- counterexample ({result.model}{mut}) --"]
+    lines.append(f"{'#':>3}  {'transition':<16} detail")
+    for i, (name, detail) in enumerate(v.trace, 1):
+        lines.append(f"{i:>3}  {name:<16} {detail}")
+    if not v.trace:
+        lines.append(f"{'-':>3}  {'(initial)':<16} "
+                     f"the initial state itself violates")
+    lines.append(f"INVARIANT VIOLATED: {v.message}")
+    return "\n".join(lines)
+
+
+def render_summary(results: List[CheckResult]) -> str:
+    """One aligned row per (model, mutation) run."""
+    lines = ["-- rqcheck --",
+             f"{'model':<14} {'mutation':<24} {'states':>8} "
+             f"{'depth':>7} {'complete':>8}  verdict"]
+    for r in results:
+        mut = r.mutation or "-"
+        depth = f"{r.depth_reached}/{r.depth_bound}"
+        comp = "yes" if r.complete else "no"
+        if r.mutation is None:
+            verdict = ("ok" if r.ok
+                       else f"VIOLATION: {r.violation.message}")
+        else:
+            verdict = (f"killed (trace {len(r.violation.trace)})"
+                       if not r.ok else "NOT KILLED")
+        lines.append(f"{r.model:<14} {mut:<24} {r.states:>8} "
+                     f"{depth:>7} {comp:>8}  {verdict}")
+    return "\n".join(lines)

@@ -8,7 +8,12 @@ every input the file's findings can depend on:
 
 - the rqlint version and the band signature (the sorted IDs of the
   selected rules — a ``--select RQ5`` cache entry must never answer a
-  full-registry run);
+  full-registry run — plus the content shas of the declarative spec
+  modules the rules are GENERATED from: ``tools/rqlint/protocols/*.py``
+  and the ``tools/rqcheck/models/*.py`` protocol models the RQ14xx
+  band checks against.  Editing a spec changes verdicts without
+  touching any scanned file's source, so the spec bytes are an
+  analysis input like any other);
 - the file's own source sha;
 - in project mode, the shas of the file's TRANSITIVE import
   neighborhood — forward (modules it imports: their summaries feed its
@@ -58,6 +63,40 @@ def _sha(data: bytes) -> str:
 def source_shas(sources: Dict[str, str]) -> Dict[str, str]:
     return {rel: _sha(src.encode("utf-8"))
             for rel, src in sources.items()}
+
+
+#: directories whose *.py contents are verdict inputs for the
+#: spec-generated rule bands, relative to the installed code (NOT the
+#: scan root: ``--root`` may point anywhere, the specs ship with the
+#: linter).  Module-level so tests can monkeypatch the lookup.
+_SPEC_DIRS = (
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "protocols"),
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "rqcheck", "models"),
+)
+
+
+def spec_signature() -> str:
+    """sha over the bytes of every declarative spec module the rule
+    registry is generated from (protocol specs + rqcheck protocol
+    models); folded into the band signature so editing a spec
+    invalidates every warm cache entry."""
+    h = hashlib.sha256()
+    for d in _SPEC_DIRS:
+        try:
+            names = sorted(n for n in os.listdir(d)
+                           if n.endswith(".py"))
+        except OSError:
+            continue
+        for n in names:
+            h.update(n.encode("utf-8"))
+            try:
+                with open(os.path.join(d, n), "rb") as f:
+                    h.update(_sha(f.read()).encode("utf-8"))
+            except OSError:
+                h.update(b"<unreadable>")
+    return h.hexdigest()
 
 
 def _closure(rel: str, view, rel_by_mod: Dict[str, str],
@@ -140,7 +179,8 @@ def file_key(rel: str, shas: Dict[str, str], view, rel_by_mod,
 def compute_keys(report: Sequence[str], sources: Dict[str, str],
                  view, rules, version: str) -> Dict[str, str]:
     shas = source_shas(sources)
-    band_sig = ",".join(sorted(r.id for r in rules))
+    band_sig = (",".join(sorted(r.id for r in rules))
+                + "|" + spec_signature())
     fingerprint = global_fingerprint(view, rules)
     rel_by_mod = {}
     neighbors: Dict[str, Set[str]] = {}

@@ -43,6 +43,7 @@ SCAN_GLOBS = (
     "*.py",
     os.path.join("tools", "*.py"),
     os.path.join("tools", "rqlint", "**", "*.py"),
+    os.path.join("tools", "rqcheck", "**", "*.py"),
     os.path.join("benchmarks", "*.py"),
     os.path.join("experiments", "*.py"),
     os.path.join("redqueen_tpu", "**", "*.py"),

@@ -100,6 +100,10 @@ class ProtocolSpec:
     scope: Tuple[str, ...] = ("redqueen_tpu/serving/*.py",)
     allow_functions: FrozenSet[str] = frozenset()
     message: Optional[Callable[[str, str, Pos, Optional[Pos]], str]] = None
+    #: analysis tier of the generated rule (reporting metadata): the
+    #: ported RQ1005-1007 stay tier 1, the spec-native RQ13xx band is
+    #: tier 4
+    tier: int = 1
 
     def __post_init__(self) -> None:
         if self.mode not in MODES:

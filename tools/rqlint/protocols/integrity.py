@@ -70,6 +70,7 @@ def is_raw_log_read(call: ast.Call) -> bool:
 
 SPEC_RQ1301 = ProtocolSpec(
     rule_id="RQ1301",
+    tier=4,
     name="unverified-protocol-log-read",
     description=("topology.log / params_log read raw (open/json.load) "
                  "instead of through the checksum-verifying reader — "
@@ -90,6 +91,7 @@ SPEC_RQ1301 = ProtocolSpec(
 
 SPEC_RQ1302 = ProtocolSpec(
     rule_id="RQ1302",
+    tier=4,
     name="swap-before-epoch-journal",
     description=("live parameter slots swapped in-memory before the "
                  "epoch record's durability point — a crash in the gap "

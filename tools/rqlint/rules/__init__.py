@@ -10,7 +10,10 @@ edge-state installs (RQ1005-1007, tier-1, spec-generated — see
 RQ12xx replay determinism (tier-4, project-only — nondeterminism
 sources reachable from recover/replay/digest entry points), RQ13xx
 declarative protocol-ordering specs (tier-4, tier-1-capable —
-``tools/rqlint/protocols/``).
+``tools/rqlint/protocols/``), RQ14xx model/code mapping (tier-5 —
+protocol-mutation sites vs the ``tools/rqcheck`` model transitions;
+RQ1401 spec drift is tier-1-capable, RQ1402 dead spec is
+project-only).
 RQ000 (unparseable file), RQ998 (unused suppression pragma) and RQ999
 (crashed rule) are emitted by the engine itself, not by rules.
 Tier-2/3 rules carry ``needs_project`` and are skipped under
@@ -33,6 +36,7 @@ from .concurrency import (FdLeakRule, LockOrderCycleRule,
 from .hostsync import HiddenSyncRule, HotLoopTransferRule
 from .mesh import (AxisUnboundCollectiveRule, DonationAfterUseRule,
                    ShardMapSpecArityRule)
+from .modelmap import MODELMAP_RULES
 from .numerics import RawNumericsRule
 from .prng import ConstantSeedRule, KeyReuseRule
 from .protocol import PROTOCOL_RULES
@@ -68,7 +72,7 @@ REGISTRY = (
     UnseededRngRule,
     UnsortedFsEnumerationRule,
     SetIterationOrderRule,
-) + PROTOCOL_RULES
+) + PROTOCOL_RULES + MODELMAP_RULES
 
 
 def all_rules() -> List[Rule]:

@@ -89,6 +89,10 @@ class Rule:
     #: skips them under ``--no-project`` (which therefore reproduces the
     #: tier-1 rule set exactly).
     needs_project: bool = False
+    #: which analysis tier the rule belongs to (1 single-file … 5
+    #: protocol model checking) — reporting metadata (SARIF
+    #: ``properties.tier``), orthogonal to ``needs_project``.
+    tier: int = 1
 
     def applies_to(self, relpath: str) -> bool:
         relpath = relpath.replace("\\", "/")
@@ -101,6 +105,7 @@ class Rule:
         return {"id": self.id, "name": self.name,
                 "severity": self.severity, "paths": list(self.paths),
                 "needs_project": self.needs_project,
+                "tier": self.tier,
                 "description": self.description}
 
 

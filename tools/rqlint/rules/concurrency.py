@@ -256,6 +256,7 @@ def _exempt_attrs(cls: ast.ClassDef) -> Set[str]:
 
 class UnguardedSharedStateRule(Rule):
     id = "RQ1001"
+    tier = 3
     name = "unguarded-shared-state"
     description = ("attribute written under the class lock in one "
                    "method but read/written with no lock in another, "
@@ -443,6 +444,7 @@ def _cyclic_lock_pairs(view) -> Set[Tuple[str, str]]:
 
 class LockOrderCycleRule(Rule):
     id = "RQ1002"
+    tier = 3
     name = "lock-order-cycle"
     description = ("two locks acquired in opposite orders somewhere in "
                    "the module graph (held->acquired edges follow call "
@@ -511,6 +513,7 @@ def _chains_in(node: ast.AST, tail: str) -> List[Tuple[str, ...]]:
 
 class UnstoppableThreadRule(Rule):
     id = "RQ1003"
+    tier = 3
     name = "unstoppable-daemon-thread"
     description = ("a daemon thread is started but nothing can stop it "
                    "— no join path and no stop-Event its target waits "
@@ -664,6 +667,7 @@ def _closes(block: Iterable[ast.stmt], name: str) -> bool:
 
 class FdLeakRule(Rule):
     id = "RQ1004"
+    tier = 3
     name = "fd-leak-on-exception"
     description = ("a locally-created socket/fd is used by calls that "
                    "can raise with no enclosing try that closes it — "

@@ -310,6 +310,7 @@ class _HostScan:
 
 class HiddenSyncRule(Rule):
     id = "RQ701"
+    tier = 2
     name = "hidden-host-sync"
     description = ("float()/int()/.item()/.tolist()/np.* on a value that "
                    "summaries prove flows from dispatched computation — "
@@ -324,6 +325,7 @@ class HiddenSyncRule(Rule):
 
 class HotLoopTransferRule(Rule):
     id = "RQ702"
+    tier = 2
     name = "transfer-in-hot-loop"
     description = ("device->host transfer executed per-iteration of a "
                    "Python loop (or element-wise iteration of a device "

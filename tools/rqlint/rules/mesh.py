@@ -198,6 +198,7 @@ def _locally_wrapped_names(fn: ast.AST) -> Set[str]:
 
 class AxisUnboundCollectiveRule(Rule):
     id = "RQ1101"
+    tier = 3
     name = "unbound-collective-axis"
     description = ("raw lax collective names an axis nothing provably "
                    "binds (no shard_map/pmap wrapping path, no "
@@ -335,6 +336,7 @@ def _local_donating_handles(scope: ast.AST) -> Dict[str, Set[int]]:
 
 class DonationAfterUseRule(Rule):
     id = "RQ1102"
+    tier = 3
     name = "donation-after-use"
     description = ("argument passed at a donate_argnums position and "
                    "read afterwards — the donated buffer is dead; on "
@@ -512,6 +514,7 @@ class DonationAfterUseRule(Rule):
 
 class ShardMapSpecArityRule(Rule):
     id = "RQ1103"
+    tier = 3
     name = "shard-map-spec-arity"
     description = ("literal in_specs/out_specs tuple whose arity "
                    "disagrees with the wrapped function's signature / "

@@ -1,0 +1,235 @@
+"""RQ14xx — the model/code mapping band (tier-5).
+
+``tools/rqcheck`` proves invariants about *models* of the shipped
+protocols; the proofs are only worth the JSON they're written in if
+the models track the code.  This band pins the mapping from the
+static side (the trace-conformance pass pins it from the runtime
+side):
+
+RQ1401 — **spec drift**: a function in a protocol module performs a
+protocol mutation (durability call, ack emission, live-param slot
+assignment, edge-state install, journal-tail truncation, protocol
+artifact write) but no rqcheck model transition claims the site.  The
+checker is proving invariants about a machine that no longer includes
+this code path.
+
+RQ1402 — **dead spec**: a model transition that is supposed to mirror
+code (``env=False``) declares no code site at all, or names a site
+that does not exist in the tree (the function was renamed or removed
+and the model kept checking the ghost).
+
+The effect matchers are the same ones the RQ10xx/RQ13xx protocol
+specs use (``tools/rqlint/protocols/durability.py``), so "protocol
+mutation" means the same thing to the model checker and to the
+ordering rules.  Model loading is lazy and cached; rqcheck is
+stdlib-only, so importing it keeps rqlint runnable with no jax on the
+machine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..astutil import chain_tail
+from ..findings import Finding, finding_at
+from ..protocols.durability import (EDGE_INSTALL_TAILS,
+                                    LIVE_PARAM_ATTRS, is_ack_emission,
+                                    is_durability_call)
+from .base import FileContext, Rule
+
+#: call tails that cut a durable journal tail (power-loss modeling /
+#: torn-record repair) — a protocol mutation the models must own
+_TRUNCATE_TAILS = frozenset({"truncate", "ftruncate"})
+
+#: call tails that land a protocol artifact (candidate params hand-off)
+_ARTIFACT_TAILS = frozenset({"write_json"})
+
+
+def _effects_in(fn: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """Every protocol-mutation effect in ``fn``: (label, node)."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            tail = chain_tail(node.func)
+            if is_durability_call(node):
+                out.append(("durability point", node))
+            if is_ack_emission(node):
+                out.append(("ack emission", node))
+            if tail in EDGE_INSTALL_TAILS:
+                out.append(("edge-state install", node))
+            if tail in _TRUNCATE_TAILS:
+                out.append(("journal-tail truncation", node))
+            if tail in _ARTIFACT_TAILS:
+                out.append(("protocol artifact write", node))
+        elif isinstance(node, (ast.Assign, ast.AugAssign,
+                               ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and tgt.attr in LIVE_PARAM_ATTRS):
+                    out.append(("live param slot assignment", node))
+    return out
+
+
+_MODEL_SITES: Optional[Dict[str, Set[str]]] = None
+_MODEL_RELPATHS: Optional[Dict[str, object]] = None
+
+
+def _load_models():
+    """The rqcheck model classes, via the package-relative import
+    (both run as ``tools.*``) with a path-based fallback for direct
+    script invocations."""
+    try:
+        from ...rqcheck.models import MODEL_CLASSES
+        return MODEL_CLASSES
+    except ImportError:
+        import importlib.util
+        import os
+        import sys
+
+        tools_dir = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        spec = importlib.util.find_spec("tools.rqcheck.models")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.MODEL_CLASSES
+
+
+def model_sites() -> Dict[str, Set[str]]:
+    """relpath -> set of qualnames claimed by ANY model transition
+    (env transitions included: power_loss etc. anchor env actions)."""
+    global _MODEL_SITES
+    if _MODEL_SITES is None:
+        sites: Dict[str, Set[str]] = {}
+        for cls in _load_models():
+            for t in cls.transitions:
+                for site in t.sites:
+                    rel, _, qual = site.partition("::")
+                    sites.setdefault(rel, set()).add(qual)
+        _MODEL_SITES = sites
+    return _MODEL_SITES
+
+
+def _models_by_relpath() -> Dict[str, object]:
+    global _MODEL_RELPATHS
+    if _MODEL_RELPATHS is None:
+        out = {}
+        for cls in _load_models():
+            rel = cls.__module__.replace(".", "/") + ".py"
+            out[rel.split("/")[-1]] = cls
+        _MODEL_RELPATHS = out
+    return _MODEL_RELPATHS
+
+
+def _toplevel_functions(tree: ast.AST):
+    """(qualname, node) with ModuleInfo's one-level convention:
+    ``func`` / ``Class.method``.  Effects inside nested defs attribute
+    to the enclosing top-level function (sites are declared at that
+    granularity)."""
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield stmt.name, stmt
+        elif isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield f"{stmt.name}.{sub.name}", sub
+
+
+class ModelSpecDriftRule(Rule):
+    id = "RQ1401"
+    name = "model-spec-drift"
+    description = ("protocol-mutation site (durability / ack / param "
+                   "install / edge install / tail truncation / "
+                   "artifact write) not claimed by any rqcheck model "
+                   "transition — the checked spec has drifted from "
+                   "the code")
+    tier = 5
+    paths = ("redqueen_tpu/serving/replication.py",
+             "redqueen_tpu/serving/paramswap.py",
+             "redqueen_tpu/serving/topology.py")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        claimed = model_sites().get(ctx.relpath, set())
+        for qual, fn in _toplevel_functions(ctx.tree):
+            if qual in claimed:
+                continue
+            effects = _effects_in(fn)
+            if not effects:
+                continue
+            labels = sorted({label for label, _n in effects})
+            label, node = effects[0]
+            yield finding_at(
+                self.id, ctx, node,
+                f"{qual}() performs a protocol mutation "
+                f"({', '.join(labels)}) but no rqcheck model "
+                f"transition claims the site "
+                f"{ctx.relpath}::{qual} — add it to a transition in "
+                f"tools/rqcheck/models/ (or move the effect behind a "
+                f"claimed site) so the model checker keeps proving "
+                f"invariants about the code that actually runs")
+
+
+class DeadSpecRule(Rule):
+    id = "RQ1402"
+    name = "dead-spec-transition"
+    description = ("rqcheck model transition mirrors no code: "
+                   "env=False with zero declared sites, or a declared "
+                   "site that does not exist in the tree")
+    tier = 5
+    paths = ("tools/rqcheck/models/*.py",)
+    needs_project = True
+
+    def _anchor(self, ctx: FileContext, tname: str) -> ast.AST:
+        """The Transition("<tname>", ...) call node, for a precise
+        finding span; the module node as a last resort."""
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and chain_tail(node.func) == "Transition"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == tname):
+                return node
+        return ctx.tree
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        cls = _models_by_relpath().get(ctx.relpath.split("/")[-1])
+        if cls is None or not ctx.relpath.startswith("tools/rqcheck/"):
+            return
+        for t in cls.transitions:
+            if t.env:
+                continue
+            if not t.sites:
+                yield finding_at(
+                    self.id, ctx, self._anchor(ctx, t.name),
+                    f"model {cls.name!r} transition {t.name!r} is "
+                    f"env=False but declares no code site — a spec "
+                    f"the code cannot drift from is a spec nobody "
+                    f"checks; anchor it with sites entries or "
+                    f"mark it env=True")
+                continue
+            for site in t.sites:
+                rel, _, qual = site.partition("::")
+                mod = ctx.project.by_relpath.get(rel)
+                if mod is None:
+                    yield finding_at(
+                        self.id, ctx, self._anchor(ctx, t.name),
+                        f"model {cls.name!r} transition {t.name!r} "
+                        f"claims site {site} but {rel} is not in the "
+                        f"scanned tree — the spec anchors to a ghost "
+                        f"module")
+                elif qual not in mod.defs:
+                    yield finding_at(
+                        self.id, ctx, self._anchor(ctx, t.name),
+                        f"model {cls.name!r} transition {t.name!r} "
+                        f"claims site {site} but {rel} defines no "
+                        f"{qual!r} — the function was renamed or "
+                        f"removed and the model kept checking the "
+                        f"ghost")
+
+
+MODELMAP_RULES = (ModelSpecDriftRule, DeadSpecRule)

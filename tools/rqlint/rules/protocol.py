@@ -24,6 +24,7 @@ def rule_for_spec(spec: ProtocolSpec) -> type:
         name = spec.name
         description = spec.description
         paths = tuple(spec.scope)
+        tier = spec.tier
         protocol_spec = spec
 
         def check(self, ctx):

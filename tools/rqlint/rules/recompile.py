@@ -123,6 +123,7 @@ def _shape_keyed(e: ast.AST) -> bool:
 
 class RecompilationHazardRule(Rule):
     id = "RQ801"
+    tier = 2
     name = "jit-recompilation-hazard"
     description = ("static jit args that vary per call (unhashable "
                    "defaults/literals, loop-varying static args) or "
@@ -257,6 +258,7 @@ class RecompilationHazardRule(Rule):
 
 class WeakTypeWideningRule(Rule):
     id = "RQ802"
+    tier = 2
     name = "strong-typed-constant-under-jit"
     description = ("np/jnp array constant with no explicit dtype "
                    "combined with a traced value under jit — widens the "

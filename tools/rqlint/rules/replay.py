@@ -180,6 +180,7 @@ def _band_matcher(view):
 
 class WallClockInReplayRule(_ReplayRule):
     id = "RQ1201"
+    tier = 4
     name = "wall-clock-in-replay"
     description = ("wall-clock read (time.time/monotonic/datetime.now) "
                    "reachable from a recover/replay/digest entry point "
@@ -191,6 +192,7 @@ class WallClockInReplayRule(_ReplayRule):
 
 class UnseededRngRule(_ReplayRule):
     id = "RQ1202"
+    tier = 4
     name = "unseeded-rng-in-replay"
     description = ("unseeded RNG (random.* / np.random globals / "
                    "default_rng() / uuid4) reachable from a replay "
@@ -202,6 +204,7 @@ class UnseededRngRule(_ReplayRule):
 
 class UnsortedFsEnumerationRule(_ReplayRule):
     id = "RQ1203"
+    tier = 4
     name = "unsorted-fs-enumeration-in-replay"
     description = ("os.listdir/glob/scandir without sorted() on a "
                    "replay path — directory order is "
@@ -213,6 +216,7 @@ class UnsortedFsEnumerationRule(_ReplayRule):
 
 class SetIterationOrderRule(_ReplayRule):
     id = "RQ1204"
+    tier = 4
     name = "set-iteration-order-in-replay"
     description = ("iteration over a set on a replay path — set order "
                    "varies with the per-process hash seed; sort it (or "

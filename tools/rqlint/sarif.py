@@ -27,6 +27,21 @@ SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
 
 _LEVEL = {Severity.ERROR: "error", Severity.WARN: "warning"}
 
+#: the engine-emitted pseudo-rules (no Rule class behind them): they
+#: must still appear in ``tool.driver.rules`` with the right default
+#: level or a conformant reader renders their results as unknown-rule
+#: errors — RQ998 is advisory (a stale pragma), RQ000/RQ999 are hard
+#: failures (unparseable file / crashed rule).
+_ENGINE_RULES = (
+    ("RQ000", "unparseable-file", "error",
+     "file could not be parsed; no rule ran against it"),
+    ("RQ998", "unused-suppression-pragma", "warning",
+     "a pragma names rule IDs that neither suppressed a finding nor "
+     "sanctioned a summary on this line"),
+    ("RQ999", "crashed-rule", "error",
+     "a rule raised while checking the file; its verdict is unknown"),
+)
+
 
 def _result(f: Finding) -> Dict:
     out: Dict = {
@@ -73,7 +88,17 @@ def sarif_doc(result: dict) -> Dict:
         "fullDescription": {"text": r.description},
         "defaultConfiguration": {
             "level": _LEVEL.get(r.severity, "error")},
+        "properties": {"tier": r.tier,
+                       "needsProject": r.needs_project},
     } for r in result["rules"]]
+    rules_meta.extend({
+        "id": rid,
+        "name": name,
+        "shortDescription": {"text": name},
+        "fullDescription": {"text": desc},
+        "defaultConfiguration": {"level": level},
+        "properties": {"tier": 0, "engineEmitted": True},
+    } for rid, name, level, desc in _ENGINE_RULES)
     return {
         "version": SARIF_VERSION,
         "$schema": SARIF_SCHEMA,
